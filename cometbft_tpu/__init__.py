@@ -1,0 +1,35 @@
+"""cometbft_tpu — a TPU-native BFT state-machine-replication framework.
+
+Built from scratch with the capabilities of CometBFT (Tendermint consensus,
+ABCI, gossip p2p, block/state sync, light clients, WAL crash recovery,
+evidence, RPC).  The host-side control plane is ordinary Python/C++ systems
+code; the verification data plane (Ed25519 batch signature verification,
+SHA-256/SHA-512 and Merkle hashing) runs on TPU as vectorized JAX kernels
+behind a pluggable BatchVerifier seam (reference: crypto/crypto.go:47-55,
+crypto/batch/batch.go:10).
+
+Layer map (mirrors SURVEY.md §1):
+  utils/     L0 base utilities (service lifecycle, logging, pubsub, events)
+  ops/       TPU kernels: GF(2^255-19) limbs, Edwards25519, SHA-2, Merkle
+  parallel/  device-mesh sharding of verification batches (pjit/shard_map)
+  crypto/    L1 host crypto API: keys, batch verifier seam, merkle, hashing
+  wire/      L2 deterministic protobuf codec + canonical sign-bytes
+  types/     L3 domain types: Block, Vote, ValidatorSet, VoteSet, params
+  store/     L4 KV DB + block store
+  state/     L4/L6 state store + block executor
+  abci/      L5 application interface + clients/servers + kvstore example
+  mempool/   L7 lane-aware mempool
+  consensus/ L7 Tendermint state machine + WAL + replay
+  privval/   L7 validator signing w/ double-sign protection
+  evidence/  L7 evidence pool + verification
+  blocksync/ L7 fast sync
+  statesync/ L7 snapshot sync
+  p2p/       L8 authenticated multiplexed gossip transport
+  light/     L9 light client
+  rpc/       L10 JSON-RPC surface
+  node/      L11 node assembly
+  config/    L12 config + CLI support
+  models/    flagship verification-plane pipelines (graft/bench entry)
+"""
+
+__version__ = "0.1.0"

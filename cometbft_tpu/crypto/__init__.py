@@ -1,0 +1,7 @@
+"""Host-side crypto API (L1).
+
+Key types, hashing, Merkle trees, and the pluggable batch-verification seam
+(reference: crypto/crypto.go:23-55, crypto/batch/batch.go:10).  The TPU
+batch verifier in cometbft_tpu.models.verifier plugs in behind
+BatchVerifier; hosts without a TPU fall back to the CPU implementation.
+"""

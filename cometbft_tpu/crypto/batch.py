@@ -1,0 +1,48 @@
+"""Batch-verifier factory — the plugin seam of the framework.
+
+Reference: crypto/batch/batch.go:10-27 (CreateBatchVerifier /
+SupportsBatchVerifier).  This is the exact point the north star names: the
+TPU provider registers here, and types.ValidatorSet.VerifyCommit routes
+through it whenever the validator set's key type supports batching.
+
+Backend selection:
+  COMETBFT_TPU_CRYPTO_BACKEND = "tpu" | "cpu" | "auto" (default "auto")
+"auto" uses the accelerator kernel whenever JAX is importable; "cpu"
+forces the sequential host path (the kernel still runs under jit on the
+CPU backend in tests).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..models.verifier import (
+    BatchVerifier,
+    CpuEd25519BatchVerifier,
+    TpuEd25519BatchVerifier,
+)
+from . import ed25519
+
+_BATCH_MIN = 2  # below this, single verification is cheaper (validation.go:15)
+
+
+def backend() -> str:
+    return os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND", "auto")
+
+
+def supports_batch_verifier(key_type: str) -> bool:
+    return key_type == ed25519.KEY_TYPE
+
+
+def create_batch_verifier(key_type: str) -> BatchVerifier:
+    if not supports_batch_verifier(key_type):
+        raise ValueError(f"no batch verifier for key type {key_type!r}")
+    be = backend()
+    if be == "cpu":
+        return CpuEd25519BatchVerifier()
+    if be != "tpu":  # "auto": accelerator only when JAX is importable
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            return CpuEd25519BatchVerifier()
+    return TpuEd25519BatchVerifier()

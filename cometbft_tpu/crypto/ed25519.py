@@ -1,0 +1,107 @@
+"""Host Ed25519 key API (reference: crypto/ed25519/ed25519.go).
+
+Signing and single verification use the `cryptography` package (OpenSSL
+speed) with a ZIP-215 recheck on rejection, so verification semantics are
+uniformly ZIP-215/cofactored — the same rules as the TPU batch kernel and
+the reference validator (ed25519.go:36-42).  OpenSSL-accepted signatures
+satisfy the cofactorless equation, which implies the cofactored one, so the
+fast path never accepts anything ZIP-215 would reject.
+
+Batch verification lives behind the BatchVerifier seam
+(cometbft_tpu.crypto.batch), where the TPU provider plugs in.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.exceptions import InvalidSignature
+
+from . import hash as tmhash
+from . import _ref25519 as ref
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64  # seed || pubkey, matching common ed25519 key files
+SIGNATURE_SIZE = 64
+
+
+def verify_signature(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """ZIP-215 single verification."""
+    if len(sig) != SIGNATURE_SIZE or len(pub) != PUBKEY_SIZE:
+        return False
+    try:
+        Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        return True
+    except (InvalidSignature, ValueError):
+        # OpenSSL is stricter than ZIP-215 (canonical encodings, cofactorless
+        # equation); recheck the slow, permissive way before rejecting.
+        return ref.verify(pub, msg, sig)
+
+
+@dataclass(frozen=True)
+class PubKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+
+    @property
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self.data)
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return verify_signature(self.data, msg, sig)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    data: bytes  # 64 bytes: seed || pubkey
+
+    def __post_init__(self):
+        if len(self.data) not in (32, PRIVKEY_SIZE):
+            raise ValueError("ed25519 privkey must be 32 or 64 bytes")
+
+    @property
+    def type(self) -> str:
+        return KEY_TYPE
+
+    @property
+    def seed(self) -> bytes:
+        return self.data[:32]
+
+    @classmethod
+    def generate(cls) -> "PrivKey":
+        seed = os.urandom(32)
+        return cls.from_seed(seed)
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PrivKey":
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        pub = sk.public_key().public_bytes_raw()
+        return cls(seed + pub)
+
+    def pub_key(self) -> PubKey:
+        if len(self.data) == PRIVKEY_SIZE:
+            return PubKey(self.data[32:])
+        sk = Ed25519PrivateKey.from_private_bytes(self.seed)
+        return PubKey(sk.public_key().public_bytes_raw())
+
+    def sign(self, msg: bytes) -> bytes:
+        sk = Ed25519PrivateKey.from_private_bytes(self.seed)
+        return sk.sign(msg)
+
+    def bytes(self) -> bytes:
+        return self.data

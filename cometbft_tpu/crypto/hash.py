@@ -1,0 +1,26 @@
+"""Host hashing helpers (reference: crypto/tmhash/hash.go:22-37).
+
+SHA-256 full and 20-byte truncated sums; addresses are truncated hashes of
+pubkey bytes (crypto/crypto.go address semantics).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+TRUNCATED_SIZE = 20
+
+
+def sum_sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_many(*chunks: bytes) -> bytes:
+    h = hashlib.sha256()
+    for c in chunks:
+        h.update(c)
+    return h.digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

@@ -1,0 +1,9 @@
+"""TPU compute kernels (JAX/XLA; Pallas where profitable).
+
+The verification data plane of the framework: vectorized GF(2^255-19) limb
+arithmetic, Edwards25519 group ops, SHA-256/SHA-512, scalar arithmetic mod L,
+and RFC-6962 Merkle tree hashing.  Everything here is batch-first: arrays are
+shaped (batch..., limbs/words) and every op is branch-free so XLA can tile it
+onto the VPU/MXU (reference hot path: types/validation.go:265
+verifyCommitBatch → crypto/ed25519 batch verify).
+"""

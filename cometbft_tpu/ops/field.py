@@ -1,0 +1,327 @@
+"""Vectorized GF(2^255-19) arithmetic for TPU.
+
+Field elements are little-endian arrays of 22 signed 12-bit limbs held in
+int32 (shape (..., 22)).  The representation is chosen for the TPU VPU: all
+intermediate products and accumulations fit in int32 (no int64 on device),
+and every operation is element-wise/branch-free over an arbitrary batch
+shape, so a 10k-signature commit verification maps onto the vector unit as
+one fused program (reference workload: crypto/ed25519/ed25519.go:188-222
+BatchVerifier — curve25519-voi's CPU-SIMD equivalent, re-designed for TPU).
+
+Bound contract (|limb| bounds; exercised adversarially in tests/test_field.py):
+
+  TIGHT: output of mul/square/carry/mul_small —
+         |limb 0| <= 3584, |limbs 1..21| <= 2051.
+  MULIN: mul/square accept sums of up to FOUR tight elements
+         (|limb 0| <= 14336, others <= 8204).
+
+  Conv safety: for output limb k, at most one product involves a_0 and one
+  involves b_0, so |conv_k| <= 22*8204^2 + 2*14336*8204 = 1.72e9 < 2^31-1.
+
+Radix 2^12 ⇒ 22 limbs span 264 bits; 2^264 ≡ 19·2^9 = 9728 (mod p).  The
+top-limb carry (weight 2^264) folds back as q·19·2^9, decomposed as
+(19q mod 8)·2^9 into limb 0 plus (19q div 8) into limb 1 so the addend never
+exceeds int32 range even for large q.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 22
+BITS = 12
+RADIX = 1 << BITS  # 4096
+MASK = RADIX - 1
+FOLD = 19 << (NLIMBS * BITS - 255)  # 2^264 mod p = 19*2^9 = 9728
+FOLD2_SHIFTED = 361 * 64  # 2^528 mod p = 361*2^18 = 23104 * 2^12
+
+P = (1 << 255) - 19
+
+_POW2 = np.array([1 << i for i in range(BITS)], dtype=np.int32)
+
+# Limb decomposition of 2^9 * p = 2^264 - 9728 with every limb in
+# [2^11, 2^13): added before the unsigned carry chain in freeze() so that
+# signed limbs become non-negative without changing the value mod p.
+_BIAS = np.full(NLIMBS, MASK, dtype=np.int32)  # all-4095 = 2^264 - 1
+_BIAS[0] = MASK - 9727 + RADIX * 3  # borrow 3 from limb 1
+_BIAS[1] = MASK - 3
+assert sum(int(_BIAS[i]) << (BITS * i) for i in range(NLIMBS)) == (P << 9)
+
+_P_LIMBS = np.zeros(NLIMBS, dtype=np.int32)
+_tmp = P
+for _i in range(NLIMBS):
+    _P_LIMBS[_i] = _tmp & MASK
+    _tmp >>= BITS
+
+
+def to_limbs(x: int, batch_shape=()) -> np.ndarray:
+    """Host-side: Python int -> limb array (numpy int32)."""
+    x %= P
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= BITS
+    if batch_shape:
+        out = np.broadcast_to(out, batch_shape + (NLIMBS,)).copy()
+    return out
+
+
+def from_limbs(limbs) -> int:
+    """Host-side: limb array (1-D) -> Python int (not reduced mod p)."""
+    limbs = np.asarray(limbs)
+    return sum(int(limbs[i]) << (BITS * i) for i in range(limbs.shape[-1]))
+
+
+def zero(batch_shape=()):
+    return jnp.zeros(batch_shape + (NLIMBS,), dtype=jnp.int32)
+
+
+def one(batch_shape=()):
+    z = np.zeros(batch_shape + (NLIMBS,), dtype=np.int32)
+    z[..., 0] = 1
+    return jnp.asarray(z)
+
+
+def add(a, b):
+    """Limb-wise add; no carry. Caller tracks the bound budget."""
+    return a + b
+
+
+def sub(a, b):
+    """Limb-wise subtract; no carry (signed limbs make this exact)."""
+    return a - b
+
+
+def neg(a):
+    return -a
+
+
+def _carry_round(c):
+    """One parallel signed carry round over the last axis.
+
+    q = round(c / 2^12); limbs land in [-2048, 2047] before carry-ins.
+    Returns (c', top_carry) where top_carry has weight 2^(12*nlimbs).
+    """
+    q = lax.shift_right_arithmetic(c + (RADIX >> 1), BITS)
+    c = c - lax.shift_left(q, BITS)
+    carry_in = jnp.pad(q[..., :-1], [(0, 0)] * (q.ndim - 1) + [(1, 0)])
+    return c + carry_in, q[..., -1]
+
+
+def _fold_top(c, q):
+    """Add q * 2^264 ≡ q*19*2^9 (mod p) into limbs 0/1 without overflow.
+
+    v = 19q (|v| < 2^26 for any carry q seen here); v*2^9 decomposes as
+    (v mod 8)*2^9 at limb 0 plus (v div 8) at limb 1 — both small.
+    """
+    v = q * 19
+    lo = (v & 7) * (1 << 9)
+    hi = lax.shift_right_arithmetic(v, 3)
+    c = c.at[..., 0].add(lo)
+    c = c.at[..., 1].add(hi)
+    return c
+
+
+def carry(a, rounds: int = 3):
+    """Reduce a 22-limb signed value (|limb| < 2^30.8) to TIGHT bounds."""
+    c = a
+    for _ in range(rounds):
+        c, top = _carry_round(c)
+        c = _fold_top(c, top)
+    return c
+
+
+def _conv(a, b, n: int, m: int):
+    """Schoolbook product of n-limb a and m-limb b -> (n+m-1)-limb conv.
+
+    Unrolled static loop: m shifted multiply-adds, each a width-n vector op.
+    """
+    out_len = n + m - 1
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (out_len,)
+    c = jnp.zeros(shape, dtype=jnp.int32)
+    for i in range(m):
+        c = c.at[..., i : i + n].add(a * b[..., i : i + 1])
+    return c
+
+
+def _reduce_conv(c):
+    """Reduce a 43-limb signed conv (|limb| <= 1.72e9) to TIGHT limbs."""
+    lo = c[..., :NLIMBS]
+    hi = c[..., NLIMBS:]  # 21 limbs, weight offset 2^264
+    # Carry hi independently (pad so round-carries stay inside; top carry of
+    # the padded array is provably zero with 3 pad limbs / 3 rounds).
+    pad = [(0, 0)] * (hi.ndim - 1) + [(0, 3)]
+    hi = jnp.pad(hi, pad)
+    for _ in range(3):
+        hi, _ = _carry_round(hi)
+    # Fold: limbs 0..21 of hi (abs positions 22..43) scale by 2^264 ≡ 9728;
+    # pad limbs 22/23 (abs 44/45) scale by 2^528 ≡ 23104·2^12 → limbs 1/2.
+    lo = lo + hi[..., :NLIMBS] * FOLD
+    lo = lo.at[..., 1].add(hi[..., NLIMBS] * FOLD2_SHIFTED)
+    lo = lo.at[..., 2].add(hi[..., NLIMBS + 1] * FOLD2_SHIFTED)
+    return carry(lo, rounds=3)
+
+
+def mul(a, b):
+    """Field multiply. Inputs within MULIN contract; output TIGHT."""
+    return _reduce_conv(_conv(a, b, NLIMBS, NLIMBS))
+
+
+def square(a):
+    """Field square (XLA CSEs the shared operand in the conv)."""
+    return _reduce_conv(_conv(a, a, NLIMBS, NLIMBS))
+
+
+def mul_small(a, k: int):
+    """Multiply by a small host constant; |a·k| limbs must stay < 2^30.8."""
+    return carry(a * jnp.int32(k), rounds=3)
+
+
+def pow2k(a, k: int):
+    """a^(2^k) by k squarings.
+
+    Long runs use lax.fori_loop so the traced graph stays one square body
+    regardless of k (XLA compiles once, loops on device).
+    """
+    if k <= 4:
+        for _ in range(k):
+            a = square(a)
+        return a
+    return lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def _chain_250(x):
+    """x^(2^250 - 1) — shared prefix of the invert and sqrt chains.
+
+    Classic curve25519 square-and-multiply ladder (public-domain structure).
+    Returns (x^(2^250-1), x^11).
+    """
+    z2 = square(x)                        # 2
+    z8 = pow2k(z2, 2)                     # 8
+    z9 = mul(x, z8)                       # 9
+    z11 = mul(z2, z9)                     # 11
+    z22 = square(z11)                     # 22
+    z_5_0 = mul(z9, z22)                  # 2^5 - 1 = 31
+    z_10_5 = pow2k(z_5_0, 5)
+    z_10_0 = mul(z_10_5, z_5_0)           # 2^10 - 1
+    z_20_10 = pow2k(z_10_0, 10)
+    z_20_0 = mul(z_20_10, z_10_0)         # 2^20 - 1
+    z_40_20 = pow2k(z_20_0, 20)
+    z_40_0 = mul(z_40_20, z_20_0)         # 2^40 - 1
+    z_50_10 = pow2k(z_40_0, 10)
+    z_50_0 = mul(z_50_10, z_10_0)         # 2^50 - 1
+    z_100_50 = pow2k(z_50_0, 50)
+    z_100_0 = mul(z_100_50, z_50_0)       # 2^100 - 1
+    z_200_100 = pow2k(z_100_0, 100)
+    z_200_0 = mul(z_200_100, z_100_0)     # 2^200 - 1
+    z_250_50 = pow2k(z_200_0, 50)
+    z_250_0 = mul(z_250_50, z_50_0)       # 2^250 - 1
+    return z_250_0, z11
+
+
+def invert(x):
+    """x^(p-2);  p-2 = 2^255 - 21 = (2^250-1)·2^5 + 11."""
+    z_250_0, z11 = _chain_250(x)
+    return mul(pow2k(z_250_0, 5), z11)
+
+
+def pow_p58(x):
+    """x^((p-5)/8);  (p-5)/8 = 2^252 - 3 = (2^250-1)·2^2 + 1."""
+    z_250_0, _ = _chain_250(x)
+    return mul(pow2k(z_250_0, 2), x)
+
+
+def freeze(a):
+    """Fully reduce to canonical limbs in [0, 2^12), value in [0, p)."""
+    c = carry(a, rounds=3)
+    # Make non-negative: add 2^9 * p (limb-wise bias keeps limbs >= 0).
+    c = c + jnp.asarray(_BIAS)
+    c = _unsigned_carry(c)
+    # Two rounds of top-bit folding: value < 2^264 -> < 2^255 + eps -> < 2^255.
+    for _ in range(2):
+        hi = lax.shift_right_logical(c[..., -1], 3)  # bits >= 255
+        c = c.at[..., -1].set(c[..., -1] & 7)
+        c = c.at[..., 0].add(hi * 19)
+        c = _unsigned_carry(c)
+    # Conditional subtract p (value in [0, 2^255) -> canonical [0, p)).
+    borrow = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    w = jnp.zeros_like(c)
+    for i in range(NLIMBS):
+        d = c[..., i] - jnp.int32(int(_P_LIMBS[i])) - borrow
+        borrow = lax.shift_right_logical(d, 31) & 1  # 1 if negative
+        w = w.at[..., i].set(d + lax.shift_left(borrow, BITS))
+    ge_p = borrow == 0
+    return jnp.where(ge_p[..., None], w, c)
+
+
+def _unsigned_carry(c):
+    """Sequential carry for non-negative limbs; top carry folds via 9728.
+
+    Top carry here is < 2^4 (values < 2^268), so q*FOLD fits trivially.
+    """
+    out = jnp.zeros_like(c)
+    k = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    for i in range(NLIMBS):
+        t = c[..., i] + k
+        out = out.at[..., i].set(t & MASK)
+        k = lax.shift_right_logical(t, BITS)
+    out = out.at[..., 0].add(k * FOLD)
+    # Local ripple in case limb 0/1 overflowed (addend < 2^18).
+    for i in range(2):
+        ki = lax.shift_right_logical(out[..., i], BITS)
+        out = out.at[..., i].set(out[..., i] & MASK)
+        out = out.at[..., i + 1].add(ki)
+    return out
+
+
+def eq(a, b):
+    """Field equality (branch-free): freeze both, compare limbs."""
+    return jnp.all(freeze(a) == freeze(b), axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(freeze(a) == 0, axis=-1)
+
+
+def is_negative(a):
+    """RFC 8032 sign: lowest bit of the canonical encoding."""
+    return (freeze(a)[..., 0] & 1).astype(jnp.bool_)
+
+
+def select(cond, a, b):
+    """Branch-free select: cond ? a : b.  cond shape = batch shape."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def from_bytes(b):
+    """(..., 32) uint8 LE -> limbs.
+
+    All 256 bits are taken; callers that need the sign bit (point
+    decompression) mask it off first.  Value may exceed p — ZIP-215
+    tolerates non-canonical y encodings, and the limb form handles
+    values up to 2^264 transparently.
+    """
+    b = b.astype(jnp.int32)
+    bits = jnp.stack(
+        [lax.shift_right_logical(b, k) & 1 for k in range(8)], axis=-1
+    )  # (..., 32, 8)
+    bits = bits.reshape(bits.shape[:-2] + (256,))
+    pad = [(0, 0)] * (bits.ndim - 1) + [(0, NLIMBS * BITS - 256)]
+    bits = jnp.pad(bits, pad)
+    bits = bits.reshape(bits.shape[:-1] + (NLIMBS, BITS))
+    return jnp.sum(bits * jnp.asarray(_POW2), axis=-1).astype(jnp.int32)
+
+
+def to_bytes(a):
+    """limbs -> canonical (..., 32) uint8 LE encoding."""
+    c = freeze(a)
+    bits = jnp.stack(
+        [lax.shift_right_logical(c, k) & 1 for k in range(BITS)], axis=-1
+    )  # (..., 22, 12)
+    bits = bits.reshape(bits.shape[:-2] + (NLIMBS * BITS,))[..., :256]
+    bits = bits.reshape(bits.shape[:-1] + (32, 8))
+    return jnp.sum(
+        bits * jnp.asarray([1 << k for k in range(8)], dtype=jnp.int32), axis=-1
+    ).astype(jnp.uint8)

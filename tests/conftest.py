@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so that every sharding/collective
+code path (the multi-chip design) is exercised without real TPU hardware,
+mirroring how the reference tests multi-node behavior in-process
+(reference: internal/consensus/common_test.go topology).
+
+Note: this environment injects a TPU PJRT plugin via sitecustomize, which
+imports jax at interpreter start — so JAX has already snapshotted
+JAX_PLATFORMS from the environment by the time this file runs.  Setting
+os.environ here would be a no-op; jax.config.update is the authoritative
+switch.  XLA_FLAGS is still read lazily at first backend initialization,
+so the host-device-count flag can be injected here.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

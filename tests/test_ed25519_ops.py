@@ -1,0 +1,142 @@
+"""Differential tests: device Edwards25519 ops vs the pure-Python reference."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import _ref25519 as ref
+from cometbft_tpu.ops import ed25519 as E
+from cometbft_tpu.ops import field as F
+
+rng = np.random.default_rng(99)
+
+
+def host_points(n, include_identity=False):
+    """Random reference points (as multiples of B)."""
+    pts = []
+    for i in range(n):
+        k = int.from_bytes(rng.bytes(32), "little") % ref.L
+        pts.append(ref.pt_mul(k, ref.BASE))
+    if include_identity:
+        pts[0] = ref.IDENT
+    return pts
+
+
+def to_device(pts) -> E.Point:
+    def limb(vals):
+        return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+
+    return E.Point(
+        limb([p[0] for p in pts]),
+        limb([p[1] for p in pts]),
+        limb([p[2] for p in pts]),
+        limb([p[3] for p in pts]),
+    )
+
+
+compress_j = jax.jit(E.compress)
+add_then_compress_j = jax.jit(lambda p, q: E.compress(E.add(p, q)))
+double_then_compress_j = jax.jit(lambda p: E.compress(E.double(p)))
+decompress_j = jax.jit(E.decompress)
+
+
+def ref_compressed(p):
+    return ref.compress(p)
+
+
+def test_compress_matches_reference():
+    pts = host_points(8, include_identity=True)
+    got = np.asarray(compress_j(to_device(pts)))
+    for i, p in enumerate(pts):
+        assert got[i].tobytes() == ref_compressed(p)
+
+
+def test_add_matches_reference():
+    ps = host_points(8, include_identity=True)
+    qs = host_points(8)
+    got = np.asarray(add_then_compress_j(to_device(ps), to_device(qs)))
+    for i in range(8):
+        assert got[i].tobytes() == ref_compressed(ref.pt_add(ps[i], qs[i]))
+
+
+def test_double_matches_reference():
+    ps = host_points(8, include_identity=True)
+    got = np.asarray(double_then_compress_j(to_device(ps)))
+    for i in range(8):
+        assert got[i].tobytes() == ref_compressed(ref.pt_double(ps[i]))
+
+
+def test_decompress_roundtrip():
+    pts = host_points(8, include_identity=True)
+    enc = np.stack([np.frombuffer(ref_compressed(p), dtype=np.uint8) for p in pts])
+    dev, ok = decompress_j(jnp.asarray(enc))
+    assert np.asarray(ok).all()
+    back = np.asarray(compress_j(dev))
+    for i in range(8):
+        assert back[i].tobytes() == ref_compressed(pts[i])
+
+
+def test_decompress_rejects_off_curve():
+    # y = 2 is not on the curve (no valid x); also try garbage.
+    bad = [
+        (2).to_bytes(32, "little"),
+        bytes(rng.bytes(31)) + b"\x00",
+    ]
+    enc = np.stack([np.frombuffer(b, dtype=np.uint8) for b in bad])
+    _, ok = decompress_j(jnp.asarray(enc))
+    ok = np.asarray(ok)
+    # Reference agreement is what matters: compare with host decompress.
+    for i, b in enumerate(bad):
+        assert bool(ok[i]) == (ref.decompress(bad[i]) is not None)
+
+
+def test_decompress_zip215_noncanonical():
+    """y >= p encodings decompress (ZIP-215), matching host reference."""
+    # y = p + small on-curve y: find one whose canonical form is on curve.
+    for delta in range(0, 40):
+        y = ref.P + delta
+        if y >= 1 << 255:
+            break
+        enc_int = y  # sign bit 0
+        b = enc_int.to_bytes(32, "little")
+        host = ref.decompress(b)
+        enc = jnp.asarray(np.frombuffer(b, dtype=np.uint8)[None, :])
+        dev, ok = decompress_j(enc)
+        assert bool(np.asarray(ok)[0]) == (host is not None)
+        if host is not None:
+            got = np.asarray(compress_j(dev))[0].tobytes()
+            assert got == ref.compress(host)
+
+
+def test_var_table_and_lookup():
+    ps = host_points(4)
+    dev = to_device(ps)
+    table_j = jax.jit(
+        lambda p, idx: E.compress(E.lookup_point(E.build_var_table(p), idx))
+    )
+    idx = jnp.asarray(np.array([0, 1, 7, 15], dtype=np.int32))
+    got = np.asarray(table_j(dev, idx))
+    for i, j in enumerate([0, 1, 7, 15]):
+        assert got[i].tobytes() == ref_compressed(ref.pt_mul(j, ps[i]))
+
+
+def test_niels_fixed_base_window():
+    """j*B from the host-precomputed niels window table."""
+    f = jax.jit(
+        lambda idx: E.compress(
+            E.add_niels(E.identity(idx.shape), E.lookup_niels(E._B_WINDOW, idx))
+        )
+    )
+    idx = jnp.asarray(np.array([0, 1, 5, 15], dtype=np.int32))
+    got = np.asarray(f(idx))
+    for i, j in enumerate([0, 1, 5, 15]):
+        assert got[i].tobytes() == ref_compressed(ref.pt_mul(j, ref.BASE))
+
+
+def test_is_identity_and_eq():
+    pts = host_points(3, include_identity=True)
+    dev = to_device(pts)
+    isid = np.asarray(jax.jit(E.is_identity)(dev))
+    assert list(isid) == [True, False, False]
+    same = np.asarray(jax.jit(E.pt_eq)(dev, dev))
+    assert same.all()

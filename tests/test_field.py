@@ -1,0 +1,131 @@
+"""Differential tests for GF(2^255-19) limb arithmetic vs Python bigints.
+
+All device ops go through module-level jitted wrappers: eager JAX would
+dispatch thousands of tiny XLA ops (the limb kernels are written for one
+big fused program), making the suite needlessly slow.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import field as F
+
+rng = np.random.default_rng(1234)
+
+mul_j = jax.jit(F.mul)
+square_j = jax.jit(F.square)
+carry_j = jax.jit(F.carry)
+freeze_j = jax.jit(F.freeze)
+invert_j = jax.jit(F.invert)
+pow_p58_j = jax.jit(F.pow_p58)
+to_bytes_j = jax.jit(F.to_bytes)
+from_bytes_j = jax.jit(F.from_bytes)
+addmul_j = jax.jit(lambda a, b: F.mul(F.add(a, b), F.sub(a, b)))
+mul_small_121666_j = jax.jit(lambda a: F.mul_small(a, 121666))
+
+
+def rand_ints(n):
+    return [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(n)]
+
+
+def limbs_of(vals):
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+
+
+def ints_of(limbs):
+    """Freeze a batch on device, convert each row to a Python int."""
+    fz = np.asarray(freeze_j(limbs))
+    return [F.from_limbs(fz[i]) for i in range(fz.shape[0])]
+
+
+def test_roundtrip():
+    vals = rand_ints(16) + [0, 1, F.P - 1, F.P - 19, (1 << 255) - 20]
+    assert ints_of(limbs_of(vals)) == [v % F.P for v in vals]
+
+
+def test_add_sub_mul_square():
+    va, vb = rand_ints(64), rand_ints(64)
+    a, b = limbs_of(va), limbs_of(vb)
+    assert ints_of(carry_j(F.add(a, b))) == [(x + y) % F.P for x, y in zip(va, vb)]
+    assert ints_of(carry_j(F.sub(a, b))) == [(x - y) % F.P for x, y in zip(va, vb)]
+    assert ints_of(mul_j(a, b)) == [(x * y) % F.P for x, y in zip(va, vb)]
+    assert ints_of(square_j(a)) == [(x * x) % F.P for x in va]
+
+
+def test_mul_of_uncarried_sums():
+    """The MULIN contract: 4-term tight sums go straight into mul."""
+    vs = [rand_ints(32) for _ in range(8)]
+    ones = limbs_of([1] * 32)
+    t = [mul_j(limbs_of(v), ones) for v in vs]  # outputs are TIGHT
+    m = mul_j(t[0] + t[1] + t[2] + t[3], t[4] + t[5] + t[6] + t[7])
+    want = [
+        (sum(vs[j][i] for j in range(4)) * sum(vs[j][i] for j in range(4, 8))) % F.P
+        for i in range(32)
+    ]
+    assert ints_of(m) == want
+
+
+def test_worst_case_bounds_no_overflow():
+    """Adversarial limbs at the documented magnitude bounds."""
+    a = np.full((1, F.NLIMBS), 8204, dtype=np.int32)
+    a[0, 0] = 14336
+    b = -a.copy()
+    for x, y in [(a, a), (a, b), (b, b)]:
+        m = mul_j(jnp.asarray(x), jnp.asarray(y))
+        want = (F.from_limbs(x[0]) * F.from_limbs(y[0])) % F.P
+        assert ints_of(m) == [want]
+
+
+def test_freeze_and_bytes():
+    vals = rand_ints(16) + [0, 1, F.P - 1]
+    a = limbs_of(vals)
+    bts = np.asarray(to_bytes_j(a))
+    for i, v in enumerate(vals):
+        assert bts[i].tobytes() == (v % F.P).to_bytes(32, "little")
+    assert ints_of(from_bytes_j(jnp.asarray(bts))) == [v % F.P for v in vals]
+
+
+def test_from_bytes_noncanonical():
+    """ZIP-215: y encodings >= p must be accepted and reduce mod p."""
+    raw = [F.P + 3, (1 << 255) - 1, (1 << 256) - 1]
+    b = jnp.asarray(
+        np.stack(
+            [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in raw]
+        )
+    )
+    assert ints_of(from_bytes_j(b)) == [v % F.P for v in raw]
+
+
+def test_invert_and_pow_p58():
+    vals = rand_ints(8) + [1, 2, F.P - 1]
+    a = limbs_of(vals)
+    assert ints_of(invert_j(a)) == [pow(v, F.P - 2, F.P) for v in vals]
+    e = (F.P - 5) // 8
+    assert ints_of(pow_p58_j(a)) == [pow(v, e, F.P) for v in vals]
+
+
+def test_predicates():
+    vals = [0, 1, 2, F.P - 1]
+    a = limbs_of(vals)
+    assert list(np.asarray(jax.jit(F.is_zero)(a))) == [True, False, False, False]
+    assert list(np.asarray(jax.jit(F.is_negative)(a))) == [False, True, False, False]
+    eq_j = jax.jit(F.eq)
+    assert bool(np.asarray(eq_j(a[:1], a[:1]))[0])
+    assert not bool(np.asarray(eq_j(a[0:1], a[1:2]))[0])
+
+
+def test_mul_small():
+    vals = rand_ints(8)
+    assert ints_of(mul_small_121666_j(limbs_of(vals))) == [
+        (v * 121666) % F.P for v in vals
+    ]
+
+
+def test_fused_expression():
+    va, vb = rand_ints(4), rand_ints(4)
+    out = addmul_j(limbs_of(va), limbs_of(vb))
+    assert ints_of(out) == [
+        ((x + y) * (x - y)) % F.P for x, y in zip(va, vb)
+    ]

@@ -1,0 +1,73 @@
+"""Differential tests: device SHA-256/SHA-512 vs hashlib; mod-L reduction."""
+
+import hashlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import sha2, scalar
+
+rng = np.random.default_rng(5)
+
+sha256_j = jax.jit(sha2.sha256_blocks)
+sha512_j = jax.jit(sha2.sha512_blocks)
+reduce_j = jax.jit(scalar.reduce_mod_l)
+s_lt_l_j = jax.jit(scalar.s_lt_l)
+
+
+def test_sha256_vs_hashlib():
+    msgs = [rng.bytes(n) for n in [0, 1, 55, 56, 63, 64, 65, 100, 119, 120, 127, 200]]
+    buf, active = sha2.pad_messages_sha256(msgs)
+    got = np.asarray(sha256_j(jnp.asarray(buf), jnp.asarray(active)))
+    for i, m in enumerate(msgs):
+        assert got[i].tobytes() == hashlib.sha256(m).digest(), f"len={len(m)}"
+
+
+def test_sha512_vs_hashlib():
+    msgs = [rng.bytes(n) for n in [0, 1, 111, 112, 127, 128, 129, 200, 216, 255, 300]]
+    buf, active = sha2.pad_messages_sha512(msgs)
+    got = np.asarray(sha512_j(jnp.asarray(buf), jnp.asarray(active)))
+    for i, m in enumerate(msgs):
+        assert got[i].tobytes() == hashlib.sha512(m).digest(), f"len={len(m)}"
+
+
+def test_reduce_mod_l():
+    L = scalar.L
+    vals = [0, 1, L - 1, L, L + 1, 2 * L + 5, (1 << 512) - 1] + [
+        int.from_bytes(rng.bytes(64), "little") for _ in range(16)
+    ]
+    b = np.stack(
+        [np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8) for v in vals]
+    )
+    limbs = scalar.bytes_to_limbs(jnp.asarray(b), scalar.NL_X)
+    got = np.asarray(reduce_j(limbs))
+    for i, v in enumerate(vals):
+        want = v % L
+        have = sum(int(got[i, k]) << (12 * k) for k in range(scalar.NL_S))
+        assert have == want, f"case {i}"
+
+
+def test_s_lt_l():
+    L = scalar.L
+    vals = [0, 1, L - 1, L, L + 1, (1 << 256) - 1]
+    b = np.stack(
+        [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals]
+    )
+    got = list(np.asarray(s_lt_l_j(jnp.asarray(b))))
+    assert got == [True, True, True, False, False, False]
+
+
+def test_windows():
+    v = int.from_bytes(rng.bytes(32), "little") % scalar.L
+    b = jnp.asarray(np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)[None])
+    w = np.asarray(jax.jit(scalar.bytes_to_windows)(b))[0]
+    # MSB-first 4-bit windows reconstruct the value
+    acc = 0
+    for x in w:
+        acc = (acc << 4) | int(x)
+    assert acc == v
+    # limb path agrees
+    limbs = scalar.bytes_to_limbs(b, scalar.NL_S)
+    w2 = np.asarray(jax.jit(scalar.limbs_to_windows)(limbs))[0]
+    assert list(w2) == list(w)
