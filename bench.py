@@ -1,0 +1,70 @@
+"""Benchmark of record: VerifyCommit over a 10,000-validator Commit.
+
+Measures the full BatchVerifier path — host batch assembly (sign-bytes
+digest padding) + fused TPU kernel (SHA-512 challenge, mod-L reduce,
+batched double-scalar mul, cofactored check) — end to end, the same work
+the reference does on CPU via curve25519-voi in verifyCommitBatch
+(types/validation.go:265, crypto/ed25519/ed25519.go:220).
+
+Prints ONE JSON line:
+  {"metric": "verify_commit_p50_10k_ms", "value": <p50 ms>, "unit": "ms",
+   "vs_baseline": <Go-CPU-baseline / ours, i.e. speedup>}
+
+Baseline: curve25519-voi batch verify ≈ 27.5 µs/sig/core on the QA CPUs
+(BASELINE.md: 50-60 µs single, ~2x batch gain) -> 275 ms for 10k sigs.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N = 10_000
+GO_CPU_BASELINE_MS = 275.0
+WARMUP = 2
+ITERS = 10
+
+
+def main() -> None:
+    from cometbft_tpu.crypto import ed25519 as host
+    from cometbft_tpu.models.verifier import TpuEd25519BatchVerifier
+
+    # One validator set, one commit: distinct keys, per-validator sign-bytes.
+    rng = np.random.default_rng(7)
+    keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(N)]
+    items = []
+    for i, sk in enumerate(keys):
+        msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|chain-bench"
+        items.append((sk.pub_key().data, msg, sk.sign(msg)))
+
+    def run_once() -> float:
+        v = TpuEd25519BatchVerifier()
+        for pub, msg, sig in items:
+            v.add(pub, msg, sig)
+        t0 = time.perf_counter()
+        ok, per_sig = v.verify()
+        dt = (time.perf_counter() - t0) * 1e3
+        assert ok and len(per_sig) == N
+        return dt
+
+    for _ in range(WARMUP):
+        run_once()
+    times = sorted(run_once() for _ in range(ITERS))
+    p50 = times[len(times) // 2]
+    print(
+        json.dumps(
+            {
+                "metric": "verify_commit_p50_10k_ms",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(GO_CPU_BASELINE_MS / p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

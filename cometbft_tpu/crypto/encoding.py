@@ -1,0 +1,28 @@
+"""proto <-> host pubkey conversion (reference: crypto/encoding/codec.go)."""
+
+from __future__ import annotations
+
+from ..wire import types_pb as pb
+from . import ed25519
+
+
+class UnsupportedKeyType(ValueError):
+    pass
+
+
+def pubkey_to_proto(pub) -> pb.PublicKey:
+    if pub.type == ed25519.KEY_TYPE:
+        return pb.PublicKey(ed25519=pub.bytes())
+    raise UnsupportedKeyType(f"key type {pub.type!r} not supported")
+
+
+def pubkey_from_proto(msg: pb.PublicKey):
+    if msg.ed25519:
+        return ed25519.PubKey(msg.ed25519)
+    raise UnsupportedKeyType("unsupported or empty PublicKey proto")
+
+
+def pubkey_from_type_and_bytes(key_type: str, data: bytes):
+    if key_type == ed25519.KEY_TYPE:
+        return ed25519.PubKey(data)
+    raise UnsupportedKeyType(f"key type {key_type!r} not supported")

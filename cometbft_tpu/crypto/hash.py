@@ -8,7 +8,12 @@ from __future__ import annotations
 
 import hashlib
 
+SIZE = 32
 TRUNCATED_SIZE = 20
+
+
+def sum(data: bytes) -> bytes:  # noqa: A001 - mirrors tmhash.Sum
+    return hashlib.sha256(data).digest()
 
 
 def sum_sha256(data: bytes) -> bytes:

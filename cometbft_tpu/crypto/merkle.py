@@ -1,0 +1,287 @@
+"""RFC-6962 Merkle tree: hashing, inclusion proofs, proof operators.
+
+Host API mirroring the reference's crypto/merkle package:
+  - hash_from_byte_slices   (tree.go:11-27; split rule tree.go:101)
+  - proofs_from_byte_slices (proof.go ProofsFromByteSlices)
+  - Proof.verify            (proof.go Proof.Verify)
+  - ProofOp chaining        (proof_op.go ProofOperators.Verify)
+
+Small trees hash on host (hashlib — a handful of SHA-256 calls); large
+trees route through the TPU kernel (ops/merkle.py) where every level is
+one batched SHA-256.  Both produce identical roots; tests assert the
+equivalence against reference vectors (crypto/merkle/rfc6962_test.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+# Below this leaf count host hashing wins (device dispatch overhead
+# dominates); above it the batched kernel takes over.
+_DEVICE_THRESHOLD = 512
+
+_JIT_ROOT = None
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def empty_hash() -> bytes:
+    """Root of the empty tree: SHA-256 of the empty string (hash.go:14)."""
+    return _sha256(b"")
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(_LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(_INNER_PREFIX + left + right)
+
+
+def get_split_point(length: int) -> int:
+    """Largest power of two strictly less than length (tree.go:101)."""
+    if length < 1:
+        raise ValueError("trying to split tree with length < 1")
+    return 1 << (length - 1).bit_length() - 1 if length > 1 else 0
+
+
+def _root_from_leaf_hashes_host(hashes: list[bytes]) -> bytes:
+    nodes = hashes
+    while len(nodes) > 1:
+        nxt = [
+            inner_hash(nodes[i], nodes[i + 1]) for i in range(0, len(nodes) - 1, 2)
+        ]
+        if len(nodes) % 2:
+            nxt.append(nodes[-1])
+        nodes = nxt
+    return nodes[0]
+
+
+def _root_device(items: list[bytes]) -> bytes:
+    global _JIT_ROOT
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops import merkle as M
+
+    blocks, active = M.pad_leaves(items)
+    if _JIT_ROOT is None:
+        _JIT_ROOT = jax.jit(M.root_from_leaves)
+    return bytes(np.asarray(_JIT_ROOT(jnp.asarray(blocks), jnp.asarray(active))))
+
+
+def hash_from_byte_slices(items: list[bytes], device: bool | None = None) -> bytes:
+    """RFC-6962 root of a list of raw leaves."""
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    if device is None:
+        device = n >= _DEVICE_THRESHOLD
+    if device:
+        try:
+            return _root_device(items)
+        except ImportError:
+            pass
+    return _root_from_leaf_hashes_host([leaf_hash(i) for i in items])
+
+
+@dataclass
+class Proof:
+    """Inclusion proof for item `index` of `total` (proof.go Proof)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes] = field(default_factory=list)
+
+    def compute_root_hash(self) -> bytes | None:
+        return _compute_hash_from_aunts(
+            self.index, self.total, self.leaf_hash, self.aunts
+        )
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        if self.total < 0:
+            raise ValueError("proof total must be positive")
+        if self.index < 0:
+            raise ValueError("proof index cannot be negative")
+        if leaf_hash(leaf) != self.leaf_hash:
+            raise ValueError("invalid leaf hash")
+        computed = self.compute_root_hash()
+        if computed != root_hash:
+            raise ValueError(
+                f"invalid root hash: wanted {root_hash.hex()} got "
+                f"{computed.hex() if computed else None}"
+            )
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: list[bytes]
+) -> bytes | None:
+    """Recursive root recomputation (proof.go computeHashFromAunts)."""
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    if not aunts:
+        return None
+    split = get_split_point(total)
+    if index < split:
+        left = _compute_hash_from_aunts(index, split, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - split, total - split, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None
+        self.right = None
+
+    def flatten_aunts(self) -> list[bytes]:
+        out = []
+        node = self
+        while node is not None:
+            parent = node.parent
+            if parent is not None:
+                sibling = parent.right if parent.left is node else parent.left
+                if sibling is not None:
+                    out.append(sibling.hash)
+            node = parent
+        return out
+
+
+def _trails_from_leaf_hashes(hashes: list[bytes]):
+    if not hashes:
+        return [], None
+    if len(hashes) == 1:
+        node = _Node(hashes[0])
+        return [node], node
+    split = get_split_point(len(hashes))
+    lefts, left_root = _trails_from_leaf_hashes(hashes[:split])
+    rights, right_root = _trails_from_leaf_hashes(hashes[split:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    root.left, root.right = left_root, right_root
+    left_root.parent = right_root.parent = root
+    return lefts + rights, root
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root + one inclusion proof per item (proof.go ProofsFromByteSlices)."""
+    hashes = [leaf_hash(i) for i in items]
+    trails, root = _trails_from_leaf_hashes(hashes)
+    root_hash = root.hash if root else empty_hash()
+    proofs = [
+        Proof(total=len(items), index=i, leaf_hash=t.hash, aunts=t.flatten_aunts())
+        for i, t in enumerate(trails)
+    ]
+    return root_hash, proofs
+
+
+# ------------------------------------------------------- proof operators
+
+
+class ProofOp:
+    """A single step in a multi-store proof chain (proof_op.go)."""
+
+    op_type: str = ""
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOp):
+    """Leaf op: proves key=value inclusion under a root (proof_value.go)."""
+
+    op_type = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def get_key(self) -> bytes:
+        return self.key
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        if len(values) != 1:
+            raise ValueError("value op expects one value")
+        vhash = _sha256(values[0])
+        if leaf_hash(self.key + vhash) != self.proof.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        root = self.proof.compute_root_hash()
+        if root is None:
+            raise ValueError("could not compute root")
+        return [root]
+
+
+class ProofOperators:
+    """A chain of ProofOps verified innermost-first (proof_op.go:47)."""
+
+    def __init__(self, ops: list[ProofOp]):
+        self.ops = ops
+
+    def verify_value(self, root: bytes, keypath: str, value: bytes) -> None:
+        self.verify(root, keypath, [value])
+
+    def verify(self, root: bytes, keypath: str, args: list[bytes]) -> None:
+        keys = _parse_key_path(keypath)
+        for op in self.ops:
+            key = op.get_key()
+            if key:
+                if not keys:
+                    raise ValueError(f"key path exhausted before op key {key!r}")
+                if keys[-1] != key:
+                    raise ValueError(f"key mismatch: {keys[-1]!r} != {key!r}")
+                keys = keys[:-1]
+            args = op.run(args)
+        if args[0] != root:
+            raise ValueError("calculated root does not match provided root")
+        if keys:
+            raise ValueError("keypath not fully consumed")
+
+
+def key_path_to_string(keys: list[bytes]) -> str:
+    """URL-ish key path encoding (proof_key_path.go KeyPath)."""
+    out = []
+    for k in keys:
+        try:
+            s = k.decode("utf-8")
+            if s.isprintable() and "/" not in s:
+                out.append(s)
+                continue
+        except UnicodeDecodeError:
+            pass
+        out.append("x:" + k.hex())
+    return "/" + "/".join(out)
+
+
+def _parse_key_path(path: str) -> list[bytes]:
+    if not path.startswith("/"):
+        raise ValueError("key path must start with /")
+    keys = []
+    for part in path.split("/")[1:]:
+        if not part:
+            continue
+        if part.startswith("x:"):
+            keys.append(bytes.fromhex(part[2:]))
+        else:
+            keys.append(part.encode("utf-8"))
+    return keys

@@ -1,0 +1,87 @@
+"""Vectorized RFC-6962 Merkle tree kernel for TPU.
+
+The reference hashes a Merkle tree per block over txs, evidence, commit
+signatures, and validator sets (crypto/merkle/tree.go:11-27 recursive,
+tree.go:68 iterative; domain-separated leaf/inner hashing at
+crypto/merkle/hash.go:21-44).  Its recursive split at the largest power of
+two below n (tree.go:101 getSplitPoint) is equivalent to a level-by-level
+reduction where an odd trailing node is promoted unchanged — which is the
+shape a TPU wants: each level is one batched 2-block SHA-256 over all
+sibling pairs, log2(n) levels total, no recursion and no data-dependent
+control flow.
+
+Leaf hashing (0x00 || leaf over variable-length leaves) is padded on host
+(ops/sha2.pad_messages_sha256) and digested as one batch; inner levels are
+assembled entirely on device (fixed 65-byte messages -> exactly 2 SHA-256
+blocks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import sha2
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+# Precomputed SHA-256 padding tail for the fixed 65-byte inner message:
+# 0x01 || left(32) || right(32) || 0x80 || zeros || bitlen(520, 8B BE).
+_INNER_TAIL = np.zeros(63, dtype=np.uint8)
+_INNER_TAIL[0] = 0x80
+_INNER_TAIL[-8:] = np.frombuffer((65 * 8).to_bytes(8, "big"), dtype=np.uint8)
+
+_EMPTY_HASH = None  # filled lazily (sha256 of b"" on host)
+
+
+def _inner_blocks(left, right):
+    """(m, 32), (m, 32) -> (m, 2, 64) padded inner-node messages."""
+    m = left.shape[0]
+    prefix = jnp.full((m, 1), 0x01, dtype=jnp.uint8)
+    tail = jnp.broadcast_to(jnp.asarray(_INNER_TAIL), (m, 63))
+    msg = jnp.concatenate([prefix, left, right, tail], axis=-1)  # (m, 128)
+    return msg.reshape(m, 2, 64)
+
+
+def hash_level(nodes):
+    """One tree level: (n, 32) -> (ceil(n/2), 32).
+
+    Adjacent pairs are inner-hashed in one batch; an odd trailing node is
+    promoted unchanged (equivalent to the reference's power-of-two split,
+    tree.go:101).  n is static under jit, so the promotion is trace-time
+    Python, not device control flow.
+    """
+    n = nodes.shape[0]
+    if n == 1:
+        return nodes
+    pairs = n // 2
+    left = nodes[: 2 * pairs : 2]
+    right = nodes[1 : 2 * pairs : 2]
+    hashed = sha2.sha256_blocks(_inner_blocks(left, right))
+    if n % 2:
+        hashed = jnp.concatenate([hashed, nodes[-1:]], axis=0)
+    return hashed
+
+
+def root_from_leaf_hashes(leaf_hashes):
+    """(n, 32) leaf hashes -> (32,) RFC-6962 root.  n >= 1, static."""
+    nodes = leaf_hashes
+    while nodes.shape[0] > 1:
+        nodes = hash_level(nodes)
+    return nodes[0]
+
+
+def leaf_hashes_from_padded(blocks, active):
+    """Device leaf hashing: padded (n, nb, 64) 0x00-prefixed messages -> (n, 32)."""
+    return sha2.sha256_blocks(blocks, active)
+
+
+def pad_leaves(leaves: list[bytes]):
+    """Host: raw leaves -> (blocks, active) with the 0x00 leaf prefix applied."""
+    return sha2.pad_messages_sha256([_LEAF_PREFIX + l for l in leaves])
+
+
+def root_from_leaves(blocks, active):
+    """Full device pipeline: host-padded leaves -> root.  Jit-friendly."""
+    return root_from_leaf_hashes(leaf_hashes_from_padded(blocks, active))

@@ -1,0 +1,12 @@
+"""Device-mesh parallelism for the verification plane.
+
+The reference's only data-parallel kernel is commit batch verification
+(types/validation.go:265), plus per-block Merkle hashing.  Here both are
+sharded across a `jax.sharding.Mesh` with `shard_map`: signatures shard
+across the "sig" axis the way sequence parallelism shards tokens, Merkle
+leaves across the "leaf" axis, and ICI collectives (psum / all_gather)
+combine per-shard results into the global verdict.
+"""
+
+from .mesh import make_mesh, device_count
+from .verify import sharded_verify_batch, sharded_merkle_root, commit_verification_step

@@ -1,0 +1,25 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "sig") -> Mesh:
+    """1-D mesh over the first n devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def make_mesh_2d(n_sig: int, n_leaf: int) -> Mesh:
+    """2-D mesh: signature-parallel x leaf-parallel."""
+    devs = np.array(jax.devices()[: n_sig * n_leaf]).reshape(n_sig, n_leaf)
+    return Mesh(devs, ("sig", "leaf"))

@@ -1,0 +1,92 @@
+"""Sharded verification kernels (shard_map over a device Mesh).
+
+One Commit = N independent signature checks plus a Merkle pass over the
+block — embarrassingly parallel across chips.  Shardings:
+
+  - signatures: batch axis sharded over "sig"; each device runs the fused
+    Ed25519 kernel on its shard; a psum over invalid counts yields the
+    global all-valid bit while the per-signature validity vector stays
+    sharded (gathered once at the end for blame, validation.go:384-399).
+  - Merkle leaves: leaf axis sharded over "sig" too (leaf counts per
+    device stay static); each device reduces its subtree, then the D
+    subtree roots are all_gathered and folded level-by-level, replicated.
+
+Everything is jit-compiled once per (shape, mesh) and reused; the commit
+verification step is the framework's flagship compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops import ed25519 as E
+from ..ops import merkle as M
+
+
+def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active):
+    """Batch Ed25519 verify with the batch axis sharded over mesh axis "sig".
+
+    Returns (all_valid: bool scalar, valid: (N,) bool fully replicated).
+    N must be divisible by the mesh size (callers pad to bucket sizes).
+    """
+    axis = mesh.axis_names[0]
+
+    def local(a, r, s, blocks, active):
+        ok = E.verify_batch(a, r, s, blocks, active)
+        bad = jnp.sum((~ok).astype(jnp.int32))
+        total_bad = jax.lax.psum(bad, axis)
+        all_ok = jax.lax.all_gather(ok, axis, tiled=True)
+        return total_bad == 0, all_ok
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    return fn(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
+
+
+def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):
+    """Merkle root with leaves sharded over the mesh's first axis.
+
+    Each device leaf-hashes and reduces its (n/D)-leaf subtree, then the D
+    subtree roots are all_gathered and folded on every device (replicated
+    result).  Exactly the reference's power-of-two split (tree.go:101)
+    when n/D is a power of two — which callers guarantee by padding.
+    """
+    axis = mesh.axis_names[0]
+
+    def local(blocks, active):
+        sub = M.root_from_leaves(blocks, active)  # (32,)
+        roots = jax.lax.all_gather(sub, axis)  # (D, 32)
+        return M.root_from_leaf_hashes(roots)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(),
+    )
+    return fn(leaf_blocks, leaf_active)
+
+
+def commit_verification_step(
+    mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active, leaf_blocks, leaf_active
+):
+    """The flagship step: verify a Commit's signature batch and recompute
+    the block's Merkle root, both sharded over the mesh.
+
+    Mirrors what finalizeCommit does per height on the host reference
+    (state/validation.go:94 VerifyCommit + types/block.go hashing).
+    """
+    all_ok, valid = sharded_verify_batch(
+        mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active
+    )
+    root = sharded_merkle_root(mesh, leaf_blocks, leaf_active)
+    return all_ok, valid, root

@@ -1,0 +1,6 @@
+"""L4/L6 state: the State record, its store, block validation and the
+BlockExecutor (reference: state/ — store.go:275, execution.go:70,
+validation.go:17)."""
+
+from .state import State, make_genesis_state
+from .store import StateStore

@@ -1,0 +1,10 @@
+"""L4 storage: KV DB abstraction + block store.
+
+Reference: db/ (pebble-backed KV, db/db.go:24), store/ (block store,
+store/store.go).  Backends here: in-memory (tests, statesync temp stores)
+and SQLite-backed persistent store; the C++ LSM backend slots in behind
+the same interface.
+"""
+
+from .db import DB, MemDB, SQLiteDB, PrefixDB, new_db
+from .block_store import BlockStore

@@ -1,0 +1,37 @@
+"""L3 domain types: blocks, votes, validator sets, commits, params.
+
+Mirrors the reference's types/ package (SURVEY.md §2.3).  Everything
+consensus-critical — sign-bytes, hashes, proposer selection — follows the
+reference's observable behavior bit-for-bit; commit verification routes
+through the pluggable BatchVerifier seam so the TPU provider serves the
+hot path (types/validation.go:265 analogue in types/validation.py).
+"""
+
+from .validators import Validator, ValidatorSet, MAX_TOTAL_VOTING_POWER
+from .block import (
+    BlockID,
+    PartSetHeader,
+    Header,
+    Data,
+    Commit,
+    CommitSig,
+    ExtendedCommit,
+    ExtendedCommitSig,
+    Block,
+    BlockIDFlag,
+)
+from .vote import Vote, VoteError
+from .proposal import Proposal
+from .validation import (
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+    SignatureCache,
+    NotEnoughVotingPowerError,
+    CommitVerificationError,
+)
+from .vote_set import VoteSet
+from .params import ConsensusParams, default_consensus_params
+from .tx import tx_hash, txs_hash, tx_proof
+from .part_set import PartSet, Part
+from .genesis import GenesisDoc, GenesisValidator

@@ -1,0 +1,78 @@
+"""Proposal domain type (reference: types/proposal.go)."""
+
+from __future__ import annotations
+
+from ..wire import types_pb as pb
+from ..wire.canonical import Timestamp, PROPOSAL_TYPE, proposal_sign_bytes
+from .block import BlockID, ZERO_TIME
+
+
+class Proposal:
+    __slots__ = ("type", "height", "round", "pol_round", "block_id", "timestamp", "signature")
+
+    def __init__(
+        self,
+        height: int = 0,
+        round: int = 0,
+        pol_round: int = -1,
+        block_id: BlockID | None = None,
+        timestamp: Timestamp | None = None,
+        signature: bytes = b"",
+    ):
+        self.type = PROPOSAL_TYPE
+        self.height = height
+        self.round = round
+        self.pol_round = pol_round
+        self.block_id = block_id or BlockID()
+        self.timestamp = timestamp or ZERO_TIME
+        self.signature = signature
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return proposal_sign_bytes(
+            chain_id,
+            self.height,
+            self.round,
+            self.pol_round,
+            self.block_id.to_canonical(),
+            self.timestamp,
+        )
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.pol_round < -1 or (self.pol_round >= self.round and self.pol_round != -1):
+            raise ValueError("POLRound must be -1 or in [0, round)")
+        self.block_id.validate_basic()
+        if not self.block_id.is_complete():
+            raise ValueError("expected a complete, non-empty BlockID")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 256:
+            raise ValueError("signature is too big")
+
+    def to_proto(self) -> pb.Proposal:
+        return pb.Proposal(
+            type=self.type,
+            height=self.height,
+            round=self.round,
+            pol_round=self.pol_round,
+            block_id=self.block_id.to_proto(),
+            timestamp=self.timestamp,
+            signature=self.signature,
+        )
+
+    @classmethod
+    def from_proto(cls, m: pb.Proposal) -> "Proposal":
+        return cls(
+            height=m.height,
+            round=m.round,
+            pol_round=m.pol_round,
+            block_id=BlockID.from_proto(m.block_id or pb.BlockID()),
+            timestamp=m.timestamp or ZERO_TIME,
+            signature=m.signature,
+        )
+
+    def __repr__(self):
+        return f"Proposal(h={self.height} r={self.round} pol={self.pol_round} -> {self.block_id})"

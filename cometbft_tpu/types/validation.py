@@ -1,0 +1,345 @@
+"""Commit verification — the framework's hot path (reference:
+types/validation.go, 529 LoC; "the heart of the north star" per SURVEY.md).
+
+verify_commit* assemble a batch of (pubkey, sign-bytes, signature) triples
+and hand it to the BatchVerifier seam (crypto/batch.create_batch_verifier),
+where the TPU provider runs the fused Ed25519 kernel; on batch failure the
+per-signature validity vector assigns blame exactly like the reference
+(validation.go:384-399), and a sequential fallback covers heterogeneous
+key sets (shouldBatchVerify, validation.go:17-21).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+from ..crypto import batch as crypto_batch
+from .block import BlockID, Commit, CommitSig
+from .validators import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2  # validation.go:15
+
+
+class CommitVerificationError(Exception):
+    pass
+
+
+class NotEnoughVotingPowerError(CommitVerificationError):
+    def __init__(self, got: int, needed: int):
+        super().__init__(f"invalid commit -- insufficient voting power: got {got}, needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+@dataclass
+class SignatureCacheValue:
+    validator_address: bytes
+    vote_sign_bytes: bytes
+
+
+class SignatureCache:
+    """Cross-call dedup of verified signatures (validation.go SignatureCache);
+    shared between the 1/3-trusting and 2/3 passes of light verification."""
+
+    def __init__(self, max_size: int = 1 << 16):
+        self._d: dict[bytes, SignatureCacheValue] = {}
+        self._max = max_size
+
+    def get(self, sig: bytes) -> SignatureCacheValue | None:
+        return self._d.get(sig)
+
+    def add(self, sig: bytes, value: SignatureCacheValue) -> None:
+        if len(self._d) >= self._max:
+            self._d.pop(next(iter(self._d)))
+        self._d[sig] = value
+
+    def __len__(self):
+        return len(self._d)
+
+
+def should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    """(validation.go:17) >= 2 sigs, key type batchable, homogeneous set."""
+    proposer = vals.get_proposer()
+    return (
+        len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
+        and proposer is not None
+        and crypto_batch.supports_batch_verifier(proposer.pub_key.type)
+        and vals.all_keys_have_same_type()
+    )
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """+2/3 of the set signed this commit; checks ALL signatures (the ABCI
+    app's incentive logic depends on every flag being right)
+    (validation.go:30)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda cs: cs.absent_flag()
+    count = lambda cs: cs.for_block()
+    if should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=True, lookup_by_index=True, cache=None,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=True, lookup_by_index=True, cache=None,
+        )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    count_all_signatures: bool = False,
+    cache: SignatureCache | None = None,
+) -> None:
+    """+2/3 check that may exit early — the light-client / blocksync path
+    (validation.go:65-147)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda cs: not cs.for_block()
+    count = lambda cs: True
+    if should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures, lookup_by_index=True,
+            cache=cache,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures, lookup_by_index=True,
+            cache=cache,
+        )
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction = Fraction(1, 3),
+    count_all_signatures: bool = False,
+    cache: SignatureCache | None = None,
+) -> None:
+    """trustLevel of a *trusted* set signed this commit; validators are
+    looked up by address since the sets differ (validation.go:150-253)."""
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if trust_level.denominator == 0:
+        raise CommitVerificationError("trustLevel has zero Denominator")
+    total = vals.total_voting_power()
+    voting_power_needed = total * trust_level.numerator // trust_level.denominator
+    ignore = lambda cs: not cs.for_block()
+    count = lambda cs: True
+    if should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures, lookup_by_index=False,
+            cache=cache,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures, lookup_by_index=False,
+            cache=cache,
+        )
+
+
+# ------------------------------------------------------------------ internal
+
+
+def _verify_basic_vals_and_commit(vals, commit, height, block_id):
+    """(validation.go:507)."""
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise CommitVerificationError(
+            f"invalid commit -- wrong set size: {vals.size()} vs {len(commit.signatures)}"
+        )
+    if height != commit.height:
+        raise CommitVerificationError(
+            f"invalid commit -- wrong height: {height} vs {commit.height}"
+        )
+    if block_id != commit.block_id:
+        raise CommitVerificationError(
+            f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+        )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+    cache: SignatureCache | None,
+) -> None:
+    """(validation.go:265) — batch assembly, power tally, TPU verify, blame."""
+    proposer = vals.get_proposer()
+    bv = crypto_batch.create_batch_verifier(proposer.pub_key.type)
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+    tallied = 0
+
+    for idx, cs in enumerate(commit.signatures):
+        if ignore_sig(cs):
+            continue
+        if lookup_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise CommitVerificationError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+
+        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+
+        cache_hit = False
+        if cache is not None:
+            cv = cache.get(cs.signature)
+            cache_hit = (
+                cv is not None
+                and cv.validator_address == val.pub_key.address()
+                and cv.vote_sign_bytes == sign_bytes
+            )
+        if not cache_hit:
+            bv.add(val.pub_key.bytes(), sign_bytes, cs.signature)
+            batch_sig_idxs.append(idx)
+
+        if count_sig(cs):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+
+    if not batch_sig_idxs:
+        return  # everything came from the cache
+
+    ok, valid_sigs = bv.verify()
+    if ok:
+        if cache is not None:
+            for i, idx in enumerate(batch_sig_idxs):
+                cs = commit.signatures[idx]
+                cache.add(
+                    cs.signature,
+                    SignatureCacheValue(
+                        validator_address=cs.validator_address,
+                        vote_sign_bytes=commit.vote_sign_bytes(chain_id, idx),
+                    ),
+                )
+        return
+
+    # per-signature blame: report the first invalid one (validation.go:384)
+    for i, sig_ok in enumerate(valid_sigs):
+        idx = batch_sig_idxs[i]
+        cs = commit.signatures[idx]
+        if not sig_ok:
+            raise CommitVerificationError(
+                f"wrong signature (#{idx}): {cs.signature.hex()}"
+            )
+        if cache is not None:
+            cache.add(
+                cs.signature,
+                SignatureCacheValue(
+                    validator_address=cs.validator_address,
+                    vote_sign_bytes=commit.vote_sign_bytes(chain_id, idx),
+                ),
+            )
+    raise CommitVerificationError(
+        "BUG: batch verification failed with no invalid signatures"
+    )
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+    cache: SignatureCache | None,
+) -> None:
+    """(validation.go:413) — the sequential fallback."""
+    seen_vals: dict[int, int] = {}
+    tallied = 0
+    for idx, cs in enumerate(commit.signatures):
+        if ignore_sig(cs):
+            continue
+        try:
+            cs.validate_basic()
+        except ValueError as e:
+            raise CommitVerificationError(
+                f"invalid signature at index {idx}: {e}"
+            ) from e
+        if lookup_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise CommitVerificationError(
+                    f"double vote from {val} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+
+        if val.pub_key is None:
+            raise CommitVerificationError(f"validator {val} has a nil PubKey at index {idx}")
+
+        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+
+        cache_hit = False
+        if cache is not None:
+            cv = cache.get(cs.signature)
+            cache_hit = (
+                cv is not None
+                and cv.validator_address == val.pub_key.address()
+                and cv.vote_sign_bytes == sign_bytes
+            )
+        if not cache_hit:
+            if not val.pub_key.verify_signature(sign_bytes, cs.signature):
+                raise CommitVerificationError(
+                    f"wrong signature (#{idx}): {cs.signature.hex()}"
+                )
+            if cache is not None:
+                cache.add(
+                    cs.signature,
+                    SignatureCacheValue(
+                        validator_address=val.pub_key.address(),
+                        vote_sign_bytes=sign_bytes,
+                    ),
+                )
+
+        if count_sig(cs):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)

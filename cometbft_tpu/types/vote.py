@@ -1,0 +1,184 @@
+"""Vote domain type (reference: types/vote.go).
+
+Sign-bytes canonicalization (vote.go VoteSignBytes), single verification
+(vote.go:247 Verify), and vote-extension verification (vote.go:281,
+ABCI 2.0)."""
+
+from __future__ import annotations
+
+from ..crypto import hash as tmhash
+from ..wire import types_pb as pb
+from ..wire.canonical import (
+    Timestamp,
+    PREVOTE_TYPE,
+    PRECOMMIT_TYPE,
+    vote_sign_bytes,
+    vote_extension_sign_bytes,
+)
+from .block import BlockID, ZERO_TIME
+
+MAX_CHAIN_ID_LEN = 50
+
+
+class VoteError(Exception):
+    pass
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
+class Vote:
+    __slots__ = (
+        "type", "height", "round", "block_id", "timestamp",
+        "validator_address", "validator_index", "signature",
+        "extension", "extension_signature",
+    )
+
+    def __init__(
+        self,
+        type: int = 0,
+        height: int = 0,
+        round: int = 0,
+        block_id: BlockID | None = None,
+        timestamp: Timestamp | None = None,
+        validator_address: bytes = b"",
+        validator_index: int = 0,
+        signature: bytes = b"",
+        extension: bytes = b"",
+        extension_signature: bytes = b"",
+    ):
+        self.type = type
+        self.height = height
+        self.round = round
+        self.block_id = block_id or BlockID()
+        self.timestamp = timestamp or ZERO_TIME
+        self.validator_address = validator_address
+        self.validator_index = validator_index
+        self.signature = signature
+        self.extension = extension
+        self.extension_signature = extension_signature
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_nil()
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        """Canonical bytes to sign (vote.go VoteSignBytes)."""
+        return vote_sign_bytes(
+            chain_id,
+            self.type,
+            self.height,
+            self.round,
+            self.block_id.to_canonical(),
+            self.timestamp,
+        )
+
+    def extension_sign_bytes(self, chain_id: str) -> bytes:
+        return vote_extension_sign_bytes(
+            chain_id, self.height, self.round, self.extension
+        )
+
+    def verify(self, chain_id: str, pub_key) -> None:
+        """Verify the vote signature (vote.go:247)."""
+        if pub_key.address() != self.validator_address:
+            raise VoteError("invalid validator address")
+        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+            raise VoteError("invalid signature")
+
+    def verify_vote_and_extension(self, chain_id: str, pub_key) -> None:
+        """Verify vote + extension signatures (vote.go VerifyVoteAndExtension)."""
+        self.verify(chain_id, pub_key)
+        if self.type == PRECOMMIT_TYPE and not self.block_id.is_nil():
+            if not self.extension_signature:
+                raise VoteError("missing extension signature")
+            if not pub_key.verify_signature(
+                self.extension_sign_bytes(chain_id), self.extension_signature
+            ):
+                raise VoteError("invalid extension signature")
+
+    def verify_extension(self, chain_id: str, pub_key) -> None:
+        if self.type != PRECOMMIT_TYPE or self.block_id.is_nil():
+            return
+        if not pub_key.verify_signature(
+            self.extension_sign_bytes(chain_id), self.extension_signature
+        ):
+            raise VoteError("invalid extension signature")
+
+    def validate_basic(self) -> None:
+        if not is_vote_type_valid(self.type):
+            raise ValueError("invalid Type")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        self.block_id.validate_basic()
+        if not self.block_id.is_nil() and not self.block_id.is_complete():
+            raise ValueError(f"blockID must be either empty or complete, got {self.block_id}")
+        if len(self.validator_address) != 20:
+            raise ValueError("expected ValidatorAddress size to be 20 bytes")
+        if self.validator_index < 0:
+            raise ValueError("negative ValidatorIndex")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 256:
+            raise ValueError("signature is too big")
+        if self.type != PRECOMMIT_TYPE or self.is_nil():
+            if self.extension:
+                raise ValueError("unexpected vote extension")
+            if self.extension_signature:
+                raise ValueError("unexpected extension signature")
+
+    def to_commit_sig(self):
+        from .block import (
+            CommitSig,
+            BLOCK_ID_FLAG_ABSENT,
+            BLOCK_ID_FLAG_COMMIT,
+            BLOCK_ID_FLAG_NIL,
+        )
+
+        flag = BLOCK_ID_FLAG_NIL if self.is_nil() else BLOCK_ID_FLAG_COMMIT
+        return CommitSig(
+            block_id_flag=flag,
+            validator_address=self.validator_address,
+            timestamp=self.timestamp,
+            signature=self.signature,
+        )
+
+    def to_proto(self) -> pb.Vote:
+        return pb.Vote(
+            type=self.type,
+            height=self.height,
+            round=self.round,
+            block_id=self.block_id.to_proto(),
+            timestamp=self.timestamp,
+            validator_address=self.validator_address,
+            validator_index=self.validator_index,
+            signature=self.signature,
+            extension=self.extension,
+            extension_signature=self.extension_signature,
+        )
+
+    @classmethod
+    def from_proto(cls, m: pb.Vote) -> "Vote":
+        return cls(
+            type=m.type,
+            height=m.height,
+            round=m.round,
+            block_id=BlockID.from_proto(m.block_id or pb.BlockID()),
+            timestamp=m.timestamp or ZERO_TIME,
+            validator_address=m.validator_address,
+            validator_index=m.validator_index,
+            signature=m.signature,
+            extension=m.extension,
+            extension_signature=m.extension_signature,
+        )
+
+    def __eq__(self, other):
+        return isinstance(other, Vote) and self.to_proto().encode() == other.to_proto().encode()
+
+    def __repr__(self):
+        kind = {PREVOTE_TYPE: "prevote", PRECOMMIT_TYPE: "precommit"}.get(
+            self.type, f"type{self.type}"
+        )
+        tgt = "nil" if self.is_nil() else self.block_id.hash.hex()[:12]
+        return f"Vote({kind} h={self.height} r={self.round} -> {tgt} by {self.validator_address.hex()[:12]})"

@@ -1,0 +1,284 @@
+"""VoteSet: per-(height, round, type) quorum tracker (reference:
+types/vote_set.go:60-75): bit array of who voted, per-block power sums,
+2/3 majority detection, and conflicting-vote capture for evidence."""
+
+from __future__ import annotations
+
+from .block import BlockID
+from .validators import ValidatorSet
+from .vote import Vote, VoteError
+from ..wire.canonical import PREVOTE_TYPE, PRECOMMIT_TYPE
+
+
+class ErrVoteConflictingVotes(VoteError):
+    def __init__(self, conflicting: Vote):
+        super().__init__("conflicting votes from validator")
+        self.conflicting_vote = conflicting
+
+
+class _BlockVotes:
+    """Votes for one particular block (vote_set.go blockVotes)."""
+
+    __slots__ = ("peer_maj23", "bit_array", "votes", "sum")
+
+    def __init__(self, peer_maj23: bool, num_validators: int):
+        self.peer_maj23 = peer_maj23
+        self.bit_array = [False] * num_validators
+        self.votes: list[Vote | None] = [None] * num_validators
+        self.sum = 0
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if not self.bit_array[idx]:
+            self.bit_array[idx] = True
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round: int,
+        signed_msg_type: int,
+        val_set: ValidatorSet,
+        extensions_enabled: bool = False,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height == 0")
+        if extensions_enabled and signed_msg_type != PRECOMMIT_TYPE:
+            raise ValueError("extensions only allowed for precommits")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
+        n = val_set.size()
+        self.votes_bit_array = [False] * n
+        self.votes: list[Vote | None] = [None] * n
+        self.sum = 0
+        self.maj23: BlockID | None = None
+        self.votes_by_block: dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: dict[str, BlockID] = {}
+
+    def size(self) -> int:
+        return self.val_set.size()
+
+    # ------------------------------------------------------------ add vote
+
+    def add_vote(self, vote: Vote | None) -> bool:
+        """Verify + add; returns True if added.  Raises
+        ErrVoteConflictingVotes when a validator equivocates
+        (vote_set.go:169 addVote)."""
+        if vote is None:
+            raise VoteError("nil vote")
+        val_index = vote.validator_index
+        val_addr = vote.validator_address
+        block_key = vote.block_id.key()
+
+        if val_index < 0:
+            raise VoteError("index < 0")
+        if not val_addr:
+            raise VoteError("empty address")
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type != self.signed_msg_type
+        ):
+            raise VoteError(
+                f"expected {self.height}/{self.round}/{self.signed_msg_type}, "
+                f"got {vote.height}/{vote.round}/{vote.type}"
+            )
+
+        lookup_addr, val = self.val_set.get_by_index(val_index)
+        if val is None:
+            raise VoteError(f"cannot find validator {val_index} in valSet")
+        if lookup_addr != val_addr:
+            raise VoteError("validator address does not match index")
+
+        # already have an identical vote?
+        existing = self.get_vote(val_index, block_key)
+        if existing is not None and existing.signature == vote.signature:
+            return False
+
+        vote.verify(self.chain_id, val.pub_key)
+
+        if self.extensions_enabled and not vote.block_id.is_nil():
+            vote.verify_extension(self.chain_id, val.pub_key)
+            if not vote.extension_signature:
+                raise VoteError("vote extension signature missing")
+
+        added, conflicting = self._add_verified_vote(
+            vote, block_key, val.voting_power
+        )
+        if conflicting is not None:
+            raise ErrVoteConflictingVotes(conflicting)
+        return added
+
+    def _add_verified_vote(
+        self, vote: Vote, block_key: bytes, voting_power: int
+    ) -> tuple[bool, Vote | None]:
+        conflicting: Vote | None = None
+        val_index = vote.validator_index
+
+        existing = self.votes[val_index]
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                raise AssertionError("duplicate vote not caught earlier")
+            conflicting = existing
+            # Replace only if this vote is for the established 2/3 majority
+            # block (vote_set.go addVerifiedVote).
+            if self.maj23 is not None and self.maj23.key() == block_key:
+                self.votes[val_index] = vote
+                self.votes_bit_array[val_index] = True
+        else:
+            self.votes[val_index] = vote
+            self.votes_bit_array[val_index] = True
+            self.sum += voting_power
+
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            if conflicting is not None and not bv.peer_maj23:
+                return False, conflicting
+        else:
+            if conflicting is not None:
+                return False, conflicting
+            bv = _BlockVotes(False, self.size())
+            self.votes_by_block[block_key] = bv
+
+        old_sum = bv.sum
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        bv.add_verified_vote(vote, voting_power)
+
+        if old_sum < quorum <= bv.sum and self.maj23 is None:
+            self.maj23 = vote.block_id
+            # promote this block's votes into the main list
+            for i, v in enumerate(bv.votes):
+                if v is not None:
+                    self.votes[i] = v
+        return True, conflicting
+
+    # ------------------------------------------------------------ queries
+
+    def get_vote(self, val_index: int, block_key: bytes) -> Vote | None:
+        v = self.votes[val_index] if val_index < len(self.votes) else None
+        if v is not None and v.block_id.key() == block_key:
+            return v
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            return bv.get_by_index(val_index)
+        return None
+
+    def get_by_index(self, val_index: int) -> Vote | None:
+        return self.votes[val_index]
+
+    def get_by_address(self, address: bytes) -> Vote | None:
+        idx, val = self.val_set.get_by_address(address)
+        if val is None:
+            return None
+        return self.votes[idx]
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def two_thirds_majority(self) -> tuple[BlockID | None, bool]:
+        if self.maj23 is not None:
+            return self.maj23, True
+        return None, False
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def bit_array(self) -> list[bool]:
+        return list(self.votes_bit_array)
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> list[bool] | None:
+        bv = self.votes_by_block.get(block_id.key())
+        if bv is not None:
+            return list(bv.bit_array)
+        return None
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """A peer claims 2/3 majority for block_id (vote_set.go SetPeerMaj23)."""
+        existing = self.peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing == block_id:
+                return
+            raise VoteError("setPeerMaj23: conflicting blockID from peer")
+        self.peer_maj23s[peer_id] = block_id
+        block_key = block_id.key()
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            bv.peer_maj23 = True
+        else:
+            self.votes_by_block[block_key] = _BlockVotes(True, self.size())
+
+    # ------------------------------------------------------------- commit
+
+    def make_commit(self):
+        """Build a Commit from 2/3+ precommits (vote_set.go MakeExtendedCommit
+        / MakeCommit)."""
+        from .block import Commit, CommitSig
+
+        if self.signed_msg_type != PRECOMMIT_TYPE:
+            raise VoteError("cannot make commit from non-precommit VoteSet")
+        if self.maj23 is None:
+            raise VoteError("cannot make commit: no 2/3 majority")
+        sigs = []
+        for i in range(self.size()):
+            v = self.votes[i]
+            if v is None:
+                sigs.append(CommitSig.absent())
+                continue
+            cs = v.to_commit_sig()
+            # A COMMIT-flagged sig for a different block than maj23 cannot be
+            # verified against this commit's BlockID — record it absent
+            # (vote_set.go MakeExtendedCommit).
+            if cs.for_block() and v.block_id != self.maj23:
+                cs = CommitSig.absent()
+            sigs.append(cs)
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=self.maj23,
+            signatures=sigs,
+        )
+
+    def make_extended_commit(self):
+        from .block import ExtendedCommit, ExtendedCommitSig, CommitSig
+
+        if self.signed_msg_type != PRECOMMIT_TYPE:
+            raise VoteError("cannot make commit from non-precommit VoteSet")
+        if self.maj23 is None:
+            raise VoteError("cannot make commit: no 2/3 majority")
+        ext_sigs = []
+        for i in range(self.size()):
+            v = self.votes[i]
+            if v is None:
+                ext_sigs.append(ExtendedCommitSig(commit_sig=CommitSig.absent()))
+                continue
+            cs = v.to_commit_sig()
+            if cs.for_block() and v.block_id != self.maj23:
+                ext_sigs.append(ExtendedCommitSig(commit_sig=CommitSig.absent()))
+            else:
+                ext_sigs.append(
+                    ExtendedCommitSig(
+                        commit_sig=cs,
+                        extension=v.extension,
+                        extension_signature=v.extension_signature,
+                    )
+                )
+        return ExtendedCommit(
+            height=self.height,
+            round=self.round,
+            block_id=self.maj23,
+            extended_signatures=ext_sigs,
+        )

@@ -1,0 +1,5 @@
+"""L0 base utilities (reference: libs/ — service lifecycle, logging,
+pubsub, bit arrays)."""
+
+from .service import Service, ServiceError
+from .log import get_logger

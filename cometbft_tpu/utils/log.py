@@ -1,0 +1,33 @@
+"""Structured logging with per-module level filtering (reference:
+libs/log/, filter.go)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("COMETBFT_TPU_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"
+        )
+    )
+    root = logging.getLogger("cometbft_tpu")
+    root.setLevel(getattr(logging, level, logging.INFO))
+    root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(module: str) -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"cometbft_tpu.{module}")

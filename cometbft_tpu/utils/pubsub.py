@@ -1,0 +1,139 @@
+"""In-process pubsub with event queries (reference: libs/pubsub/ + the
+query language in libs/pubsub/query/).
+
+Subscribers register a Query; published (message, events) pairs are
+matched and delivered over per-subscriber queues.  The query language
+covers the subset the RPC layer uses: `tm.event='NewBlock'`,
+`tx.height=5`, conjunction with AND, =, <, >, <=, >=, CONTAINS, EXISTS.
+"""
+
+from __future__ import annotations
+
+import queue
+import re
+import threading
+from dataclasses import dataclass, field
+
+
+class Query:
+    """Parsed event query (reference: libs/pubsub/query/query.go)."""
+
+    _COND_RE = re.compile(
+        r"\s*([\w.]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*('(?:[^']*)'|[\w.\-]+)?\s*"
+    )
+
+    def __init__(self, expr: str):
+        self.expr = expr
+        self.conditions: list[tuple[str, str, str | None]] = []
+        if expr.strip():
+            for part in expr.split(" AND "):
+                m = self._COND_RE.fullmatch(part)
+                if not m:
+                    raise ValueError(f"invalid query condition: {part!r}")
+                key, op, val = m.group(1), m.group(2), m.group(3)
+                if val is not None and val.startswith("'"):
+                    val = val[1:-1]
+                if op != "EXISTS" and val is None:
+                    raise ValueError(f"operator {op} requires a value: {part!r}")
+                self.conditions.append((key, op, val))
+
+    def matches(self, events: dict[str, list[str]]) -> bool:
+        for key, op, want in self.conditions:
+            values = events.get(key)
+            if values is None:
+                return False
+            if op == "EXISTS":
+                continue
+            ok = False
+            for v in values:
+                if op == "=":
+                    ok = v == want
+                elif op == "CONTAINS":
+                    ok = want in v
+                else:
+                    try:
+                        fv, fw = float(v), float(want)
+                    except ValueError:
+                        continue
+                    ok = {
+                        "<": fv < fw,
+                        ">": fv > fw,
+                        "<=": fv <= fw,
+                        ">=": fv >= fw,
+                    }[op]
+                if ok:
+                    break
+            if not ok:
+                return False
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Query) and self.expr == other.expr
+
+    def __hash__(self):
+        return hash(self.expr)
+
+    def __repr__(self):
+        return f"Query({self.expr!r})"
+
+
+ALL = Query("")
+
+
+@dataclass
+class Subscription:
+    subscriber: str
+    query: Query
+    out: queue.Queue = field(default_factory=lambda: queue.Queue(maxsize=1000))
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def get(self, timeout: float | None = None):
+        return self.out.get(timeout=timeout)
+
+
+class PubSub:
+    """Thread-safe pubsub server (libs/pubsub/pubsub.go)."""
+
+    def __init__(self):
+        self._subs: dict[tuple[str, str], Subscription] = {}
+        self._mtx = threading.RLock()
+
+    def subscribe(self, subscriber: str, query: Query | str) -> Subscription:
+        if isinstance(query, str):
+            query = Query(query)
+        key = (subscriber, query.expr)
+        with self._mtx:
+            if key in self._subs:
+                raise ValueError(f"already subscribed: {key}")
+            sub = Subscription(subscriber, query)
+            self._subs[key] = sub
+            return sub
+
+    def unsubscribe(self, subscriber: str, query: Query | str) -> None:
+        if isinstance(query, str):
+            query = Query(query)
+        with self._mtx:
+            sub = self._subs.pop((subscriber, query.expr), None)
+            if sub is None:
+                raise KeyError("subscription not found")
+            sub.cancelled.set()
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._mtx:
+            for key in [k for k in self._subs if k[0] == subscriber]:
+                self._subs.pop(key).cancelled.set()
+
+    def publish(self, msg, events: dict[str, list[str]] | None = None) -> None:
+        events = events or {}
+        with self._mtx:
+            subs = list(self._subs.values())
+        for sub in subs:
+            if sub.query.matches(events):
+                try:
+                    sub.out.put_nowait((msg, events))
+                except queue.Full:
+                    pass  # slow subscriber: drop (reference cancels; we shed)
+
+    def num_clients(self) -> int:
+        with self._mtx:
+            return len({k[0] for k in self._subs})

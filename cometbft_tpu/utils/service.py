@@ -1,0 +1,83 @@
+"""Service lifecycle base class (reference: libs/service/service.go:26).
+
+start/stop-once semantics with overridable on_start/on_stop hooks; every
+long-running component (reactors, stores, the node itself) extends this.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .log import get_logger
+
+
+class ServiceError(Exception):
+    pass
+
+
+class AlreadyStartedError(ServiceError):
+    pass
+
+
+class AlreadyStoppedError(ServiceError):
+    pass
+
+
+class NotStartedError(ServiceError):
+    pass
+
+
+class Service:
+    def __init__(self, name: str | None = None):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._mtx = threading.Lock()
+        self._quit = threading.Event()
+        self.logger = get_logger(self._name)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._started:
+                raise AlreadyStartedError(f"{self._name} already started")
+            if self._stopped:
+                raise AlreadyStoppedError(f"{self._name} already stopped")
+            self._started = True
+        self.logger.info("service start")
+        try:
+            self.on_start()
+        except Exception:
+            with self._mtx:
+                self._started = False
+            raise
+
+    def stop(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                return
+            if not self._started:
+                raise NotStartedError(f"{self._name} not started")
+            self._stopped = True
+        self.logger.info("service stop")
+        self._quit.set()
+        self.on_stop()
+
+    def is_running(self) -> bool:
+        with self._mtx:
+            return self._started and not self._stopped
+
+    def wait(self, timeout: float | None = None) -> None:
+        self._quit.wait(timeout)
+
+    @property
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    # hooks
+    def on_start(self) -> None: ...
+
+    def on_stop(self) -> None: ...

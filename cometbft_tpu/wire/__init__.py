@@ -1,0 +1,20 @@
+"""L2 wire format: deterministic protobuf codec + canonical sign-bytes.
+
+The reference's wire layer is 104 .proto files compiled by gogoproto into
+173k LoC of generated Go (SURVEY.md §2.2).  Here the same wire format is
+produced by a compact declarative codec (wire/proto.py) — field numbers
+and types mirror the public proto definitions (proto/cometbft/...), and
+encoding follows gogoproto Marshal semantics: zero scalars omitted,
+nil submessages omitted, non-nullable submessages always emitted, fields
+written in ascending tag order (deterministic — sign-bytes depend on it).
+"""
+
+from .proto import (
+    Message,
+    Field,
+    encode_varint,
+    decode_varint,
+    encode_delimited,
+    decode_delimited,
+)
+from .canonical import Timestamp

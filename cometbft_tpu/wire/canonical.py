@@ -1,0 +1,148 @@
+"""Canonical sign-bytes messages (reference: proto/cometbft/types/v1/
+canonical.proto; serialization entry points types/vote.go VoteSignBytes and
+types/proposal.go ProposalSignBytes).
+
+These byte strings are what validators sign and what the TPU batch
+verifier hashes — they are consensus-critical and must be deterministic:
+sfixed64 height/round (fixed-size, canonical), ascending field order,
+non-nullable timestamps always emitted (gogoproto semantics), and the
+whole message varint-length-delimited (protoio MarshalDelimited).
+"""
+
+from __future__ import annotations
+
+from .proto import Message, Field, encode_delimited
+
+# SignedMsgType enum (types.proto SIGNED_MSG_TYPE_*)
+UNKNOWN_TYPE = 0
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+class Timestamp(Message):
+    """google.protobuf.Timestamp: UTC wall time as (seconds, nanos)."""
+
+    FIELDS = [
+        Field(1, "seconds", "varint"),
+        Field(2, "nanos", "varint"),
+    ]
+
+    @classmethod
+    def from_unix_ns(cls, ns: int) -> "Timestamp":
+        return cls(seconds=ns // 1_000_000_000, nanos=ns % 1_000_000_000)
+
+    def unix_ns(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+    @classmethod
+    def now(cls) -> "Timestamp":
+        import time
+
+        return cls.from_unix_ns(time.time_ns())
+
+    def __lt__(self, other):
+        return self.unix_ns() < other.unix_ns()
+
+    def __le__(self, other):
+        return self.unix_ns() <= other.unix_ns()
+
+    def __hash__(self):
+        return hash(self.unix_ns())
+
+
+class CanonicalPartSetHeader(Message):
+    FIELDS = [
+        Field(1, "total", "varint"),
+        Field(2, "hash", "bytes"),
+    ]
+
+
+class CanonicalBlockID(Message):
+    FIELDS = [
+        Field(1, "hash", "bytes"),
+        Field(2, "part_set_header", "message", CanonicalPartSetHeader, emit_default=True),
+    ]
+
+
+class CanonicalVote(Message):
+    FIELDS = [
+        Field(1, "type", "varint"),
+        Field(2, "height", "sfixed64"),
+        Field(3, "round", "sfixed64"),
+        Field(4, "block_id", "message", CanonicalBlockID),  # nil when voting nil
+        Field(5, "timestamp", "message", Timestamp, emit_default=True),
+        Field(6, "chain_id", "string"),
+    ]
+
+
+class CanonicalProposal(Message):
+    FIELDS = [
+        Field(1, "type", "varint"),
+        Field(2, "height", "sfixed64"),
+        Field(3, "round", "sfixed64"),
+        Field(4, "pol_round", "varint"),
+        Field(5, "block_id", "message", CanonicalBlockID),
+        Field(6, "timestamp", "message", Timestamp, emit_default=True),
+        Field(7, "chain_id", "string"),
+    ]
+
+
+class CanonicalVoteExtension(Message):
+    FIELDS = [
+        Field(1, "extension", "bytes"),
+        Field(2, "height", "sfixed64"),
+        Field(3, "round", "sfixed64"),
+        Field(4, "chain_id", "string"),
+    ]
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: CanonicalBlockID | None,
+    timestamp: Timestamp,
+) -> bytes:
+    """The exact bytes a validator signs for a vote (types/vote.go:VoteSignBytes)."""
+    cv = CanonicalVote(
+        type=msg_type,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=timestamp,
+        chain_id=chain_id,
+    )
+    return encode_delimited(cv)
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: CanonicalBlockID | None,
+    timestamp: Timestamp,
+) -> bytes:
+    """Bytes signed for a proposal (types/proposal.go:ProposalSignBytes)."""
+    cp = CanonicalProposal(
+        type=PROPOSAL_TYPE,
+        height=height,
+        round=round_,
+        pol_round=pol_round,
+        block_id=block_id,
+        timestamp=timestamp,
+        chain_id=chain_id,
+    )
+    return encode_delimited(cp)
+
+
+def vote_extension_sign_bytes(
+    chain_id: str, height: int, round_: int, extension: bytes
+) -> bytes:
+    """Bytes signed for a vote extension (types/vote.go:VoteExtensionSignBytes)."""
+    ve = CanonicalVoteExtension(
+        extension=extension, height=height, round=round_, chain_id=chain_id
+    )
+    return encode_delimited(ve)

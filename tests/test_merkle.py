@@ -1,0 +1,92 @@
+"""Merkle tree conformance: RFC-6962 vectors, host/device equivalence,
+inclusion proofs (reference: crypto/merkle/rfc6962_test.go,
+crypto/merkle/proof_test.go)."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import merkle as M
+
+# RFC 6962 / Certificate-Transparency cross-ecosystem test vectors,
+# the same ones the reference pins in crypto/merkle/rfc6962_test.go.
+_CT_LEAVES = [
+    b"",
+    bytes([0x00]),
+    bytes([0x10]),
+    bytes([0x20, 0x21]),
+    bytes([0x30, 0x31]),
+    bytes([0x40, 0x41, 0x42, 0x43]),
+    bytes([0x50, 0x51, 0x52, 0x53, 0x54, 0x55, 0x56, 0x57]),
+    bytes(range(0x60, 0x70)),
+]
+_CT_ROOT8 = bytes.fromhex(
+    "5dc9da79a70659a9ad559cb701ded9a2ab9d823aad2f4960cfe370eff4604328"
+)
+
+
+def test_empty_tree_is_sha256_of_nothing():
+    assert M.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    assert M.hash_from_byte_slices([b"", b""][:1]) == M.leaf_hash(b"")
+    assert M.leaf_hash(b"") == hashlib.sha256(b"\x00").digest()
+
+
+def test_ct_vector_8_leaves():
+    assert M.hash_from_byte_slices(_CT_LEAVES, device=False) == _CT_ROOT8
+
+
+def test_ct_vector_8_leaves_device():
+    assert M.hash_from_byte_slices(_CT_LEAVES, device=True) == _CT_ROOT8
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8, 13, 33, 100])
+def test_host_device_equivalence(n):
+    items = [b"item-%d" % i * (i % 5 + 1) for i in range(n)]
+    assert M.hash_from_byte_slices(items, device=False) == M.hash_from_byte_slices(
+        items, device=True
+    )
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 6, 9, 16])
+def test_proofs_roundtrip(n):
+    items = [b"proof-item-%d" % i for i in range(n)]
+    root, proofs = M.proofs_from_byte_slices(items)
+    assert root == M.hash_from_byte_slices(items, device=False)
+    assert len(proofs) == n
+    for i, p in enumerate(proofs):
+        p.verify(root, items[i])  # must not raise
+        with pytest.raises(ValueError):
+            p.verify(root, b"wrong")
+        if n > 1:
+            with pytest.raises(ValueError):
+                p.verify(b"\x00" * 32, items[i])
+
+
+def test_proof_wrong_index_fails():
+    items = [b"a", b"b", b"c", b"d"]
+    root, proofs = M.proofs_from_byte_slices(items)
+    p = proofs[1]
+    p.index = 2
+    with pytest.raises(ValueError):
+        p.verify(root, items[1])
+
+
+def test_value_op_chain():
+    # A two-level store proof: value -> substore root -> app hash.
+    kvs = [(b"k1", b"v1"), (b"k2", b"v2"), (b"k3", b"v3")]
+    leaves = [k + hashlib.sha256(v).digest() for k, v in kvs]
+    sub_root, proofs = M.proofs_from_byte_slices(leaves)
+    op = M.ValueOp(b"k2", proofs[1])
+    ops = M.ProofOperators([op])
+    ops.verify(sub_root, M.key_path_to_string([b"k2"]), [b"v2"])
+    with pytest.raises(ValueError):
+        ops.verify(sub_root, M.key_path_to_string([b"k2"]), [b"bad"])
+
+
+def test_key_path_roundtrip():
+    keys = [b"plain", bytes([0x01, 0xFF]), b"with/slash"]
+    path = M.key_path_to_string(keys)
+    assert M._parse_key_path(path) == keys

@@ -1,0 +1,199 @@
+"""Storage tests: KV backends, prefix DB, block store save/load/prune,
+state store sparse validators (reference test models: db/*_test.go,
+store/store_test.go, state/store_test.go)."""
+
+import os
+
+import pytest
+
+os.environ.setdefault("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+
+from cometbft_tpu.crypto import ed25519 as host
+import cometbft_tpu.types as T
+from cometbft_tpu.store import MemDB, SQLiteDB, PrefixDB, BlockStore
+from cometbft_tpu.state import StateStore, make_genesis_state
+from cometbft_tpu.wire.canonical import Timestamp, PRECOMMIT_TYPE
+
+
+@pytest.fixture(params=["mem", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "mem":
+        return MemDB()
+    return SQLiteDB(str(tmp_path / "test.db"))
+
+
+def test_db_basic_ops(db):
+    assert db.get(b"k") is None
+    db.set(b"k", b"v")
+    assert db.get(b"k") == b"v"
+    assert db.has(b"k")
+    db.delete(b"k")
+    assert db.get(b"k") is None
+
+
+def test_db_iteration(db):
+    for i in range(10):
+        db.set(b"key%02d" % i, b"val%d" % i)
+    items = list(db.iterator(b"key03", b"key07"))
+    assert [k for k, _ in items] == [b"key03", b"key04", b"key05", b"key06"]
+    rev = list(db.reverse_iterator(b"key03", b"key07"))
+    assert [k for k, _ in rev] == [b"key06", b"key05", b"key04", b"key03"]
+
+
+def test_db_batch_atomicity(db):
+    db.set(b"a", b"1")
+    db.write_batch([(b"b", b"2"), (b"c", b"3")], deletes=[b"a"])
+    assert db.get(b"a") is None
+    assert db.get(b"b") == b"2" and db.get(b"c") == b"3"
+
+
+def test_prefix_db(db):
+    p1 = PrefixDB(db, b"one/")
+    p2 = PrefixDB(db, b"two/")
+    p1.set(b"k", b"v1")
+    p2.set(b"k", b"v2")
+    assert p1.get(b"k") == b"v1" and p2.get(b"k") == b"v2"
+    p1.set(b"k2", b"v3")
+    assert [k for k, _ in p1.iterator()] == [b"k", b"k2"]
+    assert [k for k, _ in p2.iterator()] == [b"k"]
+
+
+# ------------------------------------------------------------ block store
+
+
+def _keys(n):
+    return [host.PrivKey.from_seed(bytes([i + 1]) * 32) for i in range(n)]
+
+
+def _make_chain(n_blocks=3):
+    """A tiny valid chain of blocks with commits."""
+    keys = _keys(4)
+    vals = T.ValidatorSet([T.Validator(k.pub_key(), 10) for k in keys])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    chain_id = "store-chain"
+    blocks, part_sets, commits = [], [], []
+    last_commit = None
+    last_bid = T.BlockID()
+    ts = Timestamp(seconds=1700000000)
+    for h in range(1, n_blocks + 1):
+        header = T.Header(
+            chain_id=chain_id, height=h, time=Timestamp(seconds=1700000000 + h),
+            last_block_id=last_bid, validators_hash=vals.hash(),
+            next_validators_hash=vals.hash(), consensus_hash=b"C" * 32,
+            app_hash=b"A" * 32, proposer_address=vals.validators[0].address,
+        )
+        block = T.Block(
+            header=header, data=T.Data(txs=[b"tx-%d" % h]), last_commit=last_commit
+        )
+        block.fill_header()
+        ps = block.make_part_set(1024)
+        bid = T.BlockID(hash=block.hash(), part_set_header=ps.header)
+        sigs = []
+        for i, v in enumerate(vals.validators):
+            key = by_addr[v.address]
+            vote = T.Vote(
+                type=PRECOMMIT_TYPE, height=h, round=0, block_id=bid,
+                timestamp=ts, validator_address=v.address, validator_index=i,
+            )
+            vote.signature = key.sign(vote.sign_bytes(chain_id))
+            sigs.append(vote.to_commit_sig())
+        commit = T.Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        blocks.append(block)
+        part_sets.append(ps)
+        commits.append(commit)
+        last_commit = commit
+        last_bid = bid
+    return blocks, part_sets, commits, vals, chain_id
+
+
+def test_block_store_save_load(db):
+    blocks, part_sets, commits, vals, chain_id = _make_chain(3)
+    bs = BlockStore(db)
+    assert bs.height == 0
+    for block, ps, commit in zip(blocks, part_sets, commits):
+        bs.save_block(block, ps, commit)
+    assert bs.base == 1 and bs.height == 3
+
+    loaded = bs.load_block(2)
+    assert loaded.hash() == blocks[1].hash()
+    assert bs.load_block_by_hash(blocks[1].hash()).header.height == 2
+    meta = bs.load_block_meta(3)
+    assert meta.header.height == 3
+    # commit FOR height 2 comes from block 3's LastCommit
+    c2 = bs.load_block_commit(2)
+    assert c2.height == 2
+    sc3 = bs.load_seen_commit(3)
+    assert sc3.height == 3
+    part = bs.load_block_part(1, 0)
+    assert part is not None and part.index == 0
+
+
+def test_block_store_contiguity_enforced(db):
+    blocks, part_sets, commits, _, _ = _make_chain(3)
+    bs = BlockStore(db)
+    bs.save_block(blocks[0], part_sets[0], commits[0])
+    with pytest.raises(ValueError, match="contiguous"):
+        bs.save_block(blocks[2], part_sets[2], commits[2])
+
+
+def test_block_store_prune(db):
+    blocks, part_sets, commits, _, _ = _make_chain(3)
+    bs = BlockStore(db)
+    for block, ps, commit in zip(blocks, part_sets, commits):
+        bs.save_block(block, ps, commit)
+    pruned = bs.prune_blocks(3)
+    assert pruned == 2
+    assert bs.base == 3
+    assert bs.load_block(1) is None
+    assert bs.load_block(3) is not None
+
+
+# ------------------------------------------------------------ state store
+
+
+def _genesis_state():
+    keys = _keys(4)
+    doc = T.GenesisDoc(
+        chain_id="state-chain",
+        validators=[T.GenesisValidator("ed25519", k.pub_key().data, 10) for k in keys],
+    )
+    return make_genesis_state(doc)
+
+
+def test_state_store_roundtrip(db):
+    st = _genesis_state()
+    ss = StateStore(db)
+    assert ss.load() is None
+    ss.save(st)
+    st2 = ss.load()
+    assert st2.chain_id == "state-chain"
+    assert st2.validators.hash() == st.validators.hash()
+    assert st2.last_block_height == 0
+    assert st2.consensus_params.block.max_bytes == st.consensus_params.block.max_bytes
+
+
+def test_state_store_sparse_validators(db):
+    st = _genesis_state()
+    ss = StateStore(db)
+    ss.save(st)
+    # genesis: validators stored at initial height and height+1
+    vs1 = ss.load_validators(1)
+    assert vs1 is not None and vs1.hash() == st.validators.hash()
+    vs2 = ss.load_validators(2)
+    assert vs2 is not None and vs2.hash() == st.validators.hash()
+
+
+def test_state_store_finalize_block_response(db):
+    from cometbft_tpu.wire.abci_pb import FinalizeBlockResponse, ExecTxResult
+
+    ss = StateStore(db)
+    resp = FinalizeBlockResponse(
+        app_hash=b"H" * 32,
+        tx_results=[ExecTxResult(code=0, data=b"ok"), ExecTxResult(code=1, log="bad")],
+    )
+    ss.save_finalize_block_response(7, resp)
+    got = ss.load_finalize_block_response(7)
+    assert got.app_hash == b"H" * 32
+    assert len(got.tx_results) == 2
+    assert got.tx_results[1].code == 1
+    assert ss.load_finalize_block_response(8) is None

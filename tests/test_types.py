@@ -1,0 +1,356 @@
+"""Domain-type tests: validator sets, proposer rotation, commit
+verification (CPU backend — the TPU batch path is covered in
+test_batch_verify.py), vote sets, part sets, genesis
+(reference test models: types/validator_set_test.go,
+types/validation_test.go, types/vote_set_test.go)."""
+
+import os
+
+import pytest
+
+os.environ.setdefault("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+
+from cometbft_tpu.crypto import ed25519 as host
+import cometbft_tpu.types as T
+from cometbft_tpu.types import validation
+from cometbft_tpu.wire.canonical import Timestamp, PRECOMMIT_TYPE, PREVOTE_TYPE
+
+
+def _keys(n):
+    return [host.PrivKey.from_seed(bytes([i + 1]) * 32) for i in range(n)]
+
+
+def _valset(keys, power=10):
+    if isinstance(power, int):
+        power = [power] * len(keys)
+    return T.ValidatorSet([T.Validator(k.pub_key(), p) for k, p in zip(keys, power)])
+
+
+def _signed_commit(keys, vals, height=5, chain_id="test-chain", bad=(), absent=(), nil=()):
+    bid = T.BlockID(hash=b"B" * 32, part_set_header=T.PartSetHeader(total=2, hash=b"P" * 32))
+    ts = Timestamp(seconds=1700000000)
+    sigs = []
+    by_addr = {k.pub_key().address(): k for k in keys}
+    for i, v in enumerate(vals.validators):
+        if i in absent:
+            sigs.append(T.CommitSig.absent())
+            continue
+        key = by_addr[v.address]
+        vote_bid = T.BlockID() if i in nil else bid
+        vote = T.Vote(
+            type=PRECOMMIT_TYPE, height=height, round=0, block_id=vote_bid,
+            timestamp=ts, validator_address=v.address, validator_index=i,
+        )
+        sig = key.sign(vote.sign_bytes(chain_id))
+        if i in bad:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        vote.signature = sig
+        sigs.append(vote.to_commit_sig())
+    return bid, T.Commit(height=height, round=0, block_id=bid, signatures=sigs)
+
+
+# ----------------------------------------------------------- validator set
+
+
+def test_valset_sorted_by_power_then_address():
+    keys = _keys(4)
+    vs = _valset(keys, power=[5, 20, 10, 10])
+    powers = [v.voting_power for v in vs.validators]
+    assert powers == sorted(powers, reverse=True)
+    # equal powers sorted by address
+    equal = [v for v in vs.validators if v.voting_power == 10]
+    assert equal[0].address < equal[1].address
+
+
+def test_proposer_rotation_weighted():
+    keys = _keys(3)
+    vs = _valset(keys, power=[1, 2, 3])
+    counts = {}
+    for _ in range(60):
+        vs.increment_proposer_priority(1)
+        p = vs.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+    by_power = {v.address: v.voting_power for v in vs.validators}
+    # frequency proportional to voting power: 10/20/30 out of 60
+    for addr, count in counts.items():
+        assert count == 10 * by_power[addr]
+
+
+def test_valset_hash_changes_with_membership():
+    keys = _keys(3)
+    vs1 = _valset(keys[:2])
+    vs2 = _valset(keys)
+    assert vs1.hash() != vs2.hash()
+    assert vs1.hash() == _valset(keys[:2]).hash()
+
+
+def test_valset_update_and_remove():
+    keys = _keys(4)
+    vs = _valset(keys[:3])
+    # add a validator
+    vs.update_with_change_set([T.Validator(keys[3].pub_key(), 7)])
+    assert vs.size() == 4
+    # new validator got the -1.125*total penalty -> not immediate proposer
+    _, newv = vs.get_by_address(keys[3].pub_key().address())
+    assert newv.voting_power == 7
+    assert newv.proposer_priority < 0
+    # remove it again
+    vs.update_with_change_set([T.Validator(keys[3].pub_key(), 0)])
+    assert vs.size() == 3
+    # removing an unknown validator fails
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([T.Validator(keys[3].pub_key(), 0)])
+
+
+def test_valset_proto_roundtrip():
+    vs = _valset(_keys(3), power=[4, 5, 6])
+    vs.increment_proposer_priority(2)
+    vs2 = T.ValidatorSet.from_proto(vs.to_proto())
+    assert vs2 == vs
+    assert vs2.get_proposer().address == vs.get_proposer().address
+
+
+# ------------------------------------------------------- commit verification
+
+
+def test_verify_commit_ok():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals)
+    T.verify_commit("test-chain", vals, bid, 5, commit)
+
+
+def test_verify_commit_wrong_sig_blamed():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals, bad={2})
+    with pytest.raises(T.CommitVerificationError, match=r"wrong signature \(#2\)"):
+        T.verify_commit("test-chain", vals, bid, 5, commit)
+
+
+def test_verify_commit_insufficient_power():
+    keys = _keys(4)
+    vals = _valset(keys)  # 40 power, need > 26
+    bid, commit = _signed_commit(keys, vals, absent={0, 1})  # only 20 signed
+    with pytest.raises(T.NotEnoughVotingPowerError):
+        T.verify_commit("test-chain", vals, bid, 5, commit)
+
+
+def test_verify_commit_nil_votes_dont_count():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals, nil={0, 1})
+    # nil votes verify but don't count toward the block's power
+    with pytest.raises(T.NotEnoughVotingPowerError):
+        T.verify_commit("test-chain", vals, bid, 5, commit)
+
+
+def test_verify_commit_light_early_exit():
+    keys = _keys(4)
+    vals = _valset(keys)
+    # light verification can pass with one absent: 30 > 26
+    bid, commit = _signed_commit(keys, vals, absent={3})
+    T.verify_commit_light("test-chain", vals, bid, 5, commit)
+
+
+def test_verify_commit_light_trusting_by_address():
+    keys = _keys(6)
+    signers = keys[:4]
+    vals_signing = _valset(signers)
+    bid, commit = _signed_commit(signers, vals_signing)
+    # trusted set = subset overlap; lookup by address, need 1/3 of 20 power
+    trusted = _valset(keys[2:4] + keys[4:6])
+    T.verify_commit_light_trusting("test-chain", trusted, commit)
+
+
+def test_verify_commit_light_trusting_insufficient():
+    keys = _keys(6)
+    signers = keys[:4]
+    vals_signing = _valset(signers)
+    bid, commit = _signed_commit(signers, vals_signing)
+    trusted = _valset(keys[4:6] + [_keys(7)[6]])  # no overlap
+    with pytest.raises(T.NotEnoughVotingPowerError):
+        T.verify_commit_light_trusting("test-chain", trusted, commit)
+
+
+def test_signature_cache_dedup():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals)
+    cache = T.SignatureCache()
+    T.verify_commit_light("test-chain", vals, bid, 5, commit, cache=cache)
+    assert len(cache) > 0
+    # second call should be served from cache (works even with sigs zeroed
+    # after the cached check passes -> verify again, must not raise)
+    T.verify_commit_light("test-chain", vals, bid, 5, commit, cache=cache)
+
+
+def test_wrong_height_and_blockid_rejected():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals)
+    with pytest.raises(T.CommitVerificationError, match="wrong height"):
+        T.verify_commit("test-chain", vals, bid, 6, commit)
+    other = T.BlockID(hash=b"X" * 32, part_set_header=T.PartSetHeader(total=2, hash=b"P" * 32))
+    with pytest.raises(T.CommitVerificationError, match="wrong block ID"):
+        T.verify_commit("test-chain", vals, other, 5, commit)
+
+
+# ------------------------------------------------------------------ votes
+
+
+def test_vote_set_two_thirds():
+    keys = _keys(4)
+    vals = _valset(keys)
+    vs = T.VoteSet("test-chain", 5, 0, PREVOTE_TYPE, vals)
+    bid = T.BlockID(hash=b"B" * 32, part_set_header=T.PartSetHeader(total=1, hash=b"P" * 32))
+    ts = Timestamp(seconds=1700000000)
+    by_addr = {k.pub_key().address(): k for k in keys}
+    for i, v in enumerate(vals.validators[:3]):
+        key = by_addr[v.address]
+        vote = T.Vote(
+            type=PREVOTE_TYPE, height=5, round=0, block_id=bid, timestamp=ts,
+            validator_address=v.address, validator_index=i,
+        )
+        vote.signature = key.sign(vote.sign_bytes("test-chain"))
+        assert vs.add_vote(vote)
+        if i < 2:
+            assert not vs.has_two_thirds_majority()
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == bid
+
+
+def test_vote_set_equivocation_detected():
+    keys = _keys(4)
+    vals = _valset(keys)
+    vs = T.VoteSet("test-chain", 5, 0, PREVOTE_TYPE, vals)
+    ts = Timestamp(seconds=1700000000)
+    v0 = vals.validators[0]
+    key = next(k for k in keys if k.pub_key().address() == v0.address)
+    for h in (b"B", b"C"):
+        bid = T.BlockID(hash=h * 32, part_set_header=T.PartSetHeader(total=1, hash=b"P" * 32))
+        vote = T.Vote(
+            type=PREVOTE_TYPE, height=5, round=0, block_id=bid, timestamp=ts,
+            validator_address=v0.address, validator_index=0,
+        )
+        vote.signature = key.sign(vote.sign_bytes("test-chain"))
+        if h == b"B":
+            vs.add_vote(vote)
+        else:
+            with pytest.raises(T.vote_set.ErrVoteConflictingVotes):
+                vs.add_vote(vote)
+
+
+def test_vote_set_conflicting_vote_excluded_from_commit():
+    """A validator who precommitted a different block than maj23 must appear
+    ABSENT in the commit (vote_set.go MakeExtendedCommit exclusion rule)."""
+    keys = _keys(4)
+    vals = _valset(keys)
+    vs = T.VoteSet("test-chain", 5, 0, PRECOMMIT_TYPE, vals)
+    bid_b = T.BlockID(hash=b"B" * 32, part_set_header=T.PartSetHeader(total=1, hash=b"P" * 32))
+    bid_x = T.BlockID(hash=b"X" * 32, part_set_header=T.PartSetHeader(total=1, hash=b"P" * 32))
+    ts = Timestamp(seconds=1700000000)
+    by_addr = {k.pub_key().address(): k for k in keys}
+    for i, v in enumerate(vals.validators):
+        key = by_addr[v.address]
+        target = bid_x if i == 0 else bid_b
+        vote = T.Vote(
+            type=PRECOMMIT_TYPE, height=5, round=0, block_id=target, timestamp=ts,
+            validator_address=v.address, validator_index=i,
+        )
+        vote.signature = key.sign(vote.sign_bytes("test-chain"))
+        vs.add_vote(vote)
+    commit = vs.make_commit()
+    assert commit.block_id == bid_b
+    assert commit.signatures[0].absent_flag()
+    # the commit with the dissenter absent still verifies (30 > 26)
+    T.verify_commit("test-chain", vals, bid_b, 5, commit)
+
+
+def test_vote_set_make_commit():
+    keys = _keys(4)
+    vals = _valset(keys)
+    vs = T.VoteSet("test-chain", 5, 0, PRECOMMIT_TYPE, vals)
+    bid = T.BlockID(hash=b"B" * 32, part_set_header=T.PartSetHeader(total=1, hash=b"P" * 32))
+    ts = Timestamp(seconds=1700000000)
+    by_addr = {k.pub_key().address(): k for k in keys}
+    for i, v in enumerate(vals.validators):
+        key = by_addr[v.address]
+        vote = T.Vote(
+            type=PRECOMMIT_TYPE, height=5, round=0, block_id=bid, timestamp=ts,
+            validator_address=v.address, validator_index=i,
+        )
+        vote.signature = key.sign(vote.sign_bytes("test-chain"))
+        vs.add_vote(vote)
+    commit = vs.make_commit()
+    assert commit.block_id == bid
+    T.verify_commit("test-chain", vals, bid, 5, commit)
+
+
+# ------------------------------------------------------------- block bits
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1024  # 256 KB
+    ps = T.PartSet.from_data(data, part_size=65536)
+    assert ps.header.total == 4
+    ps2 = T.PartSet(ps.header)
+    for i in [3, 0, 2, 1]:
+        assert ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+
+
+def test_part_set_rejects_corrupt_part():
+    data = b"hello world" * 10000
+    ps = T.PartSet.from_data(data, part_size=4096)
+    ps2 = T.PartSet(ps.header)
+    part = ps.get_part(0)
+    part.bytes = b"corrupted" + part.bytes[9:]
+    with pytest.raises(ValueError):
+        ps2.add_part(part)
+
+
+def test_block_roundtrip_and_hash():
+    keys = _keys(4)
+    vals = _valset(keys)
+    bid, commit = _signed_commit(keys, vals, height=4)
+    from cometbft_tpu.state import State, make_genesis_state
+
+    header = T.Header(
+        chain_id="test-chain", height=5, time=Timestamp(seconds=1700000001),
+        last_block_id=bid, validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(), consensus_hash=b"C" * 32,
+        app_hash=b"A" * 32, proposer_address=vals.validators[0].address,
+    )
+    block = T.Block(header=header, data=T.Data(txs=[b"tx1", b"tx2"]), last_commit=commit)
+    block.fill_header()
+    block.validate_basic()
+    enc = block.encode()
+    block2 = T.Block.decode(enc)
+    assert block2.hash() == block.hash()
+    assert block2.data.txs == [b"tx1", b"tx2"]
+    block2.validate_basic()
+
+
+def test_genesis_roundtrip(tmp_path):
+    keys = _keys(3)
+    doc = T.GenesisDoc(
+        chain_id="test-chain",
+        validators=[
+            T.GenesisValidator("ed25519", k.pub_key().data, 10) for k in keys
+        ],
+    )
+    path = str(tmp_path / "genesis.json")
+    doc.save_as(path)
+    doc2 = T.GenesisDoc.load(path)
+    assert doc2.chain_id == "test-chain"
+    assert doc2.validator_hash() == doc.validator_hash()
+    assert doc2.sha256() == doc.sha256()
+
+
+def test_tx_proof():
+    txs = [b"tx-%d" % i for i in range(7)]
+    root, proof = T.tx_proof(txs, 3)
+    assert root == T.txs_hash(txs)
+    proof.verify(root, T.tx_hash(txs[3]))

@@ -1,0 +1,201 @@
+"""Wire codec tests: roundtrips, gogoproto emission semantics, and a
+differential check against the google.protobuf runtime built from
+dynamically-constructed descriptors with the same field layout
+(reference layout: proto/cometbft/types/v1/canonical.proto)."""
+
+import pytest
+
+from cometbft_tpu.wire import proto as W
+from cometbft_tpu.wire import canonical as C
+from cometbft_tpu.wire import types_pb as T
+
+
+def test_varint_roundtrip():
+    for n in [0, 1, 127, 128, 300, 2**32, 2**63 - 1, -1, -5]:
+        enc = W.encode_varint(n)
+        dec, pos = W.decode_varint(enc)
+        if n < 0:
+            assert dec == n + (1 << 64)
+        else:
+            assert dec == n
+        assert pos == len(enc)
+
+
+def test_message_roundtrip():
+    v = T.Vote(
+        type=C.PRECOMMIT_TYPE,
+        height=5,
+        round=2,
+        block_id=T.BlockID(hash=b"h" * 32, part_set_header=T.PartSetHeader(total=1, hash=b"p" * 32)),
+        timestamp=C.Timestamp(seconds=100, nanos=5),
+        validator_address=b"a" * 20,
+        validator_index=3,
+        signature=b"s" * 64,
+    )
+    enc = v.encode()
+    dec = T.Vote.decode(enc)
+    assert dec == v
+    assert dec.encode() == enc
+
+
+def test_zero_scalars_omitted_but_emit_default_messages_written():
+    # Empty commit sig: only the always-emitted timestamp appears.
+    cs = T.CommitSig()
+    enc = cs.encode()
+    # field 3 (timestamp), wire type 2, empty payload
+    assert enc == bytes([3 << 3 | 2, 0])
+
+
+def test_delimited_roundtrip():
+    ts = C.Timestamp(seconds=7, nanos=9)
+    buf = W.encode_delimited(ts) + W.encode_delimited(ts)
+    m1, pos = W.decode_delimited(C.Timestamp, buf)
+    m2, pos = W.decode_delimited(C.Timestamp, buf, pos)
+    assert m1 == ts and m2 == ts and pos == len(buf)
+
+
+def test_unknown_fields_skipped():
+    # encode a Vote, decode as Timestamp-like msg with only field 2
+    class OnlyHeight(W.Message):
+        FIELDS = [W.Field(2, "height", "varint")]
+
+    v = T.Vote(type=1, height=42, round=1, signature=b"x")
+    assert OnlyHeight.decode(v.encode()).height == 42
+
+
+def test_sfixed64_encoding():
+    cv = C.CanonicalVote(type=C.PRECOMMIT_TYPE, height=1, round=0, chain_id="t")
+    enc = cv.encode()
+    # height field 2, wire type 1 (fixed64), little-endian 1
+    assert bytes([2 << 3 | 1]) + (1).to_bytes(8, "little") in enc
+    # round == 0 omitted: no field-3 key
+    assert bytes([3 << 3 | 1]) not in enc
+
+
+def test_malformed_input_raises_value_error():
+    # length-delimited payload where a scalar is declared
+    class M(W.Message):
+        FIELDS = [W.Field(1, "x", "varint")]
+
+    bad = bytes([1 << 3 | 2, 3, 1, 2, 3])
+    with pytest.raises(ValueError):
+        M.decode(bad)
+    # truncated unknown length-delimited field
+    class N(W.Message):
+        FIELDS = [W.Field(2, "y", "varint")]
+
+    trunc = bytes([1 << 3 | 2, 100])  # claims 100 bytes, has 0
+    with pytest.raises(ValueError):
+        N.decode(trunc)
+
+
+# ------------------------------------------------- differential vs protobuf
+
+
+def _build_canonical_pool():
+    """Dynamically build canonical.proto-equivalent descriptors."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "canonical_test.proto"
+    f.package = "difftest"
+    f.syntax = "proto3"
+
+    ts = f.message_type.add()
+    ts.name = "Timestamp"
+    for i, n in ((1, "seconds"), (2, "nanos")):
+        fd = ts.field.add()
+        fd.name, fd.number = n, i
+        fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+        fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    psh = f.message_type.add()
+    psh.name = "CanonicalPartSetHeader"
+    fd = psh.field.add()
+    fd.name, fd.number = "total", 1
+    fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_UINT32
+    fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    fd = psh.field.add()
+    fd.name, fd.number = "hash", 2
+    fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+    fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    bid = f.message_type.add()
+    bid.name = "CanonicalBlockID"
+    fd = bid.field.add()
+    fd.name, fd.number = "hash", 1
+    fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+    fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    fd = bid.field.add()
+    fd.name, fd.number = "part_set_header", 2
+    fd.type = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+    fd.type_name = ".difftest.CanonicalPartSetHeader"
+    fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    cv = f.message_type.add()
+    cv.name = "CanonicalVote"
+    specs = [
+        (1, "type", descriptor_pb2.FieldDescriptorProto.TYPE_INT64, None),
+        (2, "height", descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64, None),
+        (3, "round", descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64, None),
+        (4, "block_id", descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, ".difftest.CanonicalBlockID"),
+        (5, "timestamp", descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, ".difftest.Timestamp"),
+        (6, "chain_id", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, None),
+    ]
+    for num, name, typ, tn in specs:
+        fd = cv.field.add()
+        fd.name, fd.number, fd.type = name, num, typ
+        fd.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        if tn:
+            fd.type_name = tn
+
+    pool.Add(f)
+    msgs = message_factory.GetMessages([f], pool=pool)
+    return msgs
+
+
+def test_canonical_vote_matches_protobuf_runtime():
+    msgs = _build_canonical_pool()
+    PbVote = msgs["difftest.CanonicalVote"]
+
+    pb = PbVote()
+    pb.type = C.PRECOMMIT_TYPE
+    pb.height = 12345
+    pb.round = 2
+    pb.block_id.hash = b"B" * 32
+    pb.block_id.part_set_header.total = 3
+    pb.block_id.part_set_header.hash = b"P" * 32
+    pb.timestamp.seconds = 1700000000
+    pb.timestamp.nanos = 123456789
+    pb.chain_id = "test-chain"
+    want = pb.SerializeToString(deterministic=True)
+
+    ours = C.CanonicalVote(
+        type=C.PRECOMMIT_TYPE,
+        height=12345,
+        round=2,
+        block_id=C.CanonicalBlockID(
+            hash=b"B" * 32,
+            part_set_header=C.CanonicalPartSetHeader(total=3, hash=b"P" * 32),
+        ),
+        timestamp=C.Timestamp(seconds=1700000000, nanos=123456789),
+        chain_id="test-chain",
+    ).encode()
+    assert ours == want
+
+
+def test_nil_vote_sign_bytes_structure():
+    # nil vote: no block_id; timestamp still emitted (gogo non-nullable).
+    sb = C.vote_sign_bytes(
+        "chain", C.PREVOTE_TYPE, 3, 0, None, C.Timestamp(seconds=1, nanos=0)
+    )
+    ln, pos = W.decode_varint(sb)
+    assert ln == len(sb) - pos
+    body = sb[pos:]
+    dec = C.CanonicalVote.decode(body)
+    assert dec.type == C.PREVOTE_TYPE
+    assert dec.height == 3
+    assert dec.block_id is None
+    assert dec.timestamp == C.Timestamp(seconds=1, nanos=0)
+    assert dec.chain_id == "chain"
