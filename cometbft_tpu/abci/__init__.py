@@ -1,0 +1,24 @@
+"""ABCI: the application-blockchain interface (reference: abci/).
+
+The Application interface (types.Application, 12 methods in 4 groups) is
+the process boundary between the consensus engine and the replicated
+state machine; clients/servers speak varint-delimited protobuf over a
+socket or run in-process.
+"""
+
+from .types import Application, BaseApplication, CodeTypeOK
+from .client import Client, LocalClient, UnsyncLocalClient, SocketClient
+from .server import SocketServer
+from .kvstore import KVStoreApplication
+
+__all__ = [
+    "Application",
+    "BaseApplication",
+    "CodeTypeOK",
+    "Client",
+    "LocalClient",
+    "UnsyncLocalClient",
+    "SocketClient",
+    "SocketServer",
+    "KVStoreApplication",
+]

@@ -1,0 +1,299 @@
+"""ABCI clients (reference: abci/client/).
+
+Three transports:
+  LocalClient        in-process, one shared mutex serializing every call
+                     (local_client.go) — the default for Python apps.
+  UnsyncLocalClient  in-process, no mutex; the app synchronizes itself
+                     (unsync_local_client.go).
+  SocketClient       pipelined async requests over a TCP socket speaking
+                     varint-delimited Request/Response oneof frames with
+                     strict FIFO response matching (socket_client.go:515).
+
+All clients expose the 16 methods synchronously plus check_tx_async
+(the one call sites issue concurrently: mempool broadcast) returning a
+ReqRes future, mirroring abcicli.Client's *Async/*Sync split.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections import deque
+from typing import Callable
+
+from ..utils.service import Service
+from ..wire import abci_pb as pb
+from ..wire.proto import decode_varint, encode_varint
+from .types import Application, METHODS
+
+
+class ClientError(Exception):
+    pass
+
+
+class ReqRes:
+    """A pending request/response pair (abci/client/client.go ReqRes)."""
+
+    def __init__(self, request: pb.Request):
+        self.request = request
+        self.response: pb.Response | None = None
+        self._done = threading.Event()
+        self._cb: Callable[[pb.Response], None] | None = None
+        self._mtx = threading.Lock()
+
+    def set_callback(self, cb: Callable[[pb.Response], None]) -> None:
+        with self._mtx:
+            if self.response is not None:
+                cb(self.response)
+                return
+            self._cb = cb
+
+    def set_done(self, response: pb.Response) -> None:
+        with self._mtx:
+            self.response = response
+            cb = self._cb
+        if cb:
+            cb(response)
+        self._done.set()
+
+    def wait(self, timeout: float | None = None) -> pb.Response:
+        if not self._done.wait(timeout):
+            raise ClientError("ABCI request timed out")
+        assert self.response is not None
+        return self.response
+
+
+class Client(Service):
+    """Common sync facade; subclasses implement _do(method, req_msg)."""
+
+    def _do(self, method: str, msg):
+        raise NotImplementedError
+
+    def error(self) -> Exception | None:
+        return None
+
+    # 16 sync methods
+    def echo(self, message: str) -> pb.EchoResponse:
+        return self._do("echo", pb.EchoRequest(message=message))
+
+    def flush(self) -> None:
+        self._do("flush", pb.FlushRequest())
+
+    def info(self, req: pb.InfoRequest) -> pb.InfoResponse:
+        return self._do("info", req)
+
+    def init_chain(self, req: pb.InitChainRequest) -> pb.InitChainResponse:
+        return self._do("init_chain", req)
+
+    def query(self, req: pb.QueryRequest) -> pb.QueryResponse:
+        return self._do("query", req)
+
+    def check_tx(self, req: pb.CheckTxRequest) -> pb.CheckTxResponse:
+        return self._do("check_tx", req)
+
+    def commit(self, req: pb.CommitRequest | None = None) -> pb.CommitResponse:
+        return self._do("commit", req or pb.CommitRequest())
+
+    def list_snapshots(self, req: pb.ListSnapshotsRequest) -> pb.ListSnapshotsResponse:
+        return self._do("list_snapshots", req)
+
+    def offer_snapshot(self, req: pb.OfferSnapshotRequest) -> pb.OfferSnapshotResponse:
+        return self._do("offer_snapshot", req)
+
+    def load_snapshot_chunk(
+        self, req: pb.LoadSnapshotChunkRequest
+    ) -> pb.LoadSnapshotChunkResponse:
+        return self._do("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(
+        self, req: pb.ApplySnapshotChunkRequest
+    ) -> pb.ApplySnapshotChunkResponse:
+        return self._do("apply_snapshot_chunk", req)
+
+    def prepare_proposal(
+        self, req: pb.PrepareProposalRequest
+    ) -> pb.PrepareProposalResponse:
+        return self._do("prepare_proposal", req)
+
+    def process_proposal(
+        self, req: pb.ProcessProposalRequest
+    ) -> pb.ProcessProposalResponse:
+        return self._do("process_proposal", req)
+
+    def extend_vote(self, req: pb.ExtendVoteRequest) -> pb.ExtendVoteResponse:
+        return self._do("extend_vote", req)
+
+    def verify_vote_extension(
+        self, req: pb.VerifyVoteExtensionRequest
+    ) -> pb.VerifyVoteExtensionResponse:
+        return self._do("verify_vote_extension", req)
+
+    def finalize_block(
+        self, req: pb.FinalizeBlockRequest
+    ) -> pb.FinalizeBlockResponse:
+        return self._do("finalize_block", req)
+
+    # async seam used by the mempool (socket_client pipelining)
+    def check_tx_async(self, req: pb.CheckTxRequest) -> ReqRes:
+        rr = ReqRes(pb.Request(check_tx=req))
+        resp = self._do("check_tx", req)
+        rr.set_done(pb.Response(check_tx=resp))
+        return rr
+
+
+def _dispatch(app: Application, method: str, msg):
+    if method == "echo":
+        return pb.EchoResponse(message=msg.message)
+    if method == "flush":
+        return pb.FlushResponse()
+    return getattr(app, method)(msg)
+
+
+class LocalClient(Client):
+    """In-process client; one mutex serializes all connections' calls
+    (local_client.go: shared-mutex semantics)."""
+
+    def __init__(self, app: Application, mtx: threading.RLock | None = None):
+        super().__init__("LocalClient")
+        self.app = app
+        self._app_mtx = mtx or threading.RLock()
+
+    def _do(self, method: str, msg):
+        with self._app_mtx:
+            return _dispatch(self.app, method, msg)
+
+
+class UnsyncLocalClient(Client):
+    """In-process client without locking (unsync_local_client.go) — for
+    applications that manage their own concurrency."""
+
+    def __init__(self, app: Application):
+        super().__init__("UnsyncLocalClient")
+        self.app = app
+
+    def _do(self, method: str, msg):
+        return _dispatch(self.app, method, msg)
+
+
+class SocketClient(Client):
+    """TCP client for out-of-process applications (socket_client.go).
+
+    Requests are written varint-delimited; responses return strictly in
+    order, so pending requests live in a FIFO.  A background reader thread
+    completes ReqRes futures; sync calls enqueue + wait.
+    """
+
+    def __init__(self, addr: str, must_connect: bool = True, timeout: float = 10.0):
+        super().__init__("SocketClient")
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._pending: deque[tuple[str, ReqRes]] = deque()
+        self._pending_mtx = threading.Lock()
+        self._write_mtx = threading.Lock()
+        self._err: Exception | None = None
+        self._recv_thread: threading.Thread | None = None
+        self._must_connect = must_connect
+
+    def error(self) -> Exception | None:
+        return self._err
+
+    def on_start(self) -> None:
+        import time
+
+        host, port = self.addr.rsplit(":", 1)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=self.timeout
+                )
+                break
+            except OSError:
+                # must_connect=False retries until the app comes up
+                # (socket_client.go dial retry loop), bounded by timeout
+                if self._must_connect or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+        self._sock.settimeout(None)
+        self._recv_thread = threading.Thread(
+            target=self._recv_routine, name="abci-socket-recv", daemon=True
+        )
+        self._recv_thread.start()
+
+    def on_stop(self) -> None:
+        if self._sock:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+
+    def _recv_routine(self) -> None:
+        buf = b""
+        try:
+            while True:
+                chunk = self._sock.recv(1 << 16)
+                if not chunk:
+                    raise ClientError("ABCI socket closed by server")
+                buf += chunk
+                while True:
+                    try:
+                        ln, pos = decode_varint(buf)
+                    except ValueError as e:
+                        if "truncated" in str(e):
+                            break  # need more bytes
+                        raise ClientError(f"malformed response length prefix: {e}")
+                    if len(buf) - pos < ln:
+                        break
+                    frame, buf = buf[pos : pos + ln], buf[pos + ln :]
+                    self._on_response(pb.Response.decode(frame))
+        except Exception as e:  # noqa: BLE001 - propagate as client error
+            self._err = self._err or e
+            with self._pending_mtx:
+                pending, self._pending = list(self._pending), deque()
+            for _, rr in pending:
+                rr.set_done(pb.Response(exception=pb.ExceptionResponse(error=str(e))))
+
+    def _on_response(self, resp: pb.Response) -> None:
+        which = resp.which()
+        with self._pending_mtx:
+            if not self._pending:
+                self._err = ClientError(f"unexpected response {which}")
+                return
+            method, rr = self._pending.popleft()
+        want = METHODS[method][1]
+        if which not in (want, "exception"):
+            self._err = ClientError(f"response {which} for request {method}")
+        rr.set_done(resp)
+
+    def _queue(self, method: str, msg) -> ReqRes:
+        if self._err:
+            raise ClientError(f"ABCI client failed: {self._err}")
+        req = pb.Request(**{METHODS[method][0]: msg})
+        rr = ReqRes(req)
+        with self._write_mtx:
+            with self._pending_mtx:
+                self._pending.append((method, rr))
+            payload = req.encode()
+            self._sock.sendall(encode_varint(len(payload)) + payload)
+        return rr
+
+    def _do(self, method: str, msg):
+        rr = self._queue(method, msg)
+        # flush after every sync request so the server's buffered reader
+        # can't hold our frame (reference sends Flush the same way)
+        if method != "flush":
+            self._queue("flush", pb.FlushRequest())
+        # sync calls wait as long as the app takes (a FinalizeBlock on a big
+        # block may exceed any fixed timeout; the reference blocks too) —
+        # connection death completes the future with an exception instead
+        resp = rr.wait(None)
+        if resp.exception is not None:
+            raise ClientError(resp.exception.error)
+        return resp.value()
+
+    def check_tx_async(self, req: pb.CheckTxRequest) -> ReqRes:
+        rr = self._queue("check_tx", req)
+        self._queue("flush", pb.FlushRequest())
+        return rr

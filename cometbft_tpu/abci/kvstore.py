@@ -1,0 +1,393 @@
+"""KVStore demo application (reference: abci/example/kvstore/kvstore.go).
+
+Behavior-compatible with the reference app:
+  - txs are "key=value" or "key:value" (exactly one separator, non-empty
+    key/value ends)
+  - validator-change txs: "val=<keytype>!<base64 pubkey>!<power>"
+    (kvstore.go:541-568)
+  - mempool lanes: val=9, foo=7, default=3, bar=1, assigned by key modulo
+    (DefaultLanes kvstore.go:117, assignLane:208)
+  - app hash = signed-varint(state.Size) zero-padded to 8 bytes
+    (State.Hash kvstore.go:669-673)
+  - Query paths: "/key" (value lookup), "/val" (validator lookup)
+  - FinalizeBlock stages; Commit persists — crash between them loses
+    nothing because the engine replays the block.
+
+Adds optional whole-state snapshots (one chunk) so statesync paths are
+testable against a real app; the reference's kvstore defers that to the
+e2e app.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+from ..crypto import ed25519
+from ..store.db import DB, MemDB, _prefix_end
+from ..wire import abci_pb as pb
+from .types import Application, CodeTypeOK
+
+CodeTypeInvalidTxFormat = 2
+
+VALIDATOR_PREFIX = "val="  # kvstore.go:29
+DEFAULT_LANE = "default"
+KV_PREFIX = b"kvPairKey:"
+STATE_KEY = b"appstate"
+
+APP_VERSION = 1
+
+
+def default_lanes() -> dict[str, int]:
+    return {"val": 9, "foo": 7, DEFAULT_LANE: 3, "bar": 1}
+
+
+def is_validator_tx(tx: bytes) -> bool:
+    return tx.startswith(VALIDATOR_PREFIX.encode())
+
+
+def parse_validator_tx(tx: bytes) -> tuple[str, bytes, int]:
+    parts = tx[len(VALIDATOR_PREFIX):].decode("utf-8", "replace").split("!")
+    if len(parts) != 3:
+        raise ValueError(f"expected 'pubkeytype!pubkey!power', got {parts}")
+    key_type, pub_b64, power_s = parts
+    pubkey = base64.b64decode(pub_b64, validate=True)
+    power = int(power_s)
+    if power < 0:
+        raise ValueError(f"power cannot be negative, got {power}")
+    return key_type, pubkey, power
+
+
+def make_val_set_change_tx(pubkey: bytes, power: int, key_type: str = ed25519.KEY_TYPE) -> bytes:
+    return (
+        VALIDATOR_PREFIX
+        + key_type
+        + "!"
+        + base64.b64encode(pubkey).decode()
+        + "!"
+        + str(power)
+    ).encode()
+
+
+def is_valid_tx(tx: bytes) -> bool:
+    for sep in (b":", b"="):
+        other = b"=" if sep == b":" else b":"
+        if tx.count(sep) == 1 and tx.count(other) == 0:
+            return not (tx.startswith(sep) or tx.endswith(sep))
+    return False
+
+
+def parse_tx(tx: bytes) -> tuple[str, str]:
+    parts = tx.split(b"=")
+    if len(parts) != 2 or not parts[0]:
+        raise ValueError(f"invalid tx format: {tx!r}")
+    return parts[0].decode("utf-8", "replace"), parts[1].decode("utf-8", "replace")
+
+
+def assign_lane(tx: bytes) -> str:
+    if is_validator_tx(tx):
+        return "val"
+    try:
+        key, _ = parse_tx(tx)
+        key_int = int(key)
+    except ValueError:
+        return DEFAULT_LANE
+    if key_int % 11 == 0:
+        return "foo"
+    if key_int % 3 == 0:
+        return "bar"
+    return DEFAULT_LANE
+
+
+def _iter_prefix(db: DB, prefix: bytes):
+    return db.iterator(prefix, _prefix_end(prefix)) if prefix else db.iterator()
+
+
+def _size_hash(size: int) -> bytes:
+    # binary.PutVarint into an 8-byte buffer: zigzag varint, zero-padded
+    z = (size << 1) ^ (size >> 63) if size >= 0 else ((-size) << 1) - 1
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    return bytes(out.ljust(8, b"\x00"))
+
+
+class KVStoreApplication(Application):
+    def __init__(self, db: DB | None = None, lanes: dict[str, int] | None = default_lanes()):
+        self.db = db if db is not None else MemDB()
+        self.lane_priorities = dict(lanes) if lanes else {}
+        self._mtx = threading.RLock()
+        self.size = 0
+        self.height = 0
+        self.staged_txs: list[bytes] = []
+        self.val_updates: list[pb.ValidatorUpdate] = []
+        self.val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
+        self.gen_block_events = False
+        self.next_block_delay_ms = 0
+        self._restoring: pb.Snapshot | None = None
+        self._load_state()
+
+    # ------------------------------------------------------------- state
+
+    def _load_state(self) -> None:
+        raw = self.db.get(STATE_KEY)
+        if raw:
+            st = json.loads(raw)
+            self.size, self.height = st["size"], st["height"]
+        for k, v in _iter_prefix(self.db, VALIDATOR_PREFIX.encode()):
+            addr = k[len(VALIDATOR_PREFIX):]
+            key_type, pub_b64, _ = v.decode().split("!")
+            self.val_addr_to_pubkey[addr] = (key_type, base64.b64decode(pub_b64))
+
+    def _save_state(self) -> None:
+        self.db.set(STATE_KEY, json.dumps({"size": self.size, "height": self.height}).encode())
+
+    def app_hash(self) -> bytes:
+        return _size_hash(self.size)
+
+    # -------------------------------------------------------- info/query
+
+    def info(self, req):
+        resp = pb.InfoResponse(
+            data=json.dumps({"size": self.size}),
+            version="kvstore-tpu/0.1",
+            app_version=APP_VERSION,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash() if self.height else b"",
+            default_lane=DEFAULT_LANE if self.lane_priorities else "",
+        )
+        if self.lane_priorities:
+            resp.set_lane_priorities(self.lane_priorities)
+        return resp
+
+    def query(self, req):
+        with self._mtx:
+            if req.path == "/val":
+                v = self.db.get(VALIDATOR_PREFIX.encode() + req.data)
+                return pb.QueryResponse(key=req.data, value=v or b"", height=self.height)
+            v = self.db.get(KV_PREFIX + req.data)
+            if v is None:
+                return pb.QueryResponse(code=CodeTypeOK, log="does not exist", height=self.height)
+            return pb.QueryResponse(
+                code=CodeTypeOK, log="exists", key=req.data, value=v, height=self.height
+            )
+
+    # ----------------------------------------------------------- mempool
+
+    def check_tx(self, req):
+        tx = req.tx
+        if is_validator_tx(tx):
+            try:
+                parse_validator_tx(tx)
+            except ValueError:
+                return pb.CheckTxResponse(code=CodeTypeInvalidTxFormat)
+        elif not is_valid_tx(tx):
+            return pb.CheckTxResponse(code=CodeTypeInvalidTxFormat)
+        if not self.lane_priorities:
+            return pb.CheckTxResponse(code=CodeTypeOK, gas_wanted=1)
+        return pb.CheckTxResponse(code=CodeTypeOK, gas_wanted=1, lane_id=assign_lane(tx))
+
+    # --------------------------------------------------------- consensus
+
+    def init_chain(self, req):
+        with self._mtx:
+            for v in req.validators:
+                self._update_validator(v)
+            self.staged_txs = []
+            self.val_updates = []
+            return pb.InitChainResponse(app_hash=self.app_hash())
+
+    def prepare_proposal(self, req):
+        # normalize "key:value" to "key=value" (kvstore.go formatTxs),
+        # respecting max_tx_bytes
+        total, txs = 0, []
+        for tx in req.txs:
+            out = tx if is_validator_tx(tx) else tx.replace(b":", b"=")
+            total += len(out)
+            if req.max_tx_bytes and total > req.max_tx_bytes:
+                break
+            txs.append(out)
+        return pb.PrepareProposalResponse(txs=txs)
+
+    def process_proposal(self, req):
+        for tx in req.txs:
+            if is_validator_tx(tx):
+                try:
+                    parse_validator_tx(tx)
+                except ValueError:
+                    return pb.ProcessProposalResponse(status=pb.PROCESS_PROPOSAL_STATUS_REJECT)
+            # proposals must carry normalized "=" txs only
+            elif not is_valid_tx(tx) or b"=" not in tx:
+                return pb.ProcessProposalResponse(status=pb.PROCESS_PROPOSAL_STATUS_REJECT)
+        return pb.ProcessProposalResponse(status=pb.PROCESS_PROPOSAL_STATUS_ACCEPT)
+
+    def finalize_block(self, req):
+        with self._mtx:
+            self.val_updates = []
+            self.staged_txs = []
+
+            # punish double-voters by docking one power (kvstore.go:316-334)
+            for ev in req.misbehavior:
+                if ev.type != pb.MISBEHAVIOR_TYPE_DUPLICATE_VOTE:
+                    continue
+                known = self.val_addr_to_pubkey.get(ev.validator.address)
+                if known:
+                    key_type, pubkey = known
+                    self.val_updates.append(
+                        pb.ValidatorUpdate(
+                            power=max(ev.validator.power - 1, 0),
+                            pub_key_type=key_type,
+                            pub_key_bytes=pubkey,
+                        )
+                    )
+
+            tx_results = []
+            for tx in req.txs:
+                if is_validator_tx(tx):
+                    key_type, pubkey, power = parse_validator_tx(tx)
+                    self.val_updates.append(
+                        pb.ValidatorUpdate(
+                            power=power, pub_key_type=key_type, pub_key_bytes=pubkey
+                        )
+                    )
+                    key = value = tx.decode("utf-8", "replace")
+                else:
+                    # stage normalized to "key=value"; colon-form txs reach
+                    # here when the proposer didn't run our prepare_proposal
+                    norm = tx if b"=" in tx else tx.replace(b":", b"=")
+                    try:
+                        key, value = parse_tx(norm)
+                        self.staged_txs.append(norm)
+                    except ValueError:
+                        key = value = tx.decode("utf-8", "replace")
+                tx_results.append(
+                    pb.ExecTxResult(
+                        code=CodeTypeOK,
+                        events=[
+                            pb.Event(
+                                type="app",
+                                attributes=[
+                                    pb.EventAttribute(key="key", value=key, index=True),
+                                    pb.EventAttribute(key="value", value=value, index=True),
+                                ],
+                            )
+                        ],
+                    )
+                )
+                self.size += 1
+
+            self.height = req.height
+            return pb.FinalizeBlockResponse(
+                tx_results=tx_results,
+                validator_updates=list(self.val_updates),
+                app_hash=self.app_hash(),
+                next_block_delay=pb.Duration.from_ns(self.next_block_delay_ms * 1_000_000)
+                if self.next_block_delay_ms
+                else None,
+            )
+
+    def commit(self, req):
+        with self._mtx:
+            for v in self.val_updates:
+                self._update_validator(v)
+            for tx in self.staged_txs:  # staged txs are already normalized
+                key, value = parse_tx(tx)
+                self.db.set(KV_PREFIX + key.encode(), value.encode())
+            self._save_state()
+            return pb.CommitResponse()
+
+    def _update_validator(self, v: pb.ValidatorUpdate) -> None:
+        pub = ed25519.PubKey(v.pub_key_bytes)
+        addr = pub.address()
+        key = VALIDATOR_PREFIX.encode() + addr
+        if v.power == 0:
+            self.db.delete(key)
+            self.val_addr_to_pubkey.pop(addr, None)
+        else:
+            record = f"{v.pub_key_type}!{base64.b64encode(v.pub_key_bytes).decode()}!{v.power}"
+            self.db.set(key, record.encode())
+            self.val_addr_to_pubkey[addr] = (v.pub_key_type, v.pub_key_bytes)
+
+    def get_validators(self) -> list[pb.ValidatorUpdate]:
+        out = []
+        for _, v in _iter_prefix(self.db, VALIDATOR_PREFIX.encode()):
+            key_type, pub_b64, power = v.decode().split("!")
+            out.append(
+                pb.ValidatorUpdate(
+                    power=int(power),
+                    pub_key_type=key_type,
+                    pub_key_bytes=base64.b64decode(pub_b64),
+                )
+            )
+        return out
+
+    # ---------------------------------------------------------- snapshot
+
+    SNAPSHOT_FORMAT = 1
+
+    def _snapshot_payload(self) -> bytes:
+        items = {
+            k.decode("latin1"): v.decode("latin1")
+            for k, v in _iter_prefix(self.db, b"")
+        }
+        return json.dumps({"items": items}, sort_keys=True).encode()
+
+    def list_snapshots(self, req):
+        if self.height == 0:
+            return pb.ListSnapshotsResponse()
+        payload = self._snapshot_payload()
+        from ..crypto import hash as tmhash
+
+        return pb.ListSnapshotsResponse(
+            snapshots=[
+                pb.Snapshot(
+                    height=self.height,
+                    format=self.SNAPSHOT_FORMAT,
+                    chunks=1,
+                    hash=tmhash.sum_sha256(payload),
+                )
+            ]
+        )
+
+    def offer_snapshot(self, req):
+        if req.snapshot is None or req.snapshot.format != self.SNAPSHOT_FORMAT:
+            return pb.OfferSnapshotResponse(result=pb.OFFER_SNAPSHOT_RESULT_REJECT_FORMAT)
+        self._restoring = req.snapshot
+        return pb.OfferSnapshotResponse(result=pb.OFFER_SNAPSHOT_RESULT_ACCEPT)
+
+    def load_snapshot_chunk(self, req):
+        if req.chunk != 0 or req.height != self.height:
+            return pb.LoadSnapshotChunkResponse()
+        return pb.LoadSnapshotChunkResponse(chunk=self._snapshot_payload())
+
+    def apply_snapshot_chunk(self, req):
+        with self._mtx:
+            snap = self._restoring
+            if snap is None:
+                return pb.ApplySnapshotChunkResponse(
+                    result=pb.APPLY_SNAPSHOT_CHUNK_RESULT_ABORT
+                )
+            from ..crypto import hash as tmhash
+
+            if tmhash.sum_sha256(req.chunk) != snap.hash:
+                return pb.ApplySnapshotChunkResponse(
+                    result=pb.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY,
+                    refetch_chunks=[req.index],
+                    reject_senders=[req.sender] if req.sender else [],
+                )
+            st = json.loads(req.chunk)
+            for k, v in st["items"].items():
+                self.db.set(k.encode("latin1"), v.encode("latin1"))
+            self.val_addr_to_pubkey = {}
+            self.size = 0
+            self.height = 0
+            self._load_state()
+            self._restoring = None
+        return pb.ApplySnapshotChunkResponse(result=pb.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT)

@@ -1,0 +1,126 @@
+"""ABCI socket server for out-of-process applications
+(reference: abci/server/socket_server.go:334).
+
+Accepts connections, reads varint-delimited Request frames, dispatches to
+the Application, and writes Response frames in order.  Each connection
+gets its own handler thread — the engine opens four (consensus, mempool,
+query, snapshot), which this serves concurrently like the reference.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..utils.service import Service
+from ..wire import abci_pb as pb
+from ..wire.proto import decode_varint, encode_varint
+from .types import Application, METHODS
+
+
+class SocketServer(Service):
+    def __init__(self, addr: str, app: Application):
+        super().__init__("ABCIServer")
+        self.app = app
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._listener: socket.socket | None = None
+        self._conns: list[socket.socket] = []
+        self._app_mtx = threading.RLock()
+
+    @property
+    def laddr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def on_start(self) -> None:
+        self._listener = socket.create_server(
+            (self._host, self._port), reuse_port=False
+        )
+        self._port = self._listener.getsockname()[1]
+        threading.Thread(
+            target=self._accept_routine, name="abci-accept", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        if self._listener:
+            self._listener.close()
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+
+    def _accept_routine(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        out = bytearray()
+        try:
+            while True:
+                chunk = conn.recv(1 << 16)
+                if not chunk:
+                    return
+                buf += chunk
+                del out[:]
+                bad_frame = False
+                while True:
+                    try:
+                        ln, pos = decode_varint(buf)
+                    except ValueError as e:
+                        if "truncated" in str(e):
+                            break  # need more bytes
+                        bad_frame = True  # malformed length prefix
+                        break
+                    if len(buf) - pos < ln:
+                        break
+                    frame, buf = buf[pos : pos + ln], buf[pos + ln :]
+                    try:
+                        req = pb.Request.decode(frame)
+                    except ValueError as e:
+                        # framing is lost beyond this point: answer what we
+                        # already executed, report, and drop the connection
+                        # (reference responds with exception then closes)
+                        resp = pb.Response(
+                            exception=pb.ExceptionResponse(error=f"bad request frame: {e}")
+                        )
+                        payload = resp.encode()
+                        out += encode_varint(len(payload)) + payload
+                        bad_frame = True
+                        break
+                    resp = self._handle_request(req)
+                    payload = resp.encode()
+                    out += encode_varint(len(payload)) + payload
+                if out:
+                    conn.sendall(bytes(out))
+                if bad_frame:
+                    return
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    def _handle_request(self, req: pb.Request) -> pb.Response:
+        which = req.which()
+        if which is None:
+            return pb.Response(exception=pb.ExceptionResponse(error="empty request"))
+        if which == "echo":
+            return pb.Response(echo=pb.EchoResponse(message=req.echo.message))
+        if which == "flush":
+            return pb.Response(flush=pb.FlushResponse())
+        method = next(m for m, (rq, _) in METHODS.items() if rq == which)
+        try:
+            with self._app_mtx:
+                result = getattr(self.app, method)(req.value())
+            return pb.Response(**{METHODS[method][1]: result})
+        except Exception as e:  # noqa: BLE001 - app errors cross the wire
+            return pb.Response(exception=pb.ExceptionResponse(error=str(e)))

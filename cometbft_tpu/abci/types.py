@@ -1,0 +1,166 @@
+"""Application interface + no-op base (reference: abci/types/application.go:11-41,48).
+
+Twelve methods in four connection groups:
+  Info/Query:   info, query
+  Mempool:      check_tx
+  Consensus:    init_chain, prepare_proposal, process_proposal,
+                finalize_block, extend_vote, verify_vote_extension, commit
+  Statesync:    list_snapshots, offer_snapshot, load_snapshot_chunk,
+                apply_snapshot_chunk
+
+Requests/responses are the wire messages themselves (wire/abci_pb.py);
+there is no separate domain layer — the reference's generated structs play
+both roles too.
+"""
+
+from __future__ import annotations
+
+from ..wire import abci_pb as pb
+
+CodeTypeOK = 0
+
+
+class Application:
+    """Any finite deterministic state machine, replicated by the engine."""
+
+    # Info/Query connection
+    def info(self, req: pb.InfoRequest) -> pb.InfoResponse:
+        raise NotImplementedError
+
+    def query(self, req: pb.QueryRequest) -> pb.QueryResponse:
+        raise NotImplementedError
+
+    # Mempool connection
+    def check_tx(self, req: pb.CheckTxRequest) -> pb.CheckTxResponse:
+        raise NotImplementedError
+
+    # Consensus connection
+    def init_chain(self, req: pb.InitChainRequest) -> pb.InitChainResponse:
+        raise NotImplementedError
+
+    def prepare_proposal(
+        self, req: pb.PrepareProposalRequest
+    ) -> pb.PrepareProposalResponse:
+        raise NotImplementedError
+
+    def process_proposal(
+        self, req: pb.ProcessProposalRequest
+    ) -> pb.ProcessProposalResponse:
+        raise NotImplementedError
+
+    def finalize_block(
+        self, req: pb.FinalizeBlockRequest
+    ) -> pb.FinalizeBlockResponse:
+        raise NotImplementedError
+
+    def extend_vote(self, req: pb.ExtendVoteRequest) -> pb.ExtendVoteResponse:
+        raise NotImplementedError
+
+    def verify_vote_extension(
+        self, req: pb.VerifyVoteExtensionRequest
+    ) -> pb.VerifyVoteExtensionResponse:
+        raise NotImplementedError
+
+    def commit(self, req: pb.CommitRequest) -> pb.CommitResponse:
+        raise NotImplementedError
+
+    # Statesync connection
+    def list_snapshots(
+        self, req: pb.ListSnapshotsRequest
+    ) -> pb.ListSnapshotsResponse:
+        raise NotImplementedError
+
+    def offer_snapshot(
+        self, req: pb.OfferSnapshotRequest
+    ) -> pb.OfferSnapshotResponse:
+        raise NotImplementedError
+
+    def load_snapshot_chunk(
+        self, req: pb.LoadSnapshotChunkRequest
+    ) -> pb.LoadSnapshotChunkResponse:
+        raise NotImplementedError
+
+    def apply_snapshot_chunk(
+        self, req: pb.ApplySnapshotChunkRequest
+    ) -> pb.ApplySnapshotChunkResponse:
+        raise NotImplementedError
+
+
+class BaseApplication(Application):
+    """No-op base returning sane defaults (application.go:48-110);
+    accept-all proposals, empty results."""
+
+    def info(self, req):
+        return pb.InfoResponse()
+
+    def query(self, req):
+        return pb.QueryResponse(code=CodeTypeOK)
+
+    def check_tx(self, req):
+        return pb.CheckTxResponse(code=CodeTypeOK)
+
+    def init_chain(self, req):
+        return pb.InitChainResponse()
+
+    def prepare_proposal(self, req):
+        # default: keep txs up to the size limit (application.go:84-96)
+        total, txs = 0, []
+        for tx in req.txs:
+            total += len(tx)
+            if req.max_tx_bytes and total > req.max_tx_bytes:
+                break
+            txs.append(tx)
+        return pb.PrepareProposalResponse(txs=txs)
+
+    def process_proposal(self, req):
+        return pb.ProcessProposalResponse(status=pb.PROCESS_PROPOSAL_STATUS_ACCEPT)
+
+    def finalize_block(self, req):
+        return pb.FinalizeBlockResponse(
+            tx_results=[pb.ExecTxResult(code=CodeTypeOK) for _ in req.txs]
+        )
+
+    def extend_vote(self, req):
+        return pb.ExtendVoteResponse()
+
+    def verify_vote_extension(self, req):
+        return pb.VerifyVoteExtensionResponse(
+            status=pb.VERIFY_VOTE_EXTENSION_STATUS_ACCEPT
+        )
+
+    def commit(self, req):
+        return pb.CommitResponse()
+
+    def list_snapshots(self, req):
+        return pb.ListSnapshotsResponse()
+
+    def offer_snapshot(self, req):
+        return pb.OfferSnapshotResponse()
+
+    def load_snapshot_chunk(self, req):
+        return pb.LoadSnapshotChunkResponse()
+
+    def apply_snapshot_chunk(self, req):
+        return pb.ApplySnapshotChunkResponse()
+
+
+# method name -> (request oneof field, response oneof field); used by the
+# socket client/server to route oneof frames.
+METHODS = {
+    "echo": ("echo", "echo"),
+    "flush": ("flush", "flush"),
+    "info": ("info", "info"),
+    "init_chain": ("init_chain", "init_chain"),
+    "query": ("query", "query"),
+    "check_tx": ("check_tx", "check_tx"),
+    "commit": ("commit", "commit"),
+    "list_snapshots": ("list_snapshots", "list_snapshots"),
+    "offer_snapshot": ("offer_snapshot", "offer_snapshot"),
+    "load_snapshot_chunk": ("load_snapshot_chunk", "load_snapshot_chunk"),
+    "apply_snapshot_chunk": ("apply_snapshot_chunk", "apply_snapshot_chunk"),
+    "prepare_proposal": ("prepare_proposal", "prepare_proposal"),
+    "process_proposal": ("process_proposal", "process_proposal"),
+    "extend_vote": ("extend_vote", "extend_vote"),
+    "verify_vote_extension": ("verify_vote_extension", "verify_vote_extension"),
+    "finalize_block": ("finalize_block", "finalize_block"),
+}

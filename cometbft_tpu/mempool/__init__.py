@@ -1,0 +1,27 @@
+"""Mempool: pending-transaction pool with priority lanes
+(reference: mempool/).
+"""
+
+from .mempool import (
+    Mempool,
+    MempoolError,
+    TxInCacheError,
+    MempoolFullError,
+    PreCheckMaxBytes,
+)
+from .clist_mempool import CListMempool, MempoolConfig
+from .nop import NopMempool
+from .cache import LRUTxCache, NopTxCache
+
+__all__ = [
+    "Mempool",
+    "MempoolError",
+    "TxInCacheError",
+    "MempoolFullError",
+    "PreCheckMaxBytes",
+    "CListMempool",
+    "MempoolConfig",
+    "NopMempool",
+    "LRUTxCache",
+    "NopTxCache",
+]

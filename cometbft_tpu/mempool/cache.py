@@ -1,0 +1,61 @@
+"""Tx caches (reference: mempool/cache.go).
+
+LRUTxCache remembers recently seen tx keys so repeated broadcasts don't
+hit the app's CheckTx again; NopTxCache disables caching.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class LRUTxCache:
+    def __init__(self, size: int):
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._mtx = threading.Lock()
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+    def push(self, key: bytes) -> bool:
+        """Returns False if the key was already present (it is refreshed)."""
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, key: bytes) -> None:
+        with self._mtx:
+            self._map.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        with self._mtx:
+            return key in self._map
+
+    def __len__(self):
+        with self._mtx:
+            return len(self._map)
+
+
+class NopTxCache:
+    def reset(self) -> None:
+        pass
+
+    def push(self, key: bytes) -> bool:
+        return True
+
+    def remove(self, key: bytes) -> None:
+        pass
+
+    def has(self, key: bytes) -> bool:
+        return False
+
+    def __len__(self):
+        return 0

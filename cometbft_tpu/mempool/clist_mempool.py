@@ -1,0 +1,360 @@
+"""Lane-aware concurrent mempool (reference: mempool/clist_mempool.go).
+
+Txs are admitted through the app's CheckTx on the mempool ABCI connection
+and queued into priority lanes (lane = app-defined tx class; CheckTx
+assigns it, clist_mempool.go:57-94).  Iteration — for both block reaping
+and gossip — interleaves lanes with Interleaved Weighted Round-Robin so a
+lane of priority p yields p entries per p-round cycle
+(mempool/iterators.go:38-44).  An LRU cache short-circuits repeated
+CheckTx for recently seen txs.
+
+Python threading notes: one RLock guards the lanes (the reference's
+per-CList fine-grained locking buys nothing under the GIL); update()
+runs with the consensus engine holding lock() exactly like the
+reference's Lock/Update/Unlock window.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+from ..utils.log import get_logger
+from ..wire import abci_pb as pb
+from ..wire.proto import encode_varint
+from .cache import LRUTxCache, NopTxCache
+from .mempool import (
+    AppCheckError,
+    Mempool,
+    MempoolFullError,
+    TxInCacheError,
+    TxInMempoolError,
+    key_of,
+)
+
+
+@dataclass
+class MempoolConfig:
+    """config.MempoolConfig defaults (config/config.go mempool section)."""
+
+    size: int = 5000
+    max_tx_bytes: int = 1024 * 1024
+    max_txs_bytes: int = 64 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    recheck: bool = True
+    broadcast: bool = True
+
+
+@dataclass
+class TxEntry:
+    tx: bytes
+    key: bytes
+    height: int
+    gas_wanted: int
+    lane: str
+    senders: set[str] = field(default_factory=set)
+
+    def size(self) -> int:
+        return len(self.tx)
+
+
+def proto_tx_overhead(tx: bytes) -> int:
+    """Wire size of one tx as a repeated-bytes field in Data
+    (types.ComputeProtoSizeForTxs): tag byte + length varint + payload."""
+    return 1 + len(encode_varint(len(tx))) + len(tx)
+
+
+class IWRRIterator:
+    """Interleaved weighted round-robin over lane snapshots
+    (iterators.go IWRRIterator)."""
+
+    def __init__(self, lanes: dict[str, list[TxEntry]], priorities: dict[str, int]):
+        # highest priority first; stable for equal priorities
+        self._sorted = sorted(priorities.items(), key=lambda kv: -kv[1])
+        self._queues = {lane: list(entries) for lane, entries in lanes.items()}
+        self._pos = {lane: 0 for lane in lanes}
+        self._round = 1
+        self._lane_index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> TxEntry:
+        if not self._sorted:
+            raise StopIteration
+        empty = 0
+        while True:
+            lane, priority = self._sorted[self._lane_index]
+            q, p = self._queues.get(lane, []), self._pos.get(lane, 0)
+            if p >= len(q):
+                empty += 1
+                if empty >= len(self._sorted):
+                    raise StopIteration
+                self._advance()
+                continue
+            if priority < self._round:
+                empty = 0
+                self._advance()
+                continue
+            break
+        entry = q[p]
+        self._pos[lane] = p + 1
+        self._advance()
+        return entry
+
+    def _advance(self) -> None:
+        self._lane_index += 1
+        if self._lane_index >= len(self._sorted):
+            self._lane_index = 0
+            self._round += 1
+            max_p = self._sorted[0][1] if self._sorted else 1
+            if self._round > max_p:
+                self._round = 1
+
+
+class CListMempool(Mempool):
+    def __init__(
+        self,
+        config: MempoolConfig,
+        proxy_app,  # abci Client on the mempool connection
+        height: int = 0,
+        lane_priorities: dict[str, int] | None = None,
+        default_lane: str = "",
+        pre_check: Callable[[bytes], None] | None = None,
+    ):
+        self.config = config
+        self.proxy_app = proxy_app
+        self.height = height
+        self.logger = get_logger("mempool")
+        if not lane_priorities:
+            lane_priorities, default_lane = {"": 1}, ""
+        if default_lane not in lane_priorities:
+            raise ValueError(f"default lane {default_lane!r} not in lane set")
+        self.lane_priorities = dict(lane_priorities)
+        self.default_lane = default_lane
+        self.lanes: dict[str, OrderedDict[bytes, TxEntry]] = {
+            lane: OrderedDict() for lane in lane_priorities
+        }
+        self._tx_index: dict[bytes, str] = {}  # key -> lane
+        self._bytes = 0
+        self._mtx = threading.RLock()
+        self._update_mtx = threading.RLock()  # the consensus Lock/Unlock
+        self.cache = (
+            LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
+        )
+        self.pre_check = pre_check
+        self._txs_available = threading.Event()
+        self._notify_available = False
+        self._notified_this_height = False
+
+    # ------------------------------------------------------------ admission
+
+    def check_tx(self, tx: bytes, sender: str = "") -> None:
+        if len(tx) > self.config.max_tx_bytes:
+            raise AppCheckError(
+                code=-1, log=f"tx too large: {len(tx)} > {self.config.max_tx_bytes}"
+            )
+        if self.pre_check:
+            self.pre_check(tx)
+        key = key_of(tx)
+        if not self.cache.push(key):
+            # record the additional sender for dedup accounting, then reject
+            with self._mtx:
+                lane = self._tx_index.get(key)
+                if lane is not None:
+                    entry = self.lanes[lane].get(key)
+                    if entry is not None and sender:
+                        entry.senders.add(sender)
+                    raise TxInMempoolError
+            raise TxInCacheError
+        try:
+            res = self.proxy_app.check_tx(
+                pb.CheckTxRequest(tx=tx, type=pb.CHECK_TX_TYPE_CHECK)
+            )
+        except Exception:
+            self.cache.remove(key)
+            raise
+        self._handle_check_result(tx, key, sender, res)
+
+    def _handle_check_result(
+        self, tx: bytes, key: bytes, sender: str, res: pb.CheckTxResponse
+    ) -> None:
+        if res.code != 0:
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            raise AppCheckError(code=res.code, log=res.log, codespace=res.codespace)
+        lane = res.lane_id or self.default_lane
+        if lane not in self.lanes:
+            lane = self.default_lane
+        with self._mtx:
+            if key in self._tx_index:
+                raise TxInMempoolError
+            if (
+                len(self._tx_index) >= self.config.size
+                or self._bytes + len(tx) > self.config.max_txs_bytes
+            ):
+                self.cache.remove(key)
+                raise MempoolFullError(len(self._tx_index), self._bytes)
+            entry = TxEntry(
+                tx=tx,
+                key=key,
+                height=self.height,
+                gas_wanted=res.gas_wanted,
+                lane=lane,
+                senders={sender} if sender else set(),
+            )
+            self.lanes[lane][key] = entry
+            self._tx_index[key] = lane
+            self._bytes += len(tx)
+            self._maybe_notify()
+
+    # ------------------------------------------------------------- queries
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._tx_index)
+
+    def size_bytes(self) -> int:
+        with self._mtx:
+            return self._bytes
+
+    def contains(self, key: bytes) -> bool:
+        with self._mtx:
+            return key in self._tx_index
+
+    def get_entry(self, key: bytes) -> TxEntry | None:
+        with self._mtx:
+            lane = self._tx_index.get(key)
+            return self.lanes[lane].get(key) if lane else None
+
+    def _snapshot_iter(self) -> IWRRIterator:
+        with self._mtx:
+            return IWRRIterator(
+                {lane: list(q.values()) for lane, q in self.lanes.items()},
+                self.lane_priorities,
+            )
+
+    def iter_txs(self) -> Iterable[bytes]:
+        return (e.tx for e in self._snapshot_iter())
+
+    def iter_entries(self) -> Iterable[TxEntry]:
+        return self._snapshot_iter()
+
+    # -------------------------------------------------------------- reaping
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """Collect txs in IWRR order under byte/gas budgets
+        (clist_mempool.go ReapMaxBytesMaxGas)."""
+        total_bytes = 0
+        total_gas = 0
+        out: list[bytes] = []
+        for entry in self._snapshot_iter():
+            sz = proto_tx_overhead(entry.tx)
+            if max_bytes > -1 and total_bytes + sz > max_bytes:
+                break
+            if max_gas > -1 and total_gas + entry.gas_wanted > max_gas:
+                break
+            total_bytes += sz
+            total_gas += entry.gas_wanted
+            out.append(entry.tx)
+        return out
+
+    def reap_max_txs(self, max_txs: int) -> list[bytes]:
+        out = []
+        for entry in self._snapshot_iter():
+            if max_txs > -1 and len(out) >= max_txs:
+                break
+            out.append(entry.tx)
+        return out
+
+    # ------------------------------------------------------ commit protocol
+
+    def lock(self) -> None:
+        self._update_mtx.acquire()
+
+    def unlock(self) -> None:
+        self._update_mtx.release()
+
+    def flush_app_conn(self) -> None:
+        self.proxy_app.flush()
+
+    def flush(self) -> None:
+        with self._mtx:
+            for q in self.lanes.values():
+                q.clear()
+            self._tx_index.clear()
+            self._bytes = 0
+        self.cache.reset()
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        with self._mtx:
+            self._remove_locked(key)
+
+    def _remove_locked(self, key: bytes) -> None:
+        lane = self._tx_index.pop(key, None)
+        if lane is None:
+            return
+        entry = self.lanes[lane].pop(key, None)
+        if entry is not None:
+            self._bytes -= len(entry.tx)
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list[pb.ExecTxResult],
+        pre_check: Callable[[bytes], None] | None = None,
+    ) -> None:
+        """Remove committed txs, refresh the cache, recheck what remains
+        (clist_mempool.go Update; caller holds lock())."""
+        self.height = height
+        self._notified_this_height = False
+        if pre_check is not None:
+            self.pre_check = pre_check
+        with self._mtx:
+            for tx, res in zip(txs, tx_results):
+                key = key_of(tx)
+                if res.code == 0:
+                    self.cache.push(key)  # committed: never re-admit
+                elif not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(key)
+                self._remove_locked(key)
+            remaining = [e for q in self.lanes.values() for e in q.values()]
+        if self.config.recheck and remaining:
+            self._recheck(remaining)
+        with self._mtx:
+            if self._tx_index:
+                self._maybe_notify()
+            else:
+                self._txs_available.clear()
+
+    def _recheck(self, entries: list[TxEntry]) -> None:
+        for entry in entries:
+            try:
+                res = self.proxy_app.check_tx(
+                    pb.CheckTxRequest(tx=entry.tx, type=pb.CHECK_TX_TYPE_RECHECK)
+                )
+            except Exception as e:  # noqa: BLE001 - conn failure drops recheck
+                self.logger.error(f"recheck failed: {e}")
+                return
+            if res.code != 0:
+                with self._mtx:
+                    self._remove_locked(entry.key)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(entry.key)
+
+    # -------------------------------------------------------- notifications
+
+    def txs_available(self) -> threading.Event:
+        return self._txs_available
+
+    def enable_txs_available(self) -> None:
+        self._notify_available = True
+
+    def _maybe_notify(self) -> None:
+        if self._notify_available and not self._notified_this_height:
+            self._notified_this_height = True
+            self._txs_available.set()

@@ -1,0 +1,121 @@
+"""Mempool interface + errors (reference: mempool/mempool.go:27-90,
+mempool/errors.go).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ..types.tx import tx_hash
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+class TxInMempoolError(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in mempool")
+
+
+class MempoolFullError(MempoolError):
+    def __init__(self, num_txs: int, total_bytes: int):
+        super().__init__(
+            f"mempool is full: number of txs {num_txs}, total bytes {total_bytes}"
+        )
+        self.num_txs = num_txs
+        self.total_bytes = total_bytes
+
+
+class PreCheckError(MempoolError):
+    pass
+
+
+class AppCheckError(MempoolError):
+    """CheckTx returned a non-OK code (mempool.ErrInvalidTx)."""
+
+    def __init__(self, code: int, log: str = "", codespace: str = ""):
+        super().__init__(f"application rejected tx: code {code} log {log!r}")
+        self.code = code
+        self.log = log
+        self.codespace = codespace
+
+
+def PreCheckMaxBytes(max_bytes: int) -> Callable[[bytes], None]:
+    """Pre-check rejecting txs larger than the per-tx byte cap
+    (mempool.PreCheckMaxBytes)."""
+
+    def check(tx: bytes) -> None:
+        if len(tx) > max_bytes:
+            raise PreCheckError(f"tx size {len(tx)} exceeds max {max_bytes}")
+
+    return check
+
+
+class Mempool:
+    """The interface the consensus engine consumes (mempool.go:27)."""
+
+    def check_tx(self, tx: bytes, sender: str = "") -> None:
+        """Validate tx against the app and admit it; raises MempoolError."""
+        raise NotImplementedError
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        raise NotImplementedError
+
+    def reap_max_txs(self, max_txs: int) -> list[bytes]:
+        raise NotImplementedError
+
+    def lock(self) -> None:
+        raise NotImplementedError
+
+    def unlock(self) -> None:
+        raise NotImplementedError
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list,
+        pre_check: Callable[[bytes], None] | None = None,
+    ) -> None:
+        """Called by the executor with the committed block's txs while the
+        mempool is locked."""
+        raise NotImplementedError
+
+    def flush_app_conn(self) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def size_bytes(self) -> int:
+        raise NotImplementedError
+
+    def txs_available(self):
+        """threading.Event fired once per height when txs become available."""
+        raise NotImplementedError
+
+    def enable_txs_available(self) -> None:
+        raise NotImplementedError
+
+    def contains(self, key: bytes) -> bool:
+        raise NotImplementedError
+
+    def iter_txs(self) -> Iterable[bytes]:
+        """Snapshot iteration in gossip order (lane-aware)."""
+        raise NotImplementedError
+
+
+def key_of(tx: bytes) -> bytes:
+    return tx_hash(tx)

@@ -1,0 +1,61 @@
+"""No-op mempool for apps that disseminate txs themselves
+(reference: mempool/nop_mempool.go).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .mempool import Mempool, MempoolError
+
+
+class TxsNotAvailableError(MempoolError):
+    def __init__(self):
+        super().__init__("mempool does not support tx availability")
+
+
+class NopMempool(Mempool):
+    def check_tx(self, tx: bytes, sender: str = "") -> None:
+        raise MempoolError("tx rejected: nop mempool does not accept txs")
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        pass
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        return []
+
+    def reap_max_txs(self, max_txs: int) -> list[bytes]:
+        return []
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def update(self, height, txs, tx_results, pre_check=None) -> None:
+        pass
+
+    def flush_app_conn(self) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def size(self) -> int:
+        return 0
+
+    def size_bytes(self) -> int:
+        return 0
+
+    def txs_available(self) -> threading.Event:
+        return threading.Event()  # never set
+
+    def enable_txs_available(self) -> None:
+        pass
+
+    def contains(self, key: bytes) -> bool:
+        return False
+
+    def iter_txs(self):
+        return iter(())

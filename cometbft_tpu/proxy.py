@@ -1,0 +1,72 @@
+"""Proxy: the engine's four named ABCI connections (reference: proxy/).
+
+AppConns multiplexes one client-creator into consensus / mempool / query /
+snapshot connections (multi_app_conn.go), so CheckTx traffic can run
+concurrently with block execution — the reference's ABCI pipeline
+parallelism.  Local apps share one mutex across all four (the reference's
+NewLocalClientCreator connection-synchronized default); socket apps get
+four independent pipelined connections.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .abci.client import Client, LocalClient, SocketClient
+from .abci.types import Application
+from .utils.service import Service
+
+ClientCreator = Callable[[], Client]
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    """All four connections share one mutex (proxy/client.go
+    NewLocalClientCreator)."""
+    mtx = threading.RLock()
+    return lambda: LocalClient(app, mtx)
+
+
+def unsync_local_client_creator(app: Application) -> ClientCreator:
+    from .abci.client import UnsyncLocalClient
+
+    return lambda: UnsyncLocalClient(app)
+
+
+def remote_client_creator(addr: str, must_connect: bool = True) -> ClientCreator:
+    return lambda: SocketClient(addr, must_connect=must_connect)
+
+
+class AppConns(Service):
+    """Four connections, started/stopped as one service
+    (proxy/multi_app_conn.go)."""
+
+    def __init__(self, creator: ClientCreator):
+        super().__init__("AppConns")
+        self._creator = creator
+        self.consensus: Client | None = None
+        self.mempool: Client | None = None
+        self.query: Client | None = None
+        self.snapshot: Client | None = None
+
+    def on_start(self) -> None:
+        conns = []
+        try:
+            for name in ("query", "snapshot", "mempool", "consensus"):
+                c = self._creator()
+                c.start()
+                conns.append(c)
+                setattr(self, name, c)
+        except Exception:
+            for c in conns:
+                c.stop()
+            raise
+
+    def on_stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            if c and c.is_running():
+                c.stop()
+
+
+def new_app_conns(creator: ClientCreator) -> AppConns:
+    return AppConns(creator)

@@ -1,0 +1,518 @@
+"""BlockExecutor: proposal creation, validation, and block application
+(reference: state/execution.go:70, state/validation.go:17).
+
+The executor owns the ABCI consensus connection.  ApplyBlock:
+FinalizeBlock → persist results → update State (validator/param updates)
+→ app Commit under mempool lock → evidence-pool update → prune → fire
+events.  validate_block's LastCommit check is the TPU hot path
+(state/validation.go:94 → types/validation.py verify_commit).
+"""
+
+from __future__ import annotations
+
+from ..crypto import ed25519
+from ..mempool.mempool import Mempool
+from ..types.block import Block, BlockID, Commit
+from ..types.event_bus import EventBus, NopEventBus
+from ..types.results import tx_results_hash
+from ..types.validators import Validator, ValidatorSet
+from ..utils.log import get_logger
+from ..wire import abci_pb as abci
+from ..wire.canonical import Timestamp
+from .state import State
+from .store import StateStore
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+class InvalidBlockError(BlockExecutionError):
+    pass
+
+
+class EmptyEvidencePool:
+    """No-op evidence pool (reference: sm.EmptyEvidencePool)."""
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        return [], 0
+
+    def check_evidence(self, evidence: list) -> None:
+        pass
+
+    def update(self, state: State, evidence: list) -> None:
+        pass
+
+    def add_evidence_from_consensus(self, evidence) -> None:
+        pass
+
+
+def build_last_commit_info(
+    block: Block, last_val_set: ValidatorSet, initial_height: int
+) -> abci.CommitInfo:
+    """CommitInfo handed to the app (execution.go:490 BuildLastCommitInfo)."""
+    if block.header.height == initial_height:
+        return abci.CommitInfo()
+    if block.last_commit is None or last_val_set.size() != block.last_commit.size():
+        raise BlockExecutionError(
+            f"commit size {block.last_commit.size() if block.last_commit else 0} "
+            f"doesn't match valset length {last_val_set.size()} "
+            f"at height {block.header.height}"
+        )
+    votes = []
+    for i, cs in enumerate(block.last_commit.signatures):
+        _, val = last_val_set.get_by_index(i)
+        votes.append(
+            abci.VoteInfo(
+                validator=abci.ValidatorAbci(
+                    address=val.address, power=val.voting_power
+                ),
+                block_id_flag=cs.block_id_flag,
+            )
+        )
+    return abci.CommitInfo(round=block.last_commit.round, votes=votes)
+
+
+def build_extended_commit_info(
+    ext_commit, val_set: ValidatorSet, initial_height: int
+) -> abci.ExtendedCommitInfo:
+    """ExtendedCommitInfo for PrepareProposal (execution.go
+    buildExtendedCommitInfo)."""
+    if ext_commit is None or ext_commit.height < initial_height:
+        return abci.ExtendedCommitInfo()
+    votes = []
+    for i, ecs in enumerate(ext_commit.extended_signatures):
+        _, val = val_set.get_by_index(i)
+        votes.append(
+            abci.ExtendedVoteInfo(
+                validator=abci.ValidatorAbci(
+                    address=val.address, power=val.voting_power
+                ),
+                vote_extension=ecs.extension,
+                extension_signature=ecs.extension_signature,
+                block_id_flag=ecs.commit_sig.block_id_flag,
+            )
+        )
+    return abci.ExtendedCommitInfo(round=ext_commit.round, votes=votes)
+
+
+def evidence_to_misbehavior(evidence: list) -> list[abci.Misbehavior]:
+    """types.Evidence → abci.Misbehavior (types/evidence.go ABCI())."""
+    out = []
+    for ev in evidence:
+        out.extend(ev.abci())
+    return out
+
+
+def validate_validator_updates(
+    updates: list[abci.ValidatorUpdate], params
+) -> list[Validator]:
+    """Check app-supplied validator updates against consensus params
+    (state/validation.go validateValidatorUpdates)."""
+    vals = []
+    for vu in updates:
+        if vu.power < 0:
+            raise BlockExecutionError(f"voting power can't be negative: {vu.power}")
+        if vu.pub_key_type not in params.validator.pub_key_types:
+            raise BlockExecutionError(
+                f"validator key type {vu.pub_key_type} not in consensus params "
+                f"{params.validator.pub_key_types}"
+            )
+        if vu.pub_key_type != ed25519.KEY_TYPE:
+            raise BlockExecutionError(
+                f"unsupported validator key type {vu.pub_key_type!r}"
+            )
+        vals.append(Validator(ed25519.PubKey(vu.pub_key_bytes), vu.power))
+    return vals
+
+
+def validate_block(state: State, block: Block) -> None:
+    """Full contextual validation (state/validation.go:17 validateBlock)."""
+    block.validate_basic()
+
+    h = block.header
+    from .state import BLOCK_PROTOCOL_VERSION
+
+    if h.version.block != BLOCK_PROTOCOL_VERSION or h.version.app != state.app_version:
+        raise InvalidBlockError(
+            f"wrong Block.Header.Version: expected "
+            f"block={BLOCK_PROTOCOL_VERSION}/app={state.app_version}, "
+            f"got block={h.version.block}/app={h.version.app}"
+        )
+    if h.chain_id != state.chain_id:
+        raise InvalidBlockError(
+            f"wrong Block.Header.ChainID: expected {state.chain_id}, got {h.chain_id}"
+        )
+    if state.last_block_height == 0 and h.height != state.initial_height:
+        raise InvalidBlockError(
+            f"wrong initial Block.Header.Height: expected {state.initial_height}, got {h.height}"
+        )
+    if state.last_block_height > 0 and h.height != state.last_block_height + 1:
+        raise InvalidBlockError(
+            f"wrong Block.Header.Height: expected {state.last_block_height + 1}, got {h.height}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise InvalidBlockError(
+            f"wrong Block.Header.LastBlockID: expected {state.last_block_id}, got {h.last_block_id}"
+        )
+    if h.app_hash != state.app_hash:
+        raise InvalidBlockError(
+            f"wrong Block.Header.AppHash: expected {state.app_hash.hex()}, "
+            f"got {h.app_hash.hex()} — check the app for non-determinism"
+        )
+    if h.consensus_hash != state.consensus_params.hash():
+        raise InvalidBlockError("wrong Block.Header.ConsensusHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise InvalidBlockError("wrong Block.Header.LastResultsHash")
+    if h.validators_hash != state.validators.hash():
+        raise InvalidBlockError("wrong Block.Header.ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise InvalidBlockError("wrong Block.Header.NextValidatorsHash")
+
+    # LastCommit — the hot path: batch Ed25519 verification on device
+    if h.height == state.initial_height:
+        if block.last_commit is not None and block.last_commit.size() != 0:
+            raise InvalidBlockError("initial block can't have LastCommit signatures")
+    else:
+        from ..types.validation import verify_commit
+
+        verify_commit(
+            state.chain_id,
+            state.last_validators,
+            state.last_block_id,
+            h.height - 1,
+            block.last_commit,
+        )
+
+    if len(h.proposer_address) != 20:
+        raise InvalidBlockError(
+            f"expected ProposerAddress size 20, got {len(h.proposer_address)}"
+        )
+    if not state.validators.has_address(h.proposer_address):
+        raise InvalidBlockError(
+            f"proposer {h.proposer_address.hex()} is not a validator"
+        )
+
+    # Block time (validation.go:116-150)
+    if h.height > state.initial_height:
+        if h.time.unix_ns() <= state.last_block_time.unix_ns():
+            raise InvalidBlockError(
+                f"block time {h.time} not greater than last block time "
+                f"{state.last_block_time}"
+            )
+        if not state.consensus_params.feature.pbts_enabled(h.height):
+            median = block.last_commit.median_time(state.last_validators)
+            if h.time != median:
+                raise InvalidBlockError(
+                    f"invalid block time: expected median {median}, got {h.time}"
+                )
+    elif h.height == state.initial_height:
+        if h.time.unix_ns() < state.last_block_time.unix_ns():
+            raise InvalidBlockError("block time is before genesis time")
+    else:
+        raise InvalidBlockError(
+            f"block height {h.height} lower than initial height {state.initial_height}"
+        )
+
+    ev_bytes = sum(len(e.bytes()) for e in block.evidence)
+    if ev_bytes > state.consensus_params.evidence.max_bytes:
+        raise InvalidBlockError(
+            f"evidence bytes {ev_bytes} exceed max {state.consensus_params.evidence.max_bytes}"
+        )
+
+
+def update_state(
+    state: State,
+    block_id: BlockID,
+    header,
+    fb_resp: abci.FinalizeBlockResponse,
+    validator_updates: list[Validator],
+) -> State:
+    """Derive the next State from block results (execution.go:636
+    updateState)."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if validator_updates:
+        n_val_set.update_with_change_set(validator_updates)
+        last_height_vals_changed = header.height + 1 + 1
+
+    n_val_set.increment_proposer_priority(1)
+
+    next_params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if fb_resp.consensus_param_updates is not None:
+        next_params = state.consensus_params.update(fb_resp.consensus_param_updates)
+        next_params.validate_basic()
+        last_height_params_changed = header.height + 1
+
+    next_delay = state.next_block_delay_ns
+    if fb_resp.next_block_delay is not None:
+        next_delay = fb_resp.next_block_delay.ns()
+
+    return State(
+        chain_id=state.chain_id,
+        initial_height=state.initial_height,
+        last_block_height=header.height,
+        last_block_id=block_id,
+        last_block_time=header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=next_params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=tx_results_hash(fb_resp.tx_results),
+        app_hash=fb_resp.app_hash,
+        next_block_delay_ns=next_delay,
+        app_version=next_params.version.app,
+    )
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        proxy_app,  # abci Client, consensus connection
+        mempool: Mempool,
+        ev_pool=None,
+        block_store=None,
+        event_bus: EventBus | None = None,
+    ):
+        self.store = state_store
+        self.proxy_app = proxy_app
+        self.mempool = mempool
+        self.ev_pool = ev_pool or EmptyEvidencePool()
+        self.block_store = block_store
+        self.event_bus = event_bus or NopEventBus()
+        self.logger = get_logger("executor")
+
+    # -------------------------------------------------------- proposing
+
+    def create_proposal_block(
+        self,
+        height: int,
+        state: State,
+        last_ext_commit,
+        proposer_addr: bytes,
+        block_time: Timestamp | None = None,
+    ) -> tuple[Block, object]:
+        """Reap mempool + evidence, run PrepareProposal, assemble the block
+        (execution.go:113 CreateProposalBlock).  Returns (block, part_set).
+        """
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence, ev_size = self.ev_pool.pending_evidence(
+            state.consensus_params.evidence.max_bytes
+        )
+        max_data = max_data_bytes(max_bytes, ev_size, state.validators.size())
+        txs = self.mempool.reap_max_bytes_max_gas(max_data, max_gas)
+        commit = (
+            last_ext_commit.to_commit()
+            if last_ext_commit is not None
+            else Commit(height=0, round=0)
+        )
+        local_last_commit = build_extended_commit_info(
+            last_ext_commit, state.last_validators, state.initial_height
+        ) if height > state.initial_height else abci.ExtendedCommitInfo()
+
+        block = state.make_block(
+            height, txs, commit, evidence, proposer_addr, block_time
+        )
+        req = abci.PrepareProposalRequest(
+            max_tx_bytes=max_data,
+            txs=txs,
+            local_last_commit=local_last_commit,
+            misbehavior=evidence_to_misbehavior(evidence),
+            height=height,
+            time=block.header.time,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=proposer_addr,
+        )
+        resp = self.proxy_app.prepare_proposal(req)
+        new_txs = resp.txs
+        total = sum(len(t) for t in new_txs)
+        if total > max_data:
+            raise BlockExecutionError(
+                f"transaction data size {total} exceeds maximum {max_data}"
+            )
+        block = state.make_block(
+            height, list(new_txs), commit, evidence, proposer_addr, block_time
+        )
+        return block, block.make_part_set()
+
+    def process_proposal(self, block: Block, state: State) -> bool:
+        """Ask the app to accept/reject the proposal (execution.go:173)."""
+        req = abci.ProcessProposalRequest(
+            txs=block.data.txs,
+            proposed_last_commit=build_last_commit_info(
+                block, state.last_validators, state.initial_height
+            ),
+            misbehavior=evidence_to_misbehavior(block.evidence),
+            hash=block.hash(),
+            height=block.header.height,
+            time=block.header.time,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+        )
+        resp = self.proxy_app.process_proposal(req)
+        if resp.status == abci.PROCESS_PROPOSAL_STATUS_UNKNOWN:
+            raise BlockExecutionError("ProcessProposal responded with status UNKNOWN")
+        return resp.status == abci.PROCESS_PROPOSAL_STATUS_ACCEPT
+
+    # ------------------------------------------------------- validating
+
+    def validate_block(self, state: State, block: Block) -> None:
+        """Contextual validation + evidence checks (execution.go:201)."""
+        validate_block(state, block)
+        self.ev_pool.check_evidence(block.evidence)
+
+    # --------------------------------------------------------- applying
+
+    def apply_block(
+        self, state: State, block_id: BlockID, block: Block, syncing_to_height: int | None = None
+    ) -> State:
+        self.validate_block(state, block)
+        return self._apply(state, block_id, block, syncing_to_height)
+
+    def apply_verified_block(
+        self, state: State, block_id: BlockID, block: Block, syncing_to_height: int | None = None
+    ) -> State:
+        """Skip validation — consensus already verified everything
+        (execution.go:212)."""
+        return self._apply(state, block_id, block, syncing_to_height)
+
+    def _apply(
+        self, state: State, block_id: BlockID, block: Block, syncing_to_height: int | None
+    ) -> State:
+        h = block.header.height
+        req = abci.FinalizeBlockRequest(
+            txs=block.data.txs,
+            decided_last_commit=build_last_commit_info(
+                block, state.last_validators, state.initial_height
+            ),
+            misbehavior=evidence_to_misbehavior(block.evidence),
+            hash=block.hash(),
+            height=h,
+            time=block.header.time,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+            syncing_to_height=syncing_to_height if syncing_to_height is not None else h,
+        )
+        fb_resp = self.proxy_app.finalize_block(req)
+        if len(fb_resp.tx_results) != len(block.data.txs):
+            raise BlockExecutionError(
+                f"app returned {len(fb_resp.tx_results)} tx results, "
+                f"block has {len(block.data.txs)} txs"
+            )
+        self.store.save_finalize_block_response(h, fb_resp)
+
+        validator_updates = validate_validator_updates(
+            fb_resp.validator_updates, state.consensus_params
+        )
+        new_state = update_state(
+            state, block_id, block.header, fb_resp, validator_updates
+        )
+
+        # Commit: lock mempool, flush pending CheckTx, app.Commit, mempool
+        # update with the committed txs (execution.go:403)
+        retain_height = self._commit(new_state, block, fb_resp.tx_results)
+
+        self.ev_pool.update(new_state, block.evidence)
+        self.store.save(new_state)
+
+        if retain_height > 0 and self.block_store is not None:
+            try:
+                pruned = self.block_store.prune_blocks(retain_height)
+                self.store.prune_states(retain_height, h)
+                self.logger.info(f"pruned {pruned} blocks below {retain_height}")
+            except Exception as e:  # noqa: BLE001 - pruning is best-effort
+                self.logger.error(f"pruning failed: {e}")
+
+        self._fire_events(block, block_id, fb_resp, validator_updates)
+        return new_state
+
+    def _commit(self, state: State, block: Block, tx_results) -> int:
+        self.mempool.lock()
+        try:
+            self.mempool.flush_app_conn()
+            resp = self.proxy_app.commit()
+            self.mempool.update(
+                block.header.height, block.data.txs, tx_results,
+            )
+            return resp.retain_height
+        finally:
+            self.mempool.unlock()
+
+    def _fire_events(self, block, block_id, fb_resp, validator_updates) -> None:
+        """execution.go:709 fireEvents."""
+        eb = self.event_bus
+        eb.publish_new_block(block, block_id, fb_resp)
+        eb.publish_new_block_header(block.header)
+        eb.publish_new_block_events(
+            block.header.height, fb_resp.events, len(block.data.txs)
+        )
+        for i, tx in enumerate(block.data.txs):
+            eb.publish_tx(block.header.height, i, tx, fb_resp.tx_results[i])
+        if validator_updates:
+            eb.publish_validator_set_updates(validator_updates)
+
+    # ------------------------------------------------------- extensions
+
+    def extend_vote(self, vote, block, state: State) -> bytes:
+        """execution.go:351-360: the app gets full block context."""
+        resp = self.proxy_app.extend_vote(
+            abci.ExtendVoteRequest(
+                hash=vote.block_id.hash,
+                height=vote.height,
+                time=block.header.time if block else None,
+                txs=block.data.txs if block else [],
+                proposed_last_commit=build_last_commit_info(
+                    block, state.last_validators, state.initial_height
+                )
+                if block
+                else abci.CommitInfo(),
+                misbehavior=evidence_to_misbehavior(block.evidence) if block else [],
+                next_validators_hash=block.header.next_validators_hash if block else b"",
+                proposer_address=block.header.proposer_address if block else b"",
+            )
+        )
+        return resp.vote_extension
+
+    def verify_vote_extension(self, vote) -> bool:
+        resp = self.proxy_app.verify_vote_extension(
+            abci.VerifyVoteExtensionRequest(
+                hash=vote.block_id.hash,
+                validator_address=vote.validator_address,
+                height=vote.height,
+                vote_extension=vote.extension,
+            )
+        )
+        if resp.status == abci.VERIFY_VOTE_EXTENSION_STATUS_UNKNOWN:
+            raise BlockExecutionError("VerifyVoteExtension responded UNKNOWN")
+        return resp.status == abci.VERIFY_VOTE_EXTENSION_STATUS_ACCEPT
+
+
+MAX_HEADER_BYTES = 626
+MAX_OVERHEAD_FOR_BLOCK = 11
+MAX_COMMIT_SIG_BYTES = 109
+MAX_COMMIT_OVERHEAD_BYTES = 94  # BlockID 82 + height 8 + round 4 (block.go:594)
+
+
+def max_data_bytes(max_bytes: int, evidence_bytes: int, num_vals: int) -> int:
+    """Bytes left for txs after header/commit/evidence overhead
+    (types.MaxDataBytes, types/block.go:613-618)."""
+    if max_bytes < 0:
+        return 1 << 40  # "unlimited" sentinel (-1)
+    commit_overhead = MAX_COMMIT_SIG_BYTES * num_vals + MAX_COMMIT_OVERHEAD_BYTES
+    out = (
+        max_bytes
+        - MAX_OVERHEAD_FOR_BLOCK
+        - MAX_HEADER_BYTES
+        - commit_overhead
+        - evidence_bytes
+    )
+    if out < 0:
+        raise BlockExecutionError(
+            f"negative MaxDataBytes: block max {max_bytes} too small"
+        )
+    return out

@@ -154,6 +154,54 @@ class State:
     def bytes(self) -> bytes:
         return self.to_proto().encode()
 
+    def make_block(
+        self,
+        height: int,
+        txs: list[bytes],
+        last_commit: Commit | None,
+        evidence: list,
+        proposer_address: bytes,
+        block_time: Timestamp | None = None,
+    ) -> Block:
+        """Assemble a proposal block with the header fields this state
+        dictates (state.go:262 MakeBlock).
+
+        At the initial height the timestamp is the genesis time (or the
+        proposer's time under PBTS, supplied via block_time); afterwards
+        block_time is the proposer's time (PBTS) and defaults to the
+        commit's weighted median (BFT time, state.go:252-260).
+        """
+        if height == self.initial_height:
+            if block_time is not None and self.consensus_params.feature.pbts_enabled(height):
+                ts = block_time
+            else:
+                ts = self.last_block_time  # genesis time
+        elif block_time is not None:
+            ts = block_time
+        else:
+            ts = last_commit.median_time(self.last_validators)
+        header = Header(
+            version=pb.Consensus(block=BLOCK_PROTOCOL_VERSION, app=self.app_version),
+            chain_id=self.chain_id,
+            height=height,
+            time=ts,
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        block = Block(
+            header=header,
+            data=Data(txs=list(txs)),
+            evidence=list(evidence),
+            last_commit=last_commit,
+        )
+        block.fill_header()
+        return block
+
 
 def make_genesis_state(genesis: GenesisDoc) -> State:
     """Bootstrap State from a genesis doc (state.go MakeGenesisState)."""

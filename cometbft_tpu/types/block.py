@@ -386,6 +386,26 @@ class Commit:
             signature=cs.signature,
         )
 
+    def median_time(self, validators) -> Timestamp:
+        """Voting-power-weighted median of the commit timestamps — BFT time
+        (block.go:968 MedianTime, types/time/time.go:57 WeightedMedian)."""
+        weighted = []
+        total = 0
+        for cs in self.signatures:
+            if cs.absent_flag():
+                continue
+            _, val = validators.get_by_address(cs.validator_address)
+            if val is not None:
+                total += val.voting_power
+                weighted.append((cs.timestamp.unix_ns(), val.voting_power))
+        weighted.sort()
+        median = total // 2
+        for ns, power in weighted:
+            if median <= power:
+                return Timestamp.from_unix_ns(ns)
+            median -= power
+        return ZERO_TIME
+
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """The canonical bytes validator val_idx signed (block.go:921)."""
         return self.get_vote(val_idx).sign_bytes(chain_id)

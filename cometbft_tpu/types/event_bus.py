@@ -1,0 +1,188 @@
+"""EventBus: typed event publication over pubsub
+(reference: types/event_bus.go:34, types/events.go).
+
+Consensus and the executor publish typed event payloads; RPC websocket
+subscribers and the indexer service consume them through queries like
+"tm.event='NewBlock'".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..utils.pubsub import PubSub, Query, Subscription
+from ..utils.service import Service
+from ..wire import abci_pb as abci
+
+# Event type strings (types/events.go:19-40)
+EventNewBlock = "NewBlock"
+EventNewBlockHeader = "NewBlockHeader"
+EventNewBlockEvents = "NewBlockEvents"
+EventNewEvidence = "NewEvidence"
+EventTx = "Tx"
+EventValidatorSetUpdates = "ValidatorSetUpdates"
+EventCompleteProposal = "CompleteProposal"
+EventLock = "Lock"
+EventNewRound = "NewRound"
+EventNewRoundStep = "NewRoundStep"
+EventPolka = "Polka"
+EventRelock = "Relock"
+EventTimeoutPropose = "TimeoutPropose"
+EventTimeoutWait = "TimeoutWait"
+EventValidBlock = "ValidBlock"
+EventVote = "Vote"
+EventProposalBlockPart = "ProposalBlockPart"
+
+# Reserved event keys (types/events.go:140-151)
+EventTypeKey = "tm.event"
+TxHashKey = "tx.hash"
+TxHeightKey = "tx.height"
+BlockHeightKey = "block.height"
+
+
+def query_for_event(event_type: str) -> Query:
+    return Query(f"{EventTypeKey}='{event_type}'")
+
+
+EventQueryNewBlock = query_for_event(EventNewBlock)
+EventQueryNewBlockHeader = query_for_event(EventNewBlockHeader)
+EventQueryNewBlockEvents = query_for_event(EventNewBlockEvents)
+EventQueryNewEvidence = query_for_event(EventNewEvidence)
+EventQueryTx = query_for_event(EventTx)
+EventQueryValidatorSetUpdates = query_for_event(EventValidatorSetUpdates)
+EventQueryNewRound = query_for_event(EventNewRound)
+EventQueryNewRoundStep = query_for_event(EventNewRoundStep)
+EventQueryCompleteProposal = query_for_event(EventCompleteProposal)
+EventQueryPolka = query_for_event(EventPolka)
+EventQueryValidBlock = query_for_event(EventValidBlock)
+EventQueryVote = query_for_event(EventVote)
+EventQueryLock = query_for_event(EventLock)
+EventQueryRelock = query_for_event(EventRelock)
+EventQueryTimeoutPropose = query_for_event(EventTimeoutPropose)
+EventQueryTimeoutWait = query_for_event(EventTimeoutWait)
+
+
+@dataclass
+class EventMessage:
+    """What subscribers receive: the typed payload + indexable events."""
+
+    event_type: str
+    data: Any
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+def abci_events_to_map(events: list[abci.Event]) -> dict[str, list[str]]:
+    """Flatten ABCI events to composite "type.key" -> values
+    (pubsub indexing form, libs/pubsub/query semantics)."""
+    out: dict[str, list[str]] = {}
+    for ev in events:
+        for attr in ev.attributes:
+            if not attr.key:
+                continue
+            out.setdefault(f"{ev.type}.{attr.key}", []).append(attr.value)
+    return out
+
+
+class EventBus(Service):
+    """Typed facade over PubSub (event_bus.go:34)."""
+
+    def __init__(self):
+        super().__init__("EventBus")
+        self.pubsub = PubSub()
+
+    def subscribe(self, subscriber: str, query: Query | str) -> Subscription:
+        return self.pubsub.subscribe(subscriber, query)
+
+    def unsubscribe(self, subscriber: str, query: Query | str) -> None:
+        self.pubsub.unsubscribe(subscriber, query)
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        self.pubsub.unsubscribe_all(subscriber)
+
+    def _publish(self, event_type: str, data: Any, extra: dict[str, list[str]] | None = None) -> None:
+        events = dict(extra or {})
+        events.setdefault(EventTypeKey, []).append(event_type)
+        self.pubsub.publish(EventMessage(event_type, data, events), events)
+
+    # ------------------------------------------------ typed publishers
+
+    def publish_new_block(self, block, block_id, result_finalize_block: abci.FinalizeBlockResponse) -> None:
+        extra = abci_events_to_map(result_finalize_block.events)
+        extra[BlockHeightKey] = [str(block.header.height)]
+        self._publish(
+            EventNewBlock,
+            {"block": block, "block_id": block_id, "result_finalize_block": result_finalize_block},
+            extra,
+        )
+
+    def publish_new_block_header(self, header) -> None:
+        self._publish(EventNewBlockHeader, {"header": header},
+                      {BlockHeightKey: [str(header.height)]})
+
+    def publish_new_block_events(self, height: int, events: list[abci.Event], num_txs: int) -> None:
+        extra = abci_events_to_map(events)
+        extra[BlockHeightKey] = [str(height)]
+        self._publish(
+            EventNewBlockEvents,
+            {"height": height, "events": events, "num_txs": num_txs},
+            extra,
+        )
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result: abci.ExecTxResult) -> None:
+        from .tx import tx_hash
+
+        extra = abci_events_to_map(result.events)
+        extra[TxHashKey] = [tx_hash(tx).hex().upper()]
+        extra[TxHeightKey] = [str(height)]
+        self._publish(
+            EventTx,
+            {"height": height, "index": index, "tx": tx, "result": result},
+            extra,
+        )
+
+    def publish_new_evidence(self, evidence, height: int) -> None:
+        self._publish(EventNewEvidence, {"evidence": evidence, "height": height})
+
+    def publish_validator_set_updates(self, updates) -> None:
+        self._publish(EventValidatorSetUpdates, {"validator_updates": updates})
+
+    def publish_vote(self, vote) -> None:
+        self._publish(EventVote, {"vote": vote})
+
+    def publish_new_round_step(self, rs) -> None:
+        self._publish(EventNewRoundStep, rs)
+
+    def publish_new_round(self, rs) -> None:
+        self._publish(EventNewRound, rs)
+
+    def publish_complete_proposal(self, rs) -> None:
+        self._publish(EventCompleteProposal, rs)
+
+    def publish_polka(self, rs) -> None:
+        self._publish(EventPolka, rs)
+
+    def publish_valid_block(self, rs) -> None:
+        self._publish(EventValidBlock, rs)
+
+    def publish_lock(self, rs) -> None:
+        self._publish(EventLock, rs)
+
+    def publish_relock(self, rs) -> None:
+        self._publish(EventRelock, rs)
+
+    def publish_timeout_propose(self, rs) -> None:
+        self._publish(EventTimeoutPropose, rs)
+
+    def publish_timeout_wait(self, rs) -> None:
+        self._publish(EventTimeoutWait, rs)
+
+
+class NopEventBus:
+    def subscribe(self, *a, **k):
+        raise NotImplementedError
+
+    def __getattr__(self, name):
+        if name.startswith("publish"):
+            return lambda *a, **k: None
+        raise AttributeError(name)

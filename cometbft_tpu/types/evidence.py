@@ -48,6 +48,23 @@ class DuplicateVoteEvidence(Evidence):
         self.validator_power = validator_power
         self.timestamp = timestamp or ZERO_TIME
 
+    def abci(self) -> list:
+        """ABCI Misbehavior records (evidence.go DuplicateVoteEvidence.ABCI)."""
+        from ..wire import abci_pb
+
+        return [
+            abci_pb.Misbehavior(
+                type=abci_pb.MISBEHAVIOR_TYPE_DUPLICATE_VOTE,
+                validator=abci_pb.ValidatorAbci(
+                    address=self.vote_a.validator_address,
+                    power=self.validator_power,
+                ),
+                height=self.vote_a.height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+        ]
+
     @classmethod
     def from_votes(cls, vote1: Vote, vote2: Vote, block_time: Timestamp, val_set):
         """Orders votes by BlockID key (evidence.go NewDuplicateVoteEvidence)."""
@@ -156,6 +173,24 @@ class LightClientAttackEvidence(Evidence):
             raise ValueError("conflicting block is nil")
         if self.common_height <= 0:
             raise ValueError("common height must be positive")
+
+    def abci(self) -> list:
+        """One Misbehavior per byzantine validator
+        (evidence.go LightClientAttackEvidence.ABCI)."""
+        from ..wire import abci_pb
+
+        return [
+            abci_pb.Misbehavior(
+                type=abci_pb.MISBEHAVIOR_TYPE_LIGHT_CLIENT_ATTACK,
+                validator=abci_pb.ValidatorAbci(
+                    address=v.address, power=v.voting_power
+                ),
+                height=self.common_height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+            for v in self.byzantine_validators
+        ]
 
     def to_proto(self) -> pb.LightClientAttackEvidenceProto:
         sh = self.conflicting_block.signed_header

@@ -1,0 +1,24 @@
+"""Deterministic tx-result hashing (reference: types/results.go,
+abci/types/types.go:201-208).
+
+LastResultsHash in the next block's header commits to (Code, Data,
+GasWanted, GasUsed) of every tx result — the non-deterministic fields
+(log, info, events, codespace) are stripped before hashing.
+"""
+
+from __future__ import annotations
+
+from ..crypto import merkle
+from ..wire import abci_pb as pb
+
+
+def deterministic_exec_tx_result(r: pb.ExecTxResult) -> pb.ExecTxResult:
+    return pb.ExecTxResult(
+        code=r.code, data=r.data, gas_wanted=r.gas_wanted, gas_used=r.gas_used
+    )
+
+
+def tx_results_hash(results: list[pb.ExecTxResult]) -> bytes:
+    return merkle.hash_from_byte_slices(
+        [deterministic_exec_tx_result(r).encode() for r in results], device=False
+    )

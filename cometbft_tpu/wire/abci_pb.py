@@ -1,11 +1,49 @@
-"""ABCI protobuf messages needed for persistence and the socket protocol
-(field layout mirrors proto/cometbft/abci/v1/types.proto of the reference).
+"""ABCI protobuf messages: the full request/response set plus persistence
+types (field layout mirrors proto/cometbft/abci/v1/types.proto of the
+reference; oneof Request/Response numbering at types.proto Request/Response
+messages — note the reserved 4,7,9,10 / 5,8,10,11 gaps from removed
+SetOption/BeginBlock/DeliverTx/EndBlock).
 """
 
 from __future__ import annotations
 
+from .canonical import Timestamp
 from .proto import Message, Field
 from .types_pb import ConsensusParamsProto, Duration
+
+# CheckTxType (types.proto:82-91)
+CHECK_TX_TYPE_UNKNOWN = 0
+CHECK_TX_TYPE_RECHECK = 1
+CHECK_TX_TYPE_CHECK = 2
+
+# OfferSnapshotResult (types.proto:331-346)
+OFFER_SNAPSHOT_RESULT_UNKNOWN = 0
+OFFER_SNAPSHOT_RESULT_ACCEPT = 1
+OFFER_SNAPSHOT_RESULT_ABORT = 2
+OFFER_SNAPSHOT_RESULT_REJECT = 3
+OFFER_SNAPSHOT_RESULT_REJECT_FORMAT = 4
+OFFER_SNAPSHOT_RESULT_REJECT_SENDER = 5
+
+# ApplySnapshotChunkResult (types.proto:361-377)
+APPLY_SNAPSHOT_CHUNK_RESULT_UNKNOWN = 0
+APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT = 1
+APPLY_SNAPSHOT_CHUNK_RESULT_ABORT = 2
+APPLY_SNAPSHOT_CHUNK_RESULT_RETRY = 3
+APPLY_SNAPSHOT_CHUNK_RESULT_RETRY_SNAPSHOT = 4
+APPLY_SNAPSHOT_CHUNK_RESULT_REJECT_SNAPSHOT = 5
+
+# ProcessProposalStatus / VerifyVoteExtensionStatus (types.proto:390-426)
+PROCESS_PROPOSAL_STATUS_UNKNOWN = 0
+PROCESS_PROPOSAL_STATUS_ACCEPT = 1
+PROCESS_PROPOSAL_STATUS_REJECT = 2
+VERIFY_VOTE_EXTENSION_STATUS_UNKNOWN = 0
+VERIFY_VOTE_EXTENSION_STATUS_ACCEPT = 1
+VERIFY_VOTE_EXTENSION_STATUS_REJECT = 2
+
+# MisbehaviorType (types.proto:562-572)
+MISBEHAVIOR_TYPE_UNKNOWN = 0
+MISBEHAVIOR_TYPE_DUPLICATE_VOTE = 1
+MISBEHAVIOR_TYPE_LIGHT_CLIENT_ATTACK = 2
 
 
 class EventAttribute(Message):
@@ -62,3 +100,395 @@ class FinalizeBlockResponse(Message):
         Field(5, "app_hash", "bytes"),
         Field(6, "next_block_delay", "message", Duration, emit_default=True),
     ]
+
+
+# ---------------------------------------------------------------- shared
+
+
+class ValidatorAbci(Message):
+    """abci.Validator (types.proto:524-528): 20-byte address + power."""
+
+    FIELDS = [
+        Field(1, "address", "bytes"),
+        Field(3, "power", "varint"),
+    ]
+
+
+class VoteInfo(Message):
+    FIELDS = [
+        Field(1, "validator", "message", ValidatorAbci, emit_default=True),
+        Field(3, "block_id_flag", "varint"),
+    ]
+
+
+class ExtendedVoteInfo(Message):
+    FIELDS = [
+        Field(1, "validator", "message", ValidatorAbci, emit_default=True),
+        Field(3, "vote_extension", "bytes"),
+        Field(4, "extension_signature", "bytes"),
+        Field(5, "block_id_flag", "varint"),
+    ]
+
+
+class CommitInfo(Message):
+    FIELDS = [
+        Field(1, "round", "varint"),
+        Field(2, "votes", "message", VoteInfo, repeated=True),
+    ]
+
+
+class ExtendedCommitInfo(Message):
+    FIELDS = [
+        Field(1, "round", "varint"),
+        Field(2, "votes", "message", ExtendedVoteInfo, repeated=True),
+    ]
+
+
+class Misbehavior(Message):
+    FIELDS = [
+        Field(1, "type", "varint"),
+        Field(2, "validator", "message", ValidatorAbci, emit_default=True),
+        Field(3, "height", "varint"),
+        Field(4, "time", "message", Timestamp, emit_default=True),
+        Field(5, "total_voting_power", "varint"),
+    ]
+
+
+class Snapshot(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "format", "varint"),
+        Field(3, "chunks", "varint"),
+        Field(4, "hash", "bytes"),
+        Field(5, "metadata", "bytes"),
+    ]
+
+
+class LanePriorityEntry(Message):
+    """map<string,uint32> entry for InfoResponse.lane_priorities."""
+
+    FIELDS = [
+        Field(1, "key", "string"),
+        Field(2, "value", "varint"),
+    ]
+
+
+# --------------------------------------------------------------- requests
+
+
+class EchoRequest(Message):
+    FIELDS = [Field(1, "message", "string")]
+
+
+class FlushRequest(Message):
+    FIELDS = []
+
+
+class InfoRequest(Message):
+    FIELDS = [
+        Field(1, "version", "string"),
+        Field(2, "block_version", "varint"),
+        Field(3, "p2p_version", "varint"),
+        Field(4, "abci_version", "string"),
+    ]
+
+
+class InitChainRequest(Message):
+    FIELDS = [
+        Field(1, "time", "message", Timestamp, emit_default=True),
+        Field(2, "chain_id", "string"),
+        Field(3, "consensus_params", "message", ConsensusParamsProto),
+        Field(4, "validators", "message", ValidatorUpdate, repeated=True),
+        Field(5, "app_state_bytes", "bytes"),
+        Field(6, "initial_height", "varint"),
+    ]
+
+
+class QueryRequest(Message):
+    FIELDS = [
+        Field(1, "data", "bytes"),
+        Field(2, "path", "string"),
+        Field(3, "height", "varint"),
+        Field(4, "prove", "bool"),
+    ]
+
+
+class CheckTxRequest(Message):
+    FIELDS = [
+        Field(1, "tx", "bytes"),
+        Field(3, "type", "varint"),
+    ]
+
+
+class CommitRequest(Message):
+    FIELDS = []
+
+
+class ListSnapshotsRequest(Message):
+    FIELDS = []
+
+
+class OfferSnapshotRequest(Message):
+    FIELDS = [
+        Field(1, "snapshot", "message", Snapshot),
+        Field(2, "app_hash", "bytes"),
+    ]
+
+
+class LoadSnapshotChunkRequest(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "format", "varint"),
+        Field(3, "chunk", "varint"),
+    ]
+
+
+class ApplySnapshotChunkRequest(Message):
+    FIELDS = [
+        Field(1, "index", "varint"),
+        Field(2, "chunk", "bytes"),
+        Field(3, "sender", "string"),
+    ]
+
+
+class PrepareProposalRequest(Message):
+    FIELDS = [
+        Field(1, "max_tx_bytes", "varint"),
+        Field(2, "txs", "bytes", repeated=True),
+        Field(3, "local_last_commit", "message", ExtendedCommitInfo, emit_default=True),
+        Field(4, "misbehavior", "message", Misbehavior, repeated=True),
+        Field(5, "height", "varint"),
+        Field(6, "time", "message", Timestamp, emit_default=True),
+        Field(7, "next_validators_hash", "bytes"),
+        Field(8, "proposer_address", "bytes"),
+    ]
+
+
+class ProcessProposalRequest(Message):
+    FIELDS = [
+        Field(1, "txs", "bytes", repeated=True),
+        Field(2, "proposed_last_commit", "message", CommitInfo, emit_default=True),
+        Field(3, "misbehavior", "message", Misbehavior, repeated=True),
+        Field(4, "hash", "bytes"),
+        Field(5, "height", "varint"),
+        Field(6, "time", "message", Timestamp, emit_default=True),
+        Field(7, "next_validators_hash", "bytes"),
+        Field(8, "proposer_address", "bytes"),
+    ]
+
+
+class ExtendVoteRequest(Message):
+    FIELDS = [
+        Field(1, "hash", "bytes"),
+        Field(2, "height", "varint"),
+        Field(3, "time", "message", Timestamp, emit_default=True),
+        Field(4, "txs", "bytes", repeated=True),
+        Field(5, "proposed_last_commit", "message", CommitInfo, emit_default=True),
+        Field(6, "misbehavior", "message", Misbehavior, repeated=True),
+        Field(7, "next_validators_hash", "bytes"),
+        Field(8, "proposer_address", "bytes"),
+    ]
+
+
+class VerifyVoteExtensionRequest(Message):
+    FIELDS = [
+        Field(1, "hash", "bytes"),
+        Field(2, "validator_address", "bytes"),
+        Field(3, "height", "varint"),
+        Field(4, "vote_extension", "bytes"),
+    ]
+
+
+class FinalizeBlockRequest(Message):
+    FIELDS = [
+        Field(1, "txs", "bytes", repeated=True),
+        Field(2, "decided_last_commit", "message", CommitInfo, emit_default=True),
+        Field(3, "misbehavior", "message", Misbehavior, repeated=True),
+        Field(4, "hash", "bytes"),
+        Field(5, "height", "varint"),
+        Field(6, "time", "message", Timestamp, emit_default=True),
+        Field(7, "next_validators_hash", "bytes"),
+        Field(8, "proposer_address", "bytes"),
+        Field(9, "syncing_to_height", "varint"),
+    ]
+
+
+# --------------------------------------------------------------- responses
+
+
+class ExceptionResponse(Message):
+    FIELDS = [Field(1, "error", "string")]
+
+
+class EchoResponse(Message):
+    FIELDS = [Field(1, "message", "string")]
+
+
+class FlushResponse(Message):
+    FIELDS = []
+
+
+class InfoResponse(Message):
+    FIELDS = [
+        Field(1, "data", "string"),
+        Field(2, "version", "string"),
+        Field(3, "app_version", "varint"),
+        Field(4, "last_block_height", "varint"),
+        Field(5, "last_block_app_hash", "bytes"),
+        Field(6, "lane_priorities", "message", LanePriorityEntry, repeated=True),
+        Field(7, "default_lane", "string"),
+    ]
+
+    def lane_priority_map(self) -> dict[str, int]:
+        return {e.key: e.value for e in self.lane_priorities}
+
+    def set_lane_priorities(self, m: dict[str, int]) -> None:
+        self.lane_priorities = [
+            LanePriorityEntry(key=k, value=v) for k, v in sorted(m.items())
+        ]
+
+
+class InitChainResponse(Message):
+    FIELDS = [
+        Field(1, "consensus_params", "message", ConsensusParamsProto),
+        Field(2, "validators", "message", ValidatorUpdate, repeated=True),
+        Field(3, "app_hash", "bytes"),
+    ]
+
+
+class QueryResponse(Message):
+    FIELDS = [
+        Field(1, "code", "varint"),
+        Field(3, "log", "string"),
+        Field(4, "info", "string"),
+        Field(5, "index", "varint"),
+        Field(6, "key", "bytes"),
+        Field(7, "value", "bytes"),
+        Field(9, "height", "varint"),
+        Field(10, "codespace", "string"),
+    ]
+
+
+class CheckTxResponse(Message):
+    FIELDS = [
+        Field(1, "code", "varint"),
+        Field(2, "data", "bytes"),
+        Field(3, "log", "string"),
+        Field(4, "info", "string"),
+        Field(5, "gas_wanted", "varint"),
+        Field(6, "gas_used", "varint"),
+        Field(7, "events", "message", Event, repeated=True),
+        Field(8, "codespace", "string"),
+        Field(12, "lane_id", "string"),
+    ]
+
+
+class CommitResponse(Message):
+    FIELDS = [Field(3, "retain_height", "varint")]
+
+
+class ListSnapshotsResponse(Message):
+    FIELDS = [Field(1, "snapshots", "message", Snapshot, repeated=True)]
+
+
+class OfferSnapshotResponse(Message):
+    FIELDS = [Field(1, "result", "varint")]
+
+
+class LoadSnapshotChunkResponse(Message):
+    FIELDS = [Field(1, "chunk", "bytes")]
+
+
+class ApplySnapshotChunkResponse(Message):
+    FIELDS = [
+        Field(1, "result", "varint"),
+        Field(2, "refetch_chunks", "varint", repeated=True, packed=True),
+        Field(3, "reject_senders", "string", repeated=True),
+    ]
+
+
+class PrepareProposalResponse(Message):
+    FIELDS = [Field(1, "txs", "bytes", repeated=True)]
+
+
+class ProcessProposalResponse(Message):
+    FIELDS = [Field(1, "status", "varint")]
+
+
+class ExtendVoteResponse(Message):
+    FIELDS = [Field(1, "vote_extension", "bytes")]
+
+
+class VerifyVoteExtensionResponse(Message):
+    FIELDS = [Field(1, "status", "varint")]
+
+
+# ----------------------------------------------------- oneof socket frames
+
+
+class Request(Message):
+    """oneof wrapper for the socket protocol (types.proto Request; field
+    numbers 4,7,9,10 reserved by the removed legacy methods)."""
+
+    FIELDS = [
+        Field(1, "echo", "message", EchoRequest),
+        Field(2, "flush", "message", FlushRequest),
+        Field(3, "info", "message", InfoRequest),
+        Field(5, "init_chain", "message", InitChainRequest),
+        Field(6, "query", "message", QueryRequest),
+        Field(8, "check_tx", "message", CheckTxRequest),
+        Field(11, "commit", "message", CommitRequest),
+        Field(12, "list_snapshots", "message", ListSnapshotsRequest),
+        Field(13, "offer_snapshot", "message", OfferSnapshotRequest),
+        Field(14, "load_snapshot_chunk", "message", LoadSnapshotChunkRequest),
+        Field(15, "apply_snapshot_chunk", "message", ApplySnapshotChunkRequest),
+        Field(16, "prepare_proposal", "message", PrepareProposalRequest),
+        Field(17, "process_proposal", "message", ProcessProposalRequest),
+        Field(18, "extend_vote", "message", ExtendVoteRequest),
+        Field(19, "verify_vote_extension", "message", VerifyVoteExtensionRequest),
+        Field(20, "finalize_block", "message", FinalizeBlockRequest),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
+
+    def value(self):
+        w = self.which()
+        return getattr(self, w) if w else None
+
+
+class Response(Message):
+    """oneof wrapper (types.proto Response; 5,8,10,11 reserved)."""
+
+    FIELDS = [
+        Field(1, "exception", "message", ExceptionResponse),
+        Field(2, "echo", "message", EchoResponse),
+        Field(3, "flush", "message", FlushResponse),
+        Field(4, "info", "message", InfoResponse),
+        Field(6, "init_chain", "message", InitChainResponse),
+        Field(7, "query", "message", QueryResponse),
+        Field(9, "check_tx", "message", CheckTxResponse),
+        Field(12, "commit", "message", CommitResponse),
+        Field(13, "list_snapshots", "message", ListSnapshotsResponse),
+        Field(14, "offer_snapshot", "message", OfferSnapshotResponse),
+        Field(15, "load_snapshot_chunk", "message", LoadSnapshotChunkResponse),
+        Field(16, "apply_snapshot_chunk", "message", ApplySnapshotChunkResponse),
+        Field(17, "prepare_proposal", "message", PrepareProposalResponse),
+        Field(18, "process_proposal", "message", ProcessProposalResponse),
+        Field(19, "extend_vote", "message", ExtendVoteResponse),
+        Field(20, "verify_vote_extension", "message", VerifyVoteExtensionResponse),
+        Field(21, "finalize_block", "message", FinalizeBlockResponse),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
+
+    def value(self):
+        w = self.which()
+        return getattr(self, w) if w else None

@@ -1,0 +1,349 @@
+"""ABCI layer tests (mirrors reference abci/example/kvstore/kvstore_test.go,
+abci/client tests, proxy tests)."""
+
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import (
+    BaseApplication,
+    KVStoreApplication,
+    LocalClient,
+    SocketClient,
+    SocketServer,
+)
+from cometbft_tpu.abci.kvstore import (
+    CodeTypeInvalidTxFormat,
+    assign_lane,
+    make_val_set_change_tx,
+)
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.proxy import local_client_creator, new_app_conns
+from cometbft_tpu.wire import abci_pb as pb
+
+
+def test_kvstore_checktx_formats():
+    app = KVStoreApplication()
+    cases = [
+        (0, b"hello=world"),
+        (0, b"hello:world"),
+        (CodeTypeInvalidTxFormat, b"hello"),
+        (CodeTypeInvalidTxFormat, b"=hello"),
+        (CodeTypeInvalidTxFormat, b"hello="),
+        (CodeTypeInvalidTxFormat, b"a=b=c"),
+        (CodeTypeInvalidTxFormat, b"val=hello"),   # kvstore_test.go:225
+        (CodeTypeInvalidTxFormat, b"val=hi!5"),
+    ]
+    for want, tx in cases:
+        got = app.check_tx(pb.CheckTxRequest(tx=tx)).code
+        assert got == want, tx
+
+
+def test_kvstore_lane_assignment():
+    # assignLane (kvstore.go:208): key%11 -> foo, key%3 -> bar, else default
+    assert assign_lane(b"22=x") == "foo"
+    assert assign_lane(b"9=x") == "bar"
+    assert assign_lane(b"5=x") == "default"
+    assert assign_lane(b"abc=x") == "default"
+    sk = ed25519.PrivKey.from_seed(b"\x01" * 32)
+    assert assign_lane(make_val_set_change_tx(sk.pub_key().data, 5)) == "val"
+
+
+def test_kvstore_finalize_commit_query():
+    app = KVStoreApplication()
+    r = app.finalize_block(
+        pb.FinalizeBlockRequest(txs=[b"a=1", b"b=2"], height=1)
+    )
+    assert [t.code for t in r.tx_results] == [0, 0]
+    assert r.app_hash == b"\x04" + b"\x00" * 7  # size=2, signed varint
+    app.commit(pb.CommitRequest())
+    q = app.query(pb.QueryRequest(path="/key", data=b"a"))
+    assert q.value == b"1" and q.log == "exists"
+    q2 = app.query(pb.QueryRequest(path="/key", data=b"zz"))
+    assert q2.value == b"" and q2.log == "does not exist"
+    info = app.info(pb.InfoRequest())
+    assert info.last_block_height == 1
+    assert info.last_block_app_hash == r.app_hash
+    assert info.lane_priority_map()["val"] == 9
+
+
+def test_kvstore_validator_updates():
+    app = KVStoreApplication()
+    sk = ed25519.PrivKey.from_seed(b"\x07" * 32)
+    pub = sk.pub_key().data
+    tx = make_val_set_change_tx(pub, 10)
+    r = app.finalize_block(pb.FinalizeBlockRequest(txs=[tx], height=1))
+    assert len(r.validator_updates) == 1
+    assert r.validator_updates[0].power == 10
+    assert r.validator_updates[0].pub_key_bytes == pub
+    app.commit(pb.CommitRequest())
+    vals = app.get_validators()
+    assert len(vals) == 1 and vals[0].power == 10
+    # removal
+    app.finalize_block(
+        pb.FinalizeBlockRequest(txs=[make_val_set_change_tx(pub, 0)], height=2)
+    )
+    app.commit(pb.CommitRequest())
+    assert app.get_validators() == []
+
+
+def test_kvstore_prepare_process_proposal():
+    app = KVStoreApplication()
+    prep = app.prepare_proposal(
+        pb.PrepareProposalRequest(txs=[b"a:1", b"b=2"], max_tx_bytes=100)
+    )
+    assert prep.txs == [b"a=1", b"b=2"]
+    ok = app.process_proposal(pb.ProcessProposalRequest(txs=prep.txs, height=1))
+    assert ok.status == pb.PROCESS_PROPOSAL_STATUS_ACCEPT
+    bad = app.process_proposal(pb.ProcessProposalRequest(txs=[b"nosep"], height=1))
+    assert bad.status == pb.PROCESS_PROPOSAL_STATUS_REJECT
+
+
+def test_kvstore_misbehavior_docks_power():
+    app = KVStoreApplication()
+    sk = ed25519.PrivKey.from_seed(b"\x09" * 32)
+    pub = sk.pub_key().data
+    addr = sk.pub_key().address()
+    app.init_chain(
+        pb.InitChainRequest(
+            chain_id="t",
+            validators=[
+                pb.ValidatorUpdate(power=5, pub_key_type="ed25519", pub_key_bytes=pub)
+            ],
+        )
+    )
+    r = app.finalize_block(
+        pb.FinalizeBlockRequest(
+            height=1,
+            misbehavior=[
+                pb.Misbehavior(
+                    type=pb.MISBEHAVIOR_TYPE_DUPLICATE_VOTE,
+                    validator=pb.ValidatorAbci(address=addr, power=5),
+                    height=1,
+                )
+            ],
+        )
+    )
+    assert len(r.validator_updates) == 1
+    assert r.validator_updates[0].power == 4
+
+
+def test_kvstore_snapshot_restore():
+    app = KVStoreApplication()
+    app.finalize_block(pb.FinalizeBlockRequest(txs=[b"x=42"], height=1))
+    app.commit(pb.CommitRequest())
+    snaps = app.list_snapshots(pb.ListSnapshotsRequest()).snapshots
+    assert len(snaps) == 1 and snaps[0].chunks == 1
+    chunk = app.load_snapshot_chunk(
+        pb.LoadSnapshotChunkRequest(height=snaps[0].height, format=snaps[0].format, chunk=0)
+    ).chunk
+    fresh = KVStoreApplication()
+    assert (
+        fresh.offer_snapshot(pb.OfferSnapshotRequest(snapshot=snaps[0])).result
+        == pb.OFFER_SNAPSHOT_RESULT_ACCEPT
+    )
+    res = fresh.apply_snapshot_chunk(pb.ApplySnapshotChunkRequest(index=0, chunk=chunk))
+    assert res.result == pb.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT
+    assert fresh.query(pb.QueryRequest(path="/key", data=b"x")).value == b"42"
+    assert fresh.size == app.size and fresh.height == app.height
+
+
+def test_socket_client_server_roundtrip():
+    app = KVStoreApplication()
+    srv = SocketServer("127.0.0.1:0", app)
+    srv.start()
+    try:
+        cli = SocketClient(srv.laddr)
+        cli.start()
+        try:
+            assert cli.echo("hi").message == "hi"
+            info = cli.info(pb.InfoRequest(version="v1"))
+            assert info.version == "kvstore-tpu/0.1"
+            r = cli.check_tx(pb.CheckTxRequest(tx=b"k=v"))
+            assert r.code == 0 and r.lane_id == "default"
+            fb = cli.finalize_block(pb.FinalizeBlockRequest(txs=[b"k=v"], height=1))
+            assert len(fb.tx_results) == 1
+            cli.commit()
+            assert cli.query(pb.QueryRequest(path="/key", data=b"k")).value == b"v"
+        finally:
+            cli.stop()
+    finally:
+        srv.stop()
+
+
+def test_socket_client_pipelined_checktx():
+    app = KVStoreApplication()
+    srv = SocketServer("127.0.0.1:0", app)
+    srv.start()
+    try:
+        cli = SocketClient(srv.laddr)
+        cli.start()
+        try:
+            rrs = [
+                cli.check_tx_async(pb.CheckTxRequest(tx=b"%d=v" % i))
+                for i in range(50)
+            ]
+            for rr in rrs:
+                resp = rr.wait(5.0)
+                assert resp.check_tx.code == 0
+        finally:
+            cli.stop()
+    finally:
+        srv.stop()
+
+
+def test_app_conns_four_connections_shared_mutex():
+    calls = []
+
+    class RecordingApp(BaseApplication):
+        def info(self, req):
+            calls.append(threading.get_ident())
+            return pb.InfoResponse(data="x")
+
+    conns = new_app_conns(local_client_creator(RecordingApp()))
+    conns.start()
+    try:
+        for c in (conns.consensus, conns.mempool, conns.query, conns.snapshot):
+            assert c is not None and c.is_running()
+            assert c.info(pb.InfoRequest()).data == "x"
+        # all four are distinct clients but share the app
+        assert len({id(c) for c in (conns.consensus, conns.mempool, conns.query, conns.snapshot)}) == 4
+    finally:
+        conns.stop()
+
+
+def test_base_application_defaults():
+    app = BaseApplication()
+    prep = app.prepare_proposal(
+        pb.PrepareProposalRequest(txs=[b"a" * 10, b"b" * 10], max_tx_bytes=15)
+    )
+    assert prep.txs == [b"a" * 10]
+    assert (
+        app.process_proposal(pb.ProcessProposalRequest()).status
+        == pb.PROCESS_PROPOSAL_STATUS_ACCEPT
+    )
+    fb = app.finalize_block(pb.FinalizeBlockRequest(txs=[b"t1", b"t2"]))
+    assert len(fb.tx_results) == 2
+
+
+def test_abci_request_response_wire_roundtrip():
+    # oneof framing survives encode/decode with the reference field numbers
+    req = pb.Request(
+        finalize_block=pb.FinalizeBlockRequest(
+            txs=[b"a=1"], height=7, hash=b"\xaa" * 32, syncing_to_height=7
+        )
+    )
+    back = pb.Request.decode(req.encode())
+    assert back.which() == "finalize_block"
+    assert back.finalize_block.height == 7
+    assert back.finalize_block.txs == [b"a=1"]
+
+    resp = pb.Response(
+        check_tx=pb.CheckTxResponse(code=1, gas_wanted=5, lane_id="foo")
+    )
+    back = pb.Response.decode(resp.encode())
+    assert back.which() == "check_tx"
+    assert back.check_tx.lane_id == "foo"
+
+
+def test_kvstore_colon_tx_survives_commit():
+    # colon-form txs staged by a foreign proposer must not crash commit
+    app = KVStoreApplication()
+    r = app.finalize_block(pb.FinalizeBlockRequest(txs=[b"a:b"], height=1))
+    assert r.tx_results[0].code == 0
+    app.commit(pb.CommitRequest())
+    assert app.query(pb.QueryRequest(path="/key", data=b"a")).value == b"b"
+
+
+def test_kvstore_snapshot_requires_offer_and_checks_hash():
+    app = KVStoreApplication()
+    app.finalize_block(pb.FinalizeBlockRequest(txs=[b"x=1"], height=1))
+    app.commit(pb.CommitRequest())
+    snaps = app.list_snapshots(pb.ListSnapshotsRequest()).snapshots
+    chunk = app.load_snapshot_chunk(
+        pb.LoadSnapshotChunkRequest(height=snaps[0].height, format=1, chunk=0)
+    ).chunk
+    fresh = KVStoreApplication()
+    # apply without offer -> abort
+    res = fresh.apply_snapshot_chunk(pb.ApplySnapshotChunkRequest(index=0, chunk=chunk))
+    assert res.result == pb.APPLY_SNAPSHOT_CHUNK_RESULT_ABORT
+    # corrupted chunk -> retry + sender rejection
+    fresh.offer_snapshot(pb.OfferSnapshotRequest(snapshot=snaps[0]))
+    res = fresh.apply_snapshot_chunk(
+        pb.ApplySnapshotChunkRequest(index=0, chunk=chunk + b"x", sender="peer1")
+    )
+    assert res.result == pb.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY
+    assert res.refetch_chunks == [0] and res.reject_senders == ["peer1"]
+    # good chunk -> accept
+    res = fresh.apply_snapshot_chunk(pb.ApplySnapshotChunkRequest(index=0, chunk=chunk))
+    assert res.result == pb.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT
+
+
+def test_socket_server_rejects_malformed_frame():
+    import socket as pysock
+
+    app = KVStoreApplication()
+    srv = SocketServer("127.0.0.1:0", app)
+    srv.start()
+    try:
+        host, port = srv.laddr.rsplit(":", 1)
+        s = pysock.create_connection((host, int(port)))
+        # valid echo followed by a garbage frame in the same segment
+        req = pb.Request(echo=pb.EchoRequest(message="ok"))
+        payload = req.encode()
+        from cometbft_tpu.wire.proto import encode_varint
+
+        garbage = encode_varint(4) + b"\xff\xff\xff\xff"
+        s.sendall(encode_varint(len(payload)) + payload + garbage)
+        s.settimeout(5)
+        data = b""
+        try:
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+        except pysock.timeout:
+            pass
+        # both the echo response and an exception response came back
+        from cometbft_tpu.wire.proto import decode_varint
+
+        ln, pos = decode_varint(data)
+        first = pb.Response.decode(data[pos : pos + ln])
+        assert first.which() == "echo" and first.echo.message == "ok"
+        rest = data[pos + ln :]
+        ln2, pos2 = decode_varint(rest)
+        second = pb.Response.decode(rest[pos2 : pos2 + ln2])
+        assert second.which() == "exception"
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_socket_client_retries_until_server_up():
+    import socket as pysock
+    import threading as thr
+    import time
+
+    # reserve a port, start the server late; must_connect=False client waits
+    probe = pysock.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    app = KVStoreApplication()
+    srv = SocketServer(f"127.0.0.1:{port}", app)
+
+    def late_start():
+        time.sleep(1.0)
+        srv.start()
+
+    t = thr.Thread(target=late_start)
+    t.start()
+    cli = SocketClient(f"127.0.0.1:{port}", must_connect=False, timeout=10.0)
+    cli.start()  # retries until the server binds
+    try:
+        assert cli.echo("late").message == "late"
+    finally:
+        cli.stop()
+        t.join()
+        srv.stop()

@@ -1,0 +1,288 @@
+"""BlockExecutor tests: drive a real multi-height chain against the
+kvstore app (mirrors reference state/execution_test.go, validation_test.go).
+
+This is the vertical slice through the metric path: propose → (sign) →
+VerifyCommit → ApplyBlock → store, minus the consensus timing loop.
+"""
+
+import pytest
+
+from cometbft_tpu.abci import KVStoreApplication
+from cometbft_tpu.abci.kvstore import default_lanes, make_val_set_change_tx
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool import CListMempool, MempoolConfig
+from cometbft_tpu.proxy import local_client_creator, new_app_conns
+from cometbft_tpu.state.execution import (
+    BlockExecutor,
+    InvalidBlockError,
+    build_last_commit_info,
+    max_data_bytes,
+    update_state,
+    validate_block,
+)
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.types import block as T
+from cometbft_tpu.types.event_bus import EventBus, EventQueryNewBlock, EventQueryTx
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.wire import abci_pb as pb
+from cometbft_tpu.wire.canonical import Timestamp
+
+PRECOMMIT_TYPE = 2
+GENESIS_NS = 1_700_000_000 * 1_000_000_000
+
+
+class Harness:
+    """One in-process node: app + proxy + stores + executor."""
+
+    def __init__(self, n_vals=2):
+        self.keys = [ed25519.PrivKey.from_seed(bytes([i + 1]) * 32) for i in range(n_vals)]
+        self.genesis = GenesisDoc(
+            chain_id="exec-chain",
+            genesis_time=Timestamp.from_unix_ns(GENESIS_NS),
+            validators=[
+                GenesisValidator(
+                    pub_key_type="ed25519", pub_key_bytes=k.pub_key().data, power=10
+                )
+                for k in self.keys
+            ],
+            app_hash=b"\x00" * 8,  # kvstore size-0 hash
+        )
+        self.state = make_genesis_state(self.genesis)
+        self.app = KVStoreApplication(lanes=default_lanes())
+        self.conns = new_app_conns(local_client_creator(self.app))
+        self.conns.start()
+        self.app.init_chain(
+            pb.InitChainRequest(
+                chain_id="exec-chain",
+                validators=[
+                    pb.ValidatorUpdate(
+                        power=10,
+                        pub_key_type="ed25519",
+                        pub_key_bytes=k.pub_key().data,
+                    )
+                    for k in self.keys
+                ],
+            )
+        )
+        self.state_store = StateStore(MemDB())
+        self.state_store.bootstrap(self.state)
+        self.block_store = BlockStore(MemDB())
+        self.mempool = CListMempool(
+            MempoolConfig(),
+            self.conns.mempool,
+            lane_priorities=default_lanes(),
+            default_lane="default",
+        )
+        self.event_bus = EventBus()
+        self.executor = BlockExecutor(
+            self.state_store,
+            self.conns.consensus,
+            self.mempool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+        self.last_block_id = None
+        self.last_commit = None
+        self.last_commit_ts = None
+
+    def propose(self, height, block_time=None):
+        proposer = self.state.validators.get_proposer().address
+        block, part_set = self.executor.create_proposal_block(
+            height, self.state, None, proposer, block_time
+        )
+        if height > self.state.initial_height:
+            block.last_commit = self.last_commit
+            block.header.last_commit_hash = b""
+            block.fill_header()
+        return block, part_set
+
+    def commit_for(self, block, part_set, ts):
+        """All validators precommit-sign the block (real signatures —
+        these hit the TPU batch verifier in validate_block)."""
+        bid = T.BlockID(
+            hash=block.hash(),
+            part_set_header=T.PartSetHeader(
+                total=part_set.header.total, hash=part_set.header.hash
+            ),
+        )
+        sigs = []
+        for i, v in enumerate(self.state.validators.validators):
+            key = next(k for k in self.keys if k.pub_key().address() == v.address)
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=block.header.height,
+                round=0,
+                block_id=bid,
+                timestamp=ts,
+                validator_address=v.address,
+                validator_index=i,
+            )
+            vote.signature = key.sign(vote.sign_bytes(self.state.chain_id))
+            sigs.append(vote.to_commit_sig())
+        return bid, T.Commit(
+            height=block.header.height, round=0, block_id=bid, signatures=sigs
+        )
+
+    def step(self, height, ts_ns):
+        """Full height: propose, sign, validate+apply.
+
+        BFT time: height h's block time must equal the weighted median of
+        last_commit's timestamps (validation.go:130), so the block reuses
+        the previous height's commit timestamp; this height's precommits
+        are stamped ts_ns + 1s (voting happens after proposing).
+        """
+        commit_ts = Timestamp.from_unix_ns(ts_ns + 1_000_000_000)
+        # block time: initial height uses genesis time; later heights use
+        # the median commit time of last_commit
+        block, part_set = self.propose(
+            height, None if height == self.state.initial_height else self.last_commit_ts
+        )
+        bid, commit = self.commit_for(block, part_set, commit_ts)
+        self.state = self.executor.apply_block(self.state, bid, block)
+        self.block_store.save_block(block, part_set, commit)
+        self.last_block_id = bid
+        self.last_commit = commit
+        self.last_commit_ts = commit_ts
+        return block
+
+    def stop(self):
+        self.conns.stop()
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.stop()
+
+
+def test_three_height_chain(harness):
+    h = harness
+    h.mempool.check_tx(b"a=1")
+    b1 = h.step(1, GENESIS_NS)
+    assert b1.data.txs == [b"a=1"]
+    assert h.state.last_block_height == 1
+    assert h.state.app_hash == b"\x02" + b"\x00" * 7  # kvstore size=1
+
+    # the committed tx left the mempool
+    assert h.mempool.size() == 0
+
+    h.mempool.check_tx(b"b=2")
+    h.mempool.check_tx(b"c=3")
+    # height 2 carries a real signed LastCommit for height 1 — verify_commit
+    # (the TPU-backed hot path) must pass inside apply_block
+    b2 = h.step(2, GENESIS_NS + 2_000_000_000)
+    assert sorted(b2.data.txs) == [b"b=2", b"c=3"]
+    assert h.state.last_block_height == 2
+
+    h.step(3, GENESIS_NS + 4_000_000_000)
+    assert h.state.last_block_height == 3
+    # app agrees
+    info = h.conns.query.info(pb.InfoRequest())
+    assert info.last_block_height == 3
+    assert info.last_block_app_hash == h.state.app_hash
+
+
+def test_validate_block_rejects_bad_commit(harness):
+    h = harness
+    h.step(1, GENESIS_NS)
+    block, part_set = h.propose(2, h.last_commit_ts)
+    bid, commit = h.commit_for(block, part_set, h.last_commit_ts)
+    # apply_block at height 2 with a block whose last_commit has bad sigs
+    # must fail commit verification
+    block.last_commit = T.Commit(
+        height=1,
+        round=0,
+        block_id=h.last_commit.block_id,
+        signatures=[
+            T.CommitSig(
+                block_id_flag=T.BLOCK_ID_FLAG_COMMIT,
+                validator_address=cs.validator_address,
+                timestamp=cs.timestamp,
+                signature=bytes(64),
+            )
+            for cs in h.last_commit.signatures
+        ],
+    )
+    block.header.last_commit_hash = b""
+    block.fill_header()
+    with pytest.raises(Exception):
+        h.executor.apply_block(h.state, bid, block)
+
+
+def test_validator_update_via_tx(harness):
+    h = harness
+    h.step(1, GENESIS_NS)
+    newkey = ed25519.PrivKey.from_seed(b"\x77" * 32)
+    h.mempool.check_tx(make_val_set_change_tx(newkey.pub_key().data, 4))
+    h.step(2, GENESIS_NS + 2_000_000_000)
+    # validator set at height 4 (h+2) includes the new key
+    assert h.state.next_validators.size() == 3
+    assert h.state.validators.size() == 2
+    h.keys.append(newkey)
+    h.step(3, GENESIS_NS + 4_000_000_000)
+    assert h.state.validators.size() == 3
+    # state store has the historical sets
+    assert h.state_store.load_validators(2).size() == 2
+    assert h.state_store.load_validators(4).size() == 3
+
+
+def test_events_fired_on_apply(harness):
+    h = harness
+    sub_block = h.event_bus.subscribe("test", EventQueryNewBlock)
+    sub_tx = h.event_bus.subscribe("test2", EventQueryTx)
+    h.mempool.check_tx(b"k=v")
+    h.step(1, GENESIS_NS)
+    msg, _ = sub_block.get(timeout=1)
+    assert msg.data["block"].header.height == 1
+    txmsg, tx_events = sub_tx.get(timeout=1)
+    assert txmsg.data["tx"] == b"k=v"
+    assert tx_events["tx.height"] == ["1"]
+
+
+def test_validate_block_contextual_errors(harness):
+    h = harness
+    h.step(1, GENESIS_NS)
+    block, part_set = h.propose(2, h.last_commit_ts)
+    good_app_hash = block.header.app_hash
+
+    block.header.app_hash = b"\xde\xad" * 16
+    with pytest.raises(InvalidBlockError, match="AppHash"):
+        validate_block(h.state, block)
+    block.header.app_hash = good_app_hash
+
+    # non-increasing time
+    block.header.time = Timestamp.from_unix_ns(GENESIS_NS)
+    with pytest.raises(InvalidBlockError, match="time"):
+        validate_block(h.state, block)
+
+
+def test_finalize_result_count_mismatch_detected(harness):
+    class BadApp(KVStoreApplication):
+        def finalize_block(self, req):
+            r = super().finalize_block(req)
+            r.tx_results = []
+            return r
+
+    h = harness
+    bad_app = BadApp()
+    conns = new_app_conns(local_client_creator(bad_app))
+    conns.start()
+    try:
+        h.executor.proxy_app = conns.consensus
+        h.mempool.check_tx(b"x=y")
+        with pytest.raises(Exception, match="tx results"):
+            h.step(1, GENESIS_NS)
+    finally:
+        conns.stop()
+
+
+def test_max_data_bytes():
+    assert max_data_bytes(-1, 0, 10) > 1 << 30
+    with pytest.raises(Exception):
+        max_data_bytes(100, 0, 1)  # too small for overhead
+    assert max_data_bytes(10000, 0, 1) == 10000 - 11 - 626 - 109 - 94

@@ -1,0 +1,151 @@
+"""Mempool tests (mirrors reference mempool/clist_mempool_test.go,
+iterators_test.go, cache_test.go)."""
+
+import pytest
+
+from cometbft_tpu.abci import KVStoreApplication, LocalClient
+from cometbft_tpu.abci.kvstore import default_lanes
+from cometbft_tpu.mempool import (
+    CListMempool,
+    LRUTxCache,
+    MempoolConfig,
+    MempoolFullError,
+    NopMempool,
+)
+from cometbft_tpu.mempool.clist_mempool import IWRRIterator, TxEntry
+from cometbft_tpu.mempool.mempool import (
+    AppCheckError,
+    MempoolError,
+    TxInCacheError,
+    TxInMempoolError,
+    key_of,
+)
+from cometbft_tpu.wire import abci_pb as pb
+
+
+def _mempool(config=None, lanes=True):
+    app = KVStoreApplication(lanes=default_lanes() if lanes else None)
+    client = LocalClient(app)
+    if lanes:
+        return CListMempool(
+            config or MempoolConfig(),
+            client,
+            lane_priorities=default_lanes(),
+            default_lane="default",
+        )
+    return CListMempool(config or MempoolConfig(), client)
+
+
+def test_checktx_admits_and_dedups():
+    mp = _mempool()
+    mp.check_tx(b"1=a")
+    assert mp.size() == 1
+    assert mp.size_bytes() == 3
+    with pytest.raises(TxInMempoolError):
+        mp.check_tx(b"1=a")
+    assert mp.size() == 1
+
+
+def test_checktx_rejects_invalid_tx():
+    mp = _mempool()
+    with pytest.raises(AppCheckError):
+        mp.check_tx(b"garbage")
+    assert mp.size() == 0
+    # invalid tx was evicted from the cache: checking again hits the app again
+    with pytest.raises(AppCheckError):
+        mp.check_tx(b"garbage")
+
+
+def test_lane_routing():
+    mp = _mempool()
+    mp.check_tx(b"22=a")   # foo (22 % 11 == 0)
+    mp.check_tx(b"3=b")    # bar
+    mp.check_tx(b"5=c")    # default
+    assert len(mp.lanes["foo"]) == 1
+    assert len(mp.lanes["bar"]) == 1
+    assert len(mp.lanes["default"]) == 1
+
+
+def test_mempool_full():
+    mp = _mempool(MempoolConfig(size=2))
+    mp.check_tx(b"1=a")
+    mp.check_tx(b"2=b")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"4=c")
+    assert mp.size() == 2
+    # rejected-for-capacity tx is not poisoned in the cache: succeeds later
+    mp.flush()
+    mp.check_tx(b"4=c")
+    assert mp.size() == 1
+
+
+def test_iwrr_interleaving():
+    # priorities: a=3, b=1 -> per 3-round cycle: a,b,a,a
+    lanes = {
+        "a": [TxEntry(bytes([i]), bytes([i]), 0, 0, "a") for i in range(6)],
+        "b": [TxEntry(bytes([100 + i]), bytes([100 + i]), 0, 0, "b") for i in range(6)],
+    }
+    order = [e.lane for e in IWRRIterator(lanes, {"a": 3, "b": 1})]
+    assert order[:8] == ["a", "b", "a", "a", "a", "b", "a", "a"]
+
+
+def test_reap_respects_limits_and_lane_priority():
+    mp = _mempool()
+    mp.check_tx(b"22=aa")  # foo lane, priority 7
+    mp.check_tx(b"3=bb")   # bar lane, priority 1
+    mp.check_tx(b"5=cc")   # default lane, priority 3
+    all_txs = mp.reap_max_bytes_max_gas(-1, -1)
+    assert len(all_txs) == 3
+    assert all_txs[0] == b"22=aa"  # highest-priority lane leads
+    # byte budget: one tx is 5 bytes + 2 overhead = 7
+    assert mp.reap_max_bytes_max_gas(7, -1) == [b"22=aa"]
+    # gas budget: each kvstore tx wants gas 1
+    assert len(mp.reap_max_bytes_max_gas(-1, 2)) == 2
+    assert len(mp.reap_max_txs(1)) == 1
+
+
+def test_update_removes_committed_and_rechecks():
+    mp = _mempool()
+    mp.check_tx(b"1=a")
+    mp.check_tx(b"2=b")
+    mp.lock()
+    try:
+        mp.update(
+            1, [b"1=a"], [pb.ExecTxResult(code=0)],
+        )
+    finally:
+        mp.unlock()
+    assert mp.size() == 1
+    assert not mp.contains(key_of(b"1=a"))
+    # committed tx stays cached: re-adding is rejected without an app call
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"1=a")
+
+
+def test_txs_available_notification():
+    mp = _mempool()
+    mp.enable_txs_available()
+    assert not mp.txs_available().is_set()
+    mp.check_tx(b"1=a")
+    assert mp.txs_available().is_set()
+    # drained at next height -> cleared
+    mp.lock()
+    mp.update(1, [b"1=a"], [pb.ExecTxResult(code=0)])
+    mp.unlock()
+    assert not mp.txs_available().is_set()
+
+
+def test_lru_cache_eviction():
+    c = LRUTxCache(2)
+    assert c.push(b"a") and c.push(b"b")
+    assert not c.push(b"a")  # refresh
+    c.push(b"c")             # evicts b (a was refreshed)
+    assert c.has(b"a") and c.has(b"c") and not c.has(b"b")
+
+
+def test_nop_mempool():
+    mp = NopMempool()
+    with pytest.raises(MempoolError):
+        mp.check_tx(b"x=y")
+    assert mp.reap_max_bytes_max_gas(-1, -1) == []
+    assert mp.size() == 0
