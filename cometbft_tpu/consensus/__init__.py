@@ -1,0 +1,7 @@
+"""Consensus: the Tendermint BFT state machine and its services
+(reference: internal/consensus/).
+"""
+
+from .wal import WAL, NilWAL, WALSearchOptions
+
+__all__ = ["WAL", "NilWAL", "WALSearchOptions"]

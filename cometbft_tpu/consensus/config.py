@@ -1,0 +1,45 @@
+"""Consensus timing configuration (reference: config/config.go
+ConsensusConfig; durations in seconds).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+    wal_path: str = "data/cs.wal/wal"
+
+    def propose_timeout(self, round: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round
+
+    def prevote_timeout(self, round: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round
+
+    def precommit_timeout(self, round: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round
+
+
+def test_consensus_config() -> ConsensusConfig:
+    """Fast timeouts for in-process tests (config.go TestConsensusConfig)."""
+    return ConsensusConfig(
+        timeout_propose=0.8,
+        timeout_propose_delta=0.2,
+        timeout_prevote=0.4,
+        timeout_prevote_delta=0.2,
+        timeout_precommit=0.4,
+        timeout_precommit_delta=0.2,
+        peer_gossip_sleep_duration=0.01,
+        peer_query_maj23_sleep_duration=0.25,
+    )

@@ -1,0 +1,956 @@
+"""The Tendermint consensus state machine (reference:
+internal/consensus/state.go, 2,792 LoC).
+
+One worker thread (receive_routine, state.go:795) serializes every input
+— peer messages, our own internally-routed proposals/votes, and timeouts
+— and every input is WAL-logged before it mutates state (state.go:839).
+Round flow: NewRound → Propose → Prevote → (PrevoteWait) → Precommit →
+(PrecommitWait) → Commit; on +2/3 precommits finalize_commit saves the
+block, fsyncs EndHeight into the WAL, applies the block through the
+executor (whose LastCommit verification is the TPU hot path next height)
+and schedules round 0 of the next height.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..state.execution import BlockExecutor
+from ..state.state import State as SMState
+from ..types import event_bus as events
+from ..types.block import Block, BlockID, Commit
+from ..types.part_set import Part, PartSet
+from ..types.proposal import Proposal
+from ..types.validators import ValidatorSet
+from ..types.vote import Vote, VoteError
+from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
+from ..utils.log import get_logger
+from ..utils.service import Service
+from ..wire import wal_pb
+from ..wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE, Timestamp
+from .config import ConsensusConfig
+from .ticker import TimeoutInfo, TimeoutTicker
+from .types import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+    RoundState,
+)
+from .wal import WAL, NilWAL, WALSearchOptions
+
+_NS = 1_000_000_000
+
+
+# ------------------------------------------------------------ queue items
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass
+class MsgInfo:
+    msg: object
+    peer_id: str  # "" = internal
+    receive_time_ns: int = 0
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class ConsensusState(Service):
+    """internal/consensus/state.go State."""
+
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: SMState,
+        block_exec: BlockExecutor,
+        block_store,
+        tx_notifier,  # mempool (txs_available / enable_txs_available)
+        ev_pool=None,
+        wal=None,
+        event_bus=None,
+    ):
+        super().__init__("ConsensusState")
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.tx_notifier = tx_notifier
+        self.ev_pool = ev_pool
+        self.event_bus = event_bus or events.NopEventBus()
+        self.wal = wal or NilWAL()
+        self.logger = get_logger("consensus")
+
+        self.priv_validator = None
+        self.priv_validator_pub_key = None
+
+        self.rs = RoundState()
+        self.state = None  # set by update_to_state
+
+        self._queue: queue.Queue[MsgInfo | TimeoutInfo] = queue.Queue(maxsize=1000)
+        self._ticker = TimeoutTicker(self._enqueue)
+        self._thread: threading.Thread | None = None
+        self._mtx = threading.RLock()
+        self._replay_mode = False
+
+        # hooks for tests/reactor: called with (vote) / (proposal) / (part)
+        self.on_new_round_step = lambda rs: None
+        self.decide_proposal_hook = None  # override for byzantine tests
+        # reactor seam: own proposals/votes/parts that must reach peers
+        self.broadcast_hook = None  # Callable[[object], None] | None
+
+        self.update_to_state(state)
+
+    # ------------------------------------------------------ wiring helpers
+
+    def set_priv_validator(self, pv) -> None:
+        with self._mtx:
+            self.priv_validator = pv
+            if pv is not None:
+                self.priv_validator_pub_key = pv.get_pub_key()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def on_start(self) -> None:
+        if isinstance(self.wal, NilWAL) and self.config.wal_path:
+            self.wal = WAL(self.config.wal_path)
+        if isinstance(self.wal, WAL):
+            self.wal.start()
+            self._catchup_replay(self.rs.height)
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="cs-receive", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round0(self.rs)
+
+    def on_stop(self) -> None:
+        self._ticker.stop()
+        self._enqueue(None)  # wake the routine so it can exit
+        if self._thread:
+            self._thread.join(timeout=5)
+        if isinstance(self.wal, WAL):
+            self.wal.stop()
+
+    # --------------------------------------------------------- public API
+
+    def _enqueue(self, item) -> None:
+        """Never block the caller (reactor/ticker threads): shed peer load
+        when the machine is saturated rather than deadlocking."""
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self.logger.error("consensus queue full; dropping input")
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        self._enqueue(MsgInfo(VoteMessage(vote), peer_id, time.time_ns()))
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self._enqueue(MsgInfo(ProposalMessage(proposal), peer_id, time.time_ns()))
+
+    def add_proposal_block_part(
+        self, height: int, round: int, part: Part, peer_id: str = ""
+    ) -> None:
+        self._enqueue(
+            MsgInfo(BlockPartMessage(height, round, part), peer_id, time.time_ns())
+        )
+
+    def get_round_state(self) -> RoundState:
+        with self._mtx:
+            return self.rs
+
+    def is_proposer(self) -> bool:
+        with self._mtx:
+            return (
+                self.priv_validator_pub_key is not None
+                and self.rs.validators is not None
+                and self.rs.validators.get_proposer().address
+                == self.priv_validator_pub_key.address()
+            )
+
+    # -------------------------------------------------------- state reset
+
+    def update_to_state(self, state: SMState) -> None:
+        """Prepare RoundState for state.last_block_height+1
+        (state.go updateToState)."""
+        with self._mtx:
+            # the committed round's commit time anchors the next height's
+            # start time (reference updateToState uses cs.CommitTime)
+            commit_time = self.rs.commit_time_ns or time.time_ns()
+            # last precommits become LastCommit for the next proposal
+            last_precommits = None
+            if (
+                self.rs.commit_round > -1
+                and self.rs.votes is not None
+                and self.rs.height == state.last_block_height
+            ):
+                vs = self.rs.votes.precommits(self.rs.commit_round)
+                if vs is not None and vs.has_two_thirds_majority():
+                    last_precommits = vs
+
+            height = state.last_block_height + 1
+            if height == 1:
+                height = state.initial_height
+
+            validators = state.validators
+            ext_enabled = state.consensus_params.feature.vote_extensions_enabled(height)
+
+            self.rs = RoundState(
+                height=height,
+                round=0,
+                step=STEP_NEW_HEIGHT,
+                validators=validators.copy() if validators else None,
+                votes=HeightVoteSet(
+                    state.chain_id, height, validators, ext_enabled
+                )
+                if validators
+                else None,
+                commit_round=-1,
+                last_commit=last_precommits,
+                last_validators=state.last_validators.copy()
+                if state.last_validators
+                else None,
+            )
+            self.rs.start_time_ns = commit_time + state.next_block_delay_ns
+            self.state = state
+
+    # ------------------------------------------------------- WAL catchup
+
+    def _catchup_replay(self, height: int) -> None:
+        """Replay WAL records after EndHeight(height-1) into the machine
+        (replay.go:97 catchupReplay)."""
+        end = self.state.last_block_height
+        recs = self.wal.search_for_end_height(
+            end, WALSearchOptions(ignore_data_corruption_errors=True)
+        )
+        if recs is None:
+            return
+        self._replay_mode = True
+        try:
+            for rec in recs:
+                self._replay_record(rec)
+        finally:
+            self._replay_mode = False
+        self.logger.info(f"replayed {len(recs)} WAL records after height {end}")
+
+    def _replay_record(self, rec: wal_pb.TimedWALMessageProto) -> None:
+        m = rec.msg
+        which = m.which()
+        if which == "msg_info":
+            mi = m.msg_info
+            if mi.vote is not None:
+                self._handle_msg(MsgInfo(VoteMessage(Vote.from_proto(mi.vote)), mi.peer_id))
+            elif mi.proposal is not None:
+                self._handle_msg(
+                    MsgInfo(ProposalMessage(Proposal.from_proto(mi.proposal)), mi.peer_id)
+                )
+            elif mi.block_part is not None:
+                self._handle_msg(
+                    MsgInfo(
+                        BlockPartMessage(
+                            mi.block_part_height,
+                            mi.block_part_round,
+                            Part.from_proto(mi.block_part),
+                        ),
+                        mi.peer_id,
+                    )
+                )
+        elif which == "timeout_info":
+            ti = m.timeout_info
+            self._handle_timeout(
+                TimeoutInfo(ti.duration_ms / 1000.0, ti.height, ti.round, ti.step)
+            )
+
+    # ------------------------------------------------------ receive loop
+
+    def _receive_routine(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                if isinstance(item, TimeoutInfo):
+                    self._wal_write_timeout(item)
+                    with self._mtx:
+                        self._handle_timeout(item)
+                else:
+                    self._wal_write_msg(item)
+                    with self._mtx:
+                        self._handle_msg(item)
+            except (ConsensusError, VoteError, ValueError) as e:
+                # malformed/adversarial peer input is a per-message error
+                # (state.go:900 logs and continues); never a reason to halt
+                self.logger.error(f"error handling consensus input: {e}")
+            except Exception as e:  # noqa: BLE001 - halt, never sign wrongly
+                self.logger.error(f"consensus failure: {e!r}")
+                import traceback
+
+                traceback.print_exc()
+                return
+
+    def _wal_write_msg(self, mi: MsgInfo) -> None:
+        if self._replay_mode:
+            return
+        msg = mi.msg
+        p = wal_pb.MsgInfoProto(peer_id=mi.peer_id)
+        if isinstance(msg, VoteMessage):
+            p.vote = msg.vote.to_proto()
+        elif isinstance(msg, ProposalMessage):
+            p.proposal = msg.proposal.to_proto()
+        elif isinstance(msg, BlockPartMessage):
+            p.block_part = msg.part.to_proto()
+            p.block_part_height = msg.height
+            p.block_part_round = msg.round
+        rec = wal_pb.WALMessageProto(msg_info=p)
+        if isinstance(msg, VoteMessage) and mi.peer_id == "":
+            self.wal.write_sync(rec)  # our own votes: fsync before send
+        else:
+            self.wal.write(rec)
+
+    def _wal_write_timeout(self, ti: TimeoutInfo) -> None:
+        if self._replay_mode:
+            return
+        self.wal.write(
+            wal_pb.WALMessageProto(
+                timeout_info=wal_pb.TimeoutInfoProto(
+                    duration_ms=int(ti.duration * 1000),
+                    height=ti.height,
+                    round=ti.round,
+                    step=ti.step,
+                )
+            )
+        )
+
+    # ---------------------------------------------------------- handlers
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        msg = mi.msg
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal, mi.receive_time_ns)
+        elif isinstance(msg, BlockPartMessage):
+            self._add_proposal_block_part(msg, mi.peer_id)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, mi.peer_id)
+        else:
+            self.logger.error(f"unknown msg type {type(msg)}")
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return  # stale
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self.event_bus.publish_timeout_propose(rs.round_state_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ConsensusError(f"invalid timeout step {ti.step}")
+
+    # -------------------------------------------------------- round entry
+
+    def _schedule_round0(self, rs: RoundState) -> None:
+        sleep = max(0.0, (rs.start_time_ns - time.time_ns()) / _NS)
+        self._ticker.schedule(TimeoutInfo(sleep, rs.height, 0, STEP_NEW_HEIGHT))
+
+    def _update_round_step(self, round: int, step: int) -> None:
+        self.rs.round = round
+        self.rs.step = step
+        ev = self.rs.round_state_event()
+        if not self._replay_mode:
+            self.event_bus.publish_new_round_step(ev)
+        self.on_new_round_step(self.rs)
+
+    def _enter_new_round(self, height: int, round: int) -> None:
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            rs.round == round and rs.step != STEP_NEW_HEIGHT
+        ):
+            return
+        validators = rs.validators
+        if rs.round < round:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round - rs.round)
+        self._update_round_step(round, STEP_NEW_ROUND)
+        rs.validators = validators
+        if round != 0:
+            # round advanced: drop the stale proposal (state.go:1102)
+            rs.proposal = None
+            rs.proposal_receive_time_ns = 0
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round + 1)
+        rs.triggered_timeout_precommit = False
+        self.event_bus.publish_new_round(rs.round_state_event())
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks
+            and round == 0
+            and self.tx_notifier is not None
+            and self.tx_notifier.size() == 0
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._ticker.schedule(
+                    TimeoutInfo(
+                        self.config.create_empty_blocks_interval,
+                        height,
+                        round,
+                        STEP_NEW_ROUND,
+                    )
+                )
+            self._wait_for_txs(height, round)
+        else:
+            self._enter_propose(height, round)
+
+    def _wait_for_txs(self, height: int, round: int) -> None:
+        def waiter():
+            self.tx_notifier.txs_available().wait()
+            self._queue.put(TimeoutInfo(0, height, round, STEP_NEW_ROUND))
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    # ------------------------------------------------------------ propose
+
+    def _enter_propose(self, height: int, round: int) -> None:
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            rs.round == round and rs.step >= STEP_PROPOSE
+        ):
+            return
+        self._update_round_step(round, STEP_PROPOSE)
+        self._ticker.schedule(
+            TimeoutInfo(self.config.propose_timeout(round), height, round, STEP_PROPOSE)
+        )
+        if self.priv_validator is not None and self.is_proposer():
+            self._decide_proposal(height, round)
+        if self._is_proposal_complete():
+            self._enter_prevote(height, rs.round)
+
+    def _decide_proposal(self, height: int, round: int) -> None:
+        """state.go:1226 defaultDecideProposal."""
+        if self.decide_proposal_hook is not None:
+            self.decide_proposal_hook(self, height, round)
+            return
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            last_ext_commit = self._load_last_extended_commit(height)
+            try:
+                block, block_parts = self.block_exec.create_proposal_block(
+                    height,
+                    self.state,
+                    last_ext_commit,
+                    self.priv_validator_pub_key.address(),
+                )
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"failed to create proposal block: {e}")
+                return
+        bid = BlockID(
+            hash=block.hash(),
+            part_set_header=block_parts.header,
+        )
+        proposal = Proposal(
+            height=height,
+            round=round,
+            pol_round=rs.valid_round,
+            block_id=bid,
+            timestamp=Timestamp.from_unix_ns(time.time_ns()),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:  # noqa: BLE001
+            if not self._replay_mode:
+                self.logger.error(f"propose step; failed signing proposal: {e}")
+            return
+        # internal inputs are WAL-logged exactly like peer inputs
+        self._internal_msg(MsgInfo(ProposalMessage(proposal), "", time.time_ns()))
+        for i in range(block_parts.header.total):
+            self._internal_msg(
+                MsgInfo(BlockPartMessage(height, round, block_parts.get_part(i)), "", 0)
+            )
+        self.logger.info(f"signed proposal {height}/{round} {bid.hash.hex()[:12]}")
+
+    def _load_last_extended_commit(self, height: int):
+        if height == self.state.initial_height:
+            return None
+        ext_enabled = self.state.consensus_params.feature.vote_extensions_enabled(
+            height - 1
+        )
+        if ext_enabled:
+            ec = self.block_store.load_block_extended_commit(height - 1)
+            if ec is not None:
+                return ec
+        # plain commit wrapped as extension-less extended commit
+        if self.rs.last_commit is not None and self.rs.last_commit.has_two_thirds_majority():
+            return self.rs.last_commit.make_extended_commit()
+        commit = self.block_store.load_seen_commit(height - 1)
+        if commit is None:
+            raise ConsensusError(f"no commit found for height {height - 1}")
+        from ..types.block import ExtendedCommit, ExtendedCommitSig
+
+        return ExtendedCommit(
+            height=commit.height,
+            round=commit.round,
+            block_id=commit.block_id,
+            extended_signatures=[
+                ExtendedCommitSig(commit_sig=cs) for cs in commit.signatures
+            ],
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    # --------------------------------------------------- proposal intake
+
+    def _set_proposal(self, proposal: Proposal, receive_time_ns: int) -> None:
+        """state.go defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ConsensusError("invalid proposal POLRound")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ConsensusError("invalid proposal signature")
+        rs.proposal = proposal
+        rs.proposal_receive_time_ns = receive_time_ns
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> None:
+        """state.go addProposalBlockPart."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return
+        if rs.proposal_block_parts is None:
+            return  # no proposal yet: can't validate the part against a header
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added or not rs.proposal_block_parts.is_complete():
+            return
+        rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+        self.logger.info(
+            f"received complete proposal block h={rs.proposal_block.header.height} "
+            f"hash={rs.proposal_block.hash().hex()[:12]}"
+        )
+        self.event_bus.publish_complete_proposal(rs.round_state_event())
+
+        # +2/3 prevotes for this block in the current round -> update valid
+        prevotes = rs.votes.prevotes(rs.round)
+        bid, has_maj = prevotes.two_thirds_majority() if prevotes else (None, False)
+        if has_maj and not bid.is_nil() and rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == bid.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+
+        if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(rs.height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            self._try_finalize_commit(rs.height)
+
+    # ------------------------------------------------------------ prevote
+
+    def _enter_prevote(self, height: int, round: int) -> None:
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            rs.round == round and rs.step >= STEP_PREVOTE
+        ):
+            return
+        self._update_round_step(round, STEP_PREVOTE)
+        self._do_prevote(height, round)
+
+    def _do_prevote(self, height: int, round: int) -> None:
+        """state.go defaultDoPrevote: prevote locked block, else validate
+        the proposal and prevote it, else nil."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header)
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            accepted = self.block_exec.process_proposal(rs.proposal_block, self.state)
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"prevote: invalid proposal block: {e}")
+            accepted = False
+        if accepted:
+            self._sign_add_vote(
+                PREVOTE_TYPE,
+                rs.proposal_block.hash(),
+                rs.proposal_block_parts.header,
+            )
+        else:
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+
+    # ---------------------------------------------------------- precommit
+
+    def _enter_precommit(self, height: int, round: int) -> None:
+        """state.go:1609 enterPrecommit."""
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            rs.round == round and rs.step >= STEP_PRECOMMIT
+        ):
+            return
+        self._update_round_step(round, STEP_PRECOMMIT)
+        prevotes = rs.votes.prevotes(round)
+        bid, has_maj = prevotes.two_thirds_majority() if prevotes else (None, False)
+
+        if not has_maj:
+            # no polka: precommit nil
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        self.event_bus.publish_polka(rs.round_state_event())
+
+        if bid.is_nil():
+            # polka for nil: precommit nil and unlock (state.go:1661)
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                self.event_bus.publish_lock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            # relock
+            rs.locked_round = round
+            self.event_bus.publish_relock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, bid.hash, bid.part_set_header)
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
+            # lock onto the polka block
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+            except Exception as e:
+                raise ConsensusError(f"precommit: +2/3 prevoted an invalid block: {e}")
+            rs.locked_round = round
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish_lock(rs.round_state_event())
+            self._sign_add_vote(PRECOMMIT_TYPE, bid.hash, bid.part_set_header)
+            return
+
+        # polka for a block we don't have: precommit nil, fetch it
+        rs.proposal_block = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.header == bid.part_set_header:
+            rs.proposal_block_parts = PartSet(bid.part_set_header)
+        self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+
+    # ------------------------------------------------------------- commit
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step >= STEP_COMMIT:
+            return
+        rs.commit_time_ns = time.time_ns()
+        self._update_round_step(rs.round, STEP_COMMIT)
+        rs.commit_round = commit_round
+        precommits = rs.votes.precommits(commit_round)
+        bid, ok = precommits.two_thirds_majority()
+        if not ok:
+            raise ConsensusError("enterCommit without +2/3 precommits")
+        # locked block takes precedence if it matches
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        elif rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            rs.proposal_block = None
+            if rs.proposal_block_parts is None or rs.proposal_block_parts.header != bid.part_set_header:
+                rs.proposal_block_parts = PartSet(bid.part_set_header)
+            return  # wait for parts
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        bid, ok = precommits.two_thirds_majority() if precommits else (None, False)
+        if not ok or bid.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """state.go:1834: save → WAL EndHeight → apply → next height."""
+        rs = self.rs
+        bid, _ = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+
+        self.block_exec.validate_block(self.state, block)
+
+        precommits = rs.votes.precommits(rs.commit_round)
+        if self.block_store.height < block.header.height:
+            ext_enabled = self.state.consensus_params.feature.vote_extensions_enabled(
+                height
+            )
+            if ext_enabled:
+                self.block_store.save_block_with_extended_commit(
+                    block, block_parts, precommits.make_extended_commit()
+                )
+            else:
+                self.block_store.save_block(
+                    block, block_parts, precommits.make_commit()
+                )
+
+        self.wal.write_sync(
+            wal_pb.WALMessageProto(end_height=wal_pb.EndHeightProto(height=height))
+        )
+
+        state_copy = self.state.copy()
+        new_state = self.block_exec.apply_verified_block(state_copy, bid, block)
+        self.update_to_state(new_state)
+        self._schedule_round0(self.rs)
+
+    # --------------------------------------------------------------- votes
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
+        try:
+            self._add_vote(vote, peer_id)
+        except VoteError as e:
+            if isinstance(e, ErrVoteConflictingVotes):
+                if self.ev_pool is not None and peer_id:
+                    from ..types.evidence import DuplicateVoteEvidence
+
+                    existing = e.conflicting_vote
+                    try:
+                        ev = DuplicateVoteEvidence.from_votes(
+                            vote,
+                            existing,
+                            Timestamp.from_unix_ns(self.state.last_block_time.unix_ns()),
+                            self.rs.validators,
+                        )
+                        self.ev_pool.add_evidence_from_consensus(ev)
+                    except Exception as ee:  # noqa: BLE001
+                        self.logger.error(f"failed to record equivocation: {ee}")
+                self.logger.info("found conflicting vote (equivocation)")
+            else:
+                self.logger.info(f"vote rejected: {e}")
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> None:
+        rs = self.rs
+        # precommit from the previous height (late commit vote)
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
+                return
+            if rs.last_commit.add_vote(vote):
+                self.event_bus.publish_vote(vote)
+            return
+        if vote.height != rs.height:
+            return
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return
+        self.event_bus.publish_vote(vote)
+
+        if vote.type == PREVOTE_TYPE:
+            self._on_prevote_added(vote)
+        else:
+            self._on_precommit_added(vote)
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        bid, has_maj = prevotes.two_thirds_majority()
+
+        # unlock on newer polka for a different block (state.go:2339)
+        if (
+            rs.locked_block is not None
+            and rs.locked_round < vote.round
+            and vote.round <= rs.round
+            and has_maj
+            and rs.locked_block.hash() != bid.hash
+        ):
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self.event_bus.publish_lock(rs.round_state_event())
+
+        # update valid block (state.go:2357)
+        if (
+            has_maj
+            and not bid.is_nil()
+            and rs.valid_round < vote.round
+            and vote.round == rs.round
+        ):
+            if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
+                rs.valid_round = vote.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+            else:
+                rs.proposal_block = None
+                if rs.proposal_block_parts is None or rs.proposal_block_parts.header != bid.part_set_header:
+                    rs.proposal_block_parts = PartSet(bid.part_set_header)
+            self.event_bus.publish_valid_block(rs.round_state_event())
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= STEP_PREVOTE:
+            if has_maj and (self._is_proposal_complete() or bid.is_nil()):
+                self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any() and rs.step == STEP_PREVOTE:
+                self._enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+            if self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+
+    def _enter_prevote_wait(self, height: int, round: int) -> None:
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            rs.round == round and rs.step >= STEP_PREVOTE_WAIT
+        ):
+            return
+        self._update_round_step(round, STEP_PREVOTE_WAIT)
+        self._ticker.schedule(
+            TimeoutInfo(self.config.prevote_timeout(round), height, round, STEP_PREVOTE_WAIT)
+        )
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        bid, has_maj = precommits.two_thirds_majority()
+        if has_maj:
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit(rs.height, vote.round)
+            if not bid.is_nil():
+                self._enter_commit(rs.height, vote.round)
+                if precommits.has_all():
+                    self._enter_new_round(rs.height, 0)
+            else:
+                # nil majority: wait out stragglers then next round
+                self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit_wait(rs.height, vote.round)
+
+    def _enter_precommit_wait(self, height: int, round: int) -> None:
+        rs = self.rs
+        if rs.height != height or round < rs.round or (
+            round == rs.round and rs.triggered_timeout_precommit
+        ):
+            return
+        rs.triggered_timeout_precommit = True
+        self._ticker.schedule(
+            TimeoutInfo(
+                self.config.precommit_timeout(round), height, round, STEP_PRECOMMIT_WAIT
+            )
+        )
+
+    # ------------------------------------------------------------- signing
+
+    def _vote_time_ns(self) -> int:
+        """Monotonic vote timestamps for BFT time (state.go voteTime)."""
+        now = time.time_ns()
+        minimum = self.state.last_block_time.unix_ns() + 1_000_000
+        return max(now, minimum)
+
+    def _sign_vote(self, vote_type: int, block_hash: bytes, psh) -> Vote | None:
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return None
+        addr = self.priv_validator_pub_key.address()
+        idx, val = self.rs.validators.get_by_address(addr)
+        if val is None:
+            return None
+        rs = self.rs
+        block_id = (
+            BlockID(hash=block_hash, part_set_header=psh)
+            if block_hash
+            else BlockID()
+        )
+        vote = Vote(
+            type=vote_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=block_id,
+            timestamp=Timestamp.from_unix_ns(self._vote_time_ns()),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        ext_enabled = self.state.consensus_params.feature.vote_extensions_enabled(
+            rs.height
+        )
+        if (
+            vote_type == PRECOMMIT_TYPE
+            and block_hash
+            and ext_enabled
+        ):
+            vote.extension = self.block_exec.extend_vote(
+                vote, rs.proposal_block, self.state
+            )
+        try:
+            self.priv_validator.sign_vote(
+                self.state.chain_id, vote, sign_extension=ext_enabled
+            )
+        except Exception as e:  # noqa: BLE001
+            if not self._replay_mode:
+                self.logger.error(f"failed signing vote: {e}")
+            return None
+        return vote
+
+    def _sign_add_vote(self, vote_type: int, block_hash: bytes, psh) -> None:
+        vote = self._sign_vote(vote_type, block_hash, psh)
+        if vote is not None:
+            self._internal_msg(MsgInfo(VoteMessage(vote), "", time.time_ns()))
+
+    def _internal_msg(self, mi: MsgInfo) -> None:
+        """Own proposals/votes/parts: WAL-log (fsync for votes) then
+        handle inline — the same serialization point as peer inputs since
+        we already hold the state lock."""
+        self._wal_write_msg(mi)
+        self._handle_msg(mi)
+        if self.broadcast_hook is not None and not self._replay_mode:
+            self.broadcast_hook(mi.msg)

@@ -1,0 +1,60 @@
+"""Timeout scheduling (reference: internal/consensus/ticker.go).
+
+One outstanding timeout at a time: scheduling a newer (H,R,S) replaces
+the pending one; stale timeouts (older than the current round state) are
+never delivered.  Fired timeouts are posted to the state machine's queue
+as TimeoutInfo.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float  # seconds
+    height: int
+    round: int
+    step: int
+
+
+class TimeoutTicker:
+    """threading.Timer-backed ticker (ticker.go timeoutTicker)."""
+
+    def __init__(self, fire: Callable[[TimeoutInfo], None]):
+        self._fire = fire
+        self._timer: threading.Timer | None = None
+        self._pending: TimeoutInfo | None = None
+        self._mtx = threading.Lock()
+        self._stopped = False
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        """Replace any pending timeout with this one (ticker.go
+        ScheduleTimeout; newer round states always win)."""
+        with self._mtx:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._pending = ti
+            self._timer = threading.Timer(ti.duration, self._on_fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _on_fire(self, ti: TimeoutInfo) -> None:
+        with self._mtx:
+            if self._stopped or self._pending is not ti:
+                return  # replaced meanwhile
+            self._pending = None
+        self._fire(ti)
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._pending = None

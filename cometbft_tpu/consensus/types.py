@@ -1,0 +1,187 @@
+"""Consensus round state + height vote set (reference:
+internal/consensus/types/round_state.go, height_vote_set.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.block import Block, BlockID
+from ..types.part_set import PartSet
+from ..types.proposal import Proposal
+from ..types.validators import ValidatorSet
+from ..types.vote import Vote, VoteError
+from ..types.vote_set import VoteSet
+from ..wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE, Timestamp
+
+# RoundStepType (round_state.go:12-24)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight",
+    STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose",
+    STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait",
+    STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait",
+    STEP_COMMIT: "Commit",
+}
+
+
+@dataclass
+class RoundState:
+    """Everything the state machine knows about the current height/round
+    (round_state.go:27)."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time_ns: int = 0
+    commit_time_ns: int = 0
+    validators: ValidatorSet | None = None
+    proposal: Proposal | None = None
+    proposal_receive_time_ns: int = 0
+    proposal_block: Block | None = None
+    proposal_block_parts: PartSet | None = None
+    locked_round: int = -1
+    locked_block: Block | None = None
+    locked_block_parts: PartSet | None = None
+    valid_round: int = -1
+    valid_block: Block | None = None
+    valid_block_parts: PartSet | None = None
+    votes: Optional["HeightVoteSet"] = None
+    commit_round: int = -1
+    last_commit: VoteSet | None = None
+    last_validators: ValidatorSet | None = None
+    triggered_timeout_precommit: bool = False
+
+    def step_name(self) -> str:
+        return STEP_NAMES.get(self.step, f"Unknown({self.step})")
+
+    def round_state_event(self) -> dict:
+        return {
+            "height": self.height,
+            "round": self.round,
+            "step": self.step_name(),
+        }
+
+
+class RoundVoteSet:
+    __slots__ = ("prevotes", "precommits")
+
+    def __init__(self, prevotes: VoteSet, precommits: VoteSet):
+        self.prevotes = prevotes
+        self.precommits = precommits
+
+
+class HeightVoteSet:
+    """Keeps prevote/precommit VoteSets for all rounds of one height;
+    peers may make us create one catchup round each
+    (height_vote_set.go:24-41)."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        val_set: ValidatorSet,
+        extensions_enabled: bool = False,
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
+        self.round = 0
+        self.round_vote_sets: dict[int, RoundVoteSet] = {}
+        self.peer_catchup_rounds: dict[str, list[int]] = {}
+        self._mtx = threading.RLock()
+        self._add_round(0)
+
+    def _add_round(self, round: int) -> None:
+        if round in self.round_vote_sets:
+            raise ValueError(f"round {round} already exists")
+        self.round_vote_sets[round] = RoundVoteSet(
+            prevotes=VoteSet(
+                self.chain_id, self.height, round, PREVOTE_TYPE, self.val_set
+            ),
+            precommits=VoteSet(
+                self.chain_id,
+                self.height,
+                round,
+                PRECOMMIT_TYPE,
+                self.val_set,
+                extensions_enabled=self.extensions_enabled,
+            ),
+        )
+
+    def set_round(self, round: int) -> None:
+        """Create vote sets up to round+1 (height_vote_set.go SetRound)."""
+        with self._mtx:
+            new_round = self.round - 1 if self.round > 0 else 0
+            for r in range(new_round, round + 2):
+                if r not in self.round_vote_sets:
+                    self._add_round(r)
+            self.round = round
+
+    def add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """(height_vote_set.go AddVote) — unwanted rounds are limited to
+        one peer-triggered catchup round per peer."""
+        with self._mtx:
+            if not vote.type in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+                raise VoteError(f"invalid vote type {vote.type}")
+            rvs = self.round_vote_sets.get(vote.round)
+            if rvs is None:
+                rounds = self.peer_catchup_rounds.setdefault(peer_id, [])
+                if len(rounds) < 2:
+                    self._add_round(vote.round)
+                    rvs = self.round_vote_sets[vote.round]
+                    rounds.append(vote.round)
+                else:
+                    raise VoteError(
+                        "peer has sent a vote that does not match our round "
+                        "for more than one round"
+                    )
+            vs = rvs.prevotes if vote.type == PREVOTE_TYPE else rvs.precommits
+            return vs.add_vote(vote)
+
+    def prevotes(self, round: int) -> VoteSet | None:
+        with self._mtx:
+            rvs = self.round_vote_sets.get(round)
+            return rvs.prevotes if rvs else None
+
+    def precommits(self, round: int) -> VoteSet | None:
+        with self._mtx:
+            rvs = self.round_vote_sets.get(round)
+            return rvs.precommits if rvs else None
+
+    def pol_info(self) -> tuple[int, BlockID | None]:
+        """Last round with a prevote POL (+2/3 for some block)
+        (height_vote_set.go POLInfo)."""
+        with self._mtx:
+            for r in range(self.round, -1, -1):
+                rvs = self.round_vote_sets.get(r)
+                if rvs is None:
+                    continue
+                bid, ok = rvs.prevotes.two_thirds_majority()
+                if ok:
+                    return r, bid
+            return -1, None
+
+    def set_peer_maj23(
+        self, round: int, vote_type: int, peer_id: str, block_id: BlockID
+    ) -> None:
+        with self._mtx:
+            if round not in self.round_vote_sets:
+                return
+            rvs = self.round_vote_sets[round]
+            vs = rvs.prevotes if vote_type == PREVOTE_TYPE else rvs.precommits
+            vs.set_peer_maj23(peer_id, block_id)

@@ -1,0 +1,266 @@
+"""Write-ahead log of every consensus input (reference:
+internal/consensus/wal.go, libs/autofile).
+
+Record framing (wal.go WALEncoder): crc32(4, big-endian) + length(4,
+big-endian) + proto(TimedWALMessage).  Files roll at max_file_size like
+the reference's autofile.Group (head + .000, .001, ... chunks);
+SearchForEndHeight scans for the EndHeight marker so replay can resume
+mid-stream after a crash.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+from ..wire import wal_pb
+from ..wire.canonical import Timestamp
+
+MAX_WAL_MSG_SIZE_BYTES = 1024 * 1024 * 2  # wal.go maxMsgSizeBytes
+DEFAULT_GROUP_FILE_SIZE = 10 * 1024 * 1024
+
+
+class WALError(Exception):
+    pass
+
+
+class CorruptWALError(WALError):
+    pass
+
+
+def encode_record(msg: wal_pb.TimedWALMessageProto) -> bytes:
+    data = msg.encode()
+    if len(data) > MAX_WAL_MSG_SIZE_BYTES:
+        raise WALError(f"WAL record too big: {len(data)}")
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    return struct.pack(">II", crc, len(data)) + data
+
+
+def decode_records(buf: bytes):
+    """Yield TimedWALMessageProto records; raises CorruptWALError on a
+    mangled record (truncated tail is reported as corruption too — the
+    caller decides whether to repair)."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        if n - pos < 8:
+            raise CorruptWALError("truncated record header")
+        crc, length = struct.unpack_from(">II", buf, pos)
+        pos += 8
+        if length > MAX_WAL_MSG_SIZE_BYTES:
+            raise CorruptWALError(f"record length {length} exceeds max")
+        if n - pos < length:
+            raise CorruptWALError("truncated record body")
+        data = buf[pos : pos + length]
+        pos += length
+        if zlib.crc32(data) & 0xFFFFFFFF != crc:
+            raise CorruptWALError("CRC mismatch")
+        try:
+            yield wal_pb.TimedWALMessageProto.decode(data)
+        except ValueError as e:
+            raise CorruptWALError(f"undecodable record: {e}")
+
+
+class WALSearchOptions:
+    def __init__(self, ignore_data_corruption_errors: bool = False):
+        self.ignore_data_corruption_errors = ignore_data_corruption_errors
+
+
+class WAL(Service):
+    """File-group-backed WAL (wal.go baseWAL)."""
+
+    def __init__(self, path: str, max_file_size: int = DEFAULT_GROUP_FILE_SIZE):
+        super().__init__("WAL")
+        self.head_path = path
+        self.max_file_size = max_file_size
+        self._f = None
+        self._mtx = threading.Lock()
+        self.logger = get_logger("wal")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # ------------------------------------------------------------ rolling
+
+    def _chunk_paths(self) -> list[str]:
+        """Rolled chunks in order, oldest first, head last."""
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        chunks = sorted(
+            (f for f in os.listdir(d)
+             if f.startswith(base + ".") and f.split(".")[-1].isdigit()),
+            key=lambda f: int(f.split(".")[-1]),
+        )
+        out = [os.path.join(d, c) for c in chunks]
+        if os.path.exists(self.head_path):
+            out.append(self.head_path)
+        return out
+
+    def _maybe_roll(self) -> None:
+        if self._f.tell() < self.max_file_size:
+            return
+        # the rolled chunk must be durable before it is renamed — records in
+        # it may already have been promised by write_sync
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        existing = [
+            int(f.split(".")[-1])
+            for f in os.listdir(d)
+            if f.startswith(base + ".") and f.split(".")[-1].isdigit()
+        ]
+        idx = max(existing) + 1 if existing else 0
+        os.replace(self.head_path, f"{self.head_path}.{idx:03d}")
+        dfd = os.open(os.path.dirname(self.head_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        self._f = open(self.head_path, "ab")
+
+    # ---------------------------------------------------------- lifecycle
+
+    def on_start(self) -> None:
+        self._f = open(self.head_path, "ab")
+        # reference writes EndHeight{0} on a fresh WAL (wal.go OnStart)
+        if self._f.tell() == 0 and not self._chunk_paths()[:-1]:
+            self.write_sync(wal_pb.WALMessageProto(end_height=wal_pb.EndHeightProto(height=0)))
+
+    def on_stop(self) -> None:
+        with self._mtx:
+            if self._f:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+                self._f = None
+
+    # ------------------------------------------------------------ writing
+
+    def write(self, msg: wal_pb.WALMessageProto) -> None:
+        if self._f is None:
+            return
+        rec = wal_pb.TimedWALMessageProto(
+            time=Timestamp.from_unix_ns(time.time_ns()), msg=msg
+        )
+        with self._mtx:
+            self._f.write(encode_record(rec))
+            self._maybe_roll()
+
+    def write_sync(self, msg: wal_pb.WALMessageProto) -> None:
+        """Write + fsync — used at signing points and EndHeight
+        (wal.go WriteSync)."""
+        if self._f is None:
+            return
+        self.write(msg)
+        with self._mtx:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            if self._f:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+
+    # ------------------------------------------------------------ reading
+
+    def iter_records(self, options: WALSearchOptions | None = None):
+        """All records across chunks, oldest first."""
+        options = options or WALSearchOptions()
+        for path in self._chunk_paths():
+            with open(path, "rb") as f:
+                buf = f.read()
+            try:
+                yield from decode_records(buf)
+            except CorruptWALError as e:
+                if options.ignore_data_corruption_errors:
+                    self.logger.error(f"skipping corrupt WAL tail in {path}: {e}")
+                    continue
+                raise
+
+    def search_for_end_height(
+        self, height: int, options: WALSearchOptions | None = None
+    ):
+        """Records following EndHeight{height}, or None if the marker is
+        absent (wal.go:59-69 SearchForEndHeight)."""
+        found = False
+        out = []
+        try:
+            for rec in self.iter_records(options):
+                m = rec.msg
+                if m is not None and m.which() == "end_height":
+                    if m.end_height.height == height:
+                        found = True
+                        out = []
+                        continue
+                if found:
+                    out.append(rec)
+        except CorruptWALError:
+            if not (options and options.ignore_data_corruption_errors):
+                raise
+        return out if found else None
+
+    def truncate_corrupt_tail(self) -> int:
+        """Repair a torn final write by truncating the head file at the
+        last valid record (what the reference's replay 'repair' flow does).
+        Returns bytes dropped."""
+        if not os.path.exists(self.head_path):
+            return 0
+        with open(self.head_path, "rb") as f:
+            buf = f.read()
+        good = 0
+        pos = 0
+        n = len(buf)
+        while pos + 8 <= n:
+            crc, length = struct.unpack_from(">II", buf, pos)
+            if length > MAX_WAL_MSG_SIZE_BYTES or pos + 8 + length > n:
+                break
+            data = buf[pos + 8 : pos + 8 + length]
+            if zlib.crc32(data) & 0xFFFFFFFF != crc:
+                break
+            pos += 8 + length
+            good = pos
+        dropped = n - good
+        if dropped:
+            with self._mtx:
+                reopen = self._f is not None
+                if reopen:
+                    self._f.close()
+                with open(self.head_path, "ab") as f:
+                    f.truncate(good)
+                if reopen:
+                    self._f = open(self.head_path, "ab")
+        return dropped
+
+
+class NilWAL:
+    """No-op WAL (wal.go nilWAL)."""
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def is_running(self) -> bool:
+        return True
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def iter_records(self, options=None):
+        return iter(())
+
+    def search_for_end_height(self, height, options=None):
+        return None

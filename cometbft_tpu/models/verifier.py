@@ -57,6 +57,16 @@ def _next_bucket(n: int) -> int:
     return b
 
 
+def _device_batch_min() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("COMETBFT_TPU_DEVICE_BATCH_MIN", "32"))
+    except ValueError:
+        return 32
+
+
+
 class TpuEd25519BatchVerifier:
     """Batched ZIP-215 verification on the default JAX device.
 
@@ -90,12 +100,23 @@ class TpuEd25519BatchVerifier:
         return _VERIFY_JIT
 
     def verify(self) -> tuple[bool, list[bool]]:
-        import jax.numpy as jnp
-        from ..ops import sha2
-
         n = len(self._items)
         if n == 0:
             return False, []
+        # Below the device threshold the dispatch overhead (and, on first
+        # use, compile time) dwarfs the arithmetic — verify on host.  The
+        # hot configs (150-val light blocks, 10k-val commits) always take
+        # the device path.
+        if n < _device_batch_min():
+            cpu = CpuEd25519BatchVerifier()
+            cpu._items = self._items
+            return cpu.verify()
+        return self._verify_device(n)
+
+    def _verify_device(self, n: int) -> tuple[bool, list[bool]]:
+        import jax.numpy as jnp
+        from ..ops import sha2
+
         bucket = _next_bucket(n)
         a = np.zeros((bucket, 32), dtype=np.uint8)
         r = np.zeros((bucket, 32), dtype=np.uint8)

@@ -1,0 +1,21 @@
+"""Validator signing with double-sign protection (reference: privval/)."""
+
+from .file_pv import (
+    FilePV,
+    FilePVKey,
+    FilePVLastSignState,
+    DoubleSignError,
+    STEP_PROPOSE,
+    STEP_PREVOTE,
+    STEP_PRECOMMIT,
+)
+
+__all__ = [
+    "FilePV",
+    "FilePVKey",
+    "FilePVLastSignState",
+    "DoubleSignError",
+    "STEP_PROPOSE",
+    "STEP_PREVOTE",
+    "STEP_PRECOMMIT",
+]

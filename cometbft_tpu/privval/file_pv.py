@@ -1,0 +1,288 @@
+"""FilePV: file-backed validator key with last-sign-state (HRS)
+double-sign protection (reference: privval/file.go).
+
+The last-sign state persists (height, round, step, sign-bytes, signature)
+after every signature; CheckHRS (file.go:100) refuses any HRS regression,
+and a crash-between-sign-and-WAL at the same HRS regenerates the identical
+signature (or reuses it when the new request differs only by timestamp,
+file.go:374-386).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+
+from ..crypto import ed25519
+from ..wire.canonical import (
+    CanonicalProposal,
+    CanonicalVote,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    Timestamp,
+)
+from ..wire.proto import decode_delimited
+
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_TYPE_TO_STEP = {PREVOTE_TYPE: STEP_PREVOTE, PRECOMMIT_TYPE: STEP_PRECOMMIT}
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+def _atomic_write(path: str, data: str) -> None:
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class FilePVKey:
+    """privval_key.json: address + pubkey + privkey (file.go FilePVKey)."""
+
+    def __init__(self, priv_key: ed25519.PrivKey, file_path: str = ""):
+        self.priv_key = priv_key
+        self.pub_key = priv_key.pub_key()
+        self.address = self.pub_key.address()
+        self.file_path = file_path
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        _atomic_write(
+            self.file_path,
+            json.dumps(
+                {
+                    "address": self.address.hex().upper(),
+                    "pub_key": {
+                        "type": "tendermint/PubKeyEd25519",
+                        "value": base64.b64encode(self.pub_key.data).decode(),
+                    },
+                    "priv_key": {
+                        "type": "tendermint/PrivKeyEd25519",
+                        "value": base64.b64encode(self.priv_key.data).decode(),
+                    },
+                },
+                indent=2,
+            ),
+        )
+
+    @classmethod
+    def load(cls, file_path: str) -> "FilePVKey":
+        with open(file_path) as f:
+            d = json.load(f)
+        raw = base64.b64decode(d["priv_key"]["value"])
+        return cls(ed25519.PrivKey(raw), file_path)
+
+
+class FilePVLastSignState:
+    """privval_state.json (file.go:75)."""
+
+    def __init__(self, file_path: str = ""):
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.signature = b""
+        self.sign_bytes = b""
+        self.file_path = file_path
+
+    def check_hrs(self, height: int, round: int, step: int) -> bool:
+        """True -> same HRS seen before and sign-bytes exist (caller may
+        reuse/regenerate); raises on regression (file.go:100)."""
+        if self.height > height:
+            raise DoubleSignError(
+                f"height regression: got {height}, last {self.height}"
+            )
+        if self.height != height:
+            return False
+        if self.round > round:
+            raise DoubleSignError(
+                f"round regression at height {height}: got {round}, last {self.round}"
+            )
+        if self.round != round:
+            return False
+        if self.step > step:
+            raise DoubleSignError(
+                f"step regression at {height}/{round}: got {step}, last {self.step}"
+            )
+        if self.step < step:
+            return False
+        if not self.sign_bytes:
+            raise DoubleSignError("no sign-bytes despite matching HRS")
+        if not self.signature:
+            raise DoubleSignError("signature missing despite sign-bytes present")
+        return True
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        _atomic_write(
+            self.file_path,
+            json.dumps(
+                {
+                    "height": str(self.height),
+                    "round": self.round,
+                    "step": self.step,
+                    "signature": base64.b64encode(self.signature).decode(),
+                    "signbytes": self.sign_bytes.hex().upper(),
+                },
+                indent=2,
+            ),
+        )
+
+    @classmethod
+    def load(cls, file_path: str) -> "FilePVLastSignState":
+        st = cls(file_path)
+        if os.path.exists(file_path) and os.path.getsize(file_path) > 0:
+            with open(file_path) as f:
+                d = json.load(f)
+            st.height = int(d.get("height", 0))
+            st.round = d.get("round", 0)
+            st.step = d.get("step", 0)
+            st.signature = base64.b64decode(d.get("signature", ""))
+            st.sign_bytes = bytes.fromhex(d.get("signbytes", ""))
+        return st
+
+
+def _only_differ_by_timestamp(cls, last_sign_bytes: bytes, new_sign_bytes: bytes):
+    """(last timestamp, True) if the two canonical messages are identical
+    up to timestamp (file.go:459 checkVotesOnlyDifferByTimestamp)."""
+    last, _ = decode_delimited(cls, last_sign_bytes)
+    new, _ = decode_delimited(cls, new_sign_bytes)
+    last_ts = last.timestamp
+    probe = Timestamp(seconds=1, nanos=1)
+    last.timestamp = probe
+    new.timestamp = probe
+    return last_ts, last.encode() == new.encode()
+
+
+class FilePV:
+    """A priv validator backed by key + state files (file.go FilePV)."""
+
+    def __init__(self, key: FilePVKey, last_sign_state: FilePVLastSignState):
+        self.key = key
+        self.last_sign_state = last_sign_state
+
+    # ---------------------------------------------------- construction
+
+    @classmethod
+    def generate(cls, key_file: str = "", state_file: str = "", seed: bytes | None = None) -> "FilePV":
+        priv = ed25519.PrivKey.from_seed(seed) if seed else ed25519.PrivKey.generate()
+        pv = cls(FilePVKey(priv, key_file), FilePVLastSignState(state_file))
+        return pv
+
+    @classmethod
+    def load(cls, key_file: str, state_file: str) -> "FilePV":
+        return cls(FilePVKey.load(key_file), FilePVLastSignState.load(state_file))
+
+    @classmethod
+    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+        if os.path.exists(key_file):
+            return cls.load(key_file, state_file)
+        pv = cls.generate(key_file, state_file)
+        pv.save()
+        return pv
+
+    def save(self) -> None:
+        self.key.save()
+        self.last_sign_state.save()
+
+    def reset(self) -> None:
+        """Danger: wipes double-sign protection (file.go:310)."""
+        self.last_sign_state = FilePVLastSignState(self.last_sign_state.file_path)
+        self.last_sign_state.save()
+
+    # --------------------------------------------------------- queries
+
+    def get_address(self) -> bytes:
+        return self.key.address
+
+    def get_pub_key(self) -> ed25519.PubKey:
+        return self.key.pub_key
+
+    # --------------------------------------------------------- signing
+
+    def sign_vote(self, chain_id: str, vote, sign_extension: bool = False) -> None:
+        """Sets vote.signature (and extension signature for non-nil
+        precommits when sign_extension) — file.go:332 signVote."""
+        step = _VOTE_TYPE_TO_STEP.get(vote.type)
+        if step is None:
+            raise ValueError(f"unknown vote type {vote.type}")
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(vote.height, vote.round, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+
+        if sign_extension:
+            if vote.type == PRECOMMIT_TYPE and not vote.block_id.is_nil():
+                # extensions are non-deterministic: always re-sign
+                vote.extension_signature = self.key.priv_key.sign(
+                    vote.extension_sign_bytes(chain_id)
+                )
+            elif vote.extension:
+                raise ValueError(
+                    "vote extensions are only allowed in non-nil precommits"
+                )
+
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                vote.signature = lss.signature
+                return
+            last_ts, ok = _only_differ_by_timestamp(
+                CanonicalVote, lss.sign_bytes, sign_bytes
+            )
+            if ok:
+                vote.timestamp = last_ts
+                vote.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+
+        sig = self.key.priv_key.sign(sign_bytes)
+        self._save_signed(vote.height, vote.round, step, sign_bytes, sig)
+        vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        """file.go:402 signProposal."""
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(proposal.height, proposal.round, STEP_PROPOSE)
+        sign_bytes = proposal.sign_bytes(chain_id)
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                proposal.signature = lss.signature
+                return
+            last_ts, ok = _only_differ_by_timestamp(
+                CanonicalProposal, lss.sign_bytes, sign_bytes
+            )
+            if ok:
+                proposal.timestamp = last_ts
+                proposal.signature = lss.signature
+                return
+            raise DoubleSignError("conflicting data")
+        sig = self.key.priv_key.sign(sign_bytes)
+        self._save_signed(proposal.height, proposal.round, STEP_PROPOSE, sign_bytes, sig)
+        proposal.signature = sig
+
+    def sign_bytes(self, data: bytes) -> bytes:
+        """Raw signing for p2p handshake proofs (file.go:298)."""
+        return self.key.priv_key.sign(data)
+
+    def _save_signed(
+        self, height: int, round: int, step: int, sign_bytes: bytes, sig: bytes
+    ) -> None:
+        lss = self.last_sign_state
+        lss.height, lss.round, lss.step = height, round, step
+        lss.signature, lss.sign_bytes = sig, sign_bytes
+        lss.save()

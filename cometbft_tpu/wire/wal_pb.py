@@ -1,0 +1,70 @@
+"""Consensus WAL record messages (field layout mirrors
+proto/cometbft/consensus/v1/wal.proto of the reference).
+
+Every consensus input is wrapped in a TimedWALMessage and CRC-framed by
+consensus/wal.py; EndHeight marks a completed height for replay.
+"""
+
+from __future__ import annotations
+
+from .canonical import Timestamp
+from .proto import Field, Message
+from .types_pb import Part, Proposal, Vote
+
+
+class MsgInfoProto(Message):
+    """A peer message entering the state machine (wal.proto MsgInfo)."""
+
+    FIELDS = [
+        Field(1, "vote", "message", Vote),
+        Field(2, "proposal", "message", Proposal),
+        Field(3, "block_part", "message", Part),
+        Field(4, "block_part_height", "varint"),
+        Field(5, "block_part_round", "varint"),
+        Field(6, "peer_id", "string"),
+    ]
+
+
+class TimeoutInfoProto(Message):
+    FIELDS = [
+        Field(1, "duration_ms", "varint"),
+        Field(2, "height", "varint"),
+        Field(3, "round", "varint"),
+        Field(4, "step", "varint"),
+    ]
+
+
+class EndHeightProto(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class EventDataRoundStateProto(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "step", "string"),
+    ]
+
+
+class WALMessageProto(Message):
+    """oneof wrapper (wal.proto WALMessage)."""
+
+    FIELDS = [
+        Field(1, "event_data_round_state", "message", EventDataRoundStateProto),
+        Field(2, "msg_info", "message", MsgInfoProto),
+        Field(3, "timeout_info", "message", TimeoutInfoProto),
+        Field(4, "end_height", "message", EndHeightProto),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
+
+
+class TimedWALMessageProto(Message):
+    FIELDS = [
+        Field(1, "time", "message", Timestamp, emit_default=True),
+        Field(2, "msg", "message", WALMessageProto),
+    ]

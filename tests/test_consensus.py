@@ -1,0 +1,317 @@
+"""Consensus state machine tests: single-validator chain (the e2e
+vertical slice) and in-process multi-validator networks wired by direct
+queue cross-feeding (mirrors reference internal/consensus/state_test.go +
+common_test.go topology)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci import KVStoreApplication
+from cometbft_tpu.abci.kvstore import default_lanes
+from cometbft_tpu.consensus.config import test_consensus_config
+from cometbft_tpu.consensus.state import (
+    BlockPartMessage,
+    ConsensusState,
+    ProposalMessage,
+    VoteMessage,
+)
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool import CListMempool, MempoolConfig
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.proxy import local_client_creator, new_app_conns
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.types.event_bus import (
+    EventBus,
+    EventQueryNewBlock,
+)
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.wire import abci_pb as pb
+from cometbft_tpu.wire.canonical import Timestamp
+
+GENESIS_NS = 1_700_000_000 * 1_000_000_000
+
+
+def make_node(keys, my_key, genesis, wal_path=None):
+    """Build one in-process consensus node (common_test.go newState)."""
+    state = make_genesis_state(genesis)
+    app = KVStoreApplication(lanes=default_lanes())
+    conns = new_app_conns(local_client_creator(app))
+    conns.start()
+    app.init_chain(
+        pb.InitChainRequest(
+            chain_id=genesis.chain_id,
+            validators=[
+                pb.ValidatorUpdate(
+                    power=10, pub_key_type="ed25519", pub_key_bytes=k.pub_key().data
+                )
+                for k in keys
+            ],
+        )
+    )
+    state_store = StateStore(MemDB())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemDB())
+    mempool = CListMempool(
+        MempoolConfig(),
+        conns.mempool,
+        lane_priorities=default_lanes(),
+        default_lane="default",
+    )
+    event_bus = EventBus()
+    executor = BlockExecutor(
+        state_store, conns.consensus, mempool,
+        block_store=block_store, event_bus=event_bus,
+    )
+    cfg = test_consensus_config()
+    cfg.wal_path = wal_path or ""
+    cs = ConsensusState(
+        cfg, state, executor, block_store, mempool, event_bus=event_bus
+    )
+    cs.set_priv_validator(FilePV(key=_pv_key(my_key), last_sign_state=_pv_state()))
+    cs._conns = conns  # keep for teardown
+    cs._mempool = mempool
+    return cs
+
+
+def _pv_key(priv):
+    from cometbft_tpu.privval.file_pv import FilePVKey
+
+    return FilePVKey(priv)
+
+
+def _pv_state():
+    from cometbft_tpu.privval.file_pv import FilePVLastSignState
+
+    return FilePVLastSignState()
+
+
+def _genesis(keys, chain_id="cs-chain"):
+    return GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Timestamp.from_unix_ns(GENESIS_NS),
+        validators=[
+            GenesisValidator(
+                pub_key_type="ed25519", pub_key_bytes=k.pub_key().data, power=10
+            )
+            for k in keys
+        ],
+        app_hash=b"\x00" * 8,
+    )
+
+
+def _wait_for_height(cs, height, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cs.state.last_block_height >= height:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.slow
+def test_single_validator_produces_blocks(tmp_path):
+    """The minimum end-to-end slice (SURVEY §7.5): one self-proposing
+    validator runs propose → sign → commit → VerifyCommit → ApplyBlock
+    through real consensus timing."""
+    key = ed25519.PrivKey.from_seed(b"\x11" * 32)
+    cs = make_node([key], key, _genesis([key]), wal_path=str(tmp_path / "wal"))
+    sub = cs.event_bus.subscribe("t", EventQueryNewBlock)
+    cs._mempool.check_tx(b"probe=1")
+    cs.start()
+    try:
+        assert _wait_for_height(cs, 3), f"stuck at {cs.state.last_block_height}"
+        msg, _ = sub.get(timeout=1)
+        assert msg.data["block"].header.height == 1
+        # block 1 carried the tx
+        b1 = cs.block_store.load_block(1)
+        assert b"probe=1" in b1.data.txs
+        # commits verify: block 2's last_commit signed block 1
+        b2 = cs.block_store.load_block(2)
+        assert b2.last_commit.block_id.hash == b1.hash()
+    finally:
+        cs.stop()
+        cs._conns.stop()
+
+
+@pytest.mark.slow
+def test_wal_written_and_replayable(tmp_path):
+    key = ed25519.PrivKey.from_seed(b"\x12" * 32)
+    wal_path = str(tmp_path / "wal")
+    cs = make_node([key], key, _genesis([key]), wal_path=wal_path)
+    cs.start()
+    try:
+        assert _wait_for_height(cs, 2)
+    finally:
+        cs.stop()
+        cs._conns.stop()
+    # WAL contains EndHeight markers + our signed votes
+    from cometbft_tpu.consensus.wal import WAL
+
+    wal = WAL(wal_path)
+    kinds = [r.msg.which() for r in wal.iter_records()]
+    assert "end_height" in kinds and "msg_info" in kinds
+    heights = [
+        r.msg.end_height.height for r in wal.iter_records()
+        if r.msg.which() == "end_height"
+    ]
+    assert 1 in heights and 2 in heights
+
+
+class Net:
+    """N validators cross-feeding consensus messages in-process
+    (common_test.go in-memory topology)."""
+
+    def __init__(self, n, tmp_path=None):
+        self.keys = [ed25519.PrivKey.from_seed(bytes([40 + i]) * 32) for i in range(n)]
+        gen = _genesis(self.keys)
+        self.nodes = [make_node(self.keys, k, _genesis(self.keys)) for k in self.keys]
+        for i, node in enumerate(self.nodes):
+            node.broadcast_hook = self._make_hook(i)
+
+    def _make_hook(self, sender_idx):
+        def hook(msg):
+            for j, other in enumerate(self.nodes):
+                if j == sender_idx:
+                    continue
+                peer = f"node{sender_idx}"
+                if isinstance(msg, VoteMessage):
+                    other.add_vote(msg.vote, peer)
+                elif isinstance(msg, ProposalMessage):
+                    other.set_proposal(msg.proposal, peer)
+                elif isinstance(msg, BlockPartMessage):
+                    other.add_proposal_block_part(msg.height, msg.round, msg.part, peer)
+        return hook
+
+    def start(self):
+        for node in self.nodes:
+            node.start()
+
+    def stop(self):
+        for node in self.nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
+            node._conns.stop()
+
+
+@pytest.mark.slow
+def test_four_validator_network_commits_blocks():
+    net = Net(4)
+    net.start()
+    try:
+        net.nodes[0]._mempool.check_tx(b"hello=world")
+        for node in net.nodes:
+            assert _wait_for_height(node, 2, timeout=120), (
+                f"node stuck at {node.state.last_block_height}"
+            )
+        # all nodes committed identical blocks
+        h1 = {n.block_store.load_block(1).hash() for n in net.nodes}
+        assert len(h1) == 1
+        h2 = {n.block_store.load_block(2).hash() for n in net.nodes}
+        assert len(h2) == 1
+        # app hashes agree
+        hashes = {n.state.app_hash for n in net.nodes}
+        assert len(hashes) == 1
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_network_progresses_without_one_validator():
+    """3 of 4 validators (>2/3 power) keep committing; liveness through
+    round timeouts when the missing node is the proposer."""
+    net = Net(4)
+    # node 3 never starts: its votes are absent
+    for node in net.nodes[:3]:
+        node.start()
+    try:
+        for node in net.nodes[:3]:
+            assert _wait_for_height(node, 2, timeout=180), (
+                f"node stuck at {node.state.last_block_height}"
+            )
+        blocks = [n.block_store.load_block(1).hash() for n in net.nodes[:3]]
+        assert len(set(blocks)) == 1
+    finally:
+        for node in net.nodes[:3]:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        for node in net.nodes:
+            node._conns.stop()
+
+
+@pytest.mark.slow
+def test_restart_continues_chain(tmp_path):
+    """Stop at some height, rebuild the whole node from the persisted
+    stores + WAL, and verify the chain continues (WAL catchup replay +
+    store-backed state restore — reference replay_test.go)."""
+    key = ed25519.PrivKey.from_seed(b"\x13" * 32)
+    genesis = _genesis([key])
+    wal_path = str(tmp_path / "wal")
+
+    state = make_genesis_state(genesis)
+    app = KVStoreApplication(lanes=default_lanes())
+    conns = new_app_conns(local_client_creator(app))
+    conns.start()
+    app.init_chain(
+        pb.InitChainRequest(
+            chain_id=genesis.chain_id,
+            validators=[pb.ValidatorUpdate(power=10, pub_key_type="ed25519",
+                                           pub_key_bytes=key.pub_key().data)],
+        )
+    )
+    state_db = MemDB()
+    block_db = MemDB()
+    state_store = StateStore(state_db)
+    state_store.bootstrap(state)
+    block_store = BlockStore(block_db)
+
+    def build_cs():
+        mempool = CListMempool(
+            MempoolConfig(), conns.mempool,
+            lane_priorities=default_lanes(), default_lane="default",
+        )
+        bus = EventBus()
+        ex = BlockExecutor(state_store, conns.consensus, mempool,
+                           block_store=BlockStore(block_db), event_bus=bus)
+        cfg = test_consensus_config()
+        cfg.wal_path = wal_path
+        cur = state_store.load() or state
+        cs = ConsensusState(cfg, cur, ex, BlockStore(block_db), mempool, event_bus=bus)
+        from cometbft_tpu.privval.file_pv import FilePVKey, FilePVLastSignState
+        cs.set_priv_validator(FilePV(
+            key=FilePVKey(key),
+            last_sign_state=FilePVLastSignState.load(str(tmp_path / "pvstate.json"))
+        ))
+        cs.priv_validator.last_sign_state.file_path = str(tmp_path / "pvstate.json")
+        return cs
+
+    cs1 = build_cs()
+    cs1.start()
+    assert _wait_for_height(cs1, 2)
+    h_stop = cs1.state.last_block_height
+    cs1.stop()
+
+    cs2 = build_cs()
+    cs2.start()
+    try:
+        assert _wait_for_height(cs2, h_stop + 2), (
+            f"restarted node stuck at {cs2.state.last_block_height}"
+        )
+        # chain is continuous across the restart
+        for h in range(1, cs2.state.last_block_height):
+            b = cs2.block_store.load_block(h + 1)
+            prev = cs2.block_store.load_block(h)
+            if b is not None and prev is not None and b.last_commit is not None:
+                assert b.last_commit.block_id.hash == prev.hash()
+    finally:
+        cs2.stop()
+        conns.stop()

@@ -1,0 +1,183 @@
+"""FilePV double-sign protection + WAL framing/replay tests
+(mirrors reference privval/file_test.go, internal/consensus/wal_test.go)."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.consensus.wal import (
+    WAL,
+    CorruptWALError,
+    WALSearchOptions,
+    decode_records,
+    encode_record,
+)
+from cometbft_tpu.privval import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    DoubleSignError,
+    FilePV,
+)
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.wire import wal_pb
+from cometbft_tpu.wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE, Timestamp
+
+BID = BlockID(hash=b"B" * 32, part_set_header=PartSetHeader(total=1, hash=b"P" * 32))
+TS = Timestamp(seconds=1_700_000_000)
+
+
+def _vote(height=1, round=0, type=PREVOTE_TYPE, bid=BID, ts=TS):
+    return Vote(
+        type=type, height=height, round=round, block_id=bid, timestamp=ts,
+        validator_address=b"\x01" * 20, validator_index=0,
+    )
+
+
+def test_filepv_sign_and_persist(tmp_path):
+    kf, sf = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.load_or_generate(kf, sf)
+    v = _vote()
+    pv.sign_vote("chain", v)
+    assert pv.get_pub_key().verify_signature(v.sign_bytes("chain"), v.signature)
+    # state persisted; reload sees the HRS
+    pv2 = FilePV.load(kf, sf)
+    assert pv2.last_sign_state.height == 1
+    assert pv2.last_sign_state.step == STEP_PREVOTE
+    assert pv2.get_address() == pv.get_address()
+
+
+def test_filepv_rejects_double_sign(tmp_path):
+    pv = FilePV.generate()
+    v1 = _vote()
+    pv.sign_vote("chain", v1)
+    # same HRS, different block -> conflict
+    other = BlockID(hash=b"X" * 32, part_set_header=PartSetHeader(total=1, hash=b"Y" * 32))
+    v2 = _vote(bid=other)
+    with pytest.raises(DoubleSignError, match="conflicting"):
+        pv.sign_vote("chain", v2)
+    # height regression
+    pv.sign_vote("chain", _vote(height=2))
+    with pytest.raises(DoubleSignError, match="regression"):
+        pv.sign_vote("chain", _vote(height=1))
+    # round regression at same height
+    pv.sign_vote("chain", _vote(height=3, round=5))
+    with pytest.raises(DoubleSignError, match="regression"):
+        pv.sign_vote("chain", _vote(height=3, round=4))
+    # step regression: precommit then prevote at same H/R
+    pv.sign_vote("chain", _vote(height=4, type=PRECOMMIT_TYPE))
+    assert pv.last_sign_state.step == STEP_PRECOMMIT
+    with pytest.raises(DoubleSignError, match="regression"):
+        pv.sign_vote("chain", _vote(height=4, type=PREVOTE_TYPE))
+
+
+def test_filepv_same_hrs_reuses_signature(tmp_path):
+    pv = FilePV.generate()
+    v1 = _vote()
+    pv.sign_vote("chain", v1)
+    # identical vote again (crash before WAL): same signature returned
+    v2 = _vote()
+    pv.sign_vote("chain", v2)
+    assert v2.signature == v1.signature
+    # differs only by timestamp: keep old timestamp + signature
+    v3 = _vote(ts=Timestamp(seconds=1_700_000_055))
+    pv.sign_vote("chain", v3)
+    assert v3.timestamp == TS
+    assert v3.signature == v1.signature
+
+
+def test_filepv_signs_proposal_and_extension(tmp_path):
+    pv = FilePV.generate()
+    p = Proposal(height=7, round=1, pol_round=-1, block_id=BID, timestamp=TS)
+    pv.sign_proposal("chain", p)
+    assert pv.get_pub_key().verify_signature(p.sign_bytes("chain"), p.signature)
+    # precommit with extension gets an extension signature
+    v = _vote(height=7, round=1, type=PRECOMMIT_TYPE)
+    v.extension = b"oracle-data"
+    pv.sign_vote("chain", v, sign_extension=True)
+    assert v.extension_signature
+    assert pv.get_pub_key().verify_signature(
+        v.extension_sign_bytes("chain"), v.extension_signature
+    )
+
+
+def _wal_msg(height):
+    return wal_pb.WALMessageProto(end_height=wal_pb.EndHeightProto(height=height))
+
+
+def test_wal_roundtrip_and_search(tmp_path):
+    wal = WAL(str(tmp_path / "wal" / "wal"))
+    wal.start()
+    wal.write(wal_pb.WALMessageProto(
+        timeout_info=wal_pb.TimeoutInfoProto(duration_ms=100, height=1, round=0, step=1)
+    ))
+    wal.write_sync(_wal_msg(1))
+    wal.write(wal_pb.WALMessageProto(
+        msg_info=wal_pb.MsgInfoProto(peer_id="peerA", block_part_height=2)
+    ))
+    wal.write_sync(_wal_msg(2))
+    wal.stop()
+
+    wal2 = WAL(str(tmp_path / "wal" / "wal"))
+    recs = list(wal2.iter_records())
+    # initial EndHeight{0} + 4 explicit records
+    kinds = [r.msg.which() for r in recs]
+    assert kinds == ["end_height", "timeout_info", "end_height", "msg_info", "end_height"]
+
+    after1 = wal2.search_for_end_height(1)
+    assert [r.msg.which() for r in after1] == ["msg_info", "end_height"]
+    assert after1[0].msg.msg_info.peer_id == "peerA"
+    assert wal2.search_for_end_height(2) == []
+    assert wal2.search_for_end_height(9) is None
+
+
+def test_wal_detects_corruption_and_repairs(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.start()
+    for i in range(1, 4):
+        wal.write_sync(_wal_msg(i))
+    wal.stop()
+    size = os.path.getsize(path)
+    # torn final write: append garbage
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01\x02garbage")
+    wal2 = WAL(path)
+    with pytest.raises(CorruptWALError):
+        list(wal2.iter_records())
+    # tolerant scan sees all complete records
+    recs = list(wal2.iter_records(WALSearchOptions(ignore_data_corruption_errors=True)))
+    assert len(recs) == 4
+    # repair truncates to the last valid record
+    dropped = wal2.truncate_corrupt_tail()
+    assert dropped > 0 and os.path.getsize(path) == size
+    assert len(list(wal2.iter_records())) == 4
+
+
+def test_wal_rolls_files(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path, max_file_size=256)
+    wal.start()
+    for i in range(50):
+        wal.write(_wal_msg(i))
+    wal.stop()
+    chunks = [p for p in os.listdir(tmp_path) if p.startswith("wal.")]
+    assert chunks, "expected rolled chunk files"
+    # records stream across chunks in order
+    wal2 = WAL(path, max_file_size=256)
+    heights = [r.msg.end_height.height for r in wal2.iter_records()]
+    assert heights == [0] + list(range(50))  # leading fresh-WAL EndHeight{0}
+
+
+def test_record_crc_framing():
+    rec = wal_pb.TimedWALMessageProto(
+        time=Timestamp(seconds=5), msg=_wal_msg(3)
+    )
+    framed = encode_record(rec)
+    out = list(decode_records(framed))
+    assert len(out) == 1 and out[0].msg.end_height.height == 3
+    # flip a payload byte -> CRC failure
+    bad = framed[:-1] + bytes([framed[-1] ^ 1])
+    with pytest.raises(CorruptWALError):
+        list(decode_records(bad))
