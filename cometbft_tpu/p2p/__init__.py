@@ -1,0 +1,23 @@
+"""P2P: the distributed communication backend (reference: p2p/).
+
+Host-side TCP between validators (different trust domains — ICI/DCN
+never cross nodes); inside one node the verification plane uses XLA
+collectives instead (parallel/).
+"""
+
+from .key import NodeKey
+from .conn.secret_connection import SecretConnection
+from .conn.connection import MConnection, StreamDescriptor
+from .peer import Peer
+from .switch import Switch
+from .transport import TCPTransport
+
+__all__ = [
+    "NodeKey",
+    "SecretConnection",
+    "MConnection",
+    "StreamDescriptor",
+    "Peer",
+    "Switch",
+    "TCPTransport",
+]

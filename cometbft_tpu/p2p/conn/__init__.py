@@ -1,0 +1,3 @@
+"""Authenticated encrypted multiplexed connections
+(reference: p2p/transport/tcp/conn/).
+"""

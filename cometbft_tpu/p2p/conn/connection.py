@@ -1,0 +1,270 @@
+"""MConnection: N prioritized streams multiplexed over one connection
+(reference: p2p/transport/tcp/conn/connection.go:68).
+
+Messages are chunked into ≤1024-byte PacketMsg frames (EOF bit marks the
+last chunk); the send routine picks the next stream by lowest
+sent-bytes/priority ratio (connection.go sendPacketMsg), throttles
+flushes, and exchanges ping/pong keepalives.  Each stream reassembles
+its own incoming message buffer and hands complete messages to the
+reactor's receive callback.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ...utils.log import get_logger
+from ...utils.service import Service
+from ...wire import p2p_pb
+from ...wire.proto import decode_varint, encode_varint
+
+MAX_PACKET_PAYLOAD_SIZE = 1024  # connection.go:28
+MAX_PACKET_WIRE_SIZE = 4096  # sanity cap on one framed packet
+DEFAULT_SEND_QUEUE_CAPACITY = 1
+DEFAULT_RECV_MESSAGE_CAPACITY = 22020096  # 21MB (connection.go)
+FLUSH_THROTTLE = 0.010  # 10ms (connection.go:38)
+PING_INTERVAL = 60.0
+PONG_TIMEOUT = 45.0
+
+
+@dataclass
+class StreamDescriptor:
+    """(reference: p2p/base_reactor.go StreamDescriptor / ChannelDescriptor)."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = DEFAULT_RECV_MESSAGE_CAPACITY
+
+
+class _Stream:
+    def __init__(self, desc: StreamDescriptor):
+        self.desc = desc
+        self.send_queue: queue.Queue[bytes] = queue.Queue(
+            maxsize=max(desc.send_queue_capacity, 1)
+        )
+        self.sending: bytes | None = None
+        self.sent_pos = 0
+        self.recently_sent = 0
+        self.recv_buf = bytearray()
+
+    def ratio(self) -> float:
+        return self.recently_sent / max(self.desc.priority, 1)
+
+    def next_packet(self) -> p2p_pb.PacketMsg | None:
+        if self.sending is None:
+            try:
+                self.sending = self.send_queue.get_nowait()
+                self.sent_pos = 0
+            except queue.Empty:
+                return None
+        chunk = self.sending[self.sent_pos : self.sent_pos + MAX_PACKET_PAYLOAD_SIZE]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        pkt = p2p_pb.PacketMsg(channel_id=self.desc.id, eof=eof, data=chunk)
+        if eof:
+            self.sending = None
+            self.sent_pos = 0
+        self.recently_sent += len(chunk)
+        return pkt
+
+    def has_data(self) -> bool:
+        return self.sending is not None or not self.send_queue.empty()
+
+
+class MConnection(Service):
+    """conn must expose write(bytes), read(n)->bytes, close()
+    (a SecretConnection or any socket-like duplex)."""
+
+    def __init__(
+        self,
+        conn,
+        stream_descs: list[StreamDescriptor],
+        on_receive: Callable[[int, bytes], None],
+        on_error: Callable[[Exception], None] | None = None,
+        flush_throttle: float = FLUSH_THROTTLE,
+        ping_interval: float = PING_INTERVAL,
+        pong_timeout: float = PONG_TIMEOUT,
+    ):
+        super().__init__("MConnection")
+        self.conn = conn
+        self.streams = {d.id: _Stream(d) for d in stream_descs}
+        self.on_receive = on_receive
+        self.on_error = on_error or (lambda e: None)
+        self.flush_throttle = flush_throttle
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
+        self.logger = get_logger("mconn")
+        self._send_signal = threading.Event()
+        self._pong_pending = threading.Event()
+        self._last_pong = time.monotonic()
+        self._send_thread: threading.Thread | None = None
+        self._recv_thread: threading.Thread | None = None
+        self._errored = False
+
+    def on_start(self) -> None:
+        self._send_thread = threading.Thread(
+            target=self._send_routine, name="mconn-send", daemon=True
+        )
+        self._recv_thread = threading.Thread(
+            target=self._recv_routine, name="mconn-recv", daemon=True
+        )
+        self._send_thread.start()
+        self._recv_thread.start()
+
+    def on_stop(self) -> None:
+        # deliberate stop: suppress the error callbacks the dying reader/
+        # writer threads are about to fire
+        self._errored = True
+        self._send_signal.set()
+        self.conn.close()
+
+    def _error(self, e: Exception) -> None:
+        if not self._errored:
+            self._errored = True
+            try:
+                self.stop()
+            except Exception:
+                pass
+            self.on_error(e)
+
+    # ------------------------------------------------------------ sending
+
+    def send(self, stream_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """Queue msg on the stream; False if the queue stayed full
+        (connection.go Send)."""
+        st = self.streams.get(stream_id)
+        if st is None or not self.is_running():
+            return False
+        try:
+            st.send_queue.put(msg, timeout=timeout)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def try_send(self, stream_id: int, msg: bytes) -> bool:
+        st = self.streams.get(stream_id)
+        if st is None or not self.is_running():
+            return False
+        try:
+            st.send_queue.put_nowait(msg)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def _pick_stream(self) -> _Stream | None:
+        """Lowest sent/priority ratio wins (connection.go sendPacketMsg)."""
+        best = None
+        for st in self.streams.values():
+            if not st.has_data():
+                continue
+            if best is None or st.ratio() < best.ratio():
+                best = st
+        return best
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        self._last_pong = time.monotonic()
+        out = bytearray()
+        try:
+            while self.is_running():
+                self._send_signal.wait(timeout=self.flush_throttle)
+                self._send_signal.clear()
+                now = time.monotonic()
+                if now - self._last_pong > self.ping_interval + self.pong_timeout:
+                    raise ConnectionError("pong timeout: peer unresponsive")
+                if now - last_ping > self.ping_interval:
+                    out += self._frame(p2p_pb.Packet(ping=p2p_pb.PacketPing()))
+                    last_ping = now
+                # drain up to a batch of packets each pass
+                for _ in range(64):
+                    st = self._pick_stream()
+                    if st is None:
+                        break
+                    pkt = st.next_packet()
+                    if pkt is None:
+                        break
+                    out += self._frame(p2p_pb.Packet(msg=pkt))
+                if out:
+                    self.conn.write(bytes(out))
+                    del out[:]
+                # decay the ratio counters so long-lived conns stay fair
+                for st in self.streams.values():
+                    st.recently_sent = int(st.recently_sent * 0.8)
+        except Exception as e:  # noqa: BLE001
+            self._error(e)
+
+    @staticmethod
+    def _frame(pkt: p2p_pb.Packet) -> bytes:
+        payload = pkt.encode()
+        return encode_varint(len(payload)) + payload
+
+    # ---------------------------------------------------------- receiving
+
+    def _recv_routine(self) -> None:
+        try:
+            while self.is_running():
+                pkt = self._read_packet()
+                which = pkt.which()
+                if which == "ping":
+                    self.conn.write(self._frame(p2p_pb.Packet(pong=p2p_pb.PacketPong())))
+                elif which == "pong":
+                    self._last_pong = time.monotonic()
+                elif which == "msg":
+                    self._recv_msg(pkt.msg)
+                else:
+                    raise ValueError("empty packet")
+        except Exception as e:  # noqa: BLE001
+            self._error(e)
+
+    def _read_packet(self) -> p2p_pb.Packet:
+        # varint length prefix, then payload
+        prefix = b""
+        while True:
+            b = self.conn.read(1)
+            if not b:
+                raise ConnectionError("connection closed")
+            prefix += b
+            try:
+                length, _ = decode_varint(prefix)
+                break
+            except ValueError as e:
+                if "truncated" not in str(e):
+                    raise
+                if len(prefix) > 10:
+                    raise ValueError("bad packet length prefix")
+        if length > MAX_PACKET_WIRE_SIZE:
+            raise ValueError(f"packet length {length} exceeds cap")
+        payload = self._read_exact(length)
+        return p2p_pb.Packet.decode(payload)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.conn.read(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed mid-packet")
+            buf += chunk
+        return buf
+
+    def _recv_msg(self, pkt: p2p_pb.PacketMsg) -> None:
+        st = self.streams.get(pkt.channel_id)
+        if st is None:
+            raise ValueError(f"unknown stream {pkt.channel_id}")
+        st.recv_buf += pkt.data
+        if len(st.recv_buf) > st.desc.recv_message_capacity:
+            raise ValueError(
+                f"stream {pkt.channel_id} message exceeds "
+                f"{st.desc.recv_message_capacity} bytes"
+            )
+        if pkt.eof:
+            msg = bytes(st.recv_buf)
+            st.recv_buf = bytearray()
+            self.on_receive(pkt.channel_id, msg)

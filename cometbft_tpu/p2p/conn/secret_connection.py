@@ -1,0 +1,189 @@
+"""SecretConnection: authenticated encryption for peer links
+(reference: p2p/transport/tcp/conn/secret_connection.go:67).
+
+Station-to-Station protocol with the reference's construction:
+  1. exchange ephemeral X25519 keys
+  2. ECDH → HKDF-SHA256 → two ChaCha20-Poly1305 keys (one per direction,
+     lexicographic ephemeral-key order decides which is whose) + a
+     challenge transcript hash
+  3. exchange Ed25519 identity proofs: sig over the challenge; the
+     authenticated remote pubkey becomes the peer's verified identity
+  4. all subsequent traffic in 1024-byte sealed frames with u64-LE nonce
+     counters (secret_connection.go:33-50)
+
+The reference hashes the transcript with Merlin/STROBE; this
+implementation uses HKDF-SHA256 over the sorted ephemeral keys — same
+security shape (the two sides derive identical keys and a shared
+challenge bound to the DH result), not byte-compatible with Go peers,
+which is fine: both ends of every link run this stack.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from ...crypto import ed25519
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024  # secret_connection.go totalFrameSize 1028 - 4
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + AEAD_TAG_SIZE
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+class _NonceCounter:
+    """96-bit nonce: 4 zero bytes + u64 little-endian counter."""
+
+    def __init__(self):
+        self._n = 0
+
+    def next(self) -> bytes:
+        nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", self._n)
+        self._n += 1
+        if self._n >= 1 << 64:
+            raise SecretConnectionError("nonce exhausted")
+        return nonce
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise SecretConnectionError("connection closed during read")
+        buf += chunk
+    return buf
+
+
+class SecretConnection:
+    """Wraps a socket; construct via make_secret_connection."""
+
+    def __init__(self, sock, send_key: bytes, recv_key: bytes, remote_pub: ed25519.PubKey):
+        self._sock = sock
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _NonceCounter()
+        self._recv_nonce = _NonceCounter()
+        self._send_mtx = threading.Lock()
+        self._recv_mtx = threading.Lock()
+        self._recv_buf = b""
+        self.remote_pub = remote_pub
+
+    # --------------------------------------------------------------- io
+
+    def write(self, data: bytes) -> int:
+        """Frame + seal + send (secret_connection.go Write)."""
+        total = 0
+        view = memoryview(data)
+        with self._send_mtx:
+            out = bytearray()
+            while view:
+                chunk = bytes(view[:DATA_MAX_SIZE])
+                view = view[len(chunk):]
+                frame = struct.pack("<I", len(chunk)) + chunk
+                frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+                out += self._send_aead.encrypt(self._send_nonce.next(), frame, None)
+                total += len(chunk)
+            self._sock.sendall(bytes(out))
+        return total
+
+    def read(self, n: int) -> bytes:
+        """Read up to n plaintext bytes (one frame at a time)."""
+        with self._recv_mtx:
+            if not self._recv_buf:
+                sealed = _read_exact(self._sock, SEALED_FRAME_SIZE)
+                try:
+                    frame = self._recv_aead.decrypt(
+                        self._recv_nonce.next(), sealed, None
+                    )
+                except Exception:
+                    raise SecretConnectionError("frame authentication failed")
+                (length,) = struct.unpack_from("<I", frame)
+                if length > DATA_MAX_SIZE:
+                    raise SecretConnectionError("invalid frame length")
+                self._recv_buf = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+            out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+            return out
+
+    def read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.read(n - len(buf))
+            if not chunk:
+                raise SecretConnectionError("short read")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        import socket as _socket
+
+        # shutdown() wakes any thread blocked in recv() (ours and the
+        # remote's) — close() alone leaves them stuck
+        try:
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def make_secret_connection(sock, priv_key: ed25519.PrivKey) -> SecretConnection:
+    """Perform the STS handshake over sock (blocking)."""
+    eph_priv = X25519PrivateKey.generate()
+    eph_pub = eph_priv.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+    # 1. exchange ephemerals (raw 32 bytes each way)
+    sock.sendall(eph_pub)
+    remote_eph = _read_exact(sock, 32)
+
+    if remote_eph == eph_pub:
+        # an echo of our own ephemeral key is a reflection attack: both
+        # directions would share one key/nonce stream and our own auth
+        # frame would "prove" our identity back to us
+        sock.close()
+        raise SecretConnectionError("reflected ephemeral key")
+
+    lo, hi = sorted([eph_pub, remote_eph])
+    we_are_lo = eph_pub == lo
+
+    # 2. shared secret -> directional keys + challenge
+    shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+    okm = HKDF(
+        algorithm=hashes.SHA256(),
+        length=96,
+        salt=None,
+        info=b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN" + lo + hi,
+    ).derive(shared)
+    key_lo, key_hi, challenge = okm[:32], okm[32:64], okm[64:]
+    send_key, recv_key = (key_lo, key_hi) if we_are_lo else (key_hi, key_lo)
+
+    conn = SecretConnection(sock, send_key, recv_key, remote_pub=None)
+
+    # 3. authenticate: send our pubkey + signature over the challenge
+    sig = priv_key.sign(challenge)
+    conn.write(priv_key.pub_key().data + sig)
+    auth = conn.read_exact(32 + 64)
+    remote_pub = ed25519.PubKey(auth[:32])
+    if not remote_pub.verify_signature(challenge, auth[32:]):
+        conn.close()
+        raise SecretConnectionError("peer identity proof failed")
+    conn.remote_pub = remote_pub
+    return conn

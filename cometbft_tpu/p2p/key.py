@@ -1,0 +1,59 @@
+"""Persistent node identity key (reference: p2p/internal/nodekey/nodekey.go).
+
+The node ID is the 20-byte address of the Ed25519 identity key, hex
+encoded — the same derivation as validator addresses.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+
+from ..crypto import ed25519
+
+
+class NodeKey:
+    def __init__(self, priv_key: ed25519.PrivKey):
+        self.priv_key = priv_key
+
+    @property
+    def pub_key(self) -> ed25519.PubKey:
+        return self.priv_key.pub_key()
+
+    def id(self) -> str:
+        return self.pub_key.address().hex()
+
+    @classmethod
+    def generate(cls, seed: bytes | None = None) -> "NodeKey":
+        priv = ed25519.PrivKey.from_seed(seed) if seed else ed25519.PrivKey.generate()
+        return cls(priv)
+
+    def save_as(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(
+                {
+                    "priv_key": {
+                        "type": "tendermint/PrivKeyEd25519",
+                        "value": base64.b64encode(self.priv_key.data).decode(),
+                    }
+                },
+                f,
+                indent=2,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(ed25519.PrivKey(base64.b64decode(d["priv_key"]["value"])))
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            return cls.load(path)
+        nk = cls.generate()
+        nk.save_as(path)
+        return nk

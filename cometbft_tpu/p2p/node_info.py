@@ -1,0 +1,84 @@
+"""Node identity/version info + compatibility check
+(reference: p2p/internal/nodeinfo/nodeinfo.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..wire import p2p_pb
+
+MAX_NUM_CHANNELS = 16
+
+
+class NodeInfoError(Exception):
+    pass
+
+
+@dataclass
+class NodeInfo:
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""  # chain id
+    version: str = "cometbft-tpu/0.1.0"
+    channels: bytes = b""
+    moniker: str = "node"
+    p2p_version: int = 9
+    block_version: int = 11
+    app_version: int = 0
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate_basic(self) -> None:
+        if not self.node_id:
+            raise NodeInfoError("no node ID")
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise NodeInfoError("too many channels")
+        if len(set(self.channels)) != len(self.channels):
+            raise NodeInfoError("duplicate channel id")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """(nodeinfo.go CompatibleWith): same block version, same network,
+        at least one common channel."""
+        if self.block_version != other.block_version:
+            raise NodeInfoError(
+                f"peer block version {other.block_version} != {self.block_version}"
+            )
+        if self.network != other.network:
+            raise NodeInfoError(f"peer network {other.network!r} != {self.network!r}")
+        if not set(self.channels) & set(other.channels):
+            raise NodeInfoError("no common channels")
+
+    def to_proto(self) -> p2p_pb.NodeInfoProto:
+        return p2p_pb.NodeInfoProto(
+            protocol_version=p2p_pb.ProtocolVersion(
+                p2p=self.p2p_version, block=self.block_version, app=self.app_version
+            ),
+            node_id=self.node_id,
+            listen_addr=self.listen_addr,
+            network=self.network,
+            version=self.version,
+            channels=self.channels,
+            moniker=self.moniker,
+            other=p2p_pb.NodeInfoOther(
+                tx_index=self.tx_index, rpc_address=self.rpc_address
+            ),
+        )
+
+    @classmethod
+    def from_proto(cls, m: p2p_pb.NodeInfoProto) -> "NodeInfo":
+        pv = m.protocol_version or p2p_pb.ProtocolVersion()
+        other = m.other or p2p_pb.NodeInfoOther()
+        return cls(
+            node_id=m.node_id,
+            listen_addr=m.listen_addr,
+            network=m.network,
+            version=m.version,
+            channels=m.channels,
+            moniker=m.moniker,
+            p2p_version=pv.p2p,
+            block_version=pv.block,
+            app_version=pv.app,
+            tx_index=other.tx_index,
+            rpc_address=other.rpc_address,
+        )

@@ -1,0 +1,102 @@
+"""Peer: a connected, authenticated remote node
+(reference: p2p/peer.go:533).
+
+Wraps the MConnection, routes inbound messages to the reactor that owns
+each stream, and carries per-peer key/value state for the reactors
+(consensus PeerState, mempool seen-set live under .data).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+from .conn.connection import MConnection, StreamDescriptor
+from .node_info import NodeInfo
+
+
+class Peer(Service):
+    def __init__(
+        self,
+        conn,  # SecretConnection
+        node_info: NodeInfo,
+        stream_descs: list[StreamDescriptor],
+        on_receive: Callable[[int, "Peer", bytes], None],
+        on_error: Callable[["Peer", Exception], None],
+        outbound: bool = False,
+        persistent: bool = False,
+    ):
+        super().__init__(f"peer-{node_info.node_id[:8]}")
+        self.node_info = node_info
+        self.outbound = outbound
+        self.persistent = persistent
+        self.data: dict = {}  # reactor-attached per-peer state
+        self._data_mtx = threading.Lock()
+        self.logger = get_logger(f"peer.{node_info.node_id[:8]}")
+        self.mconn = MConnection(
+            conn,
+            stream_descs,
+            on_receive=lambda sid, msg: on_receive(sid, self, msg),
+            on_error=lambda e: on_error(self, e),
+        )
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def on_start(self) -> None:
+        self.mconn.start()
+
+    def on_stop(self) -> None:
+        if self.mconn.is_running():
+            self.mconn.stop()
+
+    def send(self, stream_id: int, msg: bytes) -> bool:
+        return self.mconn.send(stream_id, msg)
+
+    def try_send(self, stream_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(stream_id, msg)
+
+    def get(self, key: str):
+        with self._data_mtx:
+            return self.data.get(key)
+
+    def set(self, key: str, value) -> None:
+        with self._data_mtx:
+            self.data[key] = value
+
+
+class PeerSet:
+    """(p2p/peer_set.go)."""
+
+    def __init__(self):
+        self._by_id: dict[str, Peer] = {}
+        self._mtx = threading.RLock()
+
+    def add(self, peer: Peer) -> None:
+        with self._mtx:
+            if peer.id in self._by_id:
+                raise ValueError(f"duplicate peer {peer.id}")
+            self._by_id[peer.id] = peer
+
+    def remove(self, peer: Peer) -> bool:
+        with self._mtx:
+            return self._by_id.pop(peer.id, None) is not None
+
+    def has(self, peer_id: str) -> bool:
+        with self._mtx:
+            return peer_id in self._by_id
+
+    def get(self, peer_id: str) -> Peer | None:
+        with self._mtx:
+            return self._by_id.get(peer_id)
+
+    def list(self) -> list[Peer]:
+        with self._mtx:
+            return list(self._by_id.values())
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._by_id)

@@ -1,0 +1,40 @@
+"""Reactor interface (reference: p2p/base_reactor.go:15-44).
+
+A reactor registers stream descriptors with the Switch, gets told about
+peers joining/leaving, and receives complete messages per stream.
+"""
+
+from __future__ import annotations
+
+from ..utils.service import Service
+from .conn.connection import StreamDescriptor
+
+
+class Reactor(Service):
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.switch = None
+
+    def set_switch(self, sw) -> None:
+        self.switch = sw
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return []
+
+    def init_peer(self, peer) -> None:
+        """Called before the peer starts (setup per-peer state)."""
+
+    def add_peer(self, peer) -> None:
+        """Called once the peer is running (start gossip routines)."""
+
+    def remove_peer(self, peer, reason: str = "") -> None:
+        pass
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        pass
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass

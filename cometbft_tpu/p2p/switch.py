@@ -1,0 +1,243 @@
+"""Switch: the peer lifecycle hub (reference: p2p/switch.go:64).
+
+Owns the transport, accepts inbound and dials outbound peers, registers
+reactors and their streams, routes received messages to the owning
+reactor, reconnects persistent peers with exponential backoff, and
+broadcasts to all peers.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+from .conn.connection import StreamDescriptor
+from .peer import Peer, PeerSet
+from .reactor import Reactor
+from .transport import TCPTransport, TransportError
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_DELAY = 1.0
+MAX_PEERS = 50
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch(Service):
+    def __init__(self, transport: TCPTransport, max_peers: int = MAX_PEERS):
+        super().__init__("Switch")
+        self.transport = transport
+        self.reactors: dict[str, Reactor] = {}
+        self.stream_descs: list[StreamDescriptor] = []
+        self._reactor_by_stream: dict[int, Reactor] = {}
+        self.peers = PeerSet()
+        self.max_peers = max_peers
+        self.persistent_addrs: set[str] = set()
+        self._dialing: set[str] = set()
+        self._mtx = threading.Lock()
+        self.logger = get_logger("switch")
+        self._accept_thread: threading.Thread | None = None
+        # node_info.channels must list every registered stream
+        self._sync_channels()
+
+    # ----------------------------------------------------------- reactors
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        for desc in reactor.stream_descriptors():
+            if desc.id in self._reactor_by_stream:
+                raise SwitchError(f"stream id {desc.id} already claimed")
+            self._reactor_by_stream[desc.id] = reactor
+            self.stream_descs.append(desc)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        self._sync_channels()
+
+    def _sync_channels(self) -> None:
+        self.transport.node_info.channels = bytes(
+            d.id for d in self.stream_descs
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            reactor.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_routine, name="switch-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def on_stop(self) -> None:
+        self.transport.close()
+        for peer in self.peers.list():
+            self.stop_peer(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            if reactor.is_running():
+                reactor.stop()
+
+    # ------------------------------------------------------------ accept
+
+    def _accept_routine(self) -> None:
+        while self.is_running():
+            if self.transport._listener is None:
+                return  # dial-only node (or listener closed)
+            try:
+                conn, info = self.transport.accept()
+            except OSError as e:
+                if self.transport._listener is None or not self.is_running():
+                    return  # listener closed
+                # transient (EMFILE, ECONNABORTED, ...): keep accepting
+                self.logger.error(f"accept error (retrying): {e}")
+                time.sleep(0.1)
+                continue
+            except TransportError as e:
+                self.logger.info(f"inbound handshake rejected: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001
+                if self.is_running():
+                    self.logger.error(f"accept error: {e}")
+                    continue
+                return
+            if info.node_id == self.transport.node_info.node_id:
+                self.logger.info("rejecting inbound connection claiming our id")
+                conn.close()
+                continue
+            if self.peers.size() >= self.max_peers:
+                self.logger.info("rejecting inbound peer: full")
+                conn.close()
+                continue
+            self._add_peer_conn(conn, info, outbound=False)
+
+    # ------------------------------------------------------------ dialing
+
+    def dial_peer_async(self, addr: str, persistent: bool = False) -> None:
+        with self._mtx:
+            if addr in self._dialing:
+                return
+            self._dialing.add(addr)
+        if persistent:
+            self.persistent_addrs.add(addr)
+        threading.Thread(
+            target=self._dial_routine, args=(addr, persistent), daemon=True
+        ).start()
+
+    def dial_peers_async(self, addrs: list[str], persistent: bool = False) -> None:
+        for addr in addrs:
+            self.dial_peer_async(addr, persistent)
+
+    def _dial_routine(self, addr: str, persistent: bool) -> None:
+        attempts = 0
+        try:
+            while self.is_running():
+                try:
+                    conn, info = self.transport.dial(addr)
+                except Exception as e:  # noqa: BLE001
+                    attempts += 1
+                    if not persistent or attempts > RECONNECT_ATTEMPTS:
+                        self.logger.info(f"dial {addr} failed: {e}")
+                        return
+                    delay = min(
+                        RECONNECT_BASE_DELAY * (2 ** min(attempts, 6)), 60.0
+                    ) * (0.75 + random.random() / 2)
+                    time.sleep(delay)
+                    continue
+                if info.node_id == self.transport.node_info.node_id:
+                    self.logger.info("dialed self; dropping")
+                    conn.close()
+                    return
+                existing = self.peers.get(info.node_id)
+                if existing is not None:
+                    # already connected (e.g. they dialed us first): keep the
+                    # persistence intent on the surviving peer so a later
+                    # disconnect still redials
+                    if persistent:
+                        existing.persistent = True
+                        existing.set("dial_addr", addr)
+                    conn.close()
+                    return
+                self._add_peer_conn(
+                    conn, info, outbound=True, persistent=persistent, addr=addr
+                )
+                return
+        finally:
+            with self._mtx:
+                self._dialing.discard(addr)
+
+    # ------------------------------------------------------- peer plumbing
+
+    def _add_peer_conn(
+        self, conn, info, outbound: bool, persistent: bool = False, addr: str = ""
+    ) -> None:
+        peer = Peer(
+            conn,
+            info,
+            self.stream_descs,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent,
+        )
+        if addr:
+            peer.set("dial_addr", addr)
+        try:
+            self.peers.add(peer)
+        except ValueError:
+            conn.close()
+            return
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        peer.start()
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+        self.logger.info(
+            f"added peer {info.node_id[:8]} ({'out' if outbound else 'in'}bound), "
+            f"total {self.peers.size()}"
+        )
+
+    def _on_peer_receive(self, stream_id: int, peer: Peer, msg: bytes) -> None:
+        reactor = self._reactor_by_stream.get(stream_id)
+        if reactor is None:
+            self.logger.error(f"message on unclaimed stream {stream_id}")
+            return
+        try:
+            reactor.receive(stream_id, peer, msg)
+        except Exception as e:  # noqa: BLE001 - a bad message never kills the switch
+            self.logger.error(f"reactor {reactor.name} receive error: {e}")
+            self.stop_peer(peer, f"reactor error: {e}")
+
+    def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        self.logger.info(f"peer {peer.id[:8]} error: {err}")
+        self.stop_peer(peer, str(err))
+        # reconnect persistent outbound peers
+        addr = peer.get("dial_addr")
+        if peer.persistent and addr and self.is_running():
+            self.dial_peer_async(addr, persistent=True)
+
+    def stop_peer(self, peer: Peer, reason: str = "") -> None:
+        if not self.peers.remove(peer):
+            return
+        try:
+            if peer.is_running():
+                peer.stop()
+        except Exception:
+            pass
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"remove_peer error in {reactor.name}: {e}")
+
+    # ----------------------------------------------------------- messaging
+
+    def broadcast(self, stream_id: int, msg: bytes) -> None:
+        """Queue msg to every peer (switch.go:250 Broadcast)."""
+        for peer in self.peers.list():
+            peer.try_send(stream_id, msg)
+
+    def num_peers(self) -> int:
+        return self.peers.size()

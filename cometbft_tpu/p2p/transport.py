@@ -1,0 +1,102 @@
+"""TCP transport: listen/dial producing authenticated, version-checked
+connections (reference: p2p/transport/tcp/tcp.go + p2p/handshake.go).
+
+dial/accept: TCP connect → SecretConnection STS handshake (identity) →
+NodeInfo exchange (varint-delimited proto over the encrypted link) →
+compatibility check → verified (conn, NodeInfo) pair for the Switch.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..utils.log import get_logger
+from ..wire import p2p_pb
+from ..wire.proto import decode_varint, encode_varint
+from .conn.secret_connection import SecretConnection, make_secret_connection
+from .key import NodeKey
+from .node_info import NodeInfo, NodeInfoError
+
+HANDSHAKE_TIMEOUT = 20.0
+
+
+class TransportError(Exception):
+    pass
+
+
+def _exchange_node_info(conn: SecretConnection, our: NodeInfo) -> NodeInfo:
+    """(p2p/handshake.go:162): both sides send, then read."""
+    payload = our.to_proto().encode()
+    conn.write(encode_varint(len(payload)) + payload)
+    # read varint prefix byte-by-byte off the decrypted stream
+    prefix = b""
+    while True:
+        prefix += conn.read_exact(1)
+        try:
+            length, _ = decode_varint(prefix)
+            break
+        except ValueError as e:
+            if "truncated" not in str(e) or len(prefix) > 10:
+                raise TransportError("bad nodeinfo length prefix")
+    if length > 10240:
+        raise TransportError("oversized nodeinfo")
+    theirs = NodeInfo.from_proto(p2p_pb.NodeInfoProto.decode(conn.read_exact(length)))
+    theirs.validate_basic()
+    return theirs
+
+
+class TCPTransport:
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo):
+        self.node_key = node_key
+        self.node_info = node_info
+        self.logger = get_logger("transport")
+        self._listener: socket.socket | None = None
+
+    # --------------------------------------------------------- listening
+
+    def listen(self, addr: str) -> str:
+        host, port = addr.rsplit(":", 1)
+        self._listener = socket.create_server((host, int(port)))
+        host, port = self._listener.getsockname()[:2]
+        self.node_info.listen_addr = f"{host}:{port}"
+        return self.node_info.listen_addr
+
+    def accept(self) -> tuple[SecretConnection, NodeInfo]:
+        """Blocks for one inbound connection; raises on listener close."""
+        if self._listener is None:
+            raise TransportError("transport is not listening")
+        sock, _ = self._listener.accept()
+        return self._upgrade(sock)
+
+    def dial(self, addr: str, timeout: float = 10.0) -> tuple[SecretConnection, NodeInfo]:
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=timeout)
+        sock.settimeout(HANDSHAKE_TIMEOUT)
+        conn, info = self._upgrade(sock)
+        return conn, info
+
+    def _upgrade(self, sock: socket.socket) -> tuple[SecretConnection, NodeInfo]:
+        sock.settimeout(HANDSHAKE_TIMEOUT)
+        try:
+            conn = make_secret_connection(sock, self.node_key.priv_key)
+            theirs = _exchange_node_info(conn, self.node_info)
+            # the authenticated identity must match the claimed node id
+            if conn.remote_pub.address().hex() != theirs.node_id:
+                raise TransportError(
+                    f"node id {theirs.node_id} doesn't match authenticated key"
+                )
+            self.node_info.compatible_with(theirs)
+        except (NodeInfoError, TransportError):
+            sock.close()
+            raise
+        except Exception as e:  # noqa: BLE001
+            sock.close()
+            raise TransportError(f"handshake failed: {e}")
+        sock.settimeout(None)
+        return conn, theirs
+
+    def close(self) -> None:
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None

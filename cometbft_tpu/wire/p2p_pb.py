@@ -1,0 +1,91 @@
+"""P2P wire messages (field layout mirrors proto/cometbft/p2p/v1 of the
+reference: conn.proto Packet/PacketMsg/PacketPing/PacketPong, types.proto
+NodeInfo, pex.proto).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+
+
+class PacketPing(Message):
+    FIELDS = []
+
+
+class PacketPong(Message):
+    FIELDS = []
+
+
+class PacketMsg(Message):
+    FIELDS = [
+        Field(1, "channel_id", "varint"),
+        Field(2, "eof", "bool"),
+        Field(3, "data", "bytes"),
+    ]
+
+
+class Packet(Message):
+    FIELDS = [
+        Field(1, "ping", "message", PacketPing),
+        Field(2, "pong", "message", PacketPong),
+        Field(3, "msg", "message", PacketMsg),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
+
+
+class ProtocolVersion(Message):
+    FIELDS = [
+        Field(1, "p2p", "varint"),
+        Field(2, "block", "varint"),
+        Field(3, "app", "varint"),
+    ]
+
+
+class NodeInfoOther(Message):
+    FIELDS = [
+        Field(1, "tx_index", "string"),
+        Field(2, "rpc_address", "string"),
+    ]
+
+
+class NodeInfoProto(Message):
+    FIELDS = [
+        Field(1, "protocol_version", "message", ProtocolVersion, emit_default=True),
+        Field(2, "node_id", "string"),
+        Field(3, "listen_addr", "string"),
+        Field(4, "network", "string"),
+        Field(5, "version", "string"),
+        Field(6, "channels", "bytes"),
+        Field(7, "moniker", "string"),
+        Field(8, "other", "message", NodeInfoOther, emit_default=True),
+    ]
+
+
+class PexAddress(Message):
+    FIELDS = [Field(3, "url", "string")]
+
+
+class PexRequest(Message):
+    FIELDS = []
+
+
+class PexAddrs(Message):
+    FIELDS = [Field(1, "addrs", "message", PexAddress, repeated=True)]
+
+
+class PexMessage(Message):
+    FIELDS = [
+        Field(3, "pex_request", "message", PexRequest),
+        Field(4, "pex_addrs", "message", PexAddrs),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
