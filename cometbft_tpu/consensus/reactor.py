@@ -1,0 +1,474 @@
+"""Consensus reactor: gossips proposals, block parts, and votes between
+the local state machine and peers (reference: internal/consensus/reactor.go).
+
+Four p2p streams (reactor.go:156): State (round steps / HasVote /
+NewValidBlock), Data (proposals + block parts), Vote, VoteSetBits.
+Per peer: a PeerState mirror of the remote round state and two gossip
+threads (data + votes, reactor.go:594,654) that push whatever the peer
+is missing — including catchup block parts for peers on old heights —
+plus a Maj23 query loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.conn.connection import StreamDescriptor
+from ..p2p.reactor import Reactor
+from ..types.block import BlockID
+from ..types.part_set import Part, PartSet
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..utils.log import get_logger
+from ..wire import consensus_pb as pb
+from ..wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from .state import (
+    BlockPartMessage,
+    ConsensusState,
+    ProposalMessage,
+    VoteMessage,
+)
+from .types import STEP_COMMIT, STEP_NEW_HEIGHT
+
+STATE_STREAM = 0x20
+DATA_STREAM = 0x21
+VOTE_STREAM = 0x22
+VOTE_SET_BITS_STREAM = 0x23
+
+
+class PeerState:
+    """What we know about a peer's round state (reactor.go:1110)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.mtx = threading.RLock()
+        self.height = 0
+        self.round = -1
+        self.step = STEP_NEW_HEIGHT
+        self.start_time_ns = 0
+        self.proposal = False
+        self.proposal_block_psh = None  # PartSetHeader
+        self.proposal_block_parts: list[bool] = []
+        self.proposal_pol_round = -1
+        # (height, round, type) -> set of validator indexes the peer has
+        self.votes_seen: dict[tuple[int, int, int], set[int]] = {}
+        self.catchup_commit_round = -1
+
+    def apply_new_round_step(self, msg: pb.NewRoundStep) -> None:
+        with self.mtx:
+            new_height = msg.height != self.height
+            new_round = new_height or msg.round != self.round
+            self.height = msg.height
+            self.round = msg.round
+            self.step = msg.step
+            if new_round:
+                self.proposal = False
+                self.proposal_block_psh = None
+                self.proposal_block_parts = []
+                self.proposal_pol_round = -1
+            if new_height:
+                self.votes_seen = {
+                    k: v for k, v in self.votes_seen.items() if k[0] >= msg.height - 1
+                }
+
+    def apply_new_valid_block(self, msg: pb.NewValidBlock) -> None:
+        with self.mtx:
+            if msg.height != self.height:
+                return
+            from ..types.block import PartSetHeader
+
+            self.proposal_block_psh = PartSetHeader.from_proto(
+                msg.block_part_set_header
+            )
+            self.proposal_block_parts = (
+                msg.block_parts.to_bools() if msg.block_parts else []
+            )
+
+    def set_has_proposal(self, proposal: Proposal) -> None:
+        with self.mtx:
+            if proposal.height != self.height or proposal.round != self.round:
+                return
+            if self.proposal:
+                return
+            self.proposal = True
+            self.proposal_block_psh = proposal.block_id.part_set_header
+            self.proposal_block_parts = [False] * proposal.block_id.part_set_header.total
+            self.proposal_pol_round = proposal.pol_round
+
+    def set_has_block_part(self, height: int, round: int, index: int) -> None:
+        with self.mtx:
+            if height != self.height:
+                return
+            if 0 <= index < len(self.proposal_block_parts):
+                self.proposal_block_parts[index] = True
+
+    def set_has_vote(self, height: int, round: int, vtype: int, index: int) -> None:
+        with self.mtx:
+            self.votes_seen.setdefault((height, round, vtype), set()).add(index)
+
+    def has_vote(self, vote: Vote) -> bool:
+        with self.mtx:
+            return vote.validator_index in self.votes_seen.get(
+                (vote.height, vote.round, vote.type), set()
+            )
+
+    def missing_part_index(self, our_parts: PartSet) -> int | None:
+        """First part we have that the peer seems to lack."""
+        with self.mtx:
+            if self.proposal_block_psh is None:
+                return None
+            if our_parts.header != self.proposal_block_psh:
+                return None
+            for i in range(our_parts.header.total):
+                have = our_parts.get_part(i) is not None
+                peer_has = (
+                    i < len(self.proposal_block_parts) and self.proposal_block_parts[i]
+                )
+                if have and not peer_has:
+                    return i
+            return None
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False):
+        super().__init__("ConsensusReactor")
+        self.cs = cs
+        self.wait_sync = wait_sync  # blocksync still running
+        self.logger = get_logger("cs-reactor")
+        self._peer_states: dict[str, PeerState] = {}
+        self._mtx = threading.Lock()
+        # the state machine tells us what to flood
+        cs.broadcast_hook = self._on_internal_msg
+        cs.on_new_round_step = self._on_new_round_step
+        cs.has_vote_hook = self._broadcast_has_vote
+
+    # ------------------------------------------------------------- config
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return [
+            StreamDescriptor(id=STATE_STREAM, priority=6, send_queue_capacity=100),
+            StreamDescriptor(id=DATA_STREAM, priority=10, send_queue_capacity=100),
+            StreamDescriptor(id=VOTE_STREAM, priority=7, send_queue_capacity=100),
+            StreamDescriptor(id=VOTE_SET_BITS_STREAM, priority=1, send_queue_capacity=20),
+        ]
+
+    def on_start(self) -> None:
+        if not self.wait_sync and not self.cs.is_running():
+            self.cs.start()
+
+    def on_stop(self) -> None:
+        if self.cs.is_running():
+            self.cs.stop()
+
+    def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Blocksync → consensus handoff (reactor.go:117)."""
+        self.cs.update_to_state(state)
+        self.wait_sync = False
+        self.cs.start()
+
+    # ------------------------------------------------------------- peers
+
+    def init_peer(self, peer) -> None:
+        ps = PeerState(peer)
+        peer.set("consensus_peer_state", ps)
+        with self._mtx:
+            self._peer_states[peer.id] = ps
+
+    def add_peer(self, peer) -> None:
+        ps = self._peer_states.get(peer.id)
+        if ps is None:
+            return
+        # announce our current round state so the peer can route to us
+        self._send_round_step(peer)
+        threading.Thread(
+            target=self._gossip_data_routine, args=(peer, ps), daemon=True
+        ).start()
+        threading.Thread(
+            target=self._gossip_votes_routine, args=(peer, ps), daemon=True
+        ).start()
+
+    def remove_peer(self, peer, reason: str = "") -> None:
+        with self._mtx:
+            self._peer_states.pop(peer.id, None)
+
+    # ----------------------------------------------------------- receive
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        msg = pb.ConsensusMessage.decode(msg_bytes)
+        which = msg.which()
+        ps: PeerState = self._peer_states.get(peer.id)
+        if ps is None:
+            return
+        if which == "new_round_step":
+            ps.apply_new_round_step(msg.new_round_step)
+        elif which == "new_valid_block":
+            ps.apply_new_valid_block(msg.new_valid_block)
+        elif which == "has_vote":
+            hv = msg.has_vote
+            ps.set_has_vote(hv.height, hv.round, hv.type, hv.index)
+        elif which == "has_proposal_block_part":
+            hp = msg.has_proposal_block_part
+            ps.set_has_block_part(hp.height, hp.round, hp.index)
+        elif which == "proposal":
+            proposal = Proposal.from_proto(msg.proposal.proposal)
+            ps.set_has_proposal(proposal)
+            self.cs.set_proposal(proposal, peer.id)
+        elif which == "block_part":
+            bp = msg.block_part
+            part = Part.from_proto(bp.part)
+            ps.set_has_block_part(bp.height, bp.round, part.index)
+            self.cs.add_proposal_block_part(bp.height, bp.round, part, peer.id)
+        elif which == "vote":
+            vote = Vote.from_proto(msg.vote.vote)
+            ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+            self.cs.add_vote(vote, peer.id)
+        elif which == "vote_set_maj23":
+            m = msg.vote_set_maj23
+            rs = self.cs.get_round_state()
+            if rs.height == m.height and rs.votes is not None:
+                rs.votes.set_peer_maj23(
+                    m.round, m.type, peer.id, BlockID.from_proto(m.block_id)
+                )
+                # respond with our bit array for that (round, type, blockID)
+                vs = (
+                    rs.votes.prevotes(m.round)
+                    if m.type == PREVOTE_TYPE
+                    else rs.votes.precommits(m.round)
+                )
+                if vs is not None:
+                    bits = vs.bit_array_by_block_id(BlockID.from_proto(m.block_id))
+                    if bits is not None:
+                        reply = pb.ConsensusMessage(
+                            vote_set_bits=pb.VoteSetBits(
+                                height=m.height,
+                                round=m.round,
+                                type=m.type,
+                                block_id=m.block_id,
+                                votes=pb.BitArrayProto.from_bools(bits),
+                            )
+                        )
+                        peer.try_send(VOTE_SET_BITS_STREAM, reply.encode())
+        elif which == "vote_set_bits":
+            pass  # informational; vote gossip handles the rest
+
+    # --------------------------------------------- own-state broadcasting
+
+    def _on_internal_msg(self, msg) -> None:
+        """Our own proposals/parts/votes flood to every peer, skipping
+        peers we know already have them."""
+        if self.switch is None:
+            return
+        if isinstance(msg, ProposalMessage):
+            wire = pb.ConsensusMessage(
+                proposal=pb.ProposalMsg(proposal=msg.proposal.to_proto())
+            ).encode()
+            for peer in self.switch.peers.list():
+                ps = self._peer_states.get(peer.id)
+                if ps is not None:
+                    ps.set_has_proposal(msg.proposal)
+                peer.try_send(DATA_STREAM, wire)
+        elif isinstance(msg, BlockPartMessage):
+            wire = pb.ConsensusMessage(
+                block_part=pb.BlockPartMsg(
+                    height=msg.height, round=msg.round, part=msg.part.to_proto()
+                )
+            ).encode()
+            for peer in self.switch.peers.list():
+                ps = self._peer_states.get(peer.id)
+                if ps is not None:
+                    ps.set_has_block_part(msg.height, msg.round, msg.part.index)
+                peer.try_send(DATA_STREAM, wire)
+        elif isinstance(msg, VoteMessage):
+            self._broadcast_vote(msg.vote)
+
+    def _broadcast_vote(self, vote: Vote) -> None:
+        wire = pb.ConsensusMessage(vote=pb.VoteMsg(vote=vote.to_proto())).encode()
+        for peer in self.switch.peers.list():
+            ps = self._peer_states.get(peer.id)
+            if ps is not None and ps.has_vote(vote):
+                continue
+            if peer.try_send(VOTE_STREAM, wire) and ps is not None:
+                ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+
+    def _broadcast_has_vote(self, vote: Vote) -> None:
+        """Tell peers we hold this vote so they skip re-sending it
+        (reactor.go broadcastHasVoteMessage)."""
+        if self.switch is None:
+            return
+        wire = pb.ConsensusMessage(
+            has_vote=pb.HasVote(
+                height=vote.height,
+                round=vote.round,
+                type=vote.type,
+                index=vote.validator_index,
+            )
+        ).encode()
+        self.switch.broadcast(STATE_STREAM, wire)
+
+    def _on_new_round_step(self, rs) -> None:
+        if self.switch is None:
+            return
+        wire = self._round_step_msg(rs)
+        self.switch.broadcast(STATE_STREAM, wire)
+
+    def _round_step_msg(self, rs) -> bytes:
+        return pb.ConsensusMessage(
+            new_round_step=pb.NewRoundStep(
+                height=rs.height,
+                round=rs.round,
+                step=rs.step,
+                seconds_since_start_time=max(
+                    0, int((time.time_ns() - rs.start_time_ns) / 1e9)
+                ),
+                last_commit_round=rs.last_commit.round if rs.last_commit else -1,
+            )
+        ).encode()
+
+    def _send_round_step(self, peer) -> None:
+        peer.try_send(STATE_STREAM, self._round_step_msg(self.cs.get_round_state()))
+
+    # ------------------------------------------------------------ gossip
+
+    def _gossip_data_routine(self, peer, ps: PeerState) -> None:
+        """Push proposal parts / catchup parts the peer lacks
+        (reactor.go:594)."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        while peer.is_running() and self.is_running():
+            try:
+                rs = self.cs.get_round_state()
+                # catchup: peer on an older height -> send committed parts
+                if 0 < ps.height < rs.height:
+                    self._gossip_catchup_part(peer, ps)
+                    time.sleep(sleep)
+                    continue
+                if ps.height == rs.height and rs.proposal_block_parts is not None:
+                    idx = ps.missing_part_index(rs.proposal_block_parts)
+                    if idx is not None:
+                        part = rs.proposal_block_parts.get_part(idx)
+                        msg = pb.ConsensusMessage(
+                            block_part=pb.BlockPartMsg(
+                                height=rs.height, round=rs.round, part=part.to_proto()
+                            )
+                        )
+                        if peer.try_send(DATA_STREAM, msg.encode()):
+                            ps.set_has_block_part(rs.height, rs.round, idx)
+                        continue
+                    # peer lacks the proposal itself
+                    if rs.proposal is not None and not ps.proposal:
+                        msg = pb.ConsensusMessage(
+                            proposal=pb.ProposalMsg(proposal=rs.proposal.to_proto())
+                        )
+                        if peer.try_send(DATA_STREAM, msg.encode()):
+                            ps.set_has_proposal(rs.proposal)
+                        continue
+                time.sleep(sleep)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"gossip data error: {e}")
+                time.sleep(sleep)
+
+    def _gossip_catchup_part(self, peer, ps: PeerState) -> None:
+        """Serve block parts for the height the peer is on
+        (reactor.go gossipDataForCatchup)."""
+        meta = self.cs.block_store.load_block_meta(ps.height)
+        if meta is None:
+            return
+        from ..types.block import PartSetHeader
+
+        psh = PartSetHeader.from_proto(meta.block_id.part_set_header)
+        with ps.mtx:
+            if ps.proposal_block_psh is None or ps.proposal_block_psh != psh:
+                ps.proposal_block_psh = psh
+                ps.proposal_block_parts = [False] * psh.total
+            want = next(
+                (
+                    i
+                    for i in range(psh.total)
+                    if i >= len(ps.proposal_block_parts)
+                    or not ps.proposal_block_parts[i]
+                ),
+                None,
+            )
+        if want is None:
+            return
+        part = self.cs.block_store.load_block_part(ps.height, want)
+        if part is None:
+            return
+        msg = pb.ConsensusMessage(
+            block_part=pb.BlockPartMsg(
+                height=ps.height, round=ps.round, part=part.to_proto()
+            )
+        )
+        if peer.try_send(DATA_STREAM, msg.encode()):
+            ps.set_has_block_part(ps.height, ps.round, want)
+
+    def _gossip_votes_routine(self, peer, ps: PeerState) -> None:
+        """Push votes the peer is missing (reactor.go:654)."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        while peer.is_running() and self.is_running():
+            try:
+                rs = self.cs.get_round_state()
+                sent = False
+                if ps.height == rs.height and rs.votes is not None:
+                    for vtype, vs in (
+                        (PREVOTE_TYPE, rs.votes.prevotes(ps.round if ps.round >= 0 else rs.round)),
+                        (PRECOMMIT_TYPE, rs.votes.precommits(ps.round if ps.round >= 0 else rs.round)),
+                    ):
+                        if vs is None:
+                            continue
+                        sent = self._pick_send_vote(peer, ps, vs) or sent
+                    # current-round sets too if the peer is on an older round
+                    if ps.round != rs.round:
+                        for vs in (rs.votes.prevotes(rs.round), rs.votes.precommits(rs.round)):
+                            if vs is not None:
+                                sent = self._pick_send_vote(peer, ps, vs) or sent
+                elif ps.height + 1 == rs.height and rs.last_commit is not None:
+                    # peer finishing the previous height: feed last commit
+                    sent = self._pick_send_vote(peer, ps, rs.last_commit)
+                elif 0 < ps.height < rs.height - 1:
+                    # deep catchup: send the stored commit as precommits
+                    sent = self._send_stored_commit_vote(peer, ps)
+                if not sent:
+                    time.sleep(sleep)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"gossip votes error: {e}")
+                time.sleep(sleep)
+
+    def _pick_send_vote(self, peer, ps: PeerState, vote_set) -> bool:
+        for i in range(vote_set.size()):
+            vote = vote_set.get_by_index(i)
+            if vote is None or ps.has_vote(vote):
+                continue
+            wire = pb.ConsensusMessage(vote=pb.VoteMsg(vote=vote.to_proto()))
+            if peer.try_send(VOTE_STREAM, wire.encode()):
+                ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+                return True
+            return False
+        return False
+
+    def _send_stored_commit_vote(self, peer, ps: PeerState) -> bool:
+        commit = self.cs.block_store.load_block_commit(ps.height)
+        if commit is None:
+            return False
+        rs_seen = ps.votes_seen.setdefault(
+            (ps.height, commit.round, PRECOMMIT_TYPE), set()
+        )
+        for i, cs_sig in enumerate(commit.signatures):
+            if i in rs_seen or not cs_sig.for_block():
+                continue
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=commit.height,
+                round=commit.round,
+                block_id=commit.block_id,
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=i,
+                signature=cs_sig.signature,
+            )
+            wire = pb.ConsensusMessage(vote=pb.VoteMsg(vote=vote.to_proto()))
+            if peer.try_send(VOTE_STREAM, wire.encode()):
+                rs_seen.add(i)
+                return True
+            return False
+        return False

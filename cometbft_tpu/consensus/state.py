@@ -123,6 +123,8 @@ class ConsensusState(Service):
         self.decide_proposal_hook = None  # override for byzantine tests
         # reactor seam: own proposals/votes/parts that must reach peers
         self.broadcast_hook = None  # Callable[[object], None] | None
+        # reactor seam: fired for every vote added to our sets (HasVote)
+        self.has_vote_hook = None  # Callable[[Vote], None] | None
 
         self.update_to_state(state)
 
@@ -796,6 +798,8 @@ class ConsensusState(Service):
         if not added:
             return
         self.event_bus.publish_vote(vote)
+        if self.has_vote_hook is not None and not self._replay_mode:
+            self.has_vote_hook(vote)
 
         if vote.type == PREVOTE_TYPE:
             self._on_prevote_added(vote)

@@ -1,0 +1,135 @@
+"""Consensus gossip wire messages (field layout mirrors
+proto/cometbft/consensus/v1/types.proto of the reference).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+from .types_pb import BlockID, Part, PartSetHeader, Proposal, Vote
+
+
+class BitArrayProto(Message):
+    """libs/bits BitArray: size in bits + u64 words (little-endian bits)."""
+
+    FIELDS = [
+        Field(1, "bits", "varint"),
+        Field(2, "elems", "fixed64", repeated=True, packed=True),
+    ]
+
+    @classmethod
+    def from_bools(cls, bools: list[bool]) -> "BitArrayProto":
+        words = [0] * ((len(bools) + 63) // 64)
+        for i, b in enumerate(bools):
+            if b:
+                words[i // 64] |= 1 << (i % 64)
+        return cls(bits=len(bools), elems=words)
+
+    def to_bools(self) -> list[bool]:
+        out = []
+        for i in range(self.bits):
+            w = self.elems[i // 64] if i // 64 < len(self.elems) else 0
+            out.append(bool(w >> (i % 64) & 1))
+        return out
+
+
+class NewRoundStep(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "step", "varint"),
+        Field(4, "seconds_since_start_time", "varint"),
+        Field(5, "last_commit_round", "varint"),
+    ]
+
+
+class NewValidBlock(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "block_part_set_header", "message", PartSetHeader, emit_default=True),
+        Field(4, "block_parts", "message", BitArrayProto),
+        Field(5, "is_commit", "bool"),
+    ]
+
+
+class ProposalMsg(Message):
+    FIELDS = [Field(1, "proposal", "message", Proposal, emit_default=True)]
+
+
+class ProposalPOL(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "proposal_pol_round", "varint"),
+        Field(3, "proposal_pol", "message", BitArrayProto, emit_default=True),
+    ]
+
+
+class BlockPartMsg(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "part", "message", Part, emit_default=True),
+    ]
+
+
+class VoteMsg(Message):
+    FIELDS = [Field(1, "vote", "message", Vote)]
+
+
+class HasVote(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "type", "varint"),
+        Field(4, "index", "varint"),
+    ]
+
+
+class VoteSetMaj23(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "type", "varint"),
+        Field(4, "block_id", "message", BlockID, emit_default=True),
+    ]
+
+
+class VoteSetBits(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "type", "varint"),
+        Field(4, "block_id", "message", BlockID, emit_default=True),
+        Field(5, "votes", "message", BitArrayProto, emit_default=True),
+    ]
+
+
+class HasProposalBlockPart(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "round", "varint"),
+        Field(3, "index", "varint"),
+    ]
+
+
+class ConsensusMessage(Message):
+    """oneof wrapper (types.proto Message)."""
+
+    FIELDS = [
+        Field(1, "new_round_step", "message", NewRoundStep),
+        Field(2, "new_valid_block", "message", NewValidBlock),
+        Field(3, "proposal", "message", ProposalMsg),
+        Field(4, "proposal_pol", "message", ProposalPOL),
+        Field(5, "block_part", "message", BlockPartMsg),
+        Field(6, "vote", "message", VoteMsg),
+        Field(7, "has_vote", "message", HasVote),
+        Field(8, "vote_set_maj23", "message", VoteSetMaj23),
+        Field(9, "vote_set_bits", "message", VoteSetBits),
+        Field(10, "has_proposal_block_part", "message", HasProposalBlockPart),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
