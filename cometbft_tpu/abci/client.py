@@ -249,8 +249,12 @@ class SocketClient(Client):
                     frame, buf = buf[pos : pos + ln], buf[pos + ln :]
                     self._on_response(pb.Response.decode(frame))
         except Exception as e:  # noqa: BLE001 - propagate as client error
-            self._err = self._err or e
+            # Set _err and drain under the same lock _queue appends under:
+            # any entry appended before this drain is completed here; any
+            # append attempted after sees _err and raises — no future can
+            # be left dangling between the two.
             with self._pending_mtx:
+                self._err = self._err or e
                 pending, self._pending = list(self._pending), deque()
             for _, rr in pending:
                 rr.set_done(pb.Response(exception=pb.ExceptionResponse(error=str(e))))
@@ -268,15 +272,31 @@ class SocketClient(Client):
         rr.set_done(resp)
 
     def _queue(self, method: str, msg) -> ReqRes:
-        if self._err:
-            raise ClientError(f"ABCI client failed: {self._err}")
         req = pb.Request(**{METHODS[method][0]: msg})
         rr = ReqRes(req)
         with self._write_mtx:
+            # error check and append share _pending_mtx with the reader's
+            # death path, so a ReqRes can never slip in after the drain
             with self._pending_mtx:
+                if self._err:
+                    raise ClientError(f"ABCI client failed: {self._err}")
                 self._pending.append((method, rr))
             payload = req.encode()
-            self._sock.sendall(encode_varint(len(payload)) + payload)
+            try:
+                self._sock.sendall(encode_varint(len(payload)) + payload)
+            except Exception as e:  # noqa: BLE001
+                # sendall on a half-closed socket: complete the future we
+                # just queued so no caller blocks forever on rr.wait(None)
+                with self._pending_mtx:
+                    self._err = self._err or e
+                    try:
+                        self._pending.remove((method, rr))
+                    except ValueError:
+                        pass  # reader's death path already drained it
+                rr.set_done(
+                    pb.Response(exception=pb.ExceptionResponse(error=str(e)))
+                )
+                raise ClientError(f"ABCI socket write failed: {e}")
         return rr
 
     def _do(self, method: str, msg):

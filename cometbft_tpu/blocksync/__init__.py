@@ -1,0 +1,14 @@
+"""Blocksync: catch up to the chain head by downloading committed blocks
+from peers instead of replaying consensus (reference: internal/blocksync).
+"""
+
+from .pool import BlockPool, BlockRequest, PeerError
+from .reactor import BlocksyncReactor, BLOCKSYNC_STREAM
+
+__all__ = [
+    "BlockPool",
+    "BlockRequest",
+    "PeerError",
+    "BlocksyncReactor",
+    "BLOCKSYNC_STREAM",
+]

@@ -1,0 +1,374 @@
+"""BlockPool: schedules concurrent block downloads across peers
+(reference: internal/blocksync/pool.go:93).
+
+Design notes vs the reference: the reference runs one goroutine per
+requester (hundreds live at once).  Python threads are far heavier, so the
+pool runs ONE scheduler thread that drives every requester as a small
+state record — same observable behavior (bounded per-peer pipelines,
+second-peer requests near the pool head, retry timers, peer ban/timeout,
+rate-based health checks), different concurrency skeleton.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.flowrate import Monitor
+from ..utils.log import get_logger
+from ..utils.service import Service
+
+MAX_PENDING_REQUESTS_PER_PEER = 20  # pool.go:32
+REQUEST_RETRY_SECONDS = 30.0  # pool.go:33
+MIN_RECV_RATE = 128 * 1024  # bytes/s, pool.go:41
+PEER_CONN_WAIT = 3.0  # pool.go:46
+MIN_BLOCKS_FOR_SINGLE_REQUEST = 50  # pool.go:52
+REQUEST_INTERVAL = 0.01  # pool.go:56
+PEER_TIMEOUT = 15.0  # pool.go:57
+BAN_DURATION = 60.0  # pool.go isPeerBanned
+
+
+@dataclass
+class BlockRequest:
+    height: int
+    peer_id: str
+
+
+@dataclass
+class PeerError(Exception):
+    err: str
+    peer_id: str
+
+
+@dataclass
+class _Peer:
+    """pool.go bpPeer."""
+
+    id: str
+    base: int
+    height: int
+    num_pending: int = 0
+    did_timeout: bool = False
+    cur_rate: float = 0.0
+    deadline: float = 0.0  # monotonic time after which the peer timed out
+    recv_monitor: Monitor = field(default_factory=lambda: Monitor(window=2.0))
+
+    def incr_pending(self) -> None:
+        if self.num_pending == 0:
+            self.recv_monitor.reset()
+            self.recv_monitor.set_rate(MIN_RECV_RATE * 2.718)
+            self.deadline = time.monotonic() + PEER_TIMEOUT
+        self.num_pending += 1
+
+    def decr_pending(self, recv_size: int) -> None:
+        self.num_pending -= 1
+        if self.num_pending == 0:
+            self.deadline = 0.0
+        else:
+            self.recv_monitor.update(recv_size)
+            self.deadline = time.monotonic() + PEER_TIMEOUT
+
+
+@dataclass
+class _Requester:
+    """pool.go bpRequester, flattened into a record the scheduler drives."""
+
+    height: int
+    peer_id: str = ""
+    second_peer_id: str = ""
+    got_block_from: str = ""
+    block: object = None
+    ext_commit: object = None
+    retry_at: float = 0.0  # monotonic deadline for re-requesting
+
+    def requested_from(self) -> list[str]:
+        return [p for p in (self.peer_id, self.second_peer_id) if p]
+
+    def did_request_from(self, peer_id: str) -> bool:
+        return peer_id in (self.peer_id, self.second_peer_id)
+
+    def reset_peer(self, peer_id: str) -> bool:
+        """Drop the block if it came from peer_id; clear that slot.
+        Returns True if a block was removed."""
+        removed = False
+        if self.got_block_from == peer_id:
+            self.block = None
+            self.ext_commit = None
+            self.got_block_from = ""
+            removed = True
+        if self.peer_id == peer_id:
+            self.peer_id = ""
+        elif self.second_peer_id == peer_id:
+            self.second_peer_id = ""
+        return removed
+
+
+class BlockPool(Service):
+    """Tracks peers, outstanding block requests, and received blocks.
+
+    send_request(BlockRequest) and send_error(PeerError) are callbacks into
+    the reactor (the reference uses channels; callbacks avoid a third
+    thread).  Both are invoked WITHOUT the pool lock held.
+    """
+
+    def __init__(self, start_height: int, send_request, send_error):
+        super().__init__("BlockPool")
+        self.start_height = start_height
+        self.height = start_height  # lowest height not yet popped
+        self._send_request = send_request
+        self._send_error = send_error
+        self._mtx = threading.RLock()
+        self.requesters: dict[int, _Requester] = {}
+        self.peers: dict[str, _Peer] = {}
+        self.banned: dict[str, float] = {}
+        self.max_peer_height = 0
+        self.logger = get_logger("blockpool")
+        self._start_time = 0.0
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def on_start(self) -> None:
+        self._start_time = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._scheduler_routine, name="blockpool", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ scheduler
+
+    def _scheduler_routine(self) -> None:
+        """Single loop doing the work of makeRequestersRoutine plus every
+        bpRequester.requestRoutine (pool.go:113,805)."""
+        while self.is_running():
+            if time.monotonic() - self._start_time < PEER_CONN_WAIT:
+                time.sleep(0.05)
+                continue
+            sends: list[BlockRequest] = []
+            with self._mtx:
+                self._remove_timedout_peers_locked()
+                # grow the requester window
+                cap = len(self.peers) * MAX_PENDING_REQUESTS_PER_PEER
+                next_height = self.height + len(self.requesters)
+                while len(self.requesters) < cap and next_height <= self.max_peer_height:
+                    self.requesters[next_height] = _Requester(next_height)
+                    next_height += 1
+                # drive each requester
+                now = time.monotonic()
+                for req in self.requesters.values():
+                    if req.block is not None:
+                        continue
+                    if req.retry_at and now >= req.retry_at:
+                        # retry everything after a timeout (requestRoutine
+                        # retryTimer branch)
+                        for pid in req.requested_from():
+                            peer = self.peers.get(pid)
+                            if peer is not None:
+                                peer.num_pending = max(0, peer.num_pending - 1)
+                        req.peer_id = ""
+                        req.second_peer_id = ""
+                        req.retry_at = 0.0
+                    if not req.peer_id:
+                        peer = self._pick_peer_locked(req.height, req.second_peer_id)
+                        if peer is not None:
+                            req.peer_id = peer.id
+                            req.retry_at = now + REQUEST_RETRY_SECONDS
+                            sends.append(BlockRequest(req.height, peer.id))
+                    # near the pool head, request from a second peer too
+                    # (bpRequester.pickSecondPeerAndSendRequest)
+                    if (
+                        req.peer_id
+                        and not req.second_peer_id
+                        and req.height - self.height < MIN_BLOCKS_FOR_SINGLE_REQUEST
+                    ):
+                        peer = self._pick_peer_locked(req.height, req.peer_id)
+                        if peer is not None:
+                            req.second_peer_id = peer.id
+                            req.retry_at = now + REQUEST_RETRY_SECONDS
+                            sends.append(BlockRequest(req.height, peer.id))
+            for brq in sends:
+                self._send_request(brq)
+            time.sleep(REQUEST_INTERVAL if sends else 0.05)
+
+    def _pick_peer_locked(self, height: int, exclude: str) -> _Peer | None:
+        """pickIncrAvailablePeer (pool.go:455): best current rate first."""
+        best = None
+        for peer in self.peers.values():
+            if peer.id == exclude or peer.did_timeout:
+                continue
+            if peer.num_pending >= MAX_PENDING_REQUESTS_PER_PEER:
+                continue
+            if height < peer.base or height > peer.height:
+                continue
+            if best is None or peer.cur_rate > best.cur_rate:
+                best = peer
+        if best is not None:
+            best.incr_pending()
+        return best
+
+    def _remove_timedout_peers_locked(self) -> None:
+        now = time.monotonic()
+        errors = []
+        for peer in list(self.peers.values()):
+            if not peer.did_timeout and peer.num_pending > 0:
+                cur_rate = peer.recv_monitor.rate()
+                peer.cur_rate = cur_rate
+                if cur_rate != 0 and cur_rate < MIN_RECV_RATE:
+                    peer.did_timeout = True
+                    errors.append(PeerError("peer is not sending us data fast enough", peer.id))
+                elif peer.deadline and now > peer.deadline:
+                    peer.did_timeout = True
+                    errors.append(PeerError("peer did not send us anything", peer.id))
+            if peer.did_timeout:
+                self._remove_peer_locked(peer.id)
+        for pid, when in list(self.banned.items()):
+            if time.monotonic() - when >= BAN_DURATION:
+                del self.banned[pid]
+        for err in errors:
+            self._send_error(err)
+
+    # ------------------------------------------------------------- queries
+
+    def is_caught_up(self) -> tuple[bool, int, int]:
+        """pool.go:190 IsCaughtUp."""
+        with self._mtx:
+            if not self.peers:
+                return False, self.height, self.max_peer_height
+            received_or_timed_out = (
+                self.height > self.start_height
+                or time.monotonic() - self._start_time > 5.0
+            )
+            caught_up = received_or_timed_out and (
+                self.max_peer_height == 0 or self.height >= self.max_peer_height - 1
+            )
+            return caught_up, self.height, self.max_peer_height
+
+    def peek_two_blocks(self):
+        """Blocks at height and height+1 plus the first's extended commit
+        (pool.go:216): the second's LastCommit validates the first."""
+        with self._mtx:
+            first = second = ext = None
+            r = self.requesters.get(self.height)
+            if r is not None:
+                first, ext = r.block, r.ext_commit
+            r2 = self.requesters.get(self.height + 1)
+            if r2 is not None:
+                second = r2.block
+            return first, second, ext
+
+    def pop_request(self) -> None:
+        """Advance past a verified block (pool.go:234)."""
+        with self._mtx:
+            if self.height not in self.requesters:
+                raise RuntimeError(f"no requester at height {self.height}")
+            del self.requesters[self.height]
+            self.height += 1
+
+    def max_height(self) -> int:
+        with self._mtx:
+            return self.max_peer_height
+
+    # --------------------------------------------------------------- peers
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """Record a peer's advertised chain span (pool.go:351)."""
+        with self._mtx:
+            peer = self.peers.get(peer_id)
+            if peer is not None:
+                if base < peer.base or height < peer.height:
+                    # a shrinking chain is a lying peer
+                    self._remove_peer_locked(peer_id)
+                    self.banned[peer_id] = time.monotonic()
+                    return
+                peer.base, peer.height = base, height
+            else:
+                if self._is_banned_locked(peer_id):
+                    return
+                self.peers[peer_id] = _Peer(peer_id, base, height)
+            if height > self.max_peer_height:
+                self.max_peer_height = height
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._remove_peer_locked(peer_id)
+
+    def _remove_peer_locked(self, peer_id: str) -> None:
+        for req in self.requesters.values():
+            if req.did_request_from(peer_id):
+                self._redo_locked(req, peer_id)
+        peer = self.peers.pop(peer_id, None)
+        if peer is not None and peer.height == self.max_peer_height:
+            self.max_peer_height = max(
+                (p.height for p in self.peers.values()), default=0
+            )
+
+    def _is_banned_locked(self, peer_id: str) -> bool:
+        return time.monotonic() - self.banned.get(peer_id, -1e9) < BAN_DURATION
+
+    def is_peer_banned(self, peer_id: str) -> bool:
+        with self._mtx:
+            return self._is_banned_locked(peer_id)
+
+    def _redo_locked(self, req: _Requester, peer_id: str) -> None:
+        req.reset_peer(peer_id)
+        if not req.requested_from():
+            req.retry_at = 0.0  # scheduler re-picks immediately
+
+    def redo_request_from(self, height: int, peer_id: str) -> None:
+        """Peer answered NoBlockResponse: retry elsewhere (pool.go:284)."""
+        with self._mtx:
+            req = self.requesters.get(height)
+            if req is not None and req.did_request_from(peer_id):
+                peer = self.peers.get(peer_id)
+                if peer is not None:
+                    peer.num_pending = max(0, peer.num_pending - 1)
+                self._redo_locked(req, peer_id)
+
+    def remove_peer_and_redo_all(self, height: int) -> str:
+        """Block at `height` failed verification: ban its sender and retry
+        everything it owed us (pool.go:269)."""
+        with self._mtx:
+            req = self.requesters.get(height)
+            peer_id = req.got_block_from if req is not None else ""
+            if peer_id:
+                self._remove_peer_locked(peer_id)
+                self.banned[peer_id] = time.monotonic()
+            return peer_id
+
+    # -------------------------------------------------------------- blocks
+
+    def add_block(self, peer_id: str, block, ext_commit, block_size: int) -> None:
+        """Accept a BlockResponse (pool.go:306).  Raises PeerError for
+        protocol violations the reactor should disconnect for."""
+        if ext_commit is not None and block.header.height != ext_commit.height:
+            raise PeerError(
+                f"block height {block.header.height} != extCommit height "
+                f"{ext_commit.height}",
+                peer_id,
+            )
+        with self._mtx:
+            height = block.header.height
+            req = self.requesters.get(height)
+            if req is None:
+                if height > self.height or height < self.start_height:
+                    raise PeerError(
+                        f"peer sent us block #{height} we didn't expect", peer_id
+                    )
+                return  # already-processed duplicate from the slower peer
+            if not req.did_request_from(peer_id):
+                raise PeerError(
+                    f"requested block #{height} from {req.requested_from()}, "
+                    f"not {peer_id}",
+                    peer_id,
+                )
+            if req.block is None:
+                req.block = block
+                req.ext_commit = ext_commit
+                req.got_block_from = peer_id
+            peer = self.peers.get(peer_id)
+            if peer is not None:
+                peer.decr_pending(block_size)

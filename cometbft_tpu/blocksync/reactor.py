@@ -1,0 +1,356 @@
+"""Blocksync reactor: serve blocks to catching-up peers and drive our own
+catch-up through the BlockPool (reference: internal/blocksync/reactor.go).
+
+The verification hot path — checking `first` against `second.LastCommit`
+— goes through ValidatorSet.verify_commit_light, i.e. the batched TPU
+Ed25519 seam (reactor.go:547 VerifyCommitLight): a catching-up node
+streams thousands of commits through the device verifier, the workload
+BASELINE.json's "blocksync replay" config measures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..p2p.conn.connection import StreamDescriptor
+from ..p2p.reactor import Reactor
+from ..types.block import Block, ExtendedCommit
+from ..utils.log import get_logger
+from ..wire import blocksync_pb as pb
+from .pool import BlockPool, BlockRequest, PeerError
+
+BLOCKSYNC_STREAM = 0x40  # reactor.go:21
+TRY_SYNC_INTERVAL = 0.01  # reactor.go:23
+STATUS_UPDATE_INTERVAL = 10.0  # reactor.go:30
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0  # reactor.go:32
+MAX_MSG_SIZE = 10 * 1024 * 1024
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(
+        self,
+        state,  # sm State at boot
+        block_exec,  # BlockExecutor
+        store,  # BlockStore
+        block_sync: bool,  # start in sync mode?
+        local_addr: bytes = b"",
+        switch_interval: float = SWITCH_TO_CONSENSUS_INTERVAL,
+    ):
+        super().__init__("BLOCKSYNC")
+        store_height = store.height
+        if store_height and state.last_block_height != store_height:
+            raise RuntimeError(
+                f"state ({state.last_block_height}) and store ({store_height}) "
+                "height mismatch"
+            )
+        start_height = store_height + 1
+        if start_height == 1:
+            start_height = state.initial_height
+        self.initial_state = state
+        self.block_exec = block_exec
+        self.store = store
+        self.block_sync = block_sync
+        self.local_addr = local_addr
+        self.switch_interval = switch_interval
+        self.logger = get_logger("blocksync")
+        self._events: queue.Queue = queue.Queue(maxsize=2000)
+        self.pool = BlockPool(
+            start_height,
+            send_request=lambda rq: self._enqueue(("request", rq)),
+            send_error=lambda err: self._enqueue(("error", err)),
+        )
+        self._pool_thread: threading.Thread | None = None
+        self._events_thread: threading.Thread | None = None
+        self._synced_callbacks: list = []
+        self.blocks_synced = 0
+        self._state_synced = False
+
+    # -------------------------------------------------------------- wiring
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return [
+            StreamDescriptor(
+                id=BLOCKSYNC_STREAM, priority=5, send_queue_capacity=1000
+            )
+        ]
+
+    def _enqueue(self, item) -> None:
+        try:
+            self._events.put_nowait(item)
+        except queue.Full:
+            self.logger.error("blocksync event queue full; dropping")
+
+    def on_start(self) -> None:
+        if self.block_sync:
+            self._start_pool(state_synced=False)
+
+    def switch_to_block_sync(self, state) -> None:
+        """Called by statesync once it has bootstrapped state
+        (reactor.go:139 SwitchToBlockSync)."""
+        self.block_sync = True
+        self.initial_state = state
+        self.pool.height = state.last_block_height + 1
+        self.pool.start_height = self.pool.height
+        self._start_pool(state_synced=True)
+
+    def _start_pool(self, state_synced: bool) -> None:
+        self._state_synced = state_synced
+        self.pool.start()
+        self._events_thread = threading.Thread(
+            target=self._events_routine, name="blocksync-events", daemon=True
+        )
+        self._events_thread.start()
+        self._pool_thread = threading.Thread(
+            target=self._pool_routine, name="blocksync-pool", daemon=True
+        )
+        self._pool_thread.start()
+
+    def on_stop(self) -> None:
+        if self.pool.is_running():
+            self.pool.stop()
+
+    # --------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        """Send our status so the peer can add us to its pool
+        (reactor.go:193 AddPeer)."""
+        peer.try_send(
+            BLOCKSYNC_STREAM,
+            pb.BlocksyncMessage(
+                status_response=pb.StatusResponse(
+                    height=self.store.height, base=self.store.base
+                )
+            ).encode(),
+        )
+
+    def remove_peer(self, peer, reason: str = "") -> None:
+        self.pool.remove_peer(peer.id)
+
+    # -------------------------------------------------------------- receive
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        if len(msg_bytes) > MAX_MSG_SIZE:
+            self.switch.stop_peer(peer, "oversized blocksync message")
+            return
+        msg = pb.BlocksyncMessage.decode(msg_bytes)
+        which = msg.which()
+        if which == "block_request":
+            self._respond_to_peer(msg.block_request, peer)
+        elif which == "block_response":
+            self._handle_block_response(msg.block_response, peer, len(msg_bytes))
+        elif which == "status_request":
+            peer.try_send(
+                BLOCKSYNC_STREAM,
+                pb.BlocksyncMessage(
+                    status_response=pb.StatusResponse(
+                        height=self.store.height, base=self.store.base
+                    )
+                ).encode(),
+            )
+        elif which == "status_response":
+            self.pool.set_peer_range(
+                peer.id, msg.status_response.base, msg.status_response.height
+            )
+        elif which == "no_block_response":
+            self.pool.redo_request_from(msg.no_block_response.height, peer.id)
+        else:
+            self.switch.stop_peer(peer, f"unknown blocksync message {which}")
+
+    def _respond_to_peer(self, msg: pb.BlockRequest, peer) -> None:
+        """Serve a stored block, or say we don't have it (reactor.go:211)."""
+        block = self.store.load_block(msg.height)
+        if block is None:
+            peer.try_send(
+                BLOCKSYNC_STREAM,
+                pb.BlocksyncMessage(
+                    no_block_response=pb.NoBlockResponse(height=msg.height)
+                ).encode(),
+            )
+            return
+        ext = None
+        state = self.block_exec.store.load()
+        if state is not None and state.consensus_params.vote_extensions_enabled(
+            msg.height
+        ):
+            ext = self.store.load_block_extended_commit(msg.height)
+            if ext is None:
+                self.logger.error(
+                    f"block {msg.height} in store with no extended commit"
+                )
+                return
+        peer.try_send(
+            BLOCKSYNC_STREAM,
+            pb.BlocksyncMessage(
+                block_response=pb.BlockResponse(
+                    block=block.to_proto(),
+                    ext_commit=ext.to_proto() if ext is not None else None,
+                )
+            ).encode(),
+        )
+
+    def _handle_block_response(self, msg: pb.BlockResponse, peer, size: int) -> None:
+        try:
+            block = Block.from_proto(msg.block)
+        except Exception as e:  # noqa: BLE001
+            self.switch.stop_peer(peer, f"invalid block: {e}")
+            return
+        ext = None
+        if msg.ext_commit is not None:
+            try:
+                ext = ExtendedCommit.from_proto(msg.ext_commit)
+            except Exception as e:  # noqa: BLE001
+                self.switch.stop_peer(peer, f"invalid extended commit: {e}")
+                return
+        try:
+            self.pool.add_block(peer.id, block, ext, size)
+        except PeerError as e:
+            self.logger.error(f"add block failed: {e.err}")
+            self._enqueue(("error", e))
+
+    # ------------------------------------------------------- event routine
+
+    def _events_routine(self) -> None:
+        """Dispatch pool-originated requests/errors (reactor.go:454
+        handleBlockRequestsRoutine) plus the periodic status broadcast."""
+        last_status = 0.0
+        while self.is_running() and self.pool.is_running():
+            now = time.monotonic()
+            if now - last_status >= STATUS_UPDATE_INTERVAL:
+                last_status = now
+                self.broadcast_status_request()
+            try:
+                kind, item = self._events.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if kind == "request":
+                self._handle_block_request(item)
+            elif kind == "error":
+                peer = self.switch.peers.get(item.peer_id) if self.switch else None
+                if peer is not None:
+                    self.switch.stop_peer(peer, item.err)
+
+    def _handle_block_request(self, rq: BlockRequest) -> None:
+        peer = self.switch.peers.get(rq.peer_id) if self.switch else None
+        if peer is None:
+            return
+        peer.try_send(
+            BLOCKSYNC_STREAM,
+            pb.BlocksyncMessage(
+                block_request=pb.BlockRequest(height=rq.height)
+            ).encode(),
+        )
+
+    def broadcast_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                BLOCKSYNC_STREAM,
+                pb.BlocksyncMessage(status_request=pb.StatusRequest()).encode(),
+            )
+
+    # --------------------------------------------------------- pool routine
+
+    def _pool_routine(self) -> None:
+        """Apply fetched blocks pairwise; switch to consensus when caught up
+        (reactor.go:315 poolRoutine)."""
+        state = self.initial_state
+        last_switch_check = 0.0
+        while self.is_running() and self.pool.is_running():
+            now = time.monotonic()
+            if now - last_switch_check >= self.switch_interval:
+                last_switch_check = now
+                if self._check_switch_to_consensus(state):
+                    return
+            first, second, ext = self.pool.peek_two_blocks()
+            if first is None or second is None:
+                time.sleep(TRY_SYNC_INTERVAL)
+                continue
+            if (
+                state.last_block_height > 0
+                and state.last_block_height + 1 != first.header.height
+            ):
+                raise RuntimeError(
+                    f"peeked first block has unexpected height "
+                    f"{first.header.height}, want {state.last_block_height + 1}"
+                )
+            try:
+                state = self._process_block(first, second, state, ext)
+                self.blocks_synced += 1
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(
+                    f"invalid block at {first.header.height}: {e}"
+                )
+                # ban both senders and refetch (reactor.go:565-581)
+                for h in (first.header.height, second.header.height):
+                    pid = self.pool.remove_peer_and_redo_all(h)
+                    peer = self.switch.peers.get(pid) if self.switch else None
+                    if peer is not None:
+                        self.switch.stop_peer(peer, f"bad block: {e}")
+
+    def _process_block(self, first: Block, second: Block, state, ext) -> object:
+        """reactor.go:536 processBlock: verify w/ second.LastCommit, save,
+        apply."""
+        from ..types.block import BlockID
+        from ..types.validation import verify_commit_light
+
+        chain_id = self.initial_state.chain_id
+        first_parts = first.make_part_set()
+        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
+
+        # the TPU-batched signature check (types/validation.go VerifyCommitLight)
+        verify_commit_light(
+            chain_id,
+            state.validators,
+            first_id,
+            first.header.height,
+            second.last_commit,
+        )
+        self.block_exec.validate_block(state, first)
+
+        extensions_enabled = state.consensus_params.vote_extensions_enabled(
+            first.header.height
+        )
+        if (ext is not None) != extensions_enabled:
+            raise ValueError(
+                "extended commit present iff extensions enabled violated "
+                f"(height {first.header.height})"
+            )
+        if extensions_enabled:
+            ext.ensure_extensions(True)
+            self.store.save_block_with_extended_commit(first, first_parts, ext)
+        else:
+            self.store.save_block(first, first_parts, second.last_commit)
+        self.pool.pop_request()
+
+        return self.block_exec.apply_verified_block(
+            state, first_id, first, syncing_to_height=self.pool.max_height()
+        )
+
+    # ------------------------------------------------- switch to consensus
+
+    def _check_switch_to_consensus(self, state) -> bool:
+        """reactor.go:516 isCaughtUp + the SwitchToConsensus handoff."""
+        caught_up, height, _ = self.pool.is_caught_up()
+        blocks_chain = False
+        if self.local_addr and state.validators is not None:
+            blocks_chain = state.validators.validator_blocks_the_chain(
+                self.local_addr
+            )
+        if not (caught_up or blocks_chain):
+            return False
+        self.logger.info(f"caught up at height {height}; switching to consensus")
+        self.pool.stop()
+        if self.switch is not None:
+            mem = self.switch.reactors.get("MEMPOOL")
+            if mem is not None and hasattr(mem, "enable_in_out_txs"):
+                mem.enable_in_out_txs()
+            con = self.switch.reactors.get("CONSENSUS")
+            if con is not None and hasattr(con, "switch_to_consensus"):
+                con.switch_to_consensus(
+                    state,
+                    skip_wal=self.blocks_synced > 0 or self._state_synced,
+                )
+        for cb in self._synced_callbacks:
+            cb(state)
+        return True

@@ -409,6 +409,16 @@ class ConsensusReactor(Reactor):
             try:
                 rs = self.cs.get_round_state()
                 sent = False
+                if (
+                    ps.height == rs.height
+                    and ps.step == STEP_NEW_HEIGHT
+                    and rs.last_commit is not None
+                ):
+                    # peer is waiting out commit-timeout for the block it
+                    # just committed: feed it any last-commit precommits it
+                    # is missing (reactor.go gossipVotesForHeight, the
+                    # RoundStepNewHeight branch)
+                    sent = self._pick_send_vote(peer, ps, rs.last_commit)
                 if ps.height == rs.height and rs.votes is not None:
                     for vtype, vs in (
                         (PREVOTE_TYPE, rs.votes.prevotes(ps.round if ps.round >= 0 else rs.round)),

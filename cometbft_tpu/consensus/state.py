@@ -13,6 +13,7 @@ and schedules round 0 of the next height.
 
 from __future__ import annotations
 
+import copy
 import queue
 import threading
 import time
@@ -182,8 +183,11 @@ class ConsensusState(Service):
         )
 
     def get_round_state(self) -> RoundState:
+        """Shallow copy under lock (state.go GetRoundState): reactor gossip
+        threads read height/round/parts while the consensus thread mutates
+        them across height transitions; a live reference allows torn reads."""
         with self._mtx:
-            return self.rs
+            return copy.copy(self.rs)
 
     def is_proposer(self) -> bool:
         with self._mtx:

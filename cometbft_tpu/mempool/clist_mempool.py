@@ -133,6 +133,15 @@ class CListMempool(Mempool):
             lane_priorities, default_lane = {"": 1}, ""
         if default_lane not in lane_priorities:
             raise ValueError(f"default lane {default_lane!r} not in lane set")
+        # IWRRIterator clamps its round counter to 1..max_priority, so a
+        # lane with priority < 1 would be skipped on every pass while
+        # resetting the empty counter — an infinite loop in reap.  The app's
+        # Info response is untrusted input; reject bad priorities up front.
+        for lane, priority in lane_priorities.items():
+            if priority < 1:
+                raise ValueError(
+                    f"lane {lane!r} priority {priority} must be >= 1"
+                )
         self.lane_priorities = dict(lane_priorities)
         self.default_lane = default_lane
         self.lanes: dict[str, OrderedDict[bytes, TxEntry]] = {

@@ -70,10 +70,28 @@ class TCPTransport:
         return self._upgrade(sock)
 
     def dial(self, addr: str, timeout: float = 10.0) -> tuple[SecretConnection, NodeInfo]:
+        """Dial `host:port` or `id@host:port`.
+
+        With the id form the secret-connection-authenticated key must hash
+        to the expected node ID, or the connection is dropped — without the
+        pin an on-path attacker (or hijacked DNS/IP) could impersonate a
+        configured persistent peer (reference: p2p/transport/tcp/tcp.go Dial
+        + netaddr.NetAddr ID checks).
+        """
+        expected_id = ""
+        if "@" in addr:
+            expected_id, addr = addr.split("@", 1)
+            expected_id = expected_id.lower()
         host, port = addr.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=timeout)
         sock.settimeout(HANDSHAKE_TIMEOUT)
         conn, info = self._upgrade(sock)
+        if expected_id and conn.remote_pub.address().hex() != expected_id:
+            conn.close()
+            raise TransportError(
+                f"dialed {expected_id} but remote authenticated as "
+                f"{conn.remote_pub.address().hex()}"
+            )
         return conn, info
 
     def _upgrade(self, sock: socket.socket) -> tuple[SecretConnection, NodeInfo]:

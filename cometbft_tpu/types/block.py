@@ -527,6 +527,26 @@ class ExtendedCommit:
             signatures=[ecs.commit_sig for ecs in self.extended_signatures],
         )
 
+    def ensure_extensions(self, ext_enabled: bool) -> None:
+        """Check extension-signature presence is consistent with the flag
+        (block.go:1173 EnsureExtensions / :791 EnsureExtension)."""
+        for ecs in self.extended_signatures:
+            flag = ecs.commit_sig.block_id_flag
+            if ext_enabled:
+                if flag == BLOCK_ID_FLAG_COMMIT and not ecs.extension_signature:
+                    raise ValueError(
+                        "vote extension signature missing for validator "
+                        + ecs.commit_sig.validator_address.hex()
+                    )
+                if flag != BLOCK_ID_FLAG_COMMIT and (
+                    ecs.extension or ecs.extension_signature
+                ):
+                    raise ValueError("non-commit vote has extension data")
+            elif ecs.extension or ecs.extension_signature:
+                raise ValueError(
+                    "vote extension present but extensions are disabled"
+                )
+
     def to_proto(self) -> pb.ExtendedCommit:
         return pb.ExtendedCommit(
             height=self.height,

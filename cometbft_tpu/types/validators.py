@@ -166,6 +166,15 @@ class ValidatorSet:
                 return i, v
         return -1, None
 
+    def validator_blocks_the_chain(self, address: bytes) -> bool:
+        """True if this validator alone holds > 1/3 power, i.e. the chain
+        cannot progress without it (validator_set.go:374) — a blocksyncing
+        node with such a key must switch to consensus immediately."""
+        _, val = self.get_by_address(address)
+        if val is None:
+            return False
+        return val.voting_power > (self.total_voting_power() - 1) // 3
+
     def get_by_index(self, index: int) -> tuple[bytes, Validator | None]:
         if index < 0 or index >= len(self.validators):
             return b"", None

@@ -1,0 +1,89 @@
+"""Flow-rate measurement and throttling (reference: internal/flowrate).
+
+Monitor tracks transfer progress over a sliding exponentially-weighted
+window and reports the current rate; Limiter adds a blocking throttle to a
+target rate.  Used by blocksync peer health checks (pool.go minRecvRate)
+and MConnection send/recv rate caps (connection.go:40-41).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Monitor:
+    """EWMA transfer-rate monitor (flowrate.Monitor, simplified: the
+    reference resamples at a fixed period; we fold each update into an
+    exponential moving average over `window` seconds)."""
+
+    def __init__(self, window: float = 1.0):
+        self._window = window
+        self._mtx = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_mtx", threading.Lock()):
+            self._start = time.monotonic()
+            self._last = self._start
+            self._total = 0
+            self._rate = 0.0  # bytes/sec EWMA
+
+    def set_rate(self, rate: float) -> None:
+        """Seed the EWMA (pool.go resetMonitor SetREMA equivalent)."""
+        with self._mtx:
+            self._rate = rate
+
+    def update(self, n: int) -> None:
+        now = time.monotonic()
+        with self._mtx:
+            dt = now - self._last
+            self._last = now
+            self._total += n
+            if dt <= 0:
+                return
+            inst = n / dt
+            alpha = min(1.0, dt / self._window)
+            self._rate += alpha * (inst - self._rate)
+
+    @property
+    def total(self) -> int:
+        with self._mtx:
+            return self._total
+
+    def rate(self) -> float:
+        """Current bytes/sec estimate, decayed if no recent updates."""
+        now = time.monotonic()
+        with self._mtx:
+            idle = now - self._last
+            if idle > self._window:
+                # no traffic for over a window: decay toward zero
+                return self._rate * self._window / idle
+            return self._rate
+
+
+class Limiter(Monitor):
+    """Monitor + blocking throttle to `limit` bytes/sec (flowrate's
+    Limit(want, rate, block=true) usage in MConnection send/recv loops)."""
+
+    def __init__(self, limit: int, window: float = 1.0):
+        super().__init__(window)
+        self.limit = limit
+
+    def throttle(self, n: int) -> None:
+        """Account n bytes and sleep long enough to keep the average rate
+        at or under the limit."""
+        if self.limit <= 0:  # unlimited
+            self.update(n)
+            return
+        now = time.monotonic()
+        with self._mtx:
+            self._total += n
+            elapsed = now - self._start
+            # time at which `total` bytes are allowed to have passed
+            allowed_at = self._total / self.limit
+            sleep = allowed_at - elapsed
+            self._last = now
+            self._rate = self.limit if sleep > 0 else self._total / max(elapsed, 1e-9)
+        if sleep > 0:
+            time.sleep(min(sleep, 10.0))

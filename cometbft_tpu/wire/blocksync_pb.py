@@ -1,0 +1,52 @@
+"""Blocksync wire messages (field layout mirrors
+proto/cometbft/blocksync/v2/types.proto of the reference).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+from .types_pb import BlockProto, ExtendedCommit
+
+
+class BlockRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class NoBlockResponse(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class StatusRequest(Message):
+    FIELDS = []
+
+
+class StatusResponse(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "base", "varint"),
+    ]
+
+
+class BlockResponse(Message):
+    FIELDS = [
+        Field(1, "block", "message", BlockProto, emit_default=True),
+        Field(2, "ext_commit", "message", ExtendedCommit),
+    ]
+
+
+class BlocksyncMessage(Message):
+    """The oneof envelope carried on the blocksync stream."""
+
+    FIELDS = [
+        Field(1, "block_request", "message", BlockRequest),
+        Field(2, "no_block_response", "message", NoBlockResponse),
+        Field(3, "block_response", "message", BlockResponse),
+        Field(4, "status_request", "message", StatusRequest),
+        Field(5, "status_response", "message", StatusResponse),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None

@@ -1,0 +1,116 @@
+"""Micro-benchmarks: primitive op throughput + H2D bandwidth on the chip."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from cometbft_tpu.ops import field as F
+
+N = 16384
+
+
+def bench(fn, *args, iters=5, label=""):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label}: {dt*1e3:.2f} ms", flush=True)
+    return dt
+
+
+# H2D bandwidth
+for sz in (1 << 20, 4 << 20, 16 << 20):
+    buf = np.random.randint(0, 255, size=sz, dtype=np.uint8)
+    jnp.asarray(buf).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jnp.asarray(buf).block_until_ready()
+    dt = (time.perf_counter() - t0) / 3
+    print(f"H2D {sz>>20} MiB: {dt*1e3:.1f} ms = {sz/dt/1e6:.0f} MB/s", flush=True)
+
+# chained int32 multiplies (VPU int path)
+x32 = jnp.asarray(np.random.randint(1, 1000, size=(N, 128), dtype=np.int32))
+
+@jax.jit
+def chain_i32(x):
+    def body(_, a):
+        return (a * a) & 0xFFFF | 1
+    return lax.fori_loop(0, 256, body, x)
+
+d = bench(chain_i32, x32, label="int32 mul+and chain 256x (N,128)")
+print(f"  -> {256*N*128/d/1e9:.1f} G int32-mul/s", flush=True)
+
+# chained f32 FMA
+xf = jnp.asarray(np.random.uniform(1.0, 1.001, size=(N, 128)).astype(np.float32))
+
+@jax.jit
+def chain_f32(x):
+    def body(_, a):
+        return a * a + 0.25
+    return lax.fori_loop(0, 256, body, x)
+
+d = bench(chain_f32, xf, label="f32 fma chain 256x (N,128)")
+print(f"  -> {256*N*128/d/1e9:.1f} G f32-fma/s", flush=True)
+
+# bf16->f32 matmul MXU reference
+a = jnp.asarray(np.random.randn(4096, 4096).astype(np.float32))
+
+@jax.jit
+def mm(a):
+    return a @ a
+
+d = bench(mm, a, label="f32 matmul 4096^3")
+print(f"  -> {2*4096**3/d/1e12:.1f} TFLOP/s", flush=True)
+
+# our field mul chained
+fx = jnp.asarray(np.random.randint(0, 2000, size=(N, 22), dtype=np.int32))
+
+@jax.jit
+def chain_fmul(x):
+    def body(_, a):
+        return F.mul(a, a)
+    return lax.fori_loop(0, 64, body, x)
+
+d = bench(chain_fmul, fx, label="field mul chain 64x (N,22)")
+print(f"  -> {64*N/d/1e6:.2f} M fieldmul/s; {d/64/N*1e9:.1f} ns/fieldmul-row", flush=True)
+
+# field squaring chain for comparison
+@jax.jit
+def chain_fsq(x):
+    def body(_, a):
+        return F.square(a)
+    return lax.fori_loop(0, 64, body, x)
+
+bench(chain_fsq, fx, label="field square chain 64x (N,22)")
+
+# int16 mul chain (does VPU do int16 better?)
+x16 = jnp.asarray(np.random.randint(1, 100, size=(N, 128), dtype=np.int16))
+
+@jax.jit
+def chain_i16(x):
+    def body(_, a):
+        return (a * a) & 0xFF | 1
+    return lax.fori_loop(0, 256, body, x)
+
+d = bench(chain_i16, x16, label="int16 mul chain 256x (N,128)")
+print(f"  -> {256*N*128/d/1e9:.1f} G int16-mul/s", flush=True)
+
+# elementwise int32 multiply, one shot over big array (memory bound check)
+big = jnp.asarray(np.random.randint(0, 1000, size=(N, 2048), dtype=np.int32))
+
+@jax.jit
+def one_mul(x):
+    return x * x
+
+d = bench(one_mul, big, label="single int32 mul (N,2048)")
+print(f"  -> {N*2048*4*2/d/1e9:.0f} GB/s effective", flush=True)

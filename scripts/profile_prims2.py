@@ -1,0 +1,88 @@
+"""Micro-benchmarks with forced D2H readback (block_until_ready appears
+unreliable through the axon tunnel for short programs)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from cometbft_tpu.ops import field as F
+
+N = 16384
+
+
+def bench(fn, *args, iters=5, label="", work=0.0):
+    out = fn(*args)
+    _ = float(np.asarray(out.ravel()[0] if hasattr(out, "ravel") else out))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        _ = float(np.asarray(out.ravel()[0]))
+    dt = (time.perf_counter() - t0) / iters
+    msg = f"{label}: {dt*1e3:.2f} ms"
+    if work:
+        msg += f" -> {work/dt/1e9:.1f} Gop/s"
+    print(msg, flush=True)
+    return dt
+
+
+x32 = jnp.asarray(np.random.randint(1, 1000, size=(N, 128), dtype=np.int32))
+
+@jax.jit
+def chain_i32(x):
+    def body(_, a):
+        return (a * a) & 0xFFFF | 1
+    return lax.fori_loop(0, 1024, body, x)
+
+bench(chain_i32, x32, label="int32 mul chain 1024x (16k,128)", work=1024*N*128)
+
+xf = jnp.asarray(np.random.uniform(1.0, 1.001, size=(N, 128)).astype(np.float32))
+
+@jax.jit
+def chain_f32(x):
+    def body(_, a):
+        return a * a + 0.25
+    return lax.fori_loop(0, 1024, body, x)
+
+bench(chain_f32, xf, label="f32 fma chain 1024x (16k,128)", work=1024*N*128)
+
+a = jnp.asarray(np.random.randn(4096, 4096).astype(np.float32))
+
+@jax.jit
+def mm(a):
+    b = a
+    for _ in range(8):
+        b = b @ a * 1e-3
+    return b
+
+d = bench(mm, a, label="f32 matmul 8x4096^3")
+print(f"  -> {8*2*4096**3/d/1e12:.1f} TFLOP/s", flush=True)
+
+ab = jnp.asarray(np.random.randn(4096, 4096)).astype(jnp.bfloat16)
+
+@jax.jit
+def mmb(a):
+    b = a
+    for _ in range(8):
+        b = (b @ a).astype(jnp.bfloat16) * jnp.bfloat16(1e-3)
+    return b
+
+d = bench(mmb, ab, label="bf16 matmul 8x4096^3")
+print(f"  -> {8*2*4096**3/d/1e12:.1f} TFLOP/s", flush=True)
+
+fx = jnp.asarray(np.random.randint(0, 2000, size=(N, 22), dtype=np.int32))
+
+@jax.jit
+def chain_fmul(x):
+    def body(_, a):
+        return F.mul(a, a)
+    return lax.fori_loop(0, 256, body, x)
+
+d = bench(chain_fmul, fx, label="field mul chain 256x (16k,22)")
+print(f"  -> {d/256/N*1e9:.2f} ns/fieldmul-row; ~{256*N*484/d/1e9:.0f} G MAC/s", flush=True)

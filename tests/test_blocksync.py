@@ -1,0 +1,200 @@
+"""Blocksync: a late-started node catches up via block requests (not vote
+gossip) and switches to consensus (reference: blocksync/reactor_test.go)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci import KVStoreApplication
+from cometbft_tpu.abci.kvstore import default_lanes
+from cometbft_tpu.blocksync import BlockPool, BlocksyncReactor, PeerError
+from cometbft_tpu.blocksync import pool as pool_mod
+from cometbft_tpu.consensus.config import test_consensus_config
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool import CListMempool, MempoolConfig
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import TCPTransport
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.privval.file_pv import FilePVKey, FilePVLastSignState
+from cometbft_tpu.proxy import local_client_creator, new_app_conns
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.types.event_bus import EventBus
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.wire import abci_pb as pb
+from cometbft_tpu.wire.canonical import Timestamp
+
+GENESIS_NS = 1_700_000_000 * 1_000_000_000
+
+
+class Node:
+    """Full node: consensus + blocksync reactors over a real switch."""
+
+    def __init__(self, idx, val_keys, genesis, is_validator, block_sync):
+        state = make_genesis_state(genesis)
+        self.app = KVStoreApplication(lanes=default_lanes())
+        self.conns = new_app_conns(local_client_creator(self.app))
+        self.conns.start()
+        self.app.init_chain(
+            pb.InitChainRequest(
+                chain_id=genesis.chain_id,
+                validators=[
+                    pb.ValidatorUpdate(
+                        power=10, pub_key_type="ed25519",
+                        pub_key_bytes=k.pub_key().data,
+                    )
+                    for k in val_keys
+                ],
+            )
+        )
+        self.state_store = StateStore(MemDB())
+        self.state_store.bootstrap(state)
+        self.block_store = BlockStore(MemDB())
+        self.mempool = CListMempool(
+            MempoolConfig(), self.conns.mempool,
+            lane_priorities=default_lanes(), default_lane="default",
+        )
+        self.event_bus = EventBus()
+        self.executor = BlockExecutor(
+            self.state_store, self.conns.consensus, self.mempool,
+            block_store=self.block_store, event_bus=self.event_bus,
+        )
+        cfg = test_consensus_config()
+        cfg.wal_path = ""
+        self.cs = ConsensusState(
+            cfg, state, self.executor, self.block_store, self.mempool,
+            event_bus=self.event_bus,
+        )
+        if is_validator:
+            self.cs.set_priv_validator(
+                FilePV(
+                    key=FilePVKey(val_keys[idx]),
+                    last_sign_state=FilePVLastSignState(),
+                )
+            )
+        self.cs_reactor = ConsensusReactor(self.cs, wait_sync=block_sync)
+        self.bs_reactor = BlocksyncReactor(
+            state, self.executor, self.block_store,
+            block_sync=block_sync, switch_interval=0.2,
+        )
+        nk = NodeKey.generate(bytes([200 + idx]) * 32)
+        info = NodeInfo(node_id=nk.id(), network=genesis.chain_id, moniker=f"n{idx}")
+        self.switch = Switch(TCPTransport(nk, info))
+        self.switch.add_reactor("CONSENSUS", self.cs_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.bs_reactor)
+        self.addr = self.switch.transport.listen("127.0.0.1:0")
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        try:
+            self.switch.stop()
+        except Exception:
+            pass
+        self.conns.stop()
+
+
+def _mk_genesis(val_keys):
+    return GenesisDoc(
+        chain_id="bs-chain",
+        genesis_time=Timestamp.from_unix_ns(GENESIS_NS),
+        validators=[
+            GenesisValidator(
+                pub_key_type="ed25519", pub_key_bytes=k.pub_key().data, power=10
+            )
+            for k in val_keys
+        ],
+        app_hash=b"\x00" * 8,
+    )
+
+
+@pytest.mark.slow
+def test_late_node_syncs_via_block_requests(monkeypatch):
+    # fast pool cadence for the test
+    monkeypatch.setattr(pool_mod, "PEER_CONN_WAIT", 0.2)
+    keys = [ed25519.PrivKey.from_seed(bytes([77]) * 32)]
+    genesis = _mk_genesis(keys)
+
+    # node A: sole validator, builds the chain alone
+    a = Node(0, keys, genesis, is_validator=True, block_sync=False)
+    a.start()
+    deadline = time.monotonic() + 120
+    while a.cs.state.last_block_height < 8 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert a.cs.state.last_block_height >= 8, "validator never built a chain"
+
+    # node B: joins late, catches up through the blocksync stream
+    b = Node(1, keys, genesis, is_validator=False, block_sync=True)
+    b.start()
+    b.switch.dial_peer_async(a.addr, persistent=True)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if b.block_store.height >= 8 and not b.bs_reactor.pool.is_running():
+                break
+            time.sleep(0.1)
+        assert b.block_store.height >= 8, (
+            f"late node only reached {b.block_store.height}"
+        )
+        # blocks came from the block stream, not vote gossip
+        assert b.bs_reactor.blocks_synced >= 8
+        # blocksync handed off to consensus
+        assert not b.bs_reactor.pool.is_running()
+        assert not b.cs_reactor.wait_sync
+        # and the synced node keeps following the chain via consensus
+        h = b.cs.state.last_block_height
+        deadline = time.monotonic() + 60
+        while b.cs.state.last_block_height < h + 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert b.cs.state.last_block_height >= h + 2
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_pool_request_scheduling_and_timeout(monkeypatch):
+    monkeypatch.setattr(pool_mod, "PEER_CONN_WAIT", 0.0)
+    monkeypatch.setattr(pool_mod, "PEER_TIMEOUT", 0.5)
+    requests, errors = [], []
+    pool = BlockPool(1, requests.append, errors.append)
+    pool.start()
+    try:
+        pool.set_peer_range("peer1", 1, 50)
+        deadline = time.monotonic() + 5
+        while not requests and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert requests, "no requests scheduled"
+        assert requests[0].height == 1
+        assert requests[0].peer_id == "peer1"
+        # peer never answers: times out and is reported
+        deadline = time.monotonic() + 5
+        while not errors and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert errors and errors[0].peer_id == "peer1"
+    finally:
+        pool.stop()
+
+
+def test_pool_rejects_wrong_sender():
+    pool = BlockPool(5, lambda r: None, lambda e: None)
+    pool.set_peer_range("p1", 1, 100)
+    pool.requesters[5] = pool_mod._Requester(5, peer_id="p1")
+
+    class B:
+        class header:
+            height = 5
+
+    try:
+        pool.add_block("intruder", B, None, 100)
+    except PeerError as e:
+        assert e.peer_id == "intruder"
+    else:
+        raise AssertionError("expected PeerError")

@@ -1,0 +1,79 @@
+"""Comb-cached verifier vs host verifier and the uncached kernel."""
+
+import hashlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.ops import comb
+
+
+def _sig_batch(n, tamper=()):
+    a = np.zeros((n, 32), dtype=np.uint8)
+    r = np.zeros((n, 32), dtype=np.uint8)
+    s = np.zeros((n, 32), dtype=np.uint8)
+    dig = np.zeros((n, 64), dtype=np.uint8)
+    for i in range(n):
+        sk = host.PrivKey.from_seed(bytes([i + 1]) * 32)
+        pub = sk.pub_key().data
+        msg = b"comb-msg-%d" % i
+        sig = sk.sign(msg)
+        if i in tamper:
+            msg = msg + b"!"
+        a[i] = np.frombuffer(pub, dtype=np.uint8)
+        r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+        dig[i] = np.frombuffer(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), dtype=np.uint8
+        )
+    return a, r, s, dig
+
+
+def test_comb_verify_good_and_bad():
+    n = 8
+    a, r, s, dig = _sig_batch(n, tamper={3, 6})
+    tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
+    assert np.asarray(valid).all()
+    bt = comb.get_b_tables()
+    ok = np.asarray(
+        jax.jit(comb.verify_cached)(
+            tables, valid, jnp.asarray(r), jnp.asarray(s), jnp.asarray(dig), bt
+        )
+    )
+    want = [i not in {3, 6} for i in range(n)]
+    assert ok.tolist() == want
+
+
+def test_comb_rejects_bad_s_and_bad_r():
+    n = 4
+    a, r, s, dig = _sig_batch(n)
+    tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
+    bt = comb.get_b_tables()
+    # s >= L
+    s_bad = s.copy()
+    s_bad[1] = 0xFF
+    ok = np.asarray(
+        jax.jit(comb.verify_cached)(
+            tables, valid, jnp.asarray(r), jnp.asarray(s_bad), jnp.asarray(dig), bt
+        )
+    )
+    assert ok.tolist() == [True, False, True, True]
+    # corrupt R (still decompressible? flip low bit -> different point or
+    # invalid; either way must fail)
+    r_bad = r.copy()
+    r_bad[2, 0] ^= 1
+    ok = np.asarray(
+        jax.jit(comb.verify_cached)(
+            tables, valid, jnp.asarray(r), jnp.asarray(s), jnp.asarray(dig), bt
+        )
+    )
+    assert ok.tolist() == [True, True, True, True]
+    ok = np.asarray(
+        jax.jit(comb.verify_cached)(
+            tables, valid, jnp.asarray(r_bad), jnp.asarray(s), jnp.asarray(dig), bt
+        )
+    )
+    assert ok.tolist() == [True, True, False, True]
