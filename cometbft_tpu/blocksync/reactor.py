@@ -171,7 +171,7 @@ class BlocksyncReactor(Reactor):
             return
         ext = None
         state = self.block_exec.store.load()
-        if state is not None and state.consensus_params.vote_extensions_enabled(
+        if state is not None and state.consensus_params.feature.vote_extensions_enabled(
             msg.height
         ):
             ext = self.store.load_block_extended_commit(msg.height)
@@ -308,7 +308,7 @@ class BlocksyncReactor(Reactor):
         )
         self.block_exec.validate_block(state, first)
 
-        extensions_enabled = state.consensus_params.vote_extensions_enabled(
+        extensions_enabled = state.consensus_params.feature.vote_extensions_enabled(
             first.header.height
         )
         if (ext is not None) != extensions_enabled:

@@ -6,7 +6,8 @@ NewValidBlock), Data (proposals + block parts), Vote, VoteSetBits.
 Per peer: a PeerState mirror of the remote round state and two gossip
 threads (data + votes, reactor.go:594,654) that push whatever the peer
 is missing — including catchup block parts for peers on old heights —
-plus a Maj23 query loop.
+plus a Maj23 query loop (reactor.go:720) that periodically advertises the
+blocks we hold 2/3 majorities for so peers reply with their vote bits.
 """
 
 from __future__ import annotations
@@ -76,6 +77,8 @@ class PeerState:
         with self.mtx:
             if msg.height != self.height:
                 return
+            if msg.round != self.round and not msg.is_commit:
+                return
             from ..types.block import PartSetHeader
 
             self.proposal_block_psh = PartSetHeader.from_proto(
@@ -142,6 +145,7 @@ class ConsensusReactor(Reactor):
         cs.broadcast_hook = self._on_internal_msg
         cs.on_new_round_step = self._on_new_round_step
         cs.has_vote_hook = self._broadcast_has_vote
+        cs.new_valid_block_hook = self._broadcast_new_valid_block
 
     # ------------------------------------------------------------- config
 
@@ -187,6 +191,9 @@ class ConsensusReactor(Reactor):
         threading.Thread(
             target=self._gossip_votes_routine, args=(peer, ps), daemon=True
         ).start()
+        threading.Thread(
+            target=self._query_maj23_routine, args=(peer, ps), daemon=True
+        ).start()
 
     def remove_peer(self, peer, reason: str = "") -> None:
         with self._mtx:
@@ -195,6 +202,12 @@ class ConsensusReactor(Reactor):
     # ----------------------------------------------------------- receive
 
     def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        # While blocksync is still running the consensus state machine is
+        # stopped: drop data/vote traffic before decoding, keeping only
+        # state-stream bookkeeping (reference: reactor.go:243-255 gates every
+        # non-state channel on conR.WaitSync()).
+        if self.wait_sync and stream_id != STATE_STREAM:
+            return
         msg = pb.ConsensusMessage.decode(msg_bytes)
         which = msg.which()
         ps: PeerState = self._peer_states.get(peer.id)
@@ -250,7 +263,14 @@ class ConsensusReactor(Reactor):
                         )
                         peer.try_send(VOTE_SET_BITS_STREAM, reply.encode())
         elif which == "vote_set_bits":
-            pass  # informational; vote gossip handles the rest
+            # the peer's answer to our VoteSetMaj23 query: mark every vote
+            # it reports holding so the gossip routines stop re-sending
+            # them and concentrate on the gaps (reactor.go
+            # ApplyVoteSetBitsMessage)
+            vb = msg.vote_set_bits
+            for i, has in enumerate(vb.votes.to_bools() if vb.votes else []):
+                if has:
+                    ps.set_has_vote(vb.height, vb.round, vb.type, i)
 
     # --------------------------------------------- own-state broadcasting
 
@@ -288,7 +308,16 @@ class ConsensusReactor(Reactor):
             ps = self._peer_states.get(peer.id)
             if ps is not None and ps.has_vote(vote):
                 continue
-            if peer.try_send(VOTE_STREAM, wire) and ps is not None:
+            # Mark as held only if the peer is AT this height — a peer on
+            # another height drops the vote, and marking it would stop the
+            # catchup gossip from ever re-sending it (the reference's
+            # PeerState.SetHasVote is a no-op for heights the peer isn't
+            # tracking, reactor.go:1287 getVoteBitArray).
+            if (
+                peer.try_send(VOTE_STREAM, wire)
+                and ps is not None
+                and vote.height == ps.height
+            ):
                 ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
 
     def _broadcast_has_vote(self, vote: Vote) -> None:
@@ -302,6 +331,25 @@ class ConsensusReactor(Reactor):
                 round=vote.round,
                 type=vote.type,
                 index=vote.validator_index,
+            )
+        ).encode()
+        self.switch.broadcast(STATE_STREAM, wire)
+
+    def _broadcast_new_valid_block(self, rs, is_commit: bool) -> None:
+        """Advertise the part-set header + which parts we hold for the block
+        being committed/validated, so peers reset their sent-parts view and
+        re-send what we lack (reactor.go NewValidBlockMessage)."""
+        if self.switch is None or rs.proposal_block_parts is None:
+            return
+        wire = pb.ConsensusMessage(
+            new_valid_block=pb.NewValidBlock(
+                height=rs.height,
+                round=rs.round,
+                block_part_set_header=rs.proposal_block_parts.header.to_proto(),
+                block_parts=pb.BitArrayProto.from_bools(
+                    rs.proposal_block_parts.bit_array()
+                ),
+                is_commit=is_commit,
             )
         ).encode()
         self.switch.broadcast(STATE_STREAM, wire)
@@ -443,6 +491,73 @@ class ConsensusReactor(Reactor):
             except Exception as e:  # noqa: BLE001
                 self.logger.error(f"gossip votes error: {e}")
                 time.sleep(sleep)
+
+    def _query_maj23_routine(self, peer, ps: PeerState) -> None:
+        """Periodically tell the peer which blocks we have 2/3 majorities
+        for, so it replies with its vote bit-arrays and the vote gossip can
+        fill in anything we're missing (reactor.go:720 queryMaj23Routine).
+
+        Cycles through prevotes / precommits / POL-prevotes at the current
+        height, and the stored commit when the peer trails us."""
+        sleep = self.cs.config.peer_query_maj23_sleep_duration
+        while peer.is_running() and self.is_running():
+            try:
+                rs = self.cs.get_round_state()
+                if rs.votes is not None and ps.height == rs.height:
+                    # query for the PEER's round (reactor.go:720 uses
+                    # prs.Round): a peer stuck in an earlier round needs
+                    # hints for that round, not ours
+                    qround = ps.round if ps.round >= 0 else rs.round
+                    for vtype, vs in (
+                        (PREVOTE_TYPE, rs.votes.prevotes(qround)),
+                        (PRECOMMIT_TYPE, rs.votes.precommits(qround)),
+                    ):
+                        if vs is None:
+                            continue
+                        maj, ok = vs.two_thirds_majority()
+                        if ok and maj is not None:
+                            self._send_maj23(peer, rs.height, qround, vtype, maj)
+                    pol_round = (
+                        rs.proposal.pol_round if rs.proposal is not None else -1
+                    )
+                    if pol_round >= 0:
+                        vs = rs.votes.prevotes(pol_round)
+                        if vs is not None:
+                            maj, ok = vs.two_thirds_majority()
+                            if ok and maj is not None:
+                                self._send_maj23(
+                                    peer, rs.height, pol_round, PREVOTE_TYPE, maj
+                                )
+                # catchup: peer on a height we already committed
+                if 0 < ps.height < rs.height:
+                    commit = self.cs.block_store.load_block_commit(ps.height)
+                    if commit is not None:
+                        self._send_maj23(
+                            peer,
+                            ps.height,
+                            commit.round,
+                            PRECOMMIT_TYPE,
+                            commit.block_id,
+                        )
+                time.sleep(sleep)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"query maj23 error: {e}")
+                time.sleep(sleep)
+
+    def _send_maj23(
+        self, peer, height: int, round: int, vtype: int, block_id: BlockID
+    ) -> None:
+        peer.try_send(
+            STATE_STREAM,
+            pb.ConsensusMessage(
+                vote_set_maj23=pb.VoteSetMaj23(
+                    height=height,
+                    round=round,
+                    type=vtype,
+                    block_id=block_id.to_proto(),
+                )
+            ).encode(),
+        )
 
     def _pick_send_vote(self, peer, ps: PeerState, vote_set) -> bool:
         for i in range(vote_set.size()):

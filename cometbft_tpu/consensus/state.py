@@ -126,6 +126,7 @@ class ConsensusState(Service):
         self.broadcast_hook = None  # Callable[[object], None] | None
         # reactor seam: fired for every vote added to our sets (HasVote)
         self.has_vote_hook = None  # Callable[[Vote], None] | None
+        self.new_valid_block_hook = None  # Callable[[RoundState, bool], None]
 
         self.update_to_state(state)
 
@@ -715,6 +716,13 @@ class ConsensusState(Service):
             rs.proposal_block = None
             if rs.proposal_block_parts is None or rs.proposal_block_parts.header != bid.part_set_header:
                 rs.proposal_block_parts = PartSet(bid.part_set_header)
+            # Announce which parts we have (none) so peers that already
+            # marked parts as sent to us reset their view and re-send
+            # (state.go enterCommit → reactor NewValidBlockMessage; without
+            # this a catchup node entering commit without the block stalls
+            # forever — peers one-shot their catchup part sends).
+            if self.new_valid_block_hook is not None and not self._replay_mode:
+                self.new_valid_block_hook(rs, True)
             return  # wait for parts
         self._try_finalize_commit(height)
 

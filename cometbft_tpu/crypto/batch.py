@@ -34,7 +34,24 @@ def supports_batch_verifier(key_type: str) -> bool:
     return key_type == ed25519.KEY_TYPE
 
 
-def create_batch_verifier(key_type: str) -> BatchVerifier:
+def comb_min() -> int:
+    """Minimum validator-set size for the device-resident comb-table path.
+    Below it the one-time table build + per-set compiled program don't pay
+    for themselves (and the CPU-backend test suite stays off the
+    minutes-long comb compile)."""
+    try:
+        return int(os.environ.get("COMETBFT_TPU_COMB_MIN", "512"))
+    except ValueError:
+        return 512
+
+
+def create_batch_verifier(
+    key_type: str, pubkeys: list[bytes] | None = None
+) -> BatchVerifier:
+    """(crypto/batch/batch.go:10)  When the caller knows the validator
+    set (pubkeys, in set order), large sets route to the comb-cached
+    verifier: tables stay device-resident across calls, keyed by the set
+    (the reference's expanded-key LRU, ed25519.go:43,68, writ large)."""
     if not supports_batch_verifier(key_type):
         raise ValueError(f"no batch verifier for key type {key_type!r}")
     be = backend()
@@ -45,4 +62,8 @@ def create_batch_verifier(key_type: str) -> BatchVerifier:
             import jax  # noqa: F401
         except ImportError:
             return CpuEd25519BatchVerifier()
+    if pubkeys is not None and len(pubkeys) >= comb_min():
+        from ..models.comb_verifier import CombBatchVerifier, global_cache
+
+        return CombBatchVerifier(global_cache().ensure(list(pubkeys)))
     return TpuEd25519BatchVerifier()

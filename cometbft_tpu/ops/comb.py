@@ -157,69 +157,79 @@ def _normalize_to_niels(tx, ty, tz):
 _B_TABLES = None  # device (NPOS_B, NENT_B, 66) f32, built lazily
 
 
-def build_b_tables():
+def build_b_tables() -> np.ndarray:
     """(22, 4096, 66) f32: j * 4096^i * B in flattened affine Niels.
 
-    Built on device: 4096-entry scalar multiples per position as a batched
-    12-bit double-and-add over all (i, j) pairs at once, then one batched
-    normalization.  f32 because the one-hot lookup is an MXU matmul; limb
-    values < 2^12 are exact in f32.
+    Built on HOST with exact integer arithmetic: the table is a pure
+    constant (~24 MB), and building it as an XLA program constant-folds
+    multi-gigabyte scatters on the CPU backend (minutes of compile).  The
+    host build is ~90k extended-coordinate additions plus one Montgomery
+    batch inversion over all entries — a couple of seconds of Python,
+    once per process.  f32 because the one-hot lookup is an MXU matmul;
+    limb values < 2^12 are exact in f32.
     """
-    # base points P_i = 4096^i * B as host ints (tiny, exact)
-    p = ref.BASE
-    bases = []
+    P = ref.P
+    out = np.zeros((NPOS_B, NENT_B, 3, F.NLIMBS), dtype=np.int32)
+    pts: list[list[tuple]] = []
+    base = ref.BASE
     for _ in range(NPOS_B):
-        bases.append(p)
+        row = [(0, 1, 1, 0), base]
+        for j in range(2, NENT_B):
+            row.append(ref.pt_add(row[-1], base))
+        pts.append(row)
         for _ in range(12):
-            p = ref.pt_add(p, p)
-    bx = np.stack([np.broadcast_to(F.to_limbs(b[0] * pow(b[2], ref.P - 2, ref.P) % ref.P), (NENT_B, F.NLIMBS)) for b in bases])
-    by = np.stack([np.broadcast_to(F.to_limbs(b[1] * pow(b[2], ref.P - 2, ref.P) % ref.P), (NENT_B, F.NLIMBS)) for b in bases])
+            base = ref.pt_add(base, base)
 
-    base = E.Point(
-        jnp.asarray(bx),
-        jnp.asarray(by),
-        F.one((NPOS_B, NENT_B)),
-        F.mul(jnp.asarray(bx), jnp.asarray(by)),
-    )
-    j = np.broadcast_to(np.arange(NENT_B, dtype=np.int32), (NPOS_B, NENT_B))
-    acc = E.identity((NPOS_B, NENT_B))
-    for bit in range(11, -1, -1):
-        acc = E.double(acc)
-        b = jnp.asarray((j >> bit) & 1)
-        acc = E.select(b == 1, E.add(acc, base), acc)
+    # Montgomery batch inversion of every Z at once
+    flat = [p for row in pts for p in row]
+    prefix = [1]
+    for p in flat:
+        prefix.append(prefix[-1] * p[2] % P)
+    inv = pow(prefix[-1], P - 2, P)
+    inv_z = [0] * len(flat)
+    for i in range(len(flat) - 1, -1, -1):
+        inv_z[i] = inv * prefix[i] % P
+        inv = inv * flat[i][2] % P
 
-    # normalize via Montgomery over the entry axis (4096-long chains are
-    # too deep to unroll; invert the per-position product of 64-entry
-    # groups instead: reshape to (22*64, 64) groups)
-    zx = acc.z.reshape(NPOS_B * 64, 64, F.NLIMBS)
-    prefix = [zx[:, 0]]
-    for k in range(1, 64):
-        prefix.append(F.carry(F.mul(prefix[-1], zx[:, k])))
-    inv_tot = F.invert(prefix[-1])
-    inv_z = jnp.zeros_like(zx)
-    run = inv_tot
-    for k in range(63, 0, -1):
-        inv_z = inv_z.at[:, k].set(F.mul(run, prefix[k - 1]))
-        run = F.mul(run, zx[:, k])
-    inv_z = inv_z.at[:, 0].set(run)
-    inv_z = inv_z.reshape(NPOS_B, NENT_B, F.NLIMBS)
-
-    x = F.mul(acc.x, inv_z)
-    y = F.mul(acc.y, inv_z)
-    xy = F.mul(x, y)
-    niels = jnp.stack(
-        [F.add(y, x), F.sub(y, x), F.mul(xy, jnp.asarray(_D2_L))], axis=-2
-    )  # (22, 4096, 3, 22)
-    # freeze to canonical limbs so the f32 cast is exact (< 2^12)
-    niels = F.freeze(niels)
-    return niels.reshape(NPOS_B, NENT_B, 3 * F.NLIMBS).astype(jnp.float32)
+    for i in range(NPOS_B):
+        for j in range(NENT_B):
+            X, Y, _, _ = pts[i][j]
+            iz = inv_z[i * NENT_B + j]
+            x, y = X * iz % P, Y * iz % P
+            out[i, j, 0] = F.to_limbs((y + x) % P)
+            out[i, j, 1] = F.to_limbs((y - x) % P)
+            out[i, j, 2] = F.to_limbs(x * y % P * ref.D2 % P)
+    return out.reshape(NPOS_B, NENT_B, 3 * F.NLIMBS).astype(np.float32)
 
 
 def get_b_tables():
     global _B_TABLES
     if _B_TABLES is None:
-        _B_TABLES = jax.jit(build_b_tables)()
+        _B_TABLES = jnp.asarray(_b_tables_cached())
     return _B_TABLES
+
+
+def _b_tables_cached() -> np.ndarray:
+    """Disk-cache the constant table next to the JAX compile cache."""
+    import os
+
+    cache = os.environ.get("COMETBFT_TPU_BTAB_CACHE", "")
+    if cache:
+        try:
+            tab = np.load(cache)
+            # reject stale caches from an older table layout
+            if tab.shape == (NPOS_B, NENT_B, 3 * F.NLIMBS) and tab.dtype == np.float32:
+                return tab
+        except (OSError, ValueError):
+            pass
+    tab = build_b_tables()
+    if cache:
+        try:
+            os.makedirs(os.path.dirname(cache) or ".", exist_ok=True)
+            np.save(cache, tab)
+        except OSError:
+            pass
+    return tab
 
 
 # ------------------------------------------------------------ verification
@@ -253,7 +263,10 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
         onehot = (dig[:, None] == jnp.arange(NENT_A, dtype=jnp.int32)).astype(
             jnp.int32
         )  # (V, 16)
-        sel = jnp.einsum("vj,vjck->vck", onehot, slab)  # (V, 3, 22)
+        sel = jnp.einsum(
+            "vj,vjck->vck", onehot, slab, precision=lax.Precision.HIGHEST
+        )  # (V, 3, 22) — int32 path; precision pinned in case XLA
+        # ever routes an integer dot through reduced-precision MXU passes
         return E.add_niels(
             acc, E.Niels(sel[:, 0], sel[:, 1], sel[:, 2])
         )
@@ -267,7 +280,13 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
         onehot = (dig[:, None] == jnp.arange(NENT_B, dtype=jnp.int32)).astype(
             jnp.float32
         )  # (V, 4096)
-        sel = (onehot @ slab).astype(jnp.int32).reshape(-1, 3, F.NLIMBS)
+        # HIGHEST: the TPU MXU default is bf16 passes (8 mantissa bits);
+        # the Niels limbs are 12-bit values and must come through exact.
+        sel = (
+            jnp.matmul(onehot, slab, precision=lax.Precision.HIGHEST)
+            .astype(jnp.int32)
+            .reshape(-1, 3, F.NLIMBS)
+        )
         return E.add_niels(
             acc, E.Niels(sel[:, 0], sel[:, 1], sel[:, 2])
         )

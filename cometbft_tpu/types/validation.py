@@ -194,7 +194,9 @@ def _verify_commit_batch(
 ) -> None:
     """(validation.go:265) — batch assembly, power tally, TPU verify, blame."""
     proposer = vals.get_proposer()
-    bv = crypto_batch.create_batch_verifier(proposer.pub_key.type)
+    bv = crypto_batch.create_batch_verifier(
+        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
+    )
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0

@@ -204,6 +204,16 @@ class ValidatorSet:
         t = self.validators[0].pub_key.type
         return all(v.pub_key.type == t for v in self.validators)
 
+    def pub_keys_bytes(self) -> list[bytes]:
+        """Raw pubkeys in set order, cached — the key for the device-side
+        comb-table cache (models/comb_verifier.ValsetCombCache); the TPU
+        analogue of the reference's expanded-key LRU (ed25519.go:43)."""
+        pks = getattr(self, "_pub_keys_bytes", None)
+        if pks is None or len(pks) != len(self.validators):
+            pks = [v.pub_key.bytes() for v in self.validators]
+            self._pub_keys_bytes = pks
+        return pks
+
     # ------------------------------------------------------------ hashing
 
     def hash(self) -> bytes:
@@ -317,6 +327,7 @@ class ValidatorSet:
 
         self.validators = sorted(merged.values(), key=_val_sort_key)
         self._total_voting_power = None
+        self._pub_keys_bytes = None  # membership changed: drop pubkey cache
         self._update_total_voting_power()
         if self.proposer is not None and self.proposer.address not in merged:
             self.proposer = None

@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # kernel compiles take minutes on the CPU backend
+
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.ops import comb
 
@@ -77,3 +79,41 @@ def test_comb_rejects_bad_s_and_bad_r():
         )
     )
     assert ok.tolist() == [True, True, False, True]
+
+
+def test_create_batch_verifier_routes_to_comb(monkeypatch):
+    """End-to-end through the crypto/batch seam: large sets route to the
+    cached comb verifier, results + blame match the host verifier."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.models.comb_verifier import CombBatchVerifier
+
+    monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "8")
+    n = 8
+    keys = [host.PrivKey.from_seed(bytes([40 + i]) * 32) for i in range(n)]
+    pubs = [k.pub_key().data for k in keys]
+    items = [
+        (pubs[i], b"route-%d" % i, keys[i].sign(b"route-%d" % i))
+        for i in range(n)
+    ]
+
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    assert isinstance(bv, CombBatchVerifier)
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert ok and per == [True] * n
+
+    # tampered message -> per-sig blame, matching validation.go:384-399
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    for i, (p, m, s) in enumerate(items):
+        bv.add(p, m + (b"x" if i == 5 else b""), s)
+    ok, per = bv.verify()
+    assert not ok and per == [i != 5 for i in range(n)]
+
+    # subset of signers (absent validators) verifies and keeps add order
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    for i in (6, 1, 3):
+        p, m, s = items[i]
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert ok and per == [True] * 3

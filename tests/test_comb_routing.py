@@ -1,0 +1,122 @@
+"""Host-side logic of the comb-cached verifier path, without kernels:
+seam routing (crypto/batch.create_batch_verifier), row scatter/mask
+ordering, foreign-key fallback demotion, and cache keying.  The device
+math itself is covered by the slow tier (tests/test_comb.py)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.models import comb_verifier as cv
+
+
+def _fake_entry(pubs, good_rows=None):
+    """A cache entry whose verify_fn checks shapes on host instead of
+    running the kernel: row i is 'valid' iff its R half is non-zero
+    (i.e. some signature was scattered there) and i is in good_rows."""
+    e = cv._CacheEntry.__new__(cv._CacheEntry)
+    e.tables = None
+    e.valid = None
+    e.index = {pk: i for i, pk in enumerate(pubs)}
+    e.size = len(pubs)
+
+    def fake_verify(tables, valid, r, s, dig):
+        r = np.asarray(r)
+        assert r.shape == (len(pubs), 32) and np.asarray(dig).shape == (
+            len(pubs),
+            64,
+        )
+        populated = r.any(axis=1)
+        ok = populated.copy()
+        if good_rows is not None:
+            for i in range(len(pubs)):
+                ok[i] = ok[i] and (i in good_rows)
+        return ok
+
+    e.verify_fn = fake_verify
+    return e
+
+
+def _sig_items(n, seed=60):
+    keys = [host.PrivKey.from_seed(bytes([seed + i]) * 32) for i in range(n)]
+    pubs = [k.pub_key().data for k in keys]
+    return pubs, [
+        (pubs[i], b"m%d" % i, keys[i].sign(b"m%d" % i)) for i in range(n)
+    ]
+
+
+def test_seam_routes_by_size_and_backend(monkeypatch):
+    pubs, _ = _sig_items(4)
+    monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "5")
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    assert not isinstance(bv, cv.CombBatchVerifier)  # below threshold
+
+    monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+    monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "2")
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    assert not isinstance(bv, cv.CombBatchVerifier)  # cpu backend opts out
+
+
+def test_scatter_order_and_mask():
+    pubs, items = _sig_items(6)
+    e = _fake_entry(pubs)
+    bv = cv.CombBatchVerifier(e)
+    # add out of set order, skipping some validators
+    order = [4, 0, 5, 2]
+    for i in order:
+        p, m, s = items[i]
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert ok and per == [True] * len(order)
+
+    # one bad row: blame must land at the add position, not the set row
+    e = _fake_entry(pubs, good_rows={0, 2, 4})  # row 5 bad
+    bv = cv.CombBatchVerifier(e)
+    for i in order:
+        p, m, s = items[i]
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert not ok and per == [True, True, False, True]  # add index of row 5
+
+
+def test_foreign_key_demotes_to_uncached(monkeypatch):
+    pubs, items = _sig_items(4)
+    e = _fake_entry(pubs[:3])  # last key missing from the cached set
+    bv = cv.CombBatchVerifier(e)
+    for p, m, s in items:  # 4th add triggers the demotion + replay
+        bv.add(p, m, s)
+    assert bv._fallback is not None and len(bv._fallback._items) == 4
+    # fallback is the generic verifier with identical semantics
+    ok, per = bv.verify()
+    assert ok and per == [True] * 4
+
+
+def test_cache_keying_and_eviction():
+    c = cv.ValsetCombCache(max_entries=2)
+    sets = [[bytes([i]) * 32 for i in range(k, k + 3)] for k in (0, 10, 20)]
+    fps = [c.fingerprint(s) for s in sets]
+    assert len({bytes(f) for f in fps}) == 3
+    for s, f in zip(sets, fps):
+        c._entries[f] = object()  # stand-in; ensure() would build tables
+        while len(c._entries) > c._max:
+            c._entries.popitem(last=False)
+    assert c.get(fps[0]) is None  # evicted (LRU)
+    assert c.get(fps[1]) is not None and c.get(fps[2]) is not None
+
+
+def test_validator_set_pubkeys_cache_invalidation():
+    from cometbft_tpu.types.validators import Validator, ValidatorSet
+
+    keys = [host.PrivKey.from_seed(bytes([80 + i]) * 32) for i in range(3)]
+    vals = ValidatorSet(
+        [Validator(k.pub_key(), voting_power=10) for k in keys]
+    )
+    pks1 = vals.pub_keys_bytes()
+    assert pks1 is vals.pub_keys_bytes()  # cached
+    new_key = host.PrivKey.from_seed(bytes([99]) * 32)
+    vals.update_with_change_set(
+        [Validator(new_key.pub_key(), voting_power=10)]
+    )
+    pks2 = vals.pub_keys_bytes()
+    assert pks2 is not pks1 and new_key.pub_key().bytes() in pks2

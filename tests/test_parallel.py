@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # kernel compiles take minutes on the CPU backend
+
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.crypto import merkle as hostM
 from cometbft_tpu.ops import merkle as M
