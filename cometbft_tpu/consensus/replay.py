@@ -1,0 +1,323 @@
+"""Handshaker: reconcile app height with chain height on boot.
+
+On start the node asks the application where it is (ABCI Info) and replays
+whatever the app is missing from the block store — or runs InitChain if
+the app is at genesis — asserting app-hash equality at every step, so a
+node whose application restarted behind the chain (or whose own state
+lagged the store after a crash) rejoins cleanly.  Reference:
+internal/consensus/replay.go:244 (Handshake), :284 (ReplayBlocks),
+:516 (replayBlock), :535-551 (app-hash assertions); exercised by the
+reference's replay_test.go crash-at-every-WAL-write suite.
+
+Crash cases covered (replay.go:373-420 case analysis):
+  store == state:  app behind  -> replay app-only (no state mutation)
+                   app == store -> nothing to do
+  store == state+1 (crashed between SaveBlock and state save):
+                   app <  state -> replay app-only, then final block
+                                   through the real executor
+                   app == state -> final block through the real executor
+                   app == store -> app ran Commit but state wasn't saved:
+                                   re-derive state from the stored
+                                   FinalizeBlockResponse (mock app)
+"""
+
+from __future__ import annotations
+
+from ..crypto import merkle
+from ..mempool.nop import NopMempool
+from ..state.execution import (
+    BlockExecutor,
+    build_last_commit_info,
+    validate_validator_updates,
+)
+from ..types.validators import ValidatorSet
+from ..utils.log import get_logger
+from ..wire import abci_pb as abci
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class AppBlockHeightTooLowError(HandshakeError):
+    """App height below the truncated store base (state.go ErrAppBlockHeightTooLow)."""
+
+    def __init__(self, app_height: int, store_base: int):
+        super().__init__(
+            f"app block height {app_height} is below the block store base "
+            f"{store_base}; the node cannot replay the missing blocks"
+        )
+
+
+class AppBlockHeightTooHighError(HandshakeError):
+    def __init__(self, store_height: int, app_height: int):
+        super().__init__(
+            f"app block height {app_height} is ahead of the block store "
+            f"height {store_height}; the app must never outrun the chain"
+        )
+
+
+class AppHashMismatchError(HandshakeError):
+    def __init__(self, got: bytes, want: bytes, where: str):
+        super().__init__(
+            f"app hash after replay does not match {where}: got {got.hex()}, "
+            f"expected {want.hex()} — was the chain reset without resetting "
+            f"the application's data?"
+        )
+
+
+class _SavedResponseApp:
+    """Stand-in consensus connection replaying a stored
+    FinalizeBlockResponse (replay.go newMockProxyApp): used when the app
+    already ran Commit for the last block but our state save was lost."""
+
+    def __init__(self, resp: abci.FinalizeBlockResponse):
+        self._resp = resp
+
+    def finalize_block(self, req) -> abci.FinalizeBlockResponse:
+        return self._resp
+
+    def commit(self, req=None) -> abci.CommitResponse:
+        return abci.CommitResponse()
+
+
+class Handshaker:
+    def __init__(
+        self,
+        state_store,
+        initial_state,
+        block_store,
+        genesis,
+        event_bus=None,
+    ):
+        self.state_store = state_store
+        self.initial_state = initial_state
+        self.block_store = block_store
+        self.genesis = genesis
+        self.event_bus = event_bus
+        self.logger = get_logger("handshaker")
+        self.n_blocks = 0  # blocks replayed, for tests/metrics
+
+    # ------------------------------------------------------------ entry
+
+    def handshake(self, app_conns) -> None:
+        """replay.go:244 — Info on the query connection, then replay."""
+        res = app_conns.query.info(abci.InfoRequest())
+        app_height = res.last_block_height
+        if app_height < 0:
+            raise HandshakeError(f"app reported negative height {app_height}")
+        self.logger.info(
+            f"ABCI handshake: app height={app_height} "
+            f"hash={res.last_block_app_hash.hex()[:16]}"
+        )
+        if self.initial_state.last_block_height == 0:
+            self.initial_state.app_version = res.app_version
+        self.replay_blocks(
+            self.initial_state, res.last_block_app_hash, app_height, app_conns
+        )
+        self.logger.info("ABCI handshake complete: engine and app are synced")
+
+    # ----------------------------------------------------------- replay
+
+    def replay_blocks(
+        self, state, app_hash: bytes, app_height: int, app_conns
+    ) -> bytes:
+        """replay.go:284 — the height-triangle case analysis."""
+        store_base = self.block_store.base
+        store_height = self.block_store.height
+        state_height = state.last_block_height
+        self.logger.info(
+            f"replay: app={app_height} store={store_height} state={state_height}"
+        )
+
+        if app_height == 0:
+            app_hash = self._init_chain(state, app_conns)
+            state_height = state.last_block_height
+
+        if store_height == 0:
+            self._assert_state_hash(app_hash, state)
+            return app_hash
+        if app_height == 0 and state.initial_height < store_base:
+            raise AppBlockHeightTooLowError(app_height, store_base)
+        if 0 < app_height < store_base - 1:
+            # can be exactly 1 behind the base: we replay the next block
+            raise AppBlockHeightTooLowError(app_height, store_base)
+        if store_height < app_height:
+            raise AppBlockHeightTooHighError(store_height, app_height)
+        if store_height < state_height:
+            raise HandshakeError(
+                f"state height {state_height} ahead of store height "
+                f"{store_height}: corrupted stores"
+            )
+        if store_height > state_height + 1:
+            raise HandshakeError(
+                f"store height {store_height} more than one ahead of state "
+                f"height {state_height}: corrupted stores"
+            )
+
+        if store_height == state_height:
+            if app_height < store_height:
+                return self._replay(state, app_conns, app_height, store_height, False)
+            self._assert_state_hash(app_hash, state)
+            return app_hash
+
+        # store == state + 1: crashed after SaveBlock, before the state save
+        if app_height < state_height:
+            return self._replay(state, app_conns, app_height, store_height, True)
+        if app_height == state_height:
+            # neither we nor the app ran the final block
+            state = self._replay_final_block(state, store_height, app_conns.consensus)
+            return state.app_hash
+        # app_height == store_height: the app ran Commit but our state save
+        # was lost — re-derive the state transition from the stored response
+        resp = self.state_store.load_finalize_block_response(store_height)
+        if resp is None:
+            raise HandshakeError(
+                f"no stored FinalizeBlockResponse for height {store_height}"
+            )
+        if not resp.app_hash:
+            resp.app_hash = app_hash
+        state = self._replay_final_block(
+            state, store_height, _SavedResponseApp(resp)
+        )
+        return state.app_hash
+
+    # --------------------------------------------------------- internals
+
+    def _init_chain(self, state, app_conns) -> bytes:
+        """replay.go:305-360 — genesis InitChain + state seeding."""
+        g = self.genesis
+        req = abci.InitChainRequest(
+            time=g.genesis_time,
+            chain_id=g.chain_id,
+            consensus_params=g.consensus_params.to_proto(),
+            validators=[
+                abci.ValidatorUpdate(
+                    power=v.power,
+                    pub_key_type=v.pub_key_type,
+                    pub_key_bytes=v.pub_key_bytes,
+                )
+                for v in g.validators
+            ],
+            app_state_bytes=g.app_state,
+            initial_height=g.initial_height,
+        )
+        res = app_conns.consensus.init_chain(req)
+        app_hash = res.app_hash
+
+        if state.last_block_height == 0:
+            if res.app_hash:
+                state.app_hash = res.app_hash
+            if res.validators:
+                vals = validate_validator_updates(
+                    res.validators, state.consensus_params
+                )
+                state.validators = ValidatorSet(vals)
+                nxt = ValidatorSet(vals)
+                nxt.increment_proposer_priority(1)
+                state.next_validators = nxt
+            elif not g.validators:
+                raise HandshakeError(
+                    "validator set is empty in genesis and still empty "
+                    "after InitChain"
+                )
+            if res.consensus_params is not None:
+                state.consensus_params = state.consensus_params.update(
+                    res.consensus_params
+                )
+                state.app_version = state.consensus_params.version.app
+            state.last_results_hash = merkle.hash_from_byte_slices([])
+            self.state_store.save(state)
+        return app_hash
+
+    def _replay(
+        self, state, app_conns, app_height: int, store_height: int, mutate_state: bool
+    ) -> bytes:
+        """replay.go:452 replayBlocks — feed stored blocks app-only; when
+        mutate_state, the last block goes through the real executor so the
+        engine state advances with it."""
+        app_hash = b""
+        final = store_height - 1 if mutate_state else store_height
+        first = app_height + 1
+        if first == 1:
+            first = state.initial_height
+        for h in range(first, final + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise HandshakeError(f"block {h} missing from store during replay")
+            if app_hash and block.header.app_hash != app_hash:
+                raise AppHashMismatchError(
+                    app_hash, block.header.app_hash, f"block {h} header"
+                )
+            self.logger.info(f"replaying block {h} into the app")
+            app_hash = self._exec_commit_block(app_conns.consensus, block, store_height)
+            self.n_blocks += 1
+        if mutate_state:
+            state = self._replay_final_block(
+                state, store_height, app_conns.consensus
+            )
+            app_hash = state.app_hash
+        self._assert_state_hash(app_hash, state)
+        return app_hash
+
+    def _exec_commit_block(self, consensus_conn, block, store_height: int) -> bytes:
+        """state.ExecCommitBlock: FinalizeBlock + Commit with no engine
+        state mutation (the state snapshots for these heights are already
+        persisted or never needed)."""
+        h = block.header.height
+        vals = self.state_store.load_validators(h - 1) if h > 1 else None
+        commit_info = (
+            build_last_commit_info(block, vals, self.initial_state.initial_height)
+            if vals is not None
+            else abci.CommitInfo()
+        )
+        resp = consensus_conn.finalize_block(
+            abci.FinalizeBlockRequest(
+                txs=block.data.txs,
+                decided_last_commit=commit_info,
+                misbehavior=[],
+                hash=block.hash(),
+                height=h,
+                time=block.header.time,
+                next_validators_hash=block.header.next_validators_hash,
+                proposer_address=block.header.proposer_address,
+                syncing_to_height=store_height,
+            )
+        )
+        if len(resp.tx_results) != len(block.data.txs):
+            raise HandshakeError(
+                f"replay height {h}: app returned {len(resp.tx_results)} tx "
+                f"results for {len(block.data.txs)} txs"
+            )
+        consensus_conn.commit()
+        return resp.app_hash
+
+    def _replay_final_block(self, state, height: int, consensus_conn):
+        """replay.go:516 replayBlock — the last block runs through a real
+        BlockExecutor (nop mempool/evidence) so the state transition is
+        recomputed and saved."""
+        block = self.block_store.load_block(height)
+        meta = self.block_store.load_block_meta(height)
+        if block is None or meta is None:
+            raise HandshakeError(f"final block {height} missing from store")
+        from ..types.block import BlockID
+
+        executor = BlockExecutor(
+            self.state_store,
+            consensus_conn,
+            NopMempool(),
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+        new_state = executor.apply_block(
+            state, BlockID.from_proto(meta.block_id), block, height
+        )
+        self.n_blocks += 1
+        # propagate: callers hold a reference to the original state object
+        for f in new_state.__dataclass_fields__:
+            setattr(state, f, getattr(new_state, f))
+        return state
+
+    def _assert_state_hash(self, app_hash: bytes, state) -> None:
+        if app_hash != state.app_hash:
+            raise AppHashMismatchError(app_hash, state.app_hash, "engine state")

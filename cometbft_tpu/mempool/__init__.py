@@ -10,6 +10,7 @@ from .mempool import (
     PreCheckMaxBytes,
 )
 from .clist_mempool import CListMempool, MempoolConfig
+from .reactor import MempoolReactor, MEMPOOL_STREAM
 from .nop import NopMempool
 from .cache import LRUTxCache, NopTxCache
 
@@ -21,6 +22,8 @@ __all__ = [
     "PreCheckMaxBytes",
     "CListMempool",
     "MempoolConfig",
+    "MempoolReactor",
+    "MEMPOOL_STREAM",
     "NopMempool",
     "LRUTxCache",
     "NopTxCache",

@@ -158,6 +158,10 @@ class CListMempool(Mempool):
         self._txs_available = threading.Event()
         self._notify_available = False
         self._notified_this_height = False
+        # change feed for the gossip reactor's blocking iterators: bumped
+        # on every admitted tx (the analogue of clist's WaitChan wakeup)
+        self._add_seq = 0
+        self._add_cond = threading.Condition(self._mtx)
 
     # ------------------------------------------------------------ admission
 
@@ -218,6 +222,8 @@ class CListMempool(Mempool):
             self.lanes[lane][key] = entry
             self._tx_index[key] = lane
             self._bytes += len(tx)
+            self._add_seq += 1
+            self._add_cond.notify_all()
             self._maybe_notify()
 
     # ------------------------------------------------------------- queries
@@ -356,6 +362,18 @@ class CListMempool(Mempool):
                     self.cache.remove(entry.key)
 
     # -------------------------------------------------------- notifications
+
+    def wait_new_tx(self, last_seq: int, timeout: float) -> int:
+        """Block until a tx has been admitted after sequence point
+        last_seq (or timeout); returns the current sequence point."""
+        with self._add_cond:
+            if self._add_seq == last_seq:
+                self._add_cond.wait(timeout)
+            return self._add_seq
+
+    def add_seq(self) -> int:
+        with self._mtx:
+            return self._add_seq
 
     def txs_available(self) -> threading.Event:
         return self._txs_available

@@ -1,0 +1,163 @@
+"""Mempool reactor: transaction gossip between nodes.
+
+Reference: mempool/reactor.go (broadcastTxRoutine :331) and
+mempool/iterators.go (BlockingIterator).  Same protocol — one stream
+carrying `Txs` batches, one broadcast routine per peer, sender dedup,
+lag-aware throttling, wait-sync gating released by the blocksync handoff
+(EnableInOutTxs) — but the iteration is redesigned: instead of the
+reference's concurrent-linked-list cursors, each peer routine walks IWRR
+snapshots of the lanes and tracks what it already offered, blocking on
+the mempool's admission sequence point when it drains.  Snapshots fit the
+GIL-serialized runtime better than fine-grained clist locking, and keep
+the mempool's internals free of per-peer state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.conn.connection import StreamDescriptor
+from ..p2p.reactor import Reactor
+from ..utils.log import get_logger
+from ..wire import mempool_pb as pb
+from .clist_mempool import CListMempool, TxEntry
+from .mempool import MempoolError
+
+MEMPOOL_STREAM = 0x30
+
+PEER_CATCHUP_SLEEP = 0.1  # reactor.go PeerCatchupSleepIntervalMS
+SEND_RETRY_SLEEP = 0.05
+DRAIN_WAIT = 0.5
+
+
+class BlockingTxIterator:
+    """Per-peer blocking IWRR iteration (iterators.go BlockingIterator,
+    snapshot-based).  next() yields each live entry once, in lane-priority
+    order, blocking on the mempool's admission feed when everything
+    current has been offered."""
+
+    def __init__(self, mempool: CListMempool):
+        self._mempool = mempool
+        self._offered: set[bytes] = set()
+        self._seq = mempool.add_seq() - 1  # there may be pre-existing txs
+        self._snap = None  # current IWRR snapshot iterator
+
+    def __iter_snapshot(self):
+        self._snap = self._mempool.iter_entries()
+
+    def next(self, keep_going) -> TxEntry | None:
+        """Return the next not-yet-offered entry; None when keep_going()
+        turns false.  Blocks while the mempool has nothing new.
+
+        One snapshot is walked to exhaustion (O(1) amortized per tx, like
+        the reference's clist cursor) and re-cut only on the drain/wait
+        path — never per yielded entry."""
+        while keep_going():
+            if self._snap is None:
+                self.__iter_snapshot()
+            for entry in self._snap:
+                if entry.key not in self._offered:
+                    self._offered.add(entry.key)
+                    return entry
+            # snapshot exhausted: prune bookkeeping to live txs, then wait
+            # for the next admission before re-cutting
+            self._snap = None
+            with self._mempool._mtx:
+                self._offered &= set(self._mempool._tx_index)
+            self._seq = self._mempool.wait_new_tx(self._seq, DRAIN_WAIT)
+        return None
+
+    def retract(self, key: bytes) -> None:
+        """Forget that an entry was offered (send failed; retry later)."""
+        self._offered.discard(key)
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: CListMempool, wait_sync: bool = False):
+        super().__init__("MempoolReactor")
+        self.mempool = mempool
+        self.logger = get_logger("mempool-reactor")
+        self._wait_sync = wait_sync
+        self._in_out_enabled = threading.Event()
+        if not wait_sync:
+            self._in_out_enabled.set()
+
+    # ------------------------------------------------------------- config
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return [
+            StreamDescriptor(
+                id=MEMPOOL_STREAM, priority=5, send_queue_capacity=100
+            )
+        ]
+
+    def wait_sync(self) -> bool:
+        return self._wait_sync
+
+    def enable_in_out_txs(self) -> None:
+        """Blocksync/statesync caught up: open the tx firehose
+        (reactor.go EnableInOutTxs)."""
+        if not self._wait_sync:
+            return
+        self.logger.info("enabling inbound and outbound transactions")
+        self._wait_sync = False
+        self._in_out_enabled.set()
+
+    # -------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        if self.mempool.config.broadcast:
+            threading.Thread(
+                target=self._broadcast_tx_routine, args=(peer,), daemon=True
+            ).start()
+
+    # ------------------------------------------------------------ receive
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        if self._wait_sync:
+            return  # syncing: inbound txs would only be rechecked away
+        msg = pb.MempoolMessage.decode(msg_bytes)
+        if msg.which() != "txs" or not msg.txs.txs:
+            return
+        for tx in msg.txs.txs:
+            try:
+                self.mempool.check_tx(tx, sender=peer.id)
+            except MempoolError:
+                pass  # duplicate / full / app-rejected: normal gossip noise
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"check_tx from {peer.id}: {e}")
+
+    # ---------------------------------------------------------- broadcast
+
+    def _broadcast_tx_routine(self, peer) -> None:
+        """One per peer (reactor.go:331): stream every mempool entry the
+        peer hasn't sent us, pacing by the peer's consensus height."""
+        while self._wait_sync:
+            if not self._in_out_enabled.wait(timeout=0.5):
+                if not (self.is_running() and peer.is_running()):
+                    return
+
+        alive = lambda: self.is_running() and peer.is_running()
+        it = BlockingTxIterator(self.mempool)
+        while alive():
+            entry = it.next(alive)
+            if entry is None:
+                return
+            # lag gating (RFC 103): hold txs for peers >1 block behind the
+            # height the tx entered at, so catching-up peers aren't flooded
+            while alive():
+                ps = peer.get("consensus_peer_state")
+                if ps is None or ps.height + 1 >= entry.height:
+                    break
+                time.sleep(PEER_CATCHUP_SLEEP)
+            if not alive():
+                return
+            if peer.id in entry.senders:
+                continue  # the peer gave us this tx
+            if not self.mempool.contains(entry.key):
+                continue  # committed/evicted since the snapshot
+            wire = pb.MempoolMessage(txs=pb.Txs(txs=[entry.tx])).encode()
+            if not peer.send(MEMPOOL_STREAM, wire):
+                it.retract(entry.key)
+                time.sleep(SEND_RETRY_SLEEP)

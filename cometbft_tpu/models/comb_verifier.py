@@ -50,6 +50,7 @@ class ValsetCombCache:
         self._entries: OrderedDict[bytes, _CacheEntry] = OrderedDict()
         self._max = max_entries
         self._mtx = threading.Lock()
+        self._building: dict[bytes, threading.Lock] = {}
 
     @staticmethod
     def fingerprint(pubkeys: list[bytes]) -> bytes:
@@ -67,11 +68,30 @@ class ValsetCombCache:
 
     def ensure(self, pubkeys: list[bytes]) -> _CacheEntry:
         """Return the entry for this exact pubkey list, building the
-        tables on first sight (one-time per validator set)."""
+        tables on first sight (one-time per validator set).  Concurrent
+        first calls for the same set serialize on a per-fingerprint lock —
+        a 10k-validator build is minutes of compile + GBs of HBM, so a
+        duplicate build must never race."""
         fp = self.fingerprint(pubkeys)
         e = self.get(fp)
         if e is not None:
             return e
+        with self._mtx:
+            build_lock = self._building.setdefault(fp, threading.Lock())
+        with build_lock:
+            e = self.get(fp)  # the race loser finds the winner's entry
+            if e is not None:
+                return e
+            entry = self._build(pubkeys)
+            with self._mtx:
+                self._entries[fp] = entry
+                while len(self._entries) > self._max:
+                    self._entries.popitem(last=False)
+                self._building.pop(fp, None)
+            return entry
+
+    @staticmethod
+    def _build(pubkeys: list[bytes]) -> _CacheEntry:
         import jax
         import jax.numpy as jnp
 
@@ -81,12 +101,7 @@ class ValsetCombCache:
         tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
         tables.block_until_ready()
         index = {pk: i for i, pk in enumerate(pubkeys)}
-        entry = _CacheEntry(tables, valid, index)
-        with self._mtx:
-            self._entries[fp] = entry
-            while len(self._entries) > self._max:
-                self._entries.popitem(last=False)
-        return entry
+        return _CacheEntry(tables, valid, index)
 
 
 _GLOBAL_CACHE = ValsetCombCache()
@@ -107,6 +122,7 @@ class CombBatchVerifier:
     def __init__(self, entry: _CacheEntry):
         self._entry = entry
         self._rows: list[int] = []
+        self._row_set: set[int] = set()
         self._sigs: list[bytes] = []
         self._digest_parts: list[bytes] = []
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -123,15 +139,17 @@ class CombBatchVerifier:
             self._fallback.add(pub_key, msg, sig)
             return
         row = self._entry.index.get(pub_key)
-        if row is None:
-            # key outside the cached set: demote to the uncached kernel,
-            # replaying everything added so far
+        if row is None or row in self._row_set:
+            # key outside the cached set, or a second signature under the
+            # same key (the scatter is one row per validator): demote to
+            # the uncached kernel, replaying everything added so far
             from .verifier import TpuEd25519BatchVerifier
 
             self._fallback = TpuEd25519BatchVerifier()
             for p, m, s in self._items:
                 self._fallback.add(p, m, s)
             return
+        self._row_set.add(row)
         self._rows.append(row)
         self._sigs.append(sig)
         # k = SHA-512(R || A || M); hashlib releases the GIL and runs the

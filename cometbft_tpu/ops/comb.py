@@ -214,6 +214,8 @@ def _b_tables_cached() -> np.ndarray:
     import os
 
     cache = os.environ.get("COMETBFT_TPU_BTAB_CACHE", "")
+    if cache and not cache.endswith(".npy"):
+        cache += ".npy"  # np.save appends it; np.load would miss the file
     if cache:
         try:
             tab = np.load(cache)

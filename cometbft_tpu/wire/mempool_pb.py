@@ -1,0 +1,23 @@
+"""Mempool wire messages (field layout mirrors
+proto/cometbft/mempool/v1/types.proto of the reference).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+
+
+class Txs(Message):
+    FIELDS = [Field(1, "txs", "bytes", repeated=True)]
+
+
+class MempoolMessage(Message):
+    """The oneof envelope carried on the mempool stream."""
+
+    FIELDS = [Field(1, "txs", "message", Txs)]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None

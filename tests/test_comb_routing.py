@@ -120,3 +120,19 @@ def test_validator_set_pubkeys_cache_invalidation():
     )
     pks2 = vals.pub_keys_bytes()
     assert pks2 is not pks1 and new_key.pub_key().bytes() in pks2
+
+
+def test_duplicate_pubkey_demotes_to_uncached():
+    """The scatter holds one row per validator; a second signature under
+    the same key must not overwrite the first (last-write-wins would
+    falsely accept a bad earlier signature)."""
+    pubs, items = _sig_items(3)
+    e = _fake_entry(pubs)
+    bv = cv.CombBatchVerifier(e)
+    p, m, s = items[0]
+    bv.add(p, m + b"tampered", s)  # bad sig under key 0
+    bv.add(p, m, s)  # good sig under the SAME key
+    bv.add(*items[1])
+    assert bv._fallback is not None  # demoted, not scattered
+    ok, per = bv.verify()
+    assert not ok and per == [False, True, True]

@@ -98,6 +98,9 @@ class Harness:
             block.last_commit = self.last_commit
             block.header.last_commit_hash = b""
             block.fill_header()
+            # re-cut parts: the part set must reflect the patched block,
+            # or blocks reloaded from the store lose their LastCommit
+            part_set = block.make_part_set()
         return block, part_set
 
     def commit_for(self, block, part_set, ts):

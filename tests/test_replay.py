@@ -1,0 +1,204 @@
+"""Handshaker / ABCI replay: a node whose app (or own state) fell behind
+the block store reconciles on boot (reference: internal/consensus/
+replay.go:244, crash cases from replay_test.go)."""
+
+import pytest
+
+from cometbft_tpu.abci import KVStoreApplication
+from cometbft_tpu.abci.kvstore import default_lanes
+from cometbft_tpu.consensus.replay import (
+    AppBlockHeightTooHighError,
+    AppHashMismatchError,
+    Handshaker,
+)
+from cometbft_tpu.proxy import local_client_creator, new_app_conns
+from cometbft_tpu.state.execution import build_last_commit_info
+from cometbft_tpu.wire import abci_pb as pb
+
+from test_execution import GENESIS_NS, Harness
+
+NS = 1_000_000_000
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.stop()
+
+
+def _grow(h: Harness, n: int, start: int = 1):
+    for i in range(n):
+        h.step(start + i, GENESIS_NS + (start + i) * 2 * NS)
+
+
+def _fresh_app_conns():
+    app = KVStoreApplication(lanes=default_lanes())
+    conns = new_app_conns(local_client_creator(app))
+    conns.start()
+    return app, conns
+
+
+def test_handshake_noop_when_synced(harness):
+    _grow(harness, 4)
+    hs = Handshaker(
+        harness.state_store, harness.state, harness.block_store, harness.genesis
+    )
+    hs.handshake(harness.conns)
+    assert hs.n_blocks == 0
+
+
+def test_handshake_replays_into_restarted_app(harness):
+    """The app lost everything (fresh kvstore); on boot the handshaker
+    runs InitChain + replays every stored block into it (replay.go:452)."""
+    _grow(harness, 6)
+    want_hash = harness.state.app_hash
+    app, conns = _fresh_app_conns()
+    try:
+        assert app.info(pb.InfoRequest()).last_block_height == 0
+        hs = Handshaker(
+            harness.state_store, harness.state, harness.block_store, harness.genesis
+        )
+        hs.handshake(conns)
+        assert hs.n_blocks == 6
+        info = app.info(pb.InfoRequest())
+        assert info.last_block_height == 6
+        assert info.last_block_app_hash == want_hash
+    finally:
+        conns.stop()
+
+
+def test_handshake_replays_partially_behind_app(harness):
+    """App restarted from an older snapshot (kept heights 1..3 of 6)."""
+    _grow(harness, 3)
+    # snapshot the app by rebuilding a fresh one and replaying 1..3 via a
+    # first handshake, then growing the chain past it with the original
+    app, conns = _fresh_app_conns()
+    try:
+        Handshaker(
+            harness.state_store, harness.state, harness.block_store, harness.genesis
+        ).handshake(conns)
+        assert app.info(pb.InfoRequest()).last_block_height == 3
+        _grow(harness, 3, start=4)
+
+        hs = Handshaker(
+            harness.state_store, harness.state, harness.block_store, harness.genesis
+        )
+        hs.handshake(conns)
+        assert hs.n_blocks == 3  # only 4..6
+        info = app.info(pb.InfoRequest())
+        assert info.last_block_height == 6
+        assert info.last_block_app_hash == harness.state.app_hash
+    finally:
+        conns.stop()
+
+
+def test_handshake_store_one_ahead_of_state(harness):
+    """Crash between SaveBlock and the state save: block 5 is in the
+    store, neither engine state nor app ran it (replay.go:414 'Replay last
+    block using real app')."""
+    _grow(harness, 4)
+    block, part_set = harness.propose(5, harness.last_commit_ts)
+    from cometbft_tpu.wire.canonical import Timestamp
+
+    ts = Timestamp.from_unix_ns(GENESIS_NS + 5 * 2 * NS + NS)
+    bid, commit = harness.commit_for(block, part_set, ts)
+    harness.block_store.save_block(block, part_set, commit)  # no apply!
+
+    state = harness.state_store.load()
+    assert state.last_block_height == 4
+    hs = Handshaker(harness.state_store, state, harness.block_store, harness.genesis)
+    hs.handshake(harness.conns)
+    assert hs.n_blocks == 1
+    assert state.last_block_height == 5
+    assert harness.app.info(pb.InfoRequest()).last_block_height == 5
+    assert state.app_hash == harness.app.info(pb.InfoRequest()).last_block_app_hash
+
+
+def test_handshake_app_ahead_of_state(harness):
+    """Crash after the app's Commit but before the engine state save: the
+    stored FinalizeBlockResponse re-derives the state transition without
+    re-executing the app (replay.go:428 'Replay last block using mock
+    app')."""
+    _grow(harness, 4)
+    block, part_set = harness.propose(5, harness.last_commit_ts)
+    from cometbft_tpu.wire.canonical import Timestamp
+
+    ts = Timestamp.from_unix_ns(GENESIS_NS + 5 * 2 * NS + NS)
+    bid, commit = harness.commit_for(block, part_set, ts)
+    harness.block_store.save_block(block, part_set, commit)
+
+    # run the block through the app only, persisting the response — the
+    # exact prefix of _apply that precedes the state save
+    resp = harness.conns.consensus.finalize_block(
+        pb.FinalizeBlockRequest(
+            txs=block.data.txs,
+            decided_last_commit=build_last_commit_info(
+                block, harness.state.last_validators, harness.state.initial_height
+            ),
+            hash=block.hash(),
+            height=5,
+            time=block.header.time,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+            syncing_to_height=5,
+        )
+    )
+    harness.state_store.save_finalize_block_response(5, resp)
+    harness.conns.consensus.commit()
+    assert harness.app.info(pb.InfoRequest()).last_block_height == 5
+
+    state = harness.state_store.load()
+    assert state.last_block_height == 4
+    hs = Handshaker(harness.state_store, state, harness.block_store, harness.genesis)
+    hs.handshake(harness.conns)
+    assert hs.n_blocks == 1
+    assert state.last_block_height == 5
+    assert state.app_hash == resp.app_hash
+
+
+def test_handshake_rejects_app_ahead_of_store(harness):
+    """An app claiming a height above the chain is corrupt (replay.go:383)."""
+    _grow(harness, 2)
+
+    class AheadApp(KVStoreApplication):
+        def info(self, req):
+            r = super().info(req)
+            r.last_block_height = 99
+            return r
+
+    app = AheadApp(lanes=default_lanes())
+    conns = new_app_conns(local_client_creator(app))
+    conns.start()
+    try:
+        hs = Handshaker(
+            harness.state_store, harness.state, harness.block_store, harness.genesis
+        )
+        with pytest.raises(AppBlockHeightTooHighError):
+            hs.handshake(conns)
+    finally:
+        conns.stop()
+
+
+def test_handshake_detects_app_hash_divergence(harness):
+    """A nondeterministic/reset app whose hash disagrees after replay is
+    refused (replay.go:535-551 assertions)."""
+    _grow(harness, 3)
+
+    class LyingApp(KVStoreApplication):
+        def finalize_block(self, req):
+            r = super().finalize_block(req)
+            r.app_hash = b"\xde\xad\xbe\xef" * 2
+            return r
+
+    app = LyingApp(lanes=default_lanes())
+    conns = new_app_conns(local_client_creator(app))
+    conns.start()
+    try:
+        hs = Handshaker(
+            harness.state_store, harness.state, harness.block_store, harness.genesis
+        )
+        with pytest.raises(AppHashMismatchError):
+            hs.handshake(conns)
+    finally:
+        conns.stop()
