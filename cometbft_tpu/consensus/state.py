@@ -777,17 +777,13 @@ class ConsensusState(Service):
         except VoteError as e:
             if isinstance(e, ErrVoteConflictingVotes):
                 if self.ev_pool is not None and peer_id:
-                    from ..types.evidence import DuplicateVoteEvidence
-
-                    existing = e.conflicting_vote
+                    # buffer the raw votes; the pool forms the evidence at
+                    # the next Update() so it carries the committed block's
+                    # timestamp and validator set (pool.go:235)
                     try:
-                        ev = DuplicateVoteEvidence.from_votes(
-                            vote,
-                            existing,
-                            Timestamp.from_unix_ns(self.state.last_block_time.unix_ns()),
-                            self.rs.validators,
+                        self.ev_pool.report_conflicting_votes(
+                            vote, e.conflicting_vote
                         )
-                        self.ev_pool.add_evidence_from_consensus(ev)
                     except Exception as ee:  # noqa: BLE001
                         self.logger.error(f"failed to record equivocation: {ee}")
                 self.logger.info("found conflicting vote (equivocation)")

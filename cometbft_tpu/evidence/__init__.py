@@ -1,0 +1,24 @@
+"""Evidence subsystem: pool, verification, and gossip
+(reference: internal/evidence/).
+"""
+
+from .pool import ErrInvalidEvidence, EvidenceError, EvidencePool
+from .reactor import EVIDENCE_STREAM, EvidenceReactor
+from .verify import (
+    EvidenceVerificationError,
+    is_evidence_expired,
+    verify_duplicate_vote,
+    verify_light_client_attack,
+)
+
+__all__ = [
+    "EvidencePool",
+    "EvidenceError",
+    "ErrInvalidEvidence",
+    "EvidenceReactor",
+    "EVIDENCE_STREAM",
+    "EvidenceVerificationError",
+    "is_evidence_expired",
+    "verify_duplicate_vote",
+    "verify_light_client_attack",
+]

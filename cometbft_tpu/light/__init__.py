@@ -1,0 +1,69 @@
+"""Light client (reference: light/): verifier, bisection client,
+divergence detector, providers, trusted store.
+"""
+
+from .client import (
+    SEQUENTIAL,
+    SKIPPING,
+    Client,
+    ErrNoWitnesses,
+    TrustOptions,
+)
+from .detector import (
+    DivergenceError,
+    ErrFailedHeaderCrossReferencing,
+    ErrLightClientAttackDetected,
+    detect_divergence,
+)
+from .provider import (
+    BlockStoreProvider,
+    ErrBadLightBlock,
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+    Provider,
+    ProviderError,
+)
+from .store import LightStore
+from .verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+    LightClientError,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "TrustOptions",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "ErrNoWitnesses",
+    "LightStore",
+    "Provider",
+    "BlockStoreProvider",
+    "ProviderError",
+    "ErrLightBlockNotFound",
+    "ErrHeightTooHigh",
+    "ErrBadLightBlock",
+    "detect_divergence",
+    "DivergenceError",
+    "ErrLightClientAttackDetected",
+    "ErrFailedHeaderCrossReferencing",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+    "verify_backwards",
+    "validate_trust_level",
+    "header_expired",
+    "DEFAULT_TRUST_LEVEL",
+    "LightClientError",
+    "ErrInvalidHeader",
+    "ErrOldHeaderExpired",
+    "ErrNewValSetCantBeTrusted",
+]

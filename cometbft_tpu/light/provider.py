@@ -1,0 +1,78 @@
+"""Light-block providers (reference: light/provider/).
+
+A provider serves LightBlocks for a chain and accepts evidence reports.
+The in-process BlockStoreProvider (the analogue of the reference's
+`provider/http` pointed at a local node) backs tests and statesync's
+state provider; an RPC-backed provider slots in once the RPC layer
+lands, behind the same three methods.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from ..types.light_block import LightBlock, SignedHeader
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ErrLightBlockNotFound(ProviderError):
+    """Benign: the provider simply doesn't have the requested height."""
+
+
+class ErrHeightTooHigh(ProviderError):
+    """Benign: the provider hasn't reached the requested height yet."""
+
+
+class ErrBadLightBlock(ProviderError):
+    """Malevolent or broken provider: drop it."""
+
+
+class Provider(Protocol):
+    def chain_id(self) -> str: ...
+
+    def light_block(self, height: int) -> LightBlock:
+        """Height 0 means the latest (provider.go LightBlock)."""
+        ...
+
+    def report_evidence(self, ev) -> None: ...
+
+
+class BlockStoreProvider:
+    """Serves light blocks straight from a node's stores — used by tests
+    and by statesync against the local blocksync'd store."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.reported_evidence: list = []
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from ..types.block import Header
+
+        if height == 0:
+            height = self.block_store.height
+        if height > self.block_store.height:
+            raise ErrHeightTooHigh(f"height {height} > {self.block_store.height}")
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            raise ErrLightBlockNotFound(f"no light block at height {height}")
+        lb = LightBlock(
+            SignedHeader(Header.from_proto(meta.header), commit), vals
+        )
+        try:
+            lb.validate_basic(self._chain_id)
+        except Exception as e:  # noqa: BLE001
+            raise ErrBadLightBlock(str(e)) from e
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        self.reported_evidence.append(ev)

@@ -218,6 +218,12 @@ class Switch(Service):
         if peer.persistent and addr and self.is_running():
             self.dial_peer_async(addr, persistent=True)
 
+    def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """Disconnect a misbehaving peer (switch.go StopPeerForError);
+        persistent peers are NOT redialed — they earned the boot."""
+        self.logger.error(f"stopping peer {peer.id[:8]} for error: {reason}")
+        self.stop_peer(peer, reason)
+
     def stop_peer(self, peer: Peer, reason: str = "") -> None:
         if not self.peers.remove(peer):
             return

@@ -43,6 +43,9 @@ class EmptyEvidencePool:
     def update(self, state: State, evidence: list) -> None:
         pass
 
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        pass
+
     def add_evidence_from_consensus(self, evidence) -> None:
         pass
 

@@ -174,6 +174,47 @@ class LightClientAttackEvidence(Evidence):
         if self.common_height <= 0:
             raise ValueError("common height must be positive")
 
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic test: a correctly-derived conflicting header agrees with
+        the trusted one on every state-derived field (evidence.go:242)."""
+        ch = self.conflicting_block.signed_header.header
+        return (
+            trusted_header.validators_hash != ch.validators_hash
+            or trusted_header.next_validators_hash != ch.next_validators_hash
+            or trusted_header.consensus_hash != ch.consensus_hash
+            or trusted_header.app_hash != ch.app_hash
+            or trusted_header.last_results_hash != ch.last_results_hash
+        )
+
+    def get_byzantine_validators(self, common_vals, trusted_signed_header) -> list:
+        """Who to report to the app (evidence.go:260): lunatic — common-set
+        validators who signed the conflicting header; equivocation — those
+        who signed both; amnesia — nobody (not attributable here)."""
+        ch = self.conflicting_block.signed_header
+        out = []
+        if self.conflicting_header_is_invalid(trusted_signed_header.header):
+            for cs in ch.commit.signatures:
+                if not cs.for_block():
+                    continue
+                _, val = common_vals.get_by_address(cs.validator_address)
+                if val is not None:
+                    out.append(val)
+        elif trusted_signed_header.commit.round == ch.commit.round:
+            for i, sig_a in enumerate(ch.commit.signatures):
+                if not sig_a.for_block():
+                    continue
+                if i >= len(trusted_signed_header.commit.signatures):
+                    continue
+                if not trusted_signed_header.commit.signatures[i].for_block():
+                    continue
+                _, val = self.conflicting_block.validator_set.get_by_address(
+                    sig_a.validator_address
+                )
+                if val is not None:
+                    out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
+
     def abci(self) -> list:
         """One Misbehavior per byzantine validator
         (evidence.go LightClientAttackEvidence.ABCI)."""
