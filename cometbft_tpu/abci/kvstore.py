@@ -120,7 +120,13 @@ def _size_hash(size: int) -> bytes:
 
 
 class KVStoreApplication(Application):
-    def __init__(self, db: DB | None = None, lanes: dict[str, int] | None = default_lanes()):
+    def __init__(
+        self,
+        db: DB | None = None,
+        lanes: dict[str, int] | None = default_lanes(),
+        snapshot_interval: int = 0,
+        snapshot_keep: int = 4,
+    ):
         self.db = db if db is not None else MemDB()
         self.lane_priorities = dict(lanes) if lanes else {}
         self._mtx = threading.RLock()
@@ -132,6 +138,12 @@ class KVStoreApplication(Application):
         self.gen_block_events = False
         self.next_block_delay_ms = 0
         self._restoring: pb.Snapshot | None = None
+        # periodic snapshots for statesync serving (the reference e2e app
+        # pattern): every snapshot_interval heights, keep the last
+        # snapshot_keep payloads; 0 = snapshot only the live height
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_keep = snapshot_keep
+        self._snapshots: dict[int, bytes] = {}  # height -> payload
         self._load_state()
 
     # ------------------------------------------------------------- state
@@ -301,6 +313,14 @@ class KVStoreApplication(Application):
                 key, value = parse_tx(tx)
                 self.db.set(KV_PREFIX + key.encode(), value.encode())
             self._save_state()
+            if (
+                self.snapshot_interval > 0
+                and self.height > 0
+                and self.height % self.snapshot_interval == 0
+            ):
+                self._snapshots[self.height] = self._snapshot_payload()
+                while len(self._snapshots) > self.snapshot_keep:
+                    del self._snapshots[min(self._snapshots)]
             return pb.CommitResponse()
 
     def _update_validator(self, v: pb.ValidatorUpdate) -> None:
@@ -340,21 +360,26 @@ class KVStoreApplication(Application):
         return json.dumps({"items": items}, sort_keys=True).encode()
 
     def list_snapshots(self, req):
-        if self.height == 0:
-            return pb.ListSnapshotsResponse()
-        payload = self._snapshot_payload()
         from ..crypto import hash as tmhash
 
-        return pb.ListSnapshotsResponse(
-            snapshots=[
-                pb.Snapshot(
-                    height=self.height,
-                    format=self.SNAPSHOT_FORMAT,
-                    chunks=1,
-                    hash=tmhash.sum_sha256(payload),
-                )
-            ]
-        )
+        with self._mtx:
+            if self._snapshots:
+                entries = sorted(self._snapshots.items())
+            elif self.height:
+                entries = [(self.height, self._snapshot_payload())]
+            else:
+                entries = []
+            return pb.ListSnapshotsResponse(
+                snapshots=[
+                    pb.Snapshot(
+                        height=h,
+                        format=self.SNAPSHOT_FORMAT,
+                        chunks=1,
+                        hash=tmhash.sum_sha256(payload),
+                    )
+                    for h, payload in entries
+                ]
+            )
 
     def offer_snapshot(self, req):
         if req.snapshot is None or req.snapshot.format != self.SNAPSHOT_FORMAT:
@@ -363,9 +388,16 @@ class KVStoreApplication(Application):
         return pb.OfferSnapshotResponse(result=pb.OFFER_SNAPSHOT_RESULT_ACCEPT)
 
     def load_snapshot_chunk(self, req):
-        if req.chunk != 0 or req.height != self.height:
-            return pb.LoadSnapshotChunkResponse()
-        return pb.LoadSnapshotChunkResponse(chunk=self._snapshot_payload())
+        with self._mtx:
+            if req.chunk != 0:
+                return pb.LoadSnapshotChunkResponse()
+            if req.height in self._snapshots:
+                return pb.LoadSnapshotChunkResponse(
+                    chunk=self._snapshots[req.height]
+                )
+            if req.height != self.height:
+                return pb.LoadSnapshotChunkResponse()
+            return pb.LoadSnapshotChunkResponse(chunk=self._snapshot_payload())
 
     def apply_snapshot_chunk(self, req):
         with self._mtx:

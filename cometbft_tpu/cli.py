@@ -1,0 +1,240 @@
+"""Command-line interface (reference: cmd/cometbft/main.go:16-40).
+
+    python -m cometbft_tpu init [--home H] [--chain-id C]
+    python -m cometbft_tpu start [--home H] [--proxy-app APP] ...
+    python -m cometbft_tpu show-node-id / show-validator
+    python -m cometbft_tpu gen-node-key / gen-validator
+    python -m cometbft_tpu unsafe-reset-all
+    python -m cometbft_tpu testnet --v 4 [--o DIR]
+    python -m cometbft_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from .config import DEFAULT_HOME, Config, load_config, save_config
+from .p2p.key import NodeKey
+from .privval import FilePV
+from .types.genesis import GenesisDoc, GenesisValidator
+from .wire.canonical import Timestamp
+
+VERSION = "0.3.0"
+
+
+def _ensure_init(cfg: Config, chain_id: str | None = None) -> None:
+    """init: config + genesis + node key + privval (commands/init.go)."""
+    os.makedirs(os.path.join(cfg.home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(cfg.home, "data"), exist_ok=True)
+    if not os.path.exists(cfg.config_file()):
+        save_config(cfg)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    )
+    NodeKey.load_or_gen(cfg.node_key_file())
+    if not os.path.exists(cfg.genesis_file()):
+        doc = GenesisDoc(
+            chain_id=chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+            validators=[
+                GenesisValidator(
+                    pub_key_type="ed25519",
+                    pub_key_bytes=pv.key.priv_key.pub_key().data,
+                    power=10,
+                )
+            ],
+        )
+        doc.save_as(cfg.genesis_file())
+    print(f"initialized node in {cfg.home}")
+
+
+def cmd_init(args) -> int:
+    _ensure_init(load_config(args.home), args.chain_id)
+    return 0
+
+
+def cmd_start(args) -> int:
+    from .node import Node
+
+    cfg = load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr is not None:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    node = Node(cfg)
+    node.start()
+
+    stop = []
+    def _sig(_s, _f):
+        stop.append(True)
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    cfg = load_config(args.home)
+    print(NodeKey.load_or_gen(cfg.node_key_file()).id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    import base64
+
+    cfg = load_config(args.home)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    )
+    pub = pv.key.priv_key.pub_key()
+    print(
+        json.dumps(
+            {
+                "type": "tendermint/PubKeyEd25519",
+                "value": base64.b64encode(pub.data).decode(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    nk = NodeKey.generate()
+    cfg = load_config(args.home)
+    nk.save_as(cfg.node_key_file())
+    print(nk.id())
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    cfg = load_config(args.home)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    )
+    print(f"validator key written to {cfg.priv_validator_key_file()}")
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go: wipe data, keep config + keys."""
+    cfg = load_config(args.home)
+    data = os.path.join(cfg.home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    # reset the last-sign state but KEEP the validator key
+    if os.path.exists(cfg.priv_validator_state_file()):
+        os.remove(cfg.priv_validator_state_file())
+    FilePV.load_or_generate(
+        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    )
+    print(f"reset data in {cfg.home}")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go: generate N validator home dirs sharing one
+    genesis, persistent-peered in a ring."""
+    n = args.v
+    out = args.o
+    homes = [os.path.join(out, f"node{i}") for i in range(n)]
+    pvs, node_keys, cfgs = [], [], []
+    for home in homes:
+        cfg = Config(home=home)
+        cfg.base.block_sync = True
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pvs.append(
+            FilePV.load_or_generate(
+                cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+            )
+        )
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
+        cfgs.append(cfg)
+    genesis = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+        validators=[
+            GenesisValidator(
+                pub_key_type="ed25519",
+                pub_key_bytes=pv.key.priv_key.pub_key().data,
+                power=10,
+            )
+            for pv in pvs
+        ],
+    )
+    base_p2p, base_rpc = args.starting_port, args.starting_port + 1000
+    for i, cfg in enumerate(cfgs):
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_rpc + i}"
+        peers = []
+        for j in range(n):
+            if j != i:
+                peers.append(
+                    f"{node_keys[j].id()}@127.0.0.1:{base_p2p + j}"
+                )
+        cfg.p2p.persistent_peers = ",".join(peers)
+        save_config(cfg)
+        genesis.save_as(cfg.genesis_file())
+    print(f"generated {n}-node testnet in {out}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="cometbft-tpu")
+    p.add_argument("--home", default=os.environ.get("CMTHOME", DEFAULT_HOME))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize config/genesis/keys")
+    sp.add_argument("--chain-id", default=None)
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy-app", default=None)
+    sp.add_argument("--p2p-laddr", default=None, dest="p2p_laddr")
+    sp.add_argument("--rpc-laddr", default=None, dest="rpc_laddr")
+    sp.add_argument("--persistent-peers", default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
+    sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("gen-node-key").set_defaults(fn=cmd_gen_node_key)
+    sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
+    sub.add_parser("unsafe-reset-all").set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("testnet", help="generate a localnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--o", default="./mytestnet")
+    sp.add_argument("--chain-id", default=None)
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

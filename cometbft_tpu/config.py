@@ -1,0 +1,188 @@
+"""Node configuration (reference: config/config.go:93 + config/toml.go).
+
+A TOML file under <home>/config/config.toml, decoded into nested
+dataclasses.  Consensus-critical parameters are NOT here — they live
+on-chain as ConsensusParams (types/params.py); this file holds only
+operator-local knobs, exactly like the reference split.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+from .consensus.config import ConsensusConfig
+
+DEFAULT_HOME = os.path.expanduser("~/.cometbft-tpu")
+
+
+@dataclass
+class BaseConfig:
+    moniker: str = "node"
+    proxy_app: str = "kvstore"  # "kvstore" | "noop" | tcp://addr (socket)
+    db_backend: str = "sqlite"  # "sqlite" | "memdb"
+    block_sync: bool = True
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    log_level: str = "info"
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    persistent_peers: str = ""  # comma-separated id@host:port
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    send_rate: int = 5_120_000  # bytes/sec (connection.go:40)
+    recv_rate: int = 5_120_000
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    max_tx_bytes: int = 1024 * 1024
+    max_txs_bytes: int = 64 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    recheck: bool = True
+    broadcast: bool = True
+
+
+@dataclass
+class StatesyncConfig:
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0  # seconds
+    discovery_time: float = 15.0
+    chunk_request_timeout: float = 10.0
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+
+
+@dataclass
+class Config:
+    home: str = DEFAULT_HOME
+    base: BaseConfig = field(default_factory=BaseConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    statesync: StatesyncConfig = field(default_factory=StatesyncConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+    # ------------------------------------------------------------- paths
+
+    def _abs(self, rel: str) -> str:
+        return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
+
+    def genesis_file(self) -> str:
+        return self._abs(self.base.genesis_file)
+
+    def node_key_file(self) -> str:
+        return self._abs(self.base.node_key_file)
+
+    def priv_validator_key_file(self) -> str:
+        return self._abs(self.base.priv_validator_key_file)
+
+    def priv_validator_state_file(self) -> str:
+        return self._abs(self.base.priv_validator_state_file)
+
+    def db_dir(self) -> str:
+        return self._abs("data")
+
+    def wal_file(self) -> str:
+        return self._abs(self.consensus.wal_path)
+
+    def config_file(self) -> str:
+        return self._abs("config/config.toml")
+
+    def validate_basic(self) -> None:
+        if self.base.db_backend not in ("sqlite", "memdb"):
+            raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        if self.statesync.enable and not (
+            self.statesync.trust_height > 0 and self.statesync.trust_hash
+        ):
+            raise ValueError(
+                "statesync.enable requires trust_height and trust_hash"
+            )
+
+
+# --------------------------------------------------------------- loading
+
+_SECTIONS = {
+    "p2p": P2PConfig,
+    "mempool": MempoolConfig,
+    "consensus": ConsensusConfig,
+    "statesync": StatesyncConfig,
+    "rpc": RPCConfig,
+    "instrumentation": InstrumentationConfig,
+}
+
+
+def load_config(home: str) -> Config:
+    """Read <home>/config/config.toml over the defaults."""
+    cfg = Config(home=home)
+    path = cfg.config_file()
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    _apply(cfg.base, data)  # top-level keys are the base section
+    for name, cls in _SECTIONS.items():
+        if name in data:
+            _apply(getattr(cfg, name), data[name])
+    cfg.validate_basic()
+    return cfg
+
+
+def _apply(obj, data: dict) -> None:
+    for f in fields(obj):
+        if f.name in data:
+            setattr(obj, f.name, data[f.name])
+
+
+def save_config(cfg: Config) -> None:
+    """Write the TOML template with current values (config/toml.go)."""
+    path = cfg.config_file()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    out = ["# CometBFT-TPU node configuration", ""]
+    out.extend(_emit(cfg.base))
+    for name in _SECTIONS:
+        out.append("")
+        out.append(f"[{name}]")
+        out.extend(_emit(getattr(cfg, name)))
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+
+
+def _emit(obj) -> list[str]:
+    lines = []
+    for f in fields(obj):
+        v = getattr(obj, f.name)
+        if isinstance(v, bool):
+            tv = "true" if v else "false"
+        elif isinstance(v, (int, float)):
+            tv = repr(v)
+        else:
+            tv = '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+        lines.append(f"{f.name} = {tv}")
+    return lines

@@ -183,6 +183,8 @@ class ConsensusReactor(Reactor):
         ps = self._peer_states.get(peer.id)
         if ps is None:
             return
+        if not peer.has_channel(STATE_STREAM):
+            return  # peer runs no consensus reactor: skip the gossip threads
         # announce our current round state so the peer can route to us
         self._send_round_step(peer)
         threading.Thread(

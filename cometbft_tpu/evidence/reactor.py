@@ -74,6 +74,8 @@ class EvidenceReactor(Reactor):
         (reactor.go broadcastEvidenceRoutine redesigned as a periodic
         sweep: the pool's admission feed cuts the sleep short when fresh
         evidence lands)."""
+        if not peer.has_channel(EVIDENCE_STREAM):
+            return  # peer runs no evidence reactor
         seq = self.evpool.add_seq() - 1  # send everything already pending
         while self.is_running() and peer.is_running():
             evs, _ = self.evpool.pending_evidence(-1)

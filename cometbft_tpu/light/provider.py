@@ -74,5 +74,10 @@ class BlockStoreProvider:
             raise ErrBadLightBlock(str(e)) from e
         return lb
 
+    def consensus_params(self, height: int):
+        """Params effective at a height (statesync's state provider
+        cross-checks the result against the verified header hash)."""
+        return self.state_store.load_consensus_params(height)
+
     def report_evidence(self, ev) -> None:
         self.reported_evidence.append(ev)

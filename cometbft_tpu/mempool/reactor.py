@@ -133,6 +133,8 @@ class MempoolReactor(Reactor):
     def _broadcast_tx_routine(self, peer) -> None:
         """One per peer (reactor.go:331): stream every mempool entry the
         peer hasn't sent us, pacing by the peer's consensus height."""
+        if not peer.has_channel(MEMPOOL_STREAM):
+            return  # peer runs no mempool reactor: nothing to stream
         while self._wait_sync:
             if not self._in_out_enabled.wait(timeout=0.5):
                 if not (self.is_running() and peer.is_running()):

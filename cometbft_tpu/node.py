@@ -1,0 +1,309 @@
+"""Node assembly: wire every subsystem into a runnable node
+(reference: node/node.go:315-595 NewNodeWithCliParams + node/setup.go).
+
+Wiring order follows the reference: DBs → state load → proxy app
+(4 ABCI connections) → EventBus → privval → ABCI handshake/replay →
+mempool (+ reactor) → evidence pool (+ reactor) → BlockExecutor →
+blocksync reactor → consensus state/reactor → statesync reactor →
+transport + switch.  start() then listens, starts the switch, dials
+persistent peers, and kicks off statesync when enabled
+(node.go:598 OnStart, setup.go:569 startStateSync).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .abci.kvstore import KVStoreApplication, default_lanes
+from .config import Config
+from .consensus.config import ConsensusConfig
+from .consensus.reactor import ConsensusReactor
+from .consensus.replay import Handshaker
+from .consensus.state import ConsensusState
+from .evidence import EvidencePool, EvidenceReactor
+from .blocksync import BlocksyncReactor
+from .light import BlockStoreProvider, TrustOptions
+from .mempool import CListMempool, MempoolReactor
+from .mempool import MempoolConfig as MemCfg
+from .p2p.key import NodeKey
+from .p2p.node_info import NodeInfo
+from .p2p.switch import Switch
+from .p2p.transport import TCPTransport
+from .privval import FilePV
+from .proxy import local_client_creator, new_app_conns, remote_client_creator
+from .state.execution import BlockExecutor
+from .state.state import make_genesis_state
+from .state.store import StateStore
+from .statesync import LightClientStateProvider, StatesyncReactor
+from .store.block_store import BlockStore
+from .store.db import DB, MemDB, PrefixDB, SQLiteDB
+from .types.event_bus import EventBus
+from .types.genesis import GenesisDoc
+from .utils.log import get_logger
+
+
+def _strip_tcp(addr: str) -> str:
+    return addr[len("tcp://"):] if addr.startswith("tcp://") else addr
+
+
+def default_db_provider(cfg: Config) -> DB:
+    """config/db.go DefaultDBProvider."""
+    if cfg.base.db_backend == "memdb":
+        return MemDB()
+    os.makedirs(cfg.db_dir(), exist_ok=True)
+    return SQLiteDB(os.path.join(cfg.db_dir(), "cometbft.db"))
+
+
+def make_app(cfg: Config):
+    """The in-process demo apps, or a socket client creator for an
+    external app (proxy/client.go DefaultClientCreator)."""
+    pa = cfg.base.proxy_app
+    if pa == "kvstore":
+        return local_client_creator(
+            KVStoreApplication(lanes=default_lanes(), snapshot_interval=100)
+        )
+    if pa == "noop":
+        from .abci.types import BaseApplication
+
+        return local_client_creator(BaseApplication())
+    return remote_client_creator(_strip_tcp(pa))
+
+
+class Node:
+    """A full node (node/node.go:91)."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis: GenesisDoc | None = None,
+        client_creator=None,
+        db: DB | None = None,
+    ):
+        self.config = config
+        self.logger = get_logger("node")
+        genesis = genesis or GenesisDoc.load(config.genesis_file())
+        self.genesis = genesis
+
+        # ---- storage (setup.go:165 initDBs)
+        self.db = db if db is not None else default_db_provider(config)
+        self.block_store = BlockStore(PrefixDB(self.db, b"bs/"))
+        self.state_store = StateStore(PrefixDB(self.db, b"ss/"))
+
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(genesis)
+            self.state_store.bootstrap(state)
+        self.state = state
+
+        # ---- ABCI app, 4 named connections (setup.go:179)
+        self.app_conns = new_app_conns(client_creator or make_app(config))
+        self.app_conns.start()
+
+        # ---- event bus (setup.go:188)
+        self.event_bus = EventBus()
+
+        # ---- privval (node.go:388; file-based — remote signer is a
+        # client_creator-style extension point)
+        self.priv_validator = FilePV.load_or_generate(
+            config.priv_validator_key_file(),
+            config.priv_validator_state_file(),
+        )
+
+        # ---- statesync decision (node.go:403): enabled + fresh node only
+        self.statesync_enabled = (
+            config.statesync.enable and state.last_block_height == 0
+        )
+
+        # ---- ABCI handshake / replay (setup.go:229) — skipped when state
+        # sync will bootstrap the app instead
+        if not self.statesync_enabled:
+            Handshaker(
+                self.state_store,
+                state,
+                self.block_store,
+                genesis,
+                event_bus=self.event_bus,
+            ).handshake(self.app_conns)
+
+        # ---- mempool + reactor (setup.go:277)
+        mp_cfg = MemCfg(
+            size=config.mempool.size,
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            max_txs_bytes=config.mempool.max_txs_bytes,
+            cache_size=config.mempool.cache_size,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            recheck=config.mempool.recheck,
+            broadcast=config.mempool.broadcast,
+        )
+        lane_info = self._lane_info()
+        self.mempool = CListMempool(
+            mp_cfg,
+            self.app_conns.mempool,
+            height=state.last_block_height,
+            lane_priorities=lane_info[0],
+            default_lane=lane_info[1],
+        )
+        # gossip stays closed until blocksync/statesync hand off
+        wait_sync = config.base.block_sync or self.statesync_enabled
+        self.mempool_reactor = MempoolReactor(self.mempool, wait_sync=wait_sync)
+
+        # ---- evidence (node.go:441)
+        self.evidence_pool = EvidencePool(
+            PrefixDB(self.db, b"ev/"), self.state_store, self.block_store
+        )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        # ---- executor (node.go:458)
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.app_conns.consensus,
+            self.mempool,
+            ev_pool=self.evidence_pool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+
+        # ---- blocksync reactor (node.go:478)
+        local_addr = (
+            self.priv_validator.key.priv_key.pub_key().address()
+            if self.priv_validator
+            else b""
+        )
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.block_executor,
+            self.block_store,
+            block_sync=config.base.block_sync and not self.statesync_enabled,
+            local_addr=local_addr,
+        )
+
+        # ---- consensus (node.go:486)
+        cs_cfg = config.consensus
+        if isinstance(cs_cfg, ConsensusConfig) and cs_cfg.wal_path:
+            cs_cfg.wal_path = config.wal_file()
+        self.consensus_state = ConsensusState(
+            cs_cfg,
+            state,
+            self.block_executor,
+            self.block_store,
+            self.mempool,
+            ev_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+        self.consensus_state.set_priv_validator(self.priv_validator)
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state,
+            wait_sync=config.base.block_sync or self.statesync_enabled,
+        )
+
+        # ---- statesync reactor (node.go:527)
+        state_provider = None
+        if self.statesync_enabled:
+            state_provider = self._make_state_provider()
+        self.statesync_reactor = StatesyncReactor(
+            self.app_conns.snapshot,
+            self.app_conns.query,
+            state_provider=state_provider,
+            enabled=self.statesync_enabled,
+        )
+
+        # ---- transport + switch (setup.go:411,485)
+        self.node_key = NodeKey.load_or_gen(config.node_key_file())
+        self.node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            listen_addr=config.p2p.laddr,
+            network=genesis.chain_id,
+            moniker=config.base.moniker,
+        )
+        self.transport = TCPTransport(self.node_key, self.node_info)
+        self.switch = Switch(self.transport)
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
+        self.listen_addr: str | None = None
+        self.rpc_server = None  # attached by start() when configured
+
+    # ---------------------------------------------------------------- util
+
+    def _lane_info(self):
+        from .wire import abci_pb
+
+        try:
+            info = self.app_conns.query.info(abci_pb.InfoRequest())
+            lanes = {e.key: e.value for e in (info.lane_priorities or [])}
+            if lanes:
+                return lanes, info.default_lane
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"failed to fetch lane info: {e}")
+        return None, ""
+
+    def _make_state_provider(self):
+        sscfg = self.config.statesync
+        # the local stores are empty; providers must be remote.  The
+        # in-process BlockStoreProvider covers tests; RPC-backed providers
+        # plug in here once configured.
+        providers = getattr(self, "state_providers", None) or [
+            BlockStoreProvider(
+                self.genesis.chain_id, self.block_store, self.state_store
+            )
+        ]
+        return LightClientStateProvider(
+            self.genesis.chain_id,
+            self.genesis.initial_height,
+            providers[0],
+            providers[1:],
+            TrustOptions(
+                period_ns=int(sscfg.trust_period * 1e9),
+                height=sscfg.trust_height,
+                hash=bytes.fromhex(sscfg.trust_hash),
+            ),
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """node.go:598 OnStart."""
+        self.listen_addr = self.transport.listen(_strip_tcp(self.config.p2p.laddr))
+        self.switch.start()
+        peers = [
+            p.strip()
+            for p in self.config.p2p.persistent_peers.split(",")
+            if p.strip()
+        ]
+        if peers:
+            self.switch.dial_peers_async(peers, persistent=True)
+        if self.statesync_enabled:
+            self.statesync_reactor.run(
+                self.state_store,
+                self.block_store,
+                discovery_time=self.config.statesync.discovery_time,
+            )
+        if self.config.rpc.laddr:
+            try:
+                from .rpc.server import RPCServer
+
+                self.rpc_server = RPCServer(self)
+                self.rpc_server.start(_strip_tcp(self.config.rpc.laddr))
+            except ImportError:
+                pass
+        self.logger.info(
+            f"node {self.node_key.id()[:8]} started: p2p {self.listen_addr}"
+        )
+
+    def stop(self) -> None:
+        if self.rpc_server is not None:
+            try:
+                self.rpc_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self.switch.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        self.app_conns.stop()
+
+    def is_running(self) -> bool:
+        return self.switch.is_running()

@@ -30,6 +30,10 @@ class Peer(Service):
     ):
         super().__init__(f"peer-{node_info.node_id[:8]}")
         self.node_info = node_info
+        # streams the REMOTE declared: sends to anything else are dropped
+        # (peer.go hasChannel — a node without, say, the consensus reactor
+        # must not receive consensus gossip, or it kills the connection)
+        self._remote_channels = set(node_info.channels)
         self.outbound = outbound
         self.persistent = persistent
         self.data: dict = {}  # reactor-attached per-peer state
@@ -53,10 +57,18 @@ class Peer(Service):
         if self.mconn.is_running():
             self.mconn.stop()
 
+    def has_channel(self, stream_id: int) -> bool:
+        # an empty declaration means a pre-channels peer: stay permissive
+        return not self._remote_channels or stream_id in self._remote_channels
+
     def send(self, stream_id: int, msg: bytes) -> bool:
+        if not self.has_channel(stream_id):
+            return False
         return self.mconn.send(stream_id, msg)
 
     def try_send(self, stream_id: int, msg: bytes) -> bool:
+        if not self.has_channel(stream_id):
+            return False
         return self.mconn.try_send(stream_id, msg)
 
     def get(self, key: str):

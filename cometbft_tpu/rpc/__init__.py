@@ -1,0 +1,15 @@
+"""JSON-RPC layer (reference: rpc/): server, routes, clients."""
+
+from .client import HTTPClient, RPCClientError, WSClient
+from .core import Environment, ROUTES, RPCError
+from .server import RPCServer
+
+__all__ = [
+    "RPCServer",
+    "Environment",
+    "ROUTES",
+    "RPCError",
+    "HTTPClient",
+    "WSClient",
+    "RPCClientError",
+]

@@ -1,0 +1,171 @@
+"""Minimal JSON-RPC HTTP + WebSocket client
+(reference: rpc/client/http) — used by tests, the CLI, and anything
+driving a node over the wire.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+from urllib.request import Request, urlopen
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCClientError(Exception):
+    pass
+
+
+class HTTPClient:
+    def __init__(self, addr: str, timeout: float = 10.0):
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        if not addr.startswith("http"):
+            addr = "http://" + addr
+        self.base = addr.rstrip("/")
+        self.timeout = timeout
+        self._rid = 0
+
+    def call(self, method: str, **params):
+        self._rid += 1
+        payload = {
+            "jsonrpc": "2.0",
+            "id": self._rid,
+            "method": method,
+            "params": {
+                k: (base64.b64encode(v).decode() if isinstance(v, bytes) else v)
+                for k, v in params.items()
+                if v is not None
+            },
+        }
+        req = Request(
+            self.base,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urlopen(req, timeout=self.timeout) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RPCClientError(out["error"])
+        return out["result"]
+
+    # conveniences mirroring rpc/client/http
+    def status(self):
+        return self.call("status")
+
+    def health(self):
+        return self.call("health")
+
+    def block(self, height: int | None = None):
+        return self.call("block", height=height)
+
+    def commit(self, height: int | None = None):
+        return self.call("commit", height=height)
+
+    def validators(self, height: int | None = None, page=1, per_page=30):
+        return self.call("validators", height=height, page=page, per_page=per_page)
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def abci_query(self, path: str, data: bytes):
+        return self.call("abci_query", path=path, data=data.hex())
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync", tx=tx)
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self.call("broadcast_tx_commit", tx=tx)
+
+    def net_info(self):
+        return self.call("net_info")
+
+
+class WSClient:
+    """Text-frame WebSocket client for /websocket subscribe."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        host, _, port = addr.rpartition(":")
+        self.sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise RPCClientError("ws handshake failed: connection closed")
+            resp += chunk
+        status = resp.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise RPCClientError(f"ws handshake failed: {status!r}")
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        if accept.encode() not in resp:
+            raise RPCClientError("ws handshake failed: bad accept key")
+        self._rid = 0
+
+    def send(self, method: str, **params) -> None:
+        self._rid += 1
+        data = json.dumps(
+            {"jsonrpc": "2.0", "id": self._rid, "method": method, "params": params}
+        ).encode()
+        mask = os.urandom(4)
+        frame = bytearray([0x81])
+        n = len(data)
+        if n < 126:
+            frame.append(0x80 | n)
+        elif n < 1 << 16:
+            frame.append(0x80 | 126)
+            frame += struct.pack(">H", n)
+        else:
+            frame.append(0x80 | 127)
+            frame += struct.pack(">Q", n)
+        frame += mask
+        frame += bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        self.sock.sendall(bytes(frame))
+
+    def subscribe(self, query: str) -> None:
+        self.send("subscribe", query=query)
+
+    def recv(self) -> dict:
+        def read_exact(n: int) -> bytes:
+            buf = b""
+            while len(buf) < n:
+                chunk = self.sock.recv(n - len(buf))
+                if not chunk:
+                    raise RPCClientError("ws closed")
+                buf += chunk
+            return buf
+
+        while True:
+            hdr = read_exact(2)
+            opcode = hdr[0] & 0x0F
+            n = hdr[1] & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", read_exact(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", read_exact(8))[0]
+            payload = read_exact(n) if n else b""
+            if opcode == 0x8:
+                raise RPCClientError("ws closed by server")
+            if opcode == 0x1:
+                return json.loads(payload)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

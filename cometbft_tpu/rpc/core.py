@@ -1,0 +1,370 @@
+"""RPC handlers against a node Environment
+(reference: rpc/core/{env,status,blocks,mempool,consensus,abci,net}.go).
+
+Each handler takes already-decoded params and returns a JSON-serializable
+dict; the server layer (rpc/server.py) does JSON-RPC framing, parameter
+coercion, and the websocket event bridge.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..mempool.mempool import MempoolError
+from ..types.event_bus import EventQueryTx
+from ..wire import abci_pb as abci
+from .serializers import (
+    b64,
+    block_id_json,
+    block_json,
+    commit_json,
+    header_json,
+    hex_up,
+    tx_result_json,
+    validator_json,
+)
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+class Environment:
+    """Pointers into the node (rpc/core/env.go)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # shortcuts
+    @property
+    def state(self):
+        return self.node.consensus_state.state
+
+    @property
+    def block_store(self):
+        return self.node.block_store
+
+    # ------------------------------------------------------------- info
+
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        """rpc/core/status.go."""
+        n = self.node
+        latest_height = self.block_store.height
+        meta = self.block_store.load_block_meta(latest_height)
+        base_meta = self.block_store.load_base_meta()
+        pv_addr = b""
+        pv_power = 0
+        if n.priv_validator is not None:
+            pv_addr = n.priv_validator.key.priv_key.pub_key().address()
+            idx, val = self.state.validators.get_by_address(pv_addr)
+            pv_power = val.voting_power if val else 0
+        return {
+            "node_info": {
+                "id": n.node_key.id(),
+                "listen_addr": n.listen_addr or n.config.p2p.laddr,
+                "network": n.genesis.chain_id,
+                "version": n.node_info.version,
+                "moniker": n.config.base.moniker,
+            },
+            "sync_info": {
+                "latest_block_hash": hex_up(
+                    meta.block_id.hash if meta and meta.block_id else b""
+                ),
+                "latest_app_hash": hex_up(self.state.app_hash),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": (
+                    header_json(_hdr(meta))["time"] if meta else "0001-01-01T00:00:00Z"
+                ),
+                "earliest_block_height": str(
+                    base_meta.header.height if base_meta else self.block_store.base
+                ),
+                "catching_up": bool(
+                    n.consensus_reactor.wait_sync
+                    or (n.blocksync_reactor.pool.is_running())
+                ),
+            },
+            "validator_info": {
+                "address": hex_up(pv_addr),
+                "voting_power": str(pv_power),
+            },
+        }
+
+    def net_info(self) -> dict:
+        peers = self.node.switch.peers.list()
+        return {
+            "listening": self.node.switch.is_running(),
+            "listeners": [self.node.listen_addr or ""],
+            "n_peers": str(len(peers)),
+            "peers": [
+                {
+                    "node_info": {
+                        "id": p.node_info.node_id,
+                        "moniker": p.node_info.moniker,
+                    },
+                    "is_outbound": p.outbound,
+                    "remote_ip": "",
+                }
+                for p in peers
+            ],
+        }
+
+    def genesis(self) -> dict:
+        import json as _json
+
+        return {"genesis": _json.loads(self.node.genesis.to_json())}
+
+    # ----------------------------------------------------------- blocks
+
+    def _height_or_latest(self, height) -> int:
+        latest = self.block_store.height
+        if height in (None, 0, "0", ""):
+            return latest
+        h = int(height)
+        if h <= 0:
+            raise RPCError(-32603, f"height must be positive, got {h}")
+        if h > latest:
+            raise RPCError(
+                -32603, f"height {h} must be less than or equal to {latest}"
+            )
+        return h
+
+    def block(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        blk = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if blk is None or meta is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {
+            "block_id": {
+                "hash": hex_up(meta.block_id.hash),
+                "parts": {
+                    "total": meta.block_id.part_set_header.total,
+                    "hash": hex_up(meta.block_id.part_set_header.hash),
+                },
+            },
+            "block": block_json(blk),
+        }
+
+    def commit(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        commit = self.block_store.load_block_commit(h)
+        canonical = True
+        if commit is None:
+            commit = self.block_store.load_seen_commit(h)
+            canonical = False
+        if commit is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        return {
+            "signed_header": {
+                "header": header_json(_hdr(meta)),
+                "commit": commit_json(commit),
+            },
+            "canonical": canonical,
+        }
+
+    def validators(self, height=None, page=1, per_page=30) -> dict:
+        h = self._height_or_latest(height)
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        page = max(1, int(page or 1))
+        per_page = min(100, max(1, int(per_page or 30)))
+        start = (page - 1) * per_page
+        sel = vals.validators[start : start + per_page]
+        return {
+            "block_height": str(h),
+            "validators": [validator_json(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(vals.size()),
+        }
+
+    # ------------------------------------------------------------ abci
+
+    def abci_info(self) -> dict:
+        resp = self.node.app_conns.query.info(abci.InfoRequest())
+        return {
+            "response": {
+                "data": resp.data,
+                "version": resp.version,
+                "app_version": str(resp.app_version),
+                "last_block_height": str(resp.last_block_height),
+                "last_block_app_hash": b64(resp.last_block_app_hash),
+            }
+        }
+
+    def abci_query(self, path="", data="", height=0, prove=False) -> dict:
+        if isinstance(data, str):
+            data = bytes.fromhex(data) if data else b""
+        resp = self.node.app_conns.query.query(
+            abci.QueryRequest(
+                path=path, data=data, height=int(height or 0), prove=bool(prove)
+            )
+        )
+        return {
+            "response": {
+                "code": resp.code,
+                "log": resp.log,
+                "key": b64(resp.key),
+                "value": b64(resp.value),
+                "height": str(resp.height),
+            }
+        }
+
+    # --------------------------------------------------------- mempool
+
+    def broadcast_tx_async(self, tx: bytes) -> dict:
+        import threading
+
+        from ..crypto import hash as tmhash
+
+        threading.Thread(
+            target=self._check_tx_quiet, args=(tx,), daemon=True
+        ).start()
+        return {"code": 0, "data": "", "log": "", "hash": hex_up(tmhash.sum(tx))}
+
+    def _check_tx_quiet(self, tx: bytes) -> None:
+        try:
+            self.node.mempool.check_tx(tx)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def broadcast_tx_sync(self, tx: bytes) -> dict:
+        from ..crypto import hash as tmhash
+
+        try:
+            self.node.mempool.check_tx(tx)
+            code, log = 0, ""
+        except MempoolError as e:
+            code, log = getattr(e, "code", 1) or 1, str(e)
+        return {"code": code, "data": "", "log": log, "hash": hex_up(tmhash.sum(tx))}
+
+    def broadcast_tx_commit(self, tx: bytes, timeout: float = 30.0) -> dict:
+        """rpc/core/mempool.go:86 — CheckTx, then wait for the tx event."""
+        from ..crypto import hash as tmhash
+
+        tx_hash = tmhash.sum(tx)
+        sub = self.node.event_bus.subscribe(
+            f"tx-wait-{tx_hash.hex()[:16]}-{time.monotonic_ns()}", EventQueryTx
+        )
+        try:
+            try:
+                self.node.mempool.check_tx(tx)
+            except MempoolError as e:
+                return {
+                    "check_tx": {"code": getattr(e, "code", 1) or 1, "log": str(e)},
+                    "tx_result": {},
+                    "hash": hex_up(tx_hash),
+                    "height": "0",
+                }
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RPCError(-32603, "timed out waiting for tx to be included")
+                import queue as _q
+
+                try:
+                    msg, _ = sub.get(timeout=min(remaining, 1.0))
+                except _q.Empty:
+                    continue
+                d = msg.data
+                if d.get("tx") == tx:
+                    return {
+                        "check_tx": {"code": 0, "log": ""},
+                        "tx_result": tx_result_json(d["result"]),
+                        "hash": hex_up(tx_hash),
+                        "height": str(d["height"]),
+                    }
+        finally:
+            self.node.event_bus.pubsub.unsubscribe_all(sub.subscriber)
+
+    def unconfirmed_txs(self, limit=30) -> dict:
+        mp = self.node.mempool
+        txs = mp.reap_max_txs(int(limit or 30))
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(mp.size()),
+            "total_bytes": str(mp.size_bytes()),
+            "txs": [b64(t) for t in txs],
+        }
+
+    def num_unconfirmed_txs(self) -> dict:
+        mp = self.node.mempool
+        return {
+            "n_txs": str(mp.size()),
+            "total": str(mp.size()),
+            "total_bytes": str(mp.size_bytes()),
+            "txs": None,
+        }
+
+    # -------------------------------------------------------- consensus
+
+    def consensus_state(self) -> dict:
+        rs = self.node.consensus_state.get_round_state()
+        return {
+            "round_state": {
+                "height/round/step": f"{rs.height}/{rs.round}/{rs.step}",
+                "start_time": str(rs.start_time_ns),
+                "proposal_block_hash": hex_up(
+                    rs.proposal_block.hash() if rs.proposal_block else b""
+                ),
+            }
+        }
+
+    def consensus_params(self, height=None) -> dict:
+        h = self._height_or_latest(height)
+        params = self.node.state_store.load_consensus_params(h)
+        if params is None:
+            params = self.state.consensus_params
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(params.block.max_bytes),
+                    "max_gas": str(params.block.max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                    "max_age_duration": str(params.evidence.max_age_duration_ns),
+                    "max_bytes": str(params.evidence.max_bytes),
+                },
+                "validator": {
+                    "pub_key_types": list(params.validator.pub_key_types)
+                },
+            },
+        }
+
+
+def _hdr(meta):
+    from ..types.block import Header
+
+    return Header.from_proto(meta.header)
+
+
+ROUTES = {
+    "health": ("", Environment.health),
+    "status": ("", Environment.status),
+    "net_info": ("", Environment.net_info),
+    "genesis": ("", Environment.genesis),
+    "block": ("height", Environment.block),
+    "commit": ("height", Environment.commit),
+    "validators": ("height,page,per_page", Environment.validators),
+    "abci_info": ("", Environment.abci_info),
+    "abci_query": ("path,data,height,prove", Environment.abci_query),
+    "broadcast_tx_async": ("tx", Environment.broadcast_tx_async),
+    "broadcast_tx_sync": ("tx", Environment.broadcast_tx_sync),
+    "broadcast_tx_commit": ("tx", Environment.broadcast_tx_commit),
+    "unconfirmed_txs": ("limit", Environment.unconfirmed_txs),
+    "num_unconfirmed_txs": ("", Environment.num_unconfirmed_txs),
+    "consensus_state": ("", Environment.consensus_state),
+    "consensus_params": ("height", Environment.consensus_params),
+}

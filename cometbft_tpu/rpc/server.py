@@ -1,0 +1,342 @@
+"""JSON-RPC server: HTTP POST + GET with URI params + WebSocket events
+(reference: rpc/jsonrpc/server/{http_server,http_json_handler,
+ws_handler}.go — rebuilt on the stdlib threading HTTP server; the
+WebSocket endpoint implements the RFC 6455 handshake + text frames
+directly, which is all the event stream needs).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import queue
+import socketserver
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from ..utils.log import get_logger
+from .core import ROUTES, Environment, RPCError
+from .serializers import events_json
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _coerce_tx(v):
+    """JSON-RPC tx params arrive base64 (POST) or 0x-hex/quoted (GET)."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        if v.startswith("0x"):
+            return bytes.fromhex(v[2:])
+        if v.startswith('"') and v.endswith('"'):
+            return v[1:-1].encode()
+        try:
+            return base64.b64decode(v, validate=True)
+        except Exception:  # noqa: BLE001
+            return v.encode()
+    return v
+
+
+class RPCServer:
+    def __init__(self, node):
+        self.env = Environment(node)
+        self.node = node
+        self.logger = get_logger("rpc")
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.listen_addr: str | None = None
+
+    def start(self, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        host = host or "127.0.0.1"
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                server._handle_jsonrpc(self, body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/websocket":
+                    server._handle_websocket(self)
+                    return
+                method = url.path.strip("/")
+                params = dict(parse_qsl(url.query))
+                req = {
+                    "jsonrpc": "2.0",
+                    "id": -1,
+                    "method": method,
+                    "params": params,
+                }
+                server._handle_jsonrpc(self, json.dumps(req).encode())
+
+        class _Srv(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Srv((host, int(port)), Handler)
+        self.listen_addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="rpc-http"
+        )
+        self._thread.start()
+        self.logger.info(f"RPC listening on {self.listen_addr}")
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # ------------------------------------------------------------ JSON-RPC
+
+    def _handle_jsonrpc(self, handler, body: bytes) -> None:
+        try:
+            req = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            self._reply(handler, None, error={"code": -32700, "message": str(e)})
+            return
+        rid = req.get("id", -1)
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        route = ROUTES.get(method)
+        if route is None:
+            self._reply(
+                handler, rid,
+                error={"code": -32601, "message": f"Method not found: {method}"},
+            )
+            return
+        param_names, fn = route
+        names = [n for n in param_names.split(",") if n]
+        kwargs = {}
+        if isinstance(params, dict):
+            for n in names:
+                if n in params:
+                    kwargs[n] = params[n]
+        elif isinstance(params, list):
+            kwargs = dict(zip(names, params))
+        if "tx" in kwargs:
+            kwargs["tx"] = _coerce_tx(kwargs["tx"])
+        try:
+            result = fn(self.env, **kwargs)
+            self._reply(handler, rid, result=result)
+        except RPCError as e:
+            self._reply(
+                handler, rid,
+                error={"code": e.code, "message": str(e), "data": e.data},
+            )
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"rpc {method} failed: {e}")
+            self._reply(
+                handler, rid,
+                error={"code": -32603, "message": f"Internal error: {e}"},
+            )
+
+    def _reply(self, handler, rid, result=None, error=None) -> None:
+        msg = {"jsonrpc": "2.0", "id": rid}
+        if error is not None:
+            msg["error"] = error
+        else:
+            msg["result"] = result
+        data = json.dumps(msg).encode()
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ----------------------------------------------------------- websocket
+
+    def _handle_websocket(self, handler) -> None:
+        """RFC 6455 server side: handshake, then serve subscribe /
+        unsubscribe over JSON-RPC text frames (ws_handler.go)."""
+        key = handler.headers.get("Sec-WebSocket-Key")
+        if not key:
+            handler.send_response(400)
+            handler.end_headers()
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        handler.send_response(101, "Switching Protocols")
+        handler.send_header("Upgrade", "websocket")
+        handler.send_header("Connection", "Upgrade")
+        handler.send_header("Sec-WebSocket-Accept", accept)
+        handler.end_headers()
+
+        conn = handler.connection
+        conn_id = f"ws-{id(handler)}"
+        send_mtx = threading.Lock()
+        subs: dict[str, object] = {}
+        stop = threading.Event()
+
+        def send_text(obj) -> None:
+            data = json.dumps(obj).encode()
+            frame = bytearray([0x81])
+            n = len(data)
+            if n < 126:
+                frame.append(n)
+            elif n < 1 << 16:
+                frame.append(126)
+                frame += struct.pack(">H", n)
+            else:
+                frame.append(127)
+                frame += struct.pack(">Q", n)
+            frame += data
+            with send_mtx:
+                conn.sendall(bytes(frame))
+
+        def pump(query_expr: str, sub) -> None:
+            while not stop.is_set() and not sub.cancelled.is_set():
+                try:
+                    msg, events = sub.out.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                try:
+                    send_text(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": f"{conn_id}#event",
+                            "result": {
+                                "query": query_expr,
+                                "data": {
+                                    "type": f"tendermint/event/{msg.event_type}",
+                                    "value": _event_value_json(msg),
+                                },
+                                "events": events,
+                            },
+                        }
+                    )
+                except OSError:
+                    stop.set()
+                    return
+
+        try:
+            while not stop.is_set():
+                req = self._read_ws_frame(conn)
+                if req is None:
+                    break
+                try:
+                    msg = json.loads(req)
+                except json.JSONDecodeError:
+                    continue
+                method = msg.get("method")
+                rid = msg.get("id", -1)
+                params = msg.get("params") or {}
+                if method == "subscribe":
+                    q = params.get("query", "")
+                    try:
+                        sub = self.node.event_bus.subscribe(conn_id, q)
+                    except Exception as e:  # noqa: BLE001
+                        send_text({
+                            "jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32603, "message": str(e)},
+                        })
+                        continue
+                    subs[q] = sub
+                    threading.Thread(
+                        target=pump, args=(q, sub), daemon=True
+                    ).start()
+                    send_text({"jsonrpc": "2.0", "id": rid, "result": {}})
+                elif method == "unsubscribe":
+                    q = params.get("query", "")
+                    self.node.event_bus.pubsub.unsubscribe(conn_id, q)
+                    subs.pop(q, None)
+                    send_text({"jsonrpc": "2.0", "id": rid, "result": {}})
+                elif method == "unsubscribe_all":
+                    self.node.event_bus.pubsub.unsubscribe_all(conn_id)
+                    subs.clear()
+                    send_text({"jsonrpc": "2.0", "id": rid, "result": {}})
+                else:
+                    send_text({
+                        "jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32601, "message": "ws supports subscribe/unsubscribe"},
+                    })
+        finally:
+            stop.set()
+            self.node.event_bus.pubsub.unsubscribe_all(conn_id)
+            handler.close_connection = True
+
+    @staticmethod
+    def _read_ws_frame(conn) -> str | None:
+        """One client->server text frame (masked per RFC 6455)."""
+
+        def read_exact(n: int) -> bytes | None:
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        hdr = read_exact(2)
+        if hdr is None:
+            return None
+        opcode = hdr[0] & 0x0F
+        masked = hdr[1] & 0x80
+        n = hdr[1] & 0x7F
+        if n == 126:
+            ext = read_exact(2)
+            if ext is None:
+                return None
+            n = struct.unpack(">H", ext)[0]
+        elif n == 127:
+            ext = read_exact(8)
+            if ext is None:
+                return None
+            n = struct.unpack(">Q", ext)[0]
+        mask = read_exact(4) if masked else b"\x00" * 4
+        if mask is None:
+            return None
+        payload = read_exact(n) if n else b""
+        if payload is None:
+            return None
+        data = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        if opcode == 0x8:  # close
+            return None
+        if opcode != 0x1:  # only text frames carry JSON-RPC
+            return ""
+        return data.decode("utf-8", "replace")
+
+
+def _event_value_json(msg) -> dict:
+    """Typed event payload -> JSON (responses.go ResultEvent shapes)."""
+    from .serializers import block_json, header_json, tx_result_json
+
+    d = msg.data
+    if msg.event_type == "NewBlock":
+        return {
+            "block": block_json(d["block"]),
+            "block_id": {"hash": d["block_id"].hash.hex().upper()},
+        }
+    if msg.event_type == "NewBlockHeader":
+        return {"header": header_json(d["header"])}
+    if msg.event_type == "Tx":
+        return {
+            "TxResult": {
+                "height": str(d["height"]),
+                "index": d["index"],
+                "tx": base64.b64encode(d["tx"]).decode(),
+                "result": tx_result_json(d["result"]),
+            }
+        }
+    if msg.event_type == "NewBlockEvents":
+        return {
+            "height": str(d["height"]),
+            "events": events_json(d["events"]),
+            "num_txs": str(d["num_txs"]),
+        }
+    return {"event": msg.event_type}

@@ -1,0 +1,38 @@
+"""Statesync: snapshot-based cold start (reference: statesync/)."""
+
+from .chunks import Chunk, ChunkQueue
+from .reactor import CHUNK_STREAM, SNAPSHOT_STREAM, StatesyncReactor
+from .snapshots import Snapshot, SnapshotPool
+from .stateprovider import LightClientStateProvider, StateProviderError
+from .syncer import (
+    ErrAbort,
+    ErrChunkTimeout,
+    ErrNoSnapshots,
+    ErrRejectFormat,
+    ErrRejectSender,
+    ErrRejectSnapshot,
+    ErrRetrySnapshot,
+    StatesyncError,
+    Syncer,
+)
+
+__all__ = [
+    "StatesyncReactor",
+    "SNAPSHOT_STREAM",
+    "CHUNK_STREAM",
+    "Syncer",
+    "Snapshot",
+    "SnapshotPool",
+    "Chunk",
+    "ChunkQueue",
+    "LightClientStateProvider",
+    "StateProviderError",
+    "StatesyncError",
+    "ErrNoSnapshots",
+    "ErrAbort",
+    "ErrRejectSnapshot",
+    "ErrRejectFormat",
+    "ErrRejectSender",
+    "ErrRetrySnapshot",
+    "ErrChunkTimeout",
+]

@@ -1,0 +1,110 @@
+"""Chunk queue for one snapshot restoration
+(reference: statesync/chunks.go, redesigned in-memory: chunks are small
+relative to host RAM and a condition variable replaces the on-disk spool
++ channel plumbing).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+PENDING, REQUESTED, RECEIVED, DONE = range(4)
+
+
+@dataclass
+class Chunk:
+    height: int
+    format: int
+    index: int
+    chunk: bytes
+    sender: str
+
+
+class ChunkQueue:
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self._mtx = threading.Condition()
+        self._status = [PENDING] * snapshot.chunks
+        self._chunks: dict[int, Chunk] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------ fetchers
+
+    def allocate(self) -> int | None:
+        """Next chunk index needing a request; None when all are in
+        flight or done (chunks.go Allocate)."""
+        with self._mtx:
+            for i, st in enumerate(self._status):
+                if st == PENDING:
+                    self._status[i] = REQUESTED
+                    return i
+            return None
+
+    def add(self, chunk: Chunk) -> bool:
+        """A chunk arrived from a peer (chunks.go Add)."""
+        with self._mtx:
+            if self._closed or not (0 <= chunk.index < len(self._status)):
+                return False
+            if self._status[chunk.index] in (RECEIVED, DONE):
+                return False
+            self._chunks[chunk.index] = chunk
+            self._status[chunk.index] = RECEIVED
+            self._mtx.notify_all()
+            return True
+
+    # ------------------------------------------------------------- applier
+
+    def next(self, timeout: float | None = None) -> Chunk | None:
+        """Lowest-index received-but-unapplied chunk, blocking until it
+        arrives; None when every chunk is DONE or the queue closed."""
+        with self._mtx:
+            while True:
+                if self._closed:
+                    return None
+                if all(st == DONE for st in self._status):
+                    return None
+                want = next(
+                    (i for i, st in enumerate(self._status) if st != DONE),
+                    None,
+                )
+                if want is not None and self._status[want] == RECEIVED:
+                    self._status[want] = DONE
+                    return self._chunks[want]
+                if not self._mtx.wait(timeout):
+                    return None  # timed out
+
+    def retry(self, index: int) -> None:
+        with self._mtx:
+            if 0 <= index < len(self._status):
+                self._status[index] = PENDING
+                self._chunks.pop(index, None)
+                self._mtx.notify_all()
+
+    def retry_all(self) -> None:
+        with self._mtx:
+            self._status = [PENDING] * len(self._status)
+            self._chunks.clear()
+            self._mtx.notify_all()
+
+    def discard(self, index: int) -> None:
+        self.retry(index)
+
+    def discard_sender(self, peer_id: str) -> None:
+        """Drop unapplied chunks from a rejected sender (chunks.go
+        DiscardSender)."""
+        with self._mtx:
+            for i, ch in list(self._chunks.items()):
+                if ch.sender == peer_id and self._status[i] == RECEIVED:
+                    self._status[i] = PENDING
+                    self._chunks.pop(i)
+            self._mtx.notify_all()
+
+    def close(self) -> None:
+        with self._mtx:
+            self._closed = True
+            self._mtx.notify_all()
+
+    def done(self) -> bool:
+        with self._mtx:
+            return all(st == DONE for st in self._status)

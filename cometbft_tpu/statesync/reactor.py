@@ -1,0 +1,231 @@
+"""Statesync reactor: snapshot/chunk exchange + the sync driver
+(reference: statesync/reactor.go; streams 0x60/0x61).
+
+Serving side: answers SnapshotsRequest from the app's ListSnapshots and
+ChunkRequest from LoadSnapshotChunk — any caught-up node is a snapshot
+server with no extra state.
+
+Syncing side: run() discovers snapshots from peers, drives the Syncer,
+then bootstraps the stores (state + seen commit) and hands off to
+blocksync (switch_to_block_sync), which later hands off to consensus —
+the full cold-start pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..p2p.conn.connection import StreamDescriptor
+from ..p2p.reactor import Reactor
+from ..utils.log import get_logger
+from ..wire import abci_pb as abci
+from ..wire import statesync_pb as pb
+from .chunks import Chunk
+from .snapshots import Snapshot
+from .syncer import Syncer
+
+SNAPSHOT_STREAM = 0x60
+CHUNK_STREAM = 0x61
+
+MAX_SNAPSHOTS_ADVERTISED = 10  # reactor.go recentSnapshots
+
+
+class StatesyncReactor(Reactor):
+    def __init__(
+        self,
+        snapshot_conn,  # abci snapshot connection (serving + restoring)
+        query_conn,  # abci query connection (Info)
+        state_provider=None,  # LightClientStateProvider when syncing
+        enabled: bool = False,  # are WE state syncing on boot?
+    ):
+        super().__init__("StatesyncReactor")
+        self.snapshot_conn = snapshot_conn
+        self.query_conn = query_conn
+        self.state_provider = state_provider
+        self.enabled = enabled
+        self.logger = get_logger("statesync-reactor")
+        self.syncer: Syncer | None = None
+        self._synced_callbacks = []
+        if enabled and state_provider is not None:
+            self.syncer = Syncer(
+                state_provider,
+                snapshot_conn,
+                query_conn,
+                self._request_chunk,
+            )
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return [
+            StreamDescriptor(id=SNAPSHOT_STREAM, priority=5, send_queue_capacity=10),
+            StreamDescriptor(id=CHUNK_STREAM, priority=3, send_queue_capacity=16),
+        ]
+
+    def on_synced(self, cb) -> None:
+        """Register a callback fired with (state, commit) after restore."""
+        self._synced_callbacks.append(cb)
+
+    # --------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        if self.syncer is not None:
+            # ask every new peer what snapshots it has (reactor.go AddPeer)
+            peer.try_send(
+                SNAPSHOT_STREAM,
+                pb.StatesyncMessage(snapshots_request=pb.SnapshotsRequest()).encode(),
+            )
+
+    def remove_peer(self, peer, reason: str = "") -> None:
+        if self.syncer is not None:
+            self.syncer.snapshots.remove_peer(peer.id)
+
+    # ------------------------------------------------------------- receive
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        msg = pb.StatesyncMessage.decode(msg_bytes)
+        which = msg.which()
+        if which == "snapshots_request":
+            self._serve_snapshots(peer)
+        elif which == "snapshots_response":
+            if self.syncer is not None:
+                m = msg.snapshots_response
+                self.syncer.add_snapshot(
+                    peer.id,
+                    Snapshot(
+                        height=m.height,
+                        format=m.format,
+                        chunks=m.chunks,
+                        hash=m.hash,
+                        metadata=m.metadata,
+                    ),
+                )
+        elif which == "chunk_request":
+            self._serve_chunk(peer, msg.chunk_request)
+        elif which == "chunk_response":
+            m = msg.chunk_response
+            if self.syncer is not None and not m.missing:
+                self.syncer.add_chunk(
+                    Chunk(
+                        height=m.height,
+                        format=m.format,
+                        index=m.index,
+                        chunk=m.chunk,
+                        sender=peer.id,
+                    )
+                )
+
+    def _serve_snapshots(self, peer) -> None:
+        """reactor.go:123 — advertise our app's newest snapshots."""
+        try:
+            resp = self.snapshot_conn.list_snapshots(abci.ListSnapshotsRequest())
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"ListSnapshots failed: {e}")
+            return
+        snaps = sorted(
+            resp.snapshots or [], key=lambda s: (s.height, s.format), reverse=True
+        )
+        for s in snaps[:MAX_SNAPSHOTS_ADVERTISED]:
+            peer.try_send(
+                SNAPSHOT_STREAM,
+                pb.StatesyncMessage(
+                    snapshots_response=pb.SnapshotsResponse(
+                        height=s.height,
+                        format=s.format,
+                        chunks=s.chunks,
+                        hash=s.hash,
+                        metadata=s.metadata,
+                    )
+                ).encode(),
+            )
+
+    def _serve_chunk(self, peer, req: pb.ChunkRequest) -> None:
+        """reactor.go:172 — load the chunk from the app and ship it."""
+        try:
+            resp = self.snapshot_conn.load_snapshot_chunk(
+                abci.LoadSnapshotChunkRequest(
+                    height=req.height, format=req.format, chunk=req.index
+                )
+            )
+            chunk = resp.chunk
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"LoadSnapshotChunk failed: {e}")
+            chunk = b""
+        peer.try_send(
+            CHUNK_STREAM,
+            pb.StatesyncMessage(
+                chunk_response=pb.ChunkResponse(
+                    height=req.height,
+                    format=req.format,
+                    index=req.index,
+                    chunk=chunk or b"",
+                    missing=not chunk,
+                )
+            ).encode(),
+        )
+
+    def _request_chunk(self, peer_id: str, snapshot, index: int) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            raise ConnectionError(f"peer {peer_id} gone")
+        peer.try_send(
+            CHUNK_STREAM,
+            pb.StatesyncMessage(
+                chunk_request=pb.ChunkRequest(
+                    height=snapshot.height, format=snapshot.format, index=index
+                )
+            ).encode(),
+        )
+
+    # ----------------------------------------------------------- sync run
+
+    def run(
+        self,
+        state_store,
+        block_store,
+        discovery_time: float = 2.0,
+        max_discovery_time: float = 60.0,
+    ) -> None:
+        """Kick off the background sync (node/setup.go:569 startStateSync):
+        restore → bootstrap stores → hand off to blocksync."""
+        if self.syncer is None:
+            raise RuntimeError("statesync reactor not configured for syncing")
+        threading.Thread(
+            target=self._sync_routine,
+            args=(state_store, block_store, discovery_time, max_discovery_time),
+            daemon=True,
+        ).start()
+
+    def _sync_routine(
+        self, state_store, block_store, discovery_time, max_discovery_time
+    ) -> None:
+        def rediscover():
+            if self.switch is not None:
+                self.switch.broadcast(
+                    SNAPSHOT_STREAM,
+                    pb.StatesyncMessage(
+                        snapshots_request=pb.SnapshotsRequest()
+                    ).encode(),
+                )
+
+        try:
+            state, commit = self.syncer.sync_any(
+                discovery_time, max_discovery_time, retry_hook=rediscover
+            )
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(f"state sync failed: {e}")
+            return
+        # persist what blocksync + consensus will build on
+        state_store.bootstrap(state)
+        block_store.save_seen_commit(state.last_block_height, commit)
+        if block_store.height < state.last_block_height:
+            block_store.base = state.last_block_height + 1
+            block_store.height = state.last_block_height
+        self.logger.info(
+            f"state synced to height {state.last_block_height}; "
+            "handing off to blocksync"
+        )
+        if self.switch is not None:
+            bs = self.switch.reactors.get("BLOCKSYNC")
+            if bs is not None and hasattr(bs, "switch_to_block_sync"):
+                bs.switch_to_block_sync(state)
+        for cb in self._synced_callbacks:
+            cb(state, commit)

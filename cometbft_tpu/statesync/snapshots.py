@@ -1,0 +1,94 @@
+"""Snapshot pool: candidate snapshots advertised by peers
+(reference: statesync/snapshots.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+    trusted_app_hash: bytes = b""  # filled by the syncer after light verify
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.hash)
+
+
+class SnapshotPool:
+    """Tracks snapshots and which peers can serve them; Best() prefers
+    the newest height, then the highest format (snapshots.go Best)."""
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._snapshots: dict[tuple, Snapshot] = {}
+        self._peers: dict[tuple, set[str]] = {}
+        self._rejected: set[tuple] = set()
+        self._rejected_formats: set[int] = set()
+        self._rejected_peers: set[str] = set()
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Returns True if this snapshot is new to the pool."""
+        k = snapshot.key()
+        with self._mtx:
+            if (
+                k in self._rejected
+                or snapshot.format in self._rejected_formats
+                or peer_id in self._rejected_peers
+            ):
+                return False
+            new = k not in self._snapshots
+            self._snapshots.setdefault(k, snapshot)
+            self._peers.setdefault(k, set()).add(peer_id)
+            return new
+
+    def best(self) -> Snapshot | None:
+        with self._mtx:
+            candidates = sorted(
+                self._snapshots.values(),
+                key=lambda s: (s.height, s.format),
+                reverse=True,
+            )
+            return candidates[0] if candidates else None
+
+    def peers_of(self, snapshot: Snapshot) -> list[str]:
+        with self._mtx:
+            return list(self._peers.get(snapshot.key(), ()))
+
+    def reject(self, snapshot: Snapshot) -> None:
+        with self._mtx:
+            k = snapshot.key()
+            self._rejected.add(k)
+            self._snapshots.pop(k, None)
+            self._peers.pop(k, None)
+
+    def reject_format(self, fmt: int) -> None:
+        with self._mtx:
+            self._rejected_formats.add(fmt)
+            for k in [k for k in self._snapshots if k[1] == fmt]:
+                self._snapshots.pop(k, None)
+                self._peers.pop(k, None)
+
+    def reject_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._rejected_peers.add(peer_id)
+            for k, peers in list(self._peers.items()):
+                peers.discard(peer_id)
+                if not peers:
+                    self._snapshots.pop(k, None)
+                    self._peers.pop(k, None)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            for k, peers in list(self._peers.items()):
+                peers.discard(peer_id)
+                # snapshots with no remaining peers are unusable
+                if not peers:
+                    self._snapshots.pop(k, None)
+                    self._peers.pop(k, None)

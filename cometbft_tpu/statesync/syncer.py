@@ -1,0 +1,306 @@
+"""Statesync syncer: restore app state from a peer snapshot
+(reference: statesync/syncer.go).
+
+Flow (syncer.go:144 SyncAny / :236 Sync): pick the best advertised
+snapshot → light-verify its app hash → OfferSnapshot to the app → fetch
+chunks from peers (the reactor feeds add_chunk) while applying them in
+order → verify the app's restored hash/height via Info → hand back the
+light-verified State + Commit for the stores.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.log import get_logger
+from ..wire import abci_pb as abci
+from .chunks import Chunk, ChunkQueue
+from .snapshots import Snapshot, SnapshotPool
+
+
+class StatesyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(StatesyncError):
+    pass
+
+
+class ErrAbort(StatesyncError):
+    pass
+
+
+class ErrRejectSnapshot(StatesyncError):
+    pass
+
+
+class ErrRejectFormat(StatesyncError):
+    pass
+
+
+class ErrRejectSender(StatesyncError):
+    pass
+
+
+class ErrRetrySnapshot(StatesyncError):
+    pass
+
+
+class ErrChunkTimeout(StatesyncError):
+    pass
+
+
+CHUNK_TIMEOUT = 30.0
+CHUNK_FETCHERS = 4
+
+
+class Syncer:
+    def __init__(
+        self,
+        state_provider,
+        snapshot_conn,  # abci client, snapshot connection
+        query_conn,  # abci client, query connection (Info)
+        request_chunk,  # callable(peer_id, snapshot, index)
+        chunk_fetchers: int = CHUNK_FETCHERS,
+        chunk_timeout: float = CHUNK_TIMEOUT,
+    ):
+        self.state_provider = state_provider
+        self.snapshot_conn = snapshot_conn
+        self.query_conn = query_conn
+        self.request_chunk = request_chunk
+        self.chunk_fetchers = chunk_fetchers
+        self.chunk_timeout = chunk_timeout
+        self.snapshots = SnapshotPool()
+        self.logger = get_logger("statesync")
+        self._mtx = threading.Lock()
+        self._chunks: ChunkQueue | None = None
+
+    # ---------------------------------------------------------- pool feeds
+
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Reactor feed: a peer advertised a snapshot (syncer.go:108).
+        Light-verify the app hash up front so garbage never enters the
+        pool."""
+        try:
+            snapshot.trusted_app_hash = self.state_provider.app_hash(
+                snapshot.height
+            )
+        except Exception as e:  # noqa: BLE001
+            self.logger.info(
+                f"failed to verify app hash for snapshot at height "
+                f"{snapshot.height}: {e}"
+            )
+            return False
+        added = self.snapshots.add(peer_id, snapshot)
+        if added:
+            self.logger.info(
+                f"discovered new snapshot height={snapshot.height} "
+                f"format={snapshot.format} hash={snapshot.hash.hex()[:12]}"
+            )
+        return added
+
+    def add_chunk(self, chunk: Chunk) -> bool:
+        with self._mtx:
+            q = self._chunks
+        if q is None:
+            return False
+        return q.add(chunk)
+
+    # ------------------------------------------------------------- syncing
+
+    def sync_any(
+        self,
+        discovery_time: float,
+        max_discovery_time: float,
+        retry_hook=None,
+    ):
+        """syncer.go:144 — wait for snapshots, then drive Sync with
+        rejection/retry handling.  Returns (state, commit)."""
+        start = time.monotonic()
+        time.sleep(discovery_time)
+        snapshot, chunks = None, None
+        while True:
+            if snapshot is None:
+                snapshot = self.snapshots.best()
+                chunks = None
+            if snapshot is None:
+                if (
+                    max_discovery_time > 0
+                    and time.monotonic() - start >= max_discovery_time
+                ):
+                    raise ErrNoSnapshots("no viable snapshots discovered")
+                if retry_hook:
+                    retry_hook()
+                time.sleep(discovery_time)
+                continue
+            if chunks is None:
+                chunks = ChunkQueue(snapshot)
+            try:
+                return self.sync(snapshot, chunks)
+            except ErrRetrySnapshot:
+                chunks.retry_all()
+                self.logger.info(f"retrying snapshot {snapshot.height}")
+                continue
+            except ErrChunkTimeout:
+                self.snapshots.reject(snapshot)
+                self.logger.error(
+                    f"timed out fetching chunks; rejected snapshot "
+                    f"{snapshot.height}"
+                )
+            except ErrRejectSnapshot:
+                self.snapshots.reject(snapshot)
+                self.logger.info(f"snapshot {snapshot.height} rejected")
+            except ErrRejectFormat:
+                self.snapshots.reject_format(snapshot.format)
+                self.logger.info(f"snapshot format {snapshot.format} rejected")
+            except ErrRejectSender:
+                self.logger.info("snapshot senders rejected")
+                for peer in self.snapshots.peers_of(snapshot):
+                    self.snapshots.reject_peer(peer)
+            finally:
+                if chunks is not None and (snapshot is None or chunks.done()):
+                    pass
+            snapshot, chunks = None, None
+
+    def sync(self, snapshot: Snapshot, chunks: ChunkQueue):
+        """syncer.go:236 — one restoration attempt."""
+        with self._mtx:
+            if self._chunks is not None:
+                raise StatesyncError("a state sync is already in progress")
+            self._chunks = chunks
+        stop_fetch = threading.Event()
+        try:
+            if not snapshot.trusted_app_hash:
+                snapshot.trusted_app_hash = self.state_provider.app_hash(
+                    snapshot.height
+                )
+
+            self._offer_snapshot(snapshot)
+
+            for _ in range(self.chunk_fetchers):
+                threading.Thread(
+                    target=self._fetch_chunks,
+                    args=(snapshot, chunks, stop_fetch),
+                    daemon=True,
+                ).start()
+
+            # optimistically build the post-snapshot state so light-client
+            # failures surface before the expensive restore
+            state = self.state_provider.state(snapshot.height)
+            commit = self.state_provider.commit(snapshot.height)
+
+            self._apply_chunks(snapshot, chunks)
+            self._verify_app(snapshot, state.app_version)
+            self.logger.info(
+                f"snapshot restored height={snapshot.height} "
+                f"hash={snapshot.hash.hex()[:12]}"
+            )
+            return state, commit
+        finally:
+            stop_fetch.set()
+            chunks.close()
+            with self._mtx:
+                self._chunks = None
+
+    # ------------------------------------------------------------ internals
+
+    def _offer_snapshot(self, snapshot: Snapshot) -> None:
+        """syncer.go:317."""
+        resp = self.snapshot_conn.offer_snapshot(
+            abci.OfferSnapshotRequest(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    chunks=snapshot.chunks,
+                    hash=snapshot.hash,
+                    metadata=snapshot.metadata,
+                ),
+                app_hash=snapshot.trusted_app_hash,
+            )
+        )
+        r = resp.result
+        if r == abci.OFFER_SNAPSHOT_RESULT_ACCEPT:
+            return
+        if r == abci.OFFER_SNAPSHOT_RESULT_ABORT:
+            raise ErrAbort("app aborted the snapshot offer")
+        if r == abci.OFFER_SNAPSHOT_RESULT_REJECT:
+            raise ErrRejectSnapshot("app rejected the snapshot")
+        if r == abci.OFFER_SNAPSHOT_RESULT_REJECT_FORMAT:
+            raise ErrRejectFormat("app rejected the snapshot format")
+        if r == abci.OFFER_SNAPSHOT_RESULT_REJECT_SENDER:
+            raise ErrRejectSender("app rejected the snapshot senders")
+        raise StatesyncError(f"unknown OfferSnapshot result {r}")
+
+    def _fetch_chunks(self, snapshot, chunks, stop: threading.Event) -> None:
+        """syncer.go:410 — request allocations until the queue is done."""
+        while not stop.is_set() and not chunks.done():
+            index = chunks.allocate()
+            if index is None:
+                time.sleep(0.05)
+                continue
+            peers = self.snapshots.peers_of(snapshot)
+            if not peers:
+                chunks.retry(index)
+                time.sleep(0.2)
+                continue
+            peer = peers[index % len(peers)]
+            try:
+                self.request_chunk(peer, snapshot, index)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"chunk request to {peer} failed: {e}")
+                chunks.retry(index)
+                time.sleep(0.2)
+
+    def _apply_chunks(self, snapshot, chunks: ChunkQueue) -> None:
+        """syncer.go:353."""
+        while True:
+            chunk = chunks.next(timeout=self.chunk_timeout)
+            if chunk is None:
+                if chunks.done():
+                    return
+                raise ErrChunkTimeout("timed out waiting for a chunk")
+            resp = self.snapshot_conn.apply_snapshot_chunk(
+                abci.ApplySnapshotChunkRequest(
+                    index=chunk.index, chunk=chunk.chunk, sender=chunk.sender
+                )
+            )
+            for index in resp.refetch_chunks or []:
+                chunks.discard(index)
+            for sender in resp.reject_senders or []:
+                if sender:
+                    self.snapshots.reject_peer(sender)
+                    chunks.discard_sender(sender)
+            r = resp.result
+            if r == abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT:
+                continue
+            if r == abci.APPLY_SNAPSHOT_CHUNK_RESULT_ABORT:
+                raise ErrAbort("app aborted chunk application")
+            if r == abci.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY:
+                chunks.retry(chunk.index)
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY_SNAPSHOT:
+                raise ErrRetrySnapshot("app asked to retry the snapshot")
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_RESULT_REJECT_SNAPSHOT:
+                raise ErrRejectSnapshot("app rejected the snapshot mid-restore")
+            else:
+                raise StatesyncError(f"unknown ApplySnapshotChunk result {r}")
+
+    def _verify_app(self, snapshot: Snapshot, app_version: int) -> None:
+        """syncer.go verifyApp: the restored app must report the snapshot
+        height and the light-verified hash."""
+        resp = self.query_conn.info(abci.InfoRequest())
+        if resp.last_block_app_hash != snapshot.trusted_app_hash:
+            raise StatesyncError(
+                f"restored app hash {resp.last_block_app_hash.hex()} does "
+                f"not match trusted hash {snapshot.trusted_app_hash.hex()}"
+            )
+        if resp.last_block_height != snapshot.height:
+            raise StatesyncError(
+                f"restored app height {resp.last_block_height} does not "
+                f"match snapshot height {snapshot.height}"
+            )
+        if app_version and resp.app_version != app_version:
+            raise StatesyncError(
+                f"restored app version {resp.app_version} does not match "
+                f"state app version {app_version}"
+            )

@@ -1,0 +1,56 @@
+"""Statesync wire messages (field layout mirrors
+proto/cometbft/statesync/v1/types.proto of the reference).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+
+
+class SnapshotsRequest(Message):
+    FIELDS = []
+
+
+class SnapshotsResponse(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "format", "varint"),
+        Field(3, "chunks", "varint"),
+        Field(4, "hash", "bytes"),
+        Field(5, "metadata", "bytes"),
+    ]
+
+
+class ChunkRequest(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "format", "varint"),
+        Field(3, "index", "varint"),
+    ]
+
+
+class ChunkResponse(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "format", "varint"),
+        Field(3, "index", "varint"),
+        Field(4, "chunk", "bytes"),
+        Field(5, "missing", "bool"),
+    ]
+
+
+class StatesyncMessage(Message):
+    """The oneof envelope carried on the statesync streams."""
+
+    FIELDS = [
+        Field(1, "snapshots_request", "message", SnapshotsRequest),
+        Field(2, "snapshots_response", "message", SnapshotsResponse),
+        Field(3, "chunk_request", "message", ChunkRequest),
+        Field(4, "chunk_response", "message", ChunkResponse),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None

@@ -38,10 +38,10 @@ GENESIS_NS = 1_700_000_000 * 1_000_000_000
 class Harness:
     """One in-process node: app + proxy + stores + executor."""
 
-    def __init__(self, n_vals=2):
+    def __init__(self, n_vals=2, snapshot_interval=0, chain_id="exec-chain"):
         self.keys = [ed25519.PrivKey.from_seed(bytes([i + 1]) * 32) for i in range(n_vals)]
         self.genesis = GenesisDoc(
-            chain_id="exec-chain",
+            chain_id=chain_id,
             genesis_time=Timestamp.from_unix_ns(GENESIS_NS),
             validators=[
                 GenesisValidator(
@@ -52,12 +52,14 @@ class Harness:
             app_hash=b"\x00" * 8,  # kvstore size-0 hash
         )
         self.state = make_genesis_state(self.genesis)
-        self.app = KVStoreApplication(lanes=default_lanes())
+        self.app = KVStoreApplication(
+            lanes=default_lanes(), snapshot_interval=snapshot_interval
+        )
         self.conns = new_app_conns(local_client_creator(self.app))
         self.conns.start()
         self.app.init_chain(
             pb.InitChainRequest(
-                chain_id="exec-chain",
+                chain_id=self.genesis.chain_id,
                 validators=[
                     pb.ValidatorUpdate(
                         power=10,
