@@ -273,7 +273,11 @@ class ConsensusState(Service):
                 self._handle_msg(MsgInfo(VoteMessage(Vote.from_proto(mi.vote)), mi.peer_id))
             elif mi.proposal is not None:
                 self._handle_msg(
-                    MsgInfo(ProposalMessage(Proposal.from_proto(mi.proposal)), mi.peer_id)
+                    MsgInfo(
+                        ProposalMessage(Proposal.from_proto(mi.proposal)),
+                        mi.peer_id,
+                        mi.receive_time_ns,
+                    )
                 )
             elif mi.block_part is not None:
                 self._handle_msg(
@@ -323,7 +327,9 @@ class ConsensusState(Service):
         if self._replay_mode:
             return
         msg = mi.msg
-        p = wal_pb.MsgInfoProto(peer_id=mi.peer_id)
+        p = wal_pb.MsgInfoProto(
+            peer_id=mi.peer_id, receive_time_ns=mi.receive_time_ns
+        )
         if isinstance(msg, VoteMessage):
             p.vote = msg.vote.to_proto()
         elif isinstance(msg, ProposalMessage):
@@ -478,12 +484,27 @@ class ConsensusState(Service):
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
             last_ext_commit = self._load_last_extended_commit(height)
+            # PBTS: the proposer stamps its own clock, clamped above the
+            # previous block's time so a lagging clock can't produce an
+            # invalid non-monotonic block (the reference instead WAITS for
+            # the clock to pass lastBlockTime before proposing; clamping
+            # trades that head start for the round's liveness).  BFT time
+            # (the default) derives the block time from the commit median.
+            block_time = None
+            if self.state.consensus_params.feature.pbts_enabled(height):
+                block_time = Timestamp.from_unix_ns(
+                    max(
+                        time.time_ns(),
+                        self.state.last_block_time.unix_ns() + 1,
+                    )
+                )
             try:
                 block, block_parts = self.block_exec.create_proposal_block(
                     height,
                     self.state,
                     last_ext_commit,
                     self.priv_validator_pub_key.address(),
+                    block_time=block_time,
                 )
             except Exception as e:  # noqa: BLE001
                 self.logger.error(f"failed to create proposal block: {e}")
@@ -497,7 +518,9 @@ class ConsensusState(Service):
             round=round,
             pol_round=rs.valid_round,
             block_id=bid,
-            timestamp=Timestamp.from_unix_ns(time.time_ns()),
+            # the proposal carries the BLOCK's time (state.go:1252) — PBTS
+            # receivers check proposal.timestamp == block.header.time
+            timestamp=block.header.time,
         )
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
@@ -616,7 +639,10 @@ class ConsensusState(Service):
 
     def _do_prevote(self, height: int, round: int) -> None:
         """state.go defaultDoPrevote: prevote locked block, else validate
-        the proposal and prevote it, else nil."""
+        the proposal and prevote it, else nil.  With PBTS enabled
+        (state.go:1440-1460), a fresh proposal (POLRound == -1) must carry
+        the block's own timestamp and arrive within the synchrony bounds,
+        or we prevote nil."""
         rs = self.rs
         if rs.locked_block is not None:
             self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header)
@@ -624,6 +650,33 @@ class ConsensusState(Service):
         if rs.proposal_block is None:
             self._sign_add_vote(PREVOTE_TYPE, b"", None)
             return
+        if rs.proposal is not None and self.state.consensus_params.feature.pbts_enabled(
+            height
+        ):
+            # EVERY proposal must carry the block's own time under PBTS;
+            # only the timeliness window is restricted to fresh proposals
+            # (POLRound == -1) — a re-proposed POL'd block was already
+            # judged timely in its original round (state.go:1440-1460)
+            if rs.proposal.timestamp.unix_ns() != rs.proposal_block.header.time.unix_ns():
+                self.logger.info(
+                    "prevote: proposal timestamp != block time; prevoting nil"
+                )
+                self._sign_add_vote(PREVOTE_TYPE, b"", None)
+                return
+            if rs.proposal.pol_round == -1:
+                sp = self.state.consensus_params.synchrony.in_round(
+                    rs.proposal.round
+                )
+                if not rs.proposal.is_timely(rs.proposal_receive_time_ns, sp):
+                    self.logger.info(
+                        f"prevote: proposal not timely "
+                        f"(ts={rs.proposal.timestamp.unix_ns()} "
+                        f"recv={rs.proposal_receive_time_ns} "
+                        f"delay={sp.message_delay_ns} prec={sp.precision_ns}); "
+                        "prevoting nil"
+                    )
+                    self._sign_add_vote(PREVOTE_TYPE, b"", None)
+                    return
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
             accepted = self.block_exec.process_proposal(rs.proposal_block, self.state)

@@ -68,6 +68,25 @@ class SynchronyParams:
     precision_ns: int = 505 * _MS_NS  # params.go:225
     message_delay_ns: int = 15 * _SEC_NS
 
+    MAX_MESSAGE_DELAY_NS = 24 * 3600 * _SEC_NS  # params.go:39
+
+    def in_round(self, round: int) -> "SynchronyParams":
+        """Adaptive relaxation: MessageDelay grows 10% per round so an
+        honest proposal eventually counts as timely (params.go:159)."""
+        if round <= 0:
+            return self
+        # cap in float space first: 1.1**round overflows float range near
+        # round ~7450, and int() of an inf raises
+        scaled = (1.1 ** min(round, 1000)) * float(self.message_delay_ns)
+        d = (
+            self.MAX_MESSAGE_DELAY_NS
+            if scaled >= self.MAX_MESSAGE_DELAY_NS
+            else int(scaled)
+        )
+        return SynchronyParams(
+            precision_ns=self.precision_ns, message_delay_ns=d
+        )
+
     def validate(self) -> None:
         if self.precision_ns < 0 or self.message_delay_ns < 0:
             raise ValueError("synchrony params must be non-negative")

@@ -37,6 +37,17 @@ class Proposal:
             self.timestamp,
         )
 
+    def is_timely(self, recv_time_ns: int, sp) -> bool:
+        """PBTS timeliness (proposal.go:97):
+        timestamp - Precision <= receive_time <= timestamp + MessageDelay
+        + Precision."""
+        ts = self.timestamp.unix_ns()
+        return (
+            ts - sp.precision_ns
+            <= recv_time_ns
+            <= ts + sp.message_delay_ns + sp.precision_ns
+        )
+
     def validate_basic(self) -> None:
         if self.height < 0:
             raise ValueError("negative Height")

@@ -22,6 +22,10 @@ class MsgInfoProto(Message):
         Field(4, "block_part_height", "varint"),
         Field(5, "block_part_round", "varint"),
         Field(6, "peer_id", "string"),
+        # PBTS: proposal timeliness is judged by receive time, so replay
+        # must restore it (the reference persists ReceiveTime in its WAL
+        # msgInfo for the same reason)
+        Field(7, "receive_time_ns", "varint"),
     ]
 
 
