@@ -26,8 +26,12 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # when set (host:port), the node listens here for a remote signer
+    # instead of using the file privval (config.go PrivValidatorListenAddr)
+    priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     log_level: str = "info"
+    tx_index: str = "kv"  # "kv" | "null" (config.go TxIndexConfig)
 
 
 @dataclass
@@ -35,6 +39,10 @@ class P2PConfig:
     laddr: str = "tcp://0.0.0.0:26656"
     external_address: str = ""
     persistent_peers: str = ""  # comma-separated id@host:port
+    seeds: str = ""  # comma-separated id@host:port
+    pex: bool = True
+    seed_mode: bool = False
+    addr_book_file: str = "config/addrbook.json"
     max_num_inbound_peers: int = 40
     max_num_outbound_peers: int = 10
     send_rate: int = 5_120_000  # bytes/sec (connection.go:40)

@@ -1,0 +1,14 @@
+"""Tx/block event indexing (reference: state/txindex, state/indexer)."""
+
+from .block import BlockIndexer, NullBlockIndexer
+from .service import IndexerService
+from .tx import NullTxIndexer, TxIndexer, tx_hash
+
+__all__ = [
+    "TxIndexer",
+    "NullTxIndexer",
+    "BlockIndexer",
+    "NullBlockIndexer",
+    "IndexerService",
+    "tx_hash",
+]

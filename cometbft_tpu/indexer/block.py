@@ -1,0 +1,78 @@
+"""KV block-event indexer (reference: state/indexer/block/kv/kv.go):
+heights searchable by the ABCI events their blocks emitted.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+
+from ..utils.pubsub import Query
+
+_REC = b"bkm/"
+_EVT = b"bke/"
+
+
+class BlockIndexer:
+    def __init__(self, db):
+        self.db = db
+        self._mtx = threading.Lock()
+
+    def has(self, height: int) -> bool:
+        return self.db.has(_REC + struct.pack(">q", height))
+
+    def index(self, height: int, events: dict[str, list[str]]) -> None:
+        hb = struct.pack(">q", height)
+        sets = [(_REC + hb, json.dumps(events).encode())]
+        for key, values in events.items():
+            for v in values:
+                sets.append(
+                    (_EVT + key.encode() + b"=" + v.encode() + b"/" + hb, hb)
+                )
+        with self._mtx:
+            self.db.write_batch(sets, [])
+
+    def search(self, query: Query | str, limit: int = 100) -> list[int]:
+        if isinstance(query, str):
+            query = Query(query)
+        out = []
+        for height in self._candidates(query):
+            raw = self.db.get(_REC + struct.pack(">q", height))
+            if raw is None:
+                continue
+            events = json.loads(raw)
+            events.setdefault("block.height", [str(height)])
+            if query.matches(events):
+                out.append(height)
+                if len(out) >= limit:
+                    break
+        return sorted(out)
+
+    def _candidates(self, query: Query):
+        for key, op, val in query.conditions:
+            if op == "=" and key != "block.height":
+                prefix = _EVT + key.encode() + b"=" + val.encode() + b"/"
+                return sorted(
+                    {
+                        struct.unpack(">q", v)[0]
+                        for _, v in self.db.iterator(prefix, prefix + b"\xff")
+                    }
+                )
+            if key == "block.height" and op == "=":
+                return [int(val)]
+        return sorted(
+            struct.unpack(">q", k[len(_REC):])[0]
+            for k, _ in self.db.iterator(_REC, _REC + b"\xff")
+        )
+
+
+class NullBlockIndexer:
+    def has(self, height: int) -> bool:
+        return False
+
+    def index(self, *a, **k) -> None:
+        pass
+
+    def search(self, query, limit: int = 100) -> list[int]:
+        return []

@@ -1,0 +1,67 @@
+"""Indexer service: subscribes to the EventBus and feeds the indexers
+(reference: state/txindex/indexer_service.go).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..types.event_bus import (
+    EventQueryNewBlockEvents,
+    EventQueryTx,
+    abci_events_to_map,
+)
+from ..utils.log import get_logger
+from ..utils.service import Service
+
+
+class IndexerService(Service):
+    def __init__(self, tx_indexer, block_indexer, event_bus):
+        super().__init__("IndexerService")
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self.logger = get_logger("indexer")
+        self._threads: list[threading.Thread] = []
+
+    def on_start(self) -> None:
+        tx_sub = self.event_bus.subscribe("indexer-tx", EventQueryTx)
+        blk_sub = self.event_bus.subscribe("indexer-blk", EventQueryNewBlockEvents)
+        for name, sub, fn in (
+            ("indexer-tx", tx_sub, self._index_tx),
+            ("indexer-blk", blk_sub, self._index_block),
+        ):
+            t = threading.Thread(
+                target=self._pump, args=(sub, fn), daemon=True, name=name
+            )
+            t.start()
+            self._threads.append(t)
+
+    def on_stop(self) -> None:
+        self.event_bus.pubsub.unsubscribe_all("indexer-tx")
+        self.event_bus.pubsub.unsubscribe_all("indexer-blk")
+
+    def _pump(self, sub, fn) -> None:
+        while self.is_running():
+            try:
+                msg, events = sub.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                fn(msg, events)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"indexing failed: {e}")
+
+    def _index_tx(self, msg, events) -> None:
+        d = msg.data
+        self.tx_indexer.index(
+            d["height"], d["index"], d["tx"], d["result"],
+            abci_events_to_map(d["result"].events or []),
+        )
+
+    def _index_block(self, msg, events) -> None:
+        d = msg.data
+        self.block_indexer.index(
+            d["height"], abci_events_to_map(d["events"] or [])
+        )

@@ -1,0 +1,134 @@
+"""KV transaction indexer (reference: state/txindex/kv/kv.go).
+
+Each committed tx is stored under its hash, with secondary index keys per
+ABCI event attribute ("type.key=value") and height so searches narrow to
+candidates by range scan before full predicate matching (the same
+two-phase shape as the reference; the match predicate reuses the pubsub
+query language).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import threading
+
+from ..crypto import hash as tmhash
+from ..utils.pubsub import Query
+
+_REC = b"txm/"
+_EVT = b"txe/"
+_HGT = b"txh/"
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return tmhash.sum(tx)
+
+
+class TxIndexer:
+    def __init__(self, db):
+        self.db = db
+        self._mtx = threading.Lock()
+
+    # ------------------------------------------------------------- writes
+
+    def index(
+        self, height: int, index: int, tx: bytes, result, events: dict[str, list[str]]
+    ) -> None:
+        """Store the tx result + event index entries."""
+        h = tx_hash(tx)
+        record = {
+            "height": height,
+            "index": index,
+            "tx": base64.b64encode(tx).decode(),
+            "result": {
+                "code": result.code,
+                "data": base64.b64encode(result.data or b"").decode(),
+                "log": result.log,
+                "gas_wanted": getattr(result, "gas_wanted", 0),
+                "gas_used": getattr(result, "gas_used", 0),
+                "codespace": getattr(result, "codespace", ""),
+            },
+            "events": events,
+        }
+        sets = [(_REC + h, json.dumps(record).encode())]
+        suffix = struct.pack(">qi", height, index)
+        sets.append((_HGT + suffix + b"/" + h, h))
+        for key, values in events.items():
+            for v in values:
+                sets.append(
+                    (
+                        _EVT + key.encode() + b"=" + v.encode() + b"/" + suffix + b"/" + h,
+                        h,
+                    )
+                )
+        with self._mtx:
+            self.db.write_batch(sets, [])
+
+    # -------------------------------------------------------------- reads
+
+    def get(self, h: bytes) -> dict | None:
+        raw = self.db.get(_REC + h)
+        return json.loads(raw) if raw else None
+
+    def search(self, query: Query | str, limit: int = 100) -> list[dict]:
+        """Two-phase search: candidate narrowing on the first usable
+        condition, then full predicate match (kv.go Search)."""
+        if isinstance(query, str):
+            query = Query(query)
+        candidates = self._candidates(query)
+        out = []
+        for h in candidates:
+            rec = self.get(h)
+            if rec is None:
+                continue
+            events = dict(rec["events"])
+            events.setdefault("tx.height", [str(rec["height"])])
+            events.setdefault("tx.hash", [h.hex().upper()])
+            if query.matches(events):
+                out.append(rec)
+                if len(out) >= limit:
+                    break
+        out.sort(key=lambda r: (r["height"], r["index"]))
+        return out
+
+    def _candidates(self, query: Query):
+        for key, op, val in query.conditions:
+            if op == "=" and key not in ("tx.height", "tx.hash"):
+                prefix = _EVT + key.encode() + b"=" + val.encode() + b"/"
+                return self._dedup(
+                    v for _, v in self.db.iterator(prefix, prefix + b"\xff")
+                )
+            if key == "tx.hash" and op == "=":
+                return [bytes.fromhex(val)]
+            if key == "tx.height" and op == "=":
+                prefix = _HGT + struct.pack(">q", int(val))
+                return self._dedup(
+                    v for _, v in self.db.iterator(prefix, prefix + b"\xff")
+                )
+        # no indexable condition: scan everything
+        return self._dedup(
+            k[len(_REC):] for k, _ in self.db.iterator(_REC, _REC + b"\xff")
+        )
+
+    @staticmethod
+    def _dedup(it):
+        seen = set()
+        out = []
+        for h in it:
+            if h not in seen:
+                seen.add(h)
+                out.append(h)
+        return out
+
+
+class NullTxIndexer:
+    def index(self, *a, **k) -> None:
+        pass
+
+    def get(self, h: bytes):
+        return None
+
+    def search(self, query, limit: int = 100) -> list:
+        return []

@@ -99,15 +99,54 @@ class Node:
         self.app_conns = new_app_conns(client_creator or make_app(config))
         self.app_conns.start()
 
-        # ---- event bus (setup.go:188)
+        # ---- event bus + indexers (setup.go:188,197)
         self.event_bus = EventBus()
-
-        # ---- privval (node.go:388; file-based — remote signer is a
-        # client_creator-style extension point)
-        self.priv_validator = FilePV.load_or_generate(
-            config.priv_validator_key_file(),
-            config.priv_validator_state_file(),
+        from .indexer import (
+            BlockIndexer,
+            IndexerService,
+            NullBlockIndexer,
+            NullTxIndexer,
+            TxIndexer,
         )
+
+        if config.base.tx_index == "kv":
+            self.tx_indexer = TxIndexer(PrefixDB(self.db, b"txi/"))
+            self.block_indexer = BlockIndexer(PrefixDB(self.db, b"bli/"))
+        else:
+            self.tx_indexer = NullTxIndexer()
+            self.block_indexer = NullBlockIndexer()
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus
+        )
+
+        # ---- privval (node.go:388): file-based, or a remote signer
+        # dialing into priv_validator_laddr
+        self.signer_endpoint = None
+        if config.base.priv_validator_laddr:
+            from .privval import (
+                RetrySignerClient,
+                SignerClient,
+                SignerListenerEndpoint,
+            )
+
+            laddr = _strip_tcp(config.base.priv_validator_laddr)
+            self.node_key = NodeKey.load_or_gen(config.node_key_file())
+            self.signer_endpoint = SignerListenerEndpoint(
+                laddr, identity_key=self.node_key.priv_key
+            )
+            self.logger.info(
+                f"waiting for remote signer on {self.signer_endpoint.listen_addr}"
+            )
+            if not self.signer_endpoint.wait_for_signer(30.0):
+                raise RuntimeError("remote signer never connected")
+            self.priv_validator = RetrySignerClient(
+                SignerClient(self.signer_endpoint, genesis.chain_id)
+            )
+        else:
+            self.priv_validator = FilePV.load_or_generate(
+                config.priv_validator_key_file(),
+                config.priv_validator_state_file(),
+            )
 
         # ---- statesync decision (node.go:403): enabled + fresh node only
         self.statesync_enabled = (
@@ -216,12 +255,35 @@ class Node:
             moniker=config.base.moniker,
         )
         self.transport = TCPTransport(self.node_key, self.node_info)
-        self.switch = Switch(self.transport)
+        self.switch = Switch(
+            self.transport,
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+        )
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
+        # ---- PEX + address book (setup.go:547)
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from .p2p.pex import AddrBook, PexReactor
+
+            self.addr_book = AddrBook(config._abs(config.p2p.addr_book_file))
+            for addr in (config.p2p.seeds or "").split(","):
+                if addr.strip():
+                    self.addr_book.add_address(addr.strip(), src="config")
+            for addr in (config.p2p.persistent_peers or "").split(","):
+                if addr.strip():
+                    self.addr_book.add_address(addr.strip(), src="config")
+            self.pex_reactor = PexReactor(
+                self.addr_book,
+                seed_mode=config.p2p.seed_mode,
+                target_outbound=config.p2p.max_num_outbound_peers,
+            )
+            self.switch.add_reactor("PEX", self.pex_reactor)
 
         self.listen_addr: str | None = None
         self.rpc_server = None  # attached by start() when configured
@@ -266,6 +328,7 @@ class Node:
 
     def start(self) -> None:
         """node.go:598 OnStart."""
+        self.indexer_service.start()
         self.listen_addr = self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.switch.start()
         peers = [
@@ -289,6 +352,8 @@ class Node:
                 self.rpc_server.start(_strip_tcp(self.config.rpc.laddr))
             except ImportError:
                 pass
+        if self.pex_reactor is not None:
+            self.addr_book.save()
         self.logger.info(
             f"node {self.node_key.id()[:8]} started: p2p {self.listen_addr}"
         )
@@ -303,6 +368,15 @@ class Node:
             self.switch.stop()
         except Exception:  # noqa: BLE001
             pass
+        if self.indexer_service.is_running():
+            self.indexer_service.stop()
+        if self.signer_endpoint is not None:
+            self.signer_endpoint.close()
+        if self.pex_reactor is not None:
+            try:
+                self.addr_book.save()  # keep PEX-learned peers for restart
+            except Exception:  # noqa: BLE001
+                pass
         self.app_conns.stop()
 
     def is_running(self) -> bool:

@@ -30,6 +30,8 @@ DEFAULT_RECV_MESSAGE_CAPACITY = 22020096  # 21MB (connection.go)
 FLUSH_THROTTLE = 0.010  # 10ms (connection.go:38)
 PING_INTERVAL = 60.0
 PONG_TIMEOUT = 45.0
+SEND_RATE = 5_120_000  # bytes/sec (connection.go:40 defaultSendRate)
+RECV_RATE = 5_120_000  # bytes/sec (connection.go:41 defaultRecvRate)
 
 
 @dataclass
@@ -90,6 +92,8 @@ class MConnection(Service):
         flush_throttle: float = FLUSH_THROTTLE,
         ping_interval: float = PING_INTERVAL,
         pong_timeout: float = PONG_TIMEOUT,
+        send_rate: int = SEND_RATE,
+        recv_rate: int = RECV_RATE,
     ):
         super().__init__("MConnection")
         self.conn = conn
@@ -99,6 +103,12 @@ class MConnection(Service):
         self.flush_throttle = flush_throttle
         self.ping_interval = ping_interval
         self.pong_timeout = pong_timeout
+        # flow control (connection.go:40-41): one noisy peer must not
+        # saturate the node; throttling blocks the per-conn IO threads only
+        from ...utils.flowrate import Limiter
+
+        self.send_monitor = Limiter(send_rate)
+        self.recv_monitor = Limiter(recv_rate)
         self.logger = get_logger("mconn")
         self._send_signal = threading.Event()
         self._pong_pending = threading.Event()
@@ -193,6 +203,7 @@ class MConnection(Service):
                         break
                     out += self._frame(p2p_pb.Packet(msg=pkt))
                 if out:
+                    self.send_monitor.throttle(len(out))
                     self.conn.write(bytes(out))
                     del out[:]
                 # decay the ratio counters so long-lived conns stay fair
@@ -243,6 +254,7 @@ class MConnection(Service):
         if length > MAX_PACKET_WIRE_SIZE:
             raise ValueError(f"packet length {length} exceeds cap")
         payload = self._read_exact(length)
+        self.recv_monitor.throttle(len(prefix) + length)
         return p2p_pb.Packet.decode(payload)
 
     def _read_exact(self, n: int) -> bytes:

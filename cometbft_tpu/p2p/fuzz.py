@@ -1,0 +1,72 @@
+"""Probabilistic connection fuzzer for resilience testing
+(reference: p2p/internal/fuzz/fuzz.go).
+
+Wraps any duplex conn (write/read/close) and randomly delays, drops, or
+corrupts traffic.  Used by tests to confirm that peers survive (or
+cleanly drop) garbage links — never in production paths.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+MODE_DROP = "drop"
+MODE_DELAY = "delay"
+MODE_CORRUPT = "corrupt"
+
+
+class FuzzedConnection:
+    def __init__(
+        self,
+        conn,
+        prob_drop_rw: float = 0.0,
+        prob_corrupt: float = 0.0,
+        prob_sleep: float = 0.0,
+        max_sleep: float = 0.1,
+        start_after: float = 0.0,
+        seed: int | None = None,
+    ):
+        self.conn = conn
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_corrupt = prob_corrupt
+        self.prob_sleep = prob_sleep
+        self.max_sleep = max_sleep
+        self._active_at = time.monotonic() + start_after
+        self._rng = random.Random(seed)
+
+    def _fuzzing(self) -> bool:
+        return time.monotonic() >= self._active_at
+
+    def _maybe_sleep(self) -> None:
+        if self.prob_sleep and self._rng.random() < self.prob_sleep:
+            time.sleep(self._rng.uniform(0, self.max_sleep))
+
+    def _maybe_corrupt(self, data: bytes) -> bytes:
+        if self.prob_corrupt and self._rng.random() < self.prob_corrupt and data:
+            i = self._rng.randrange(len(data))
+            flipped = bytes([data[i] ^ (1 << self._rng.randrange(8))])
+            return data[:i] + flipped + data[i + 1:]
+        return data
+
+    # ------------------------------------------------------------- duplex
+
+    def write(self, data: bytes):
+        if self._fuzzing():
+            if self.prob_drop_rw and self._rng.random() < self.prob_drop_rw:
+                return len(data)  # silently swallowed
+            self._maybe_sleep()
+            data = self._maybe_corrupt(data)
+        return self.conn.write(data)
+
+    def read(self, n: int) -> bytes:
+        data = self.conn.read(n)
+        if self._fuzzing():
+            if self.prob_drop_rw and self._rng.random() < self.prob_drop_rw:
+                return b""  # reads as a closed/idle conn
+            self._maybe_sleep()
+            data = self._maybe_corrupt(data)
+        return data
+
+    def close(self) -> None:
+        self.conn.close()

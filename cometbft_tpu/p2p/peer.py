@@ -27,6 +27,8 @@ class Peer(Service):
         on_error: Callable[["Peer", Exception], None],
         outbound: bool = False,
         persistent: bool = False,
+        send_rate: int | None = None,
+        recv_rate: int | None = None,
     ):
         super().__init__(f"peer-{node_info.node_id[:8]}")
         self.node_info = node_info
@@ -39,11 +41,17 @@ class Peer(Service):
         self.data: dict = {}  # reactor-attached per-peer state
         self._data_mtx = threading.Lock()
         self.logger = get_logger(f"peer.{node_info.node_id[:8]}")
+        extra = {}
+        if send_rate is not None:
+            extra["send_rate"] = send_rate
+        if recv_rate is not None:
+            extra["recv_rate"] = recv_rate
         self.mconn = MConnection(
             conn,
             stream_descs,
             on_receive=lambda sid, msg: on_receive(sid, self, msg),
             on_error=lambda e: on_error(self, e),
+            **extra,
         )
 
     @property

@@ -1,0 +1,6 @@
+"""Peer exchange (reference: p2p/pex/)."""
+
+from .addrbook import AddrBook, KnownAddress
+from .reactor import PEX_STREAM, PexReactor
+
+__all__ = ["AddrBook", "KnownAddress", "PexReactor", "PEX_STREAM"]

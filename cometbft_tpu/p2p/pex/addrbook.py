@@ -1,0 +1,220 @@
+"""Address book: known peer addresses in hashed new/old buckets
+(reference: p2p/pex/addrbook.go, 921 LoC).
+
+Same structure as the reference — addresses enter "new" buckets keyed by
+(source, address) hashing, get promoted to "old" buckets when a
+connection succeeds, and are evicted bucket-locally when full — with the
+file format simplified to one JSON document.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+BUCKET_SIZE = 64
+# how often a mostly-old book still answers with new addresses
+BIAS_TOWARDS_NEW = 0.3
+MAX_ATTEMPTS = 3
+
+
+@dataclass
+class KnownAddress:
+    """addrbook.go knownAddress."""
+
+    addr: str  # id@host:port
+    src: str  # peer id that told us
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"  # "new" | "old"
+    bucket: int = -1
+
+    @property
+    def peer_id(self) -> str:
+        return self.addr.split("@", 1)[0] if "@" in self.addr else ""
+
+    def is_bad(self) -> bool:
+        """Too many failed attempts without a success (knownAddress.isBad)."""
+        return self.attempts >= MAX_ATTEMPTS and self.last_success == 0
+
+
+class AddrBook:
+    def __init__(self, file_path: str = "", key: bytes | None = None):
+        self.file_path = file_path
+        self.key = key or os.urandom(24)
+        self._mtx = threading.Lock()
+        self._addrs: dict[str, KnownAddress] = {}  # peer id -> record
+        self._new: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]
+        self._old: list[set[str]] = [set() for _ in range(OLD_BUCKET_COUNT)]
+        self._rng = random.Random()
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # ------------------------------------------------------------- writes
+
+    def add_address(self, addr: str, src: str = "") -> bool:
+        """A peer (or config) told us about addr (addrbook.go AddAddress)."""
+        pid = addr.split("@", 1)[0] if "@" in addr else ""
+        if not pid or ":" not in addr:
+            return False
+        with self._mtx:
+            ka = self._addrs.get(pid)
+            if ka is not None:
+                if ka.bucket_type == "old":
+                    return False  # a vetted address sticks until it fails
+                if ka.addr != addr:
+                    # the peer moved: adopt the fresh address, reset history
+                    ka.addr = addr
+                    ka.src = src
+                    ka.attempts = 0
+                    return True
+                return False
+            ka = KnownAddress(addr=addr, src=src)
+            b = self._bucket_for(addr, src, NEW_BUCKET_COUNT)
+            ka.bucket = b
+            self._addrs[pid] = ka
+            self._evict_if_full(self._new[b], "new")
+            self._new[b].add(pid)
+            return True
+
+    def mark_attempt(self, addr: str) -> None:
+        with self._mtx:
+            ka = self._lookup(addr)
+            if ka:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+
+    def mark_good(self, addr: str) -> None:
+        """Successful handshake: promote to an old bucket
+        (addrbook.go MarkGood)."""
+        with self._mtx:
+            ka = self._lookup(addr)
+            if ka is None:
+                return
+            ka.attempts = 0
+            ka.last_success = time.time()
+            if ka.bucket_type == "new":
+                self._new[ka.bucket].discard(ka.peer_id)
+                b = self._bucket_for(ka.addr, "", OLD_BUCKET_COUNT)
+                self._evict_if_full(self._old[b], "old")
+                self._old[b].add(ka.peer_id)
+                ka.bucket_type, ka.bucket = "old", b
+
+    def mark_bad(self, addr: str) -> None:
+        with self._mtx:
+            ka = self._lookup(addr)
+            if ka is not None and ka.is_bad():
+                self._remove(ka)
+
+    def remove_address(self, addr: str) -> None:
+        with self._mtx:
+            ka = self._lookup(addr)
+            if ka is not None:
+                self._remove(ka)
+
+    # -------------------------------------------------------------- reads
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._addrs)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def pick_address(self, new_bias: float = BIAS_TOWARDS_NEW) -> str | None:
+        """Random address for dialing, biased between new/old
+        (addrbook.go PickAddress)."""
+        with self._mtx:
+            news = [a for a in self._addrs.values() if a.bucket_type == "new" and not a.is_bad()]
+            olds = [a for a in self._addrs.values() if a.bucket_type == "old" and not a.is_bad()]
+            pool = None
+            if news and (not olds or self._rng.random() < new_bias):
+                pool = news
+            elif olds:
+                pool = olds
+            if not pool:
+                return None
+            return self._rng.choice(pool).addr
+
+    def get_selection(self, max_count: int = 30) -> list[str]:
+        """Random selection to answer a PEX request
+        (addrbook.go GetSelection)."""
+        with self._mtx:
+            good = [a.addr for a in self._addrs.values() if not a.is_bad()]
+            self._rng.shuffle(good)
+            return good[:max_count]
+
+    def has(self, addr: str) -> bool:
+        with self._mtx:
+            return self._lookup(addr) is not None
+
+    # ---------------------------------------------------------- internals
+
+    def _lookup(self, addr: str) -> KnownAddress | None:
+        pid = addr.split("@", 1)[0] if "@" in addr else addr
+        return self._addrs.get(pid)
+
+    def _remove(self, ka: KnownAddress) -> None:
+        (self._new if ka.bucket_type == "new" else self._old)[ka.bucket].discard(
+            ka.peer_id
+        )
+        self._addrs.pop(ka.peer_id, None)
+
+    def _bucket_for(self, addr: str, src: str, n: int) -> int:
+        h = hashlib.sha256(self.key + addr.encode() + b"|" + src.encode()).digest()
+        return int.from_bytes(h[:8], "big") % n
+
+    def _evict_if_full(self, bucket: set[str], kind: str) -> None:
+        if len(bucket) < BUCKET_SIZE:
+            return
+        # evict the worst: bad first, then oldest attempt
+        members = [self._addrs[p] for p in bucket if p in self._addrs]
+        members.sort(key=lambda a: (not a.is_bad(), a.last_success, -a.attempts))
+        victim = members[0]
+        bucket.discard(victim.peer_id)
+        self._addrs.pop(victim.peer_id, None)
+
+    # ---------------------------------------------------------- persistence
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        with self._mtx:
+            data = {
+                "key": self.key.hex(),
+                "addrs": [
+                    {
+                        "addr": a.addr,
+                        "src": a.src,
+                        "attempts": a.attempts,
+                        "last_success": a.last_success,
+                        "bucket_type": a.bucket_type,
+                    }
+                    for a in self._addrs.values()
+                ],
+            }
+        os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        with open(self.file_path, "w") as f:
+            json.dump(data, f)
+
+    def _load(self) -> None:
+        try:
+            with open(self.file_path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        self.key = bytes.fromhex(data.get("key", self.key.hex()))
+        for rec in data.get("addrs", []):
+            self.add_address(rec["addr"], rec.get("src", ""))
+            ka = self._lookup(rec["addr"])
+            if ka and rec.get("bucket_type") == "old":
+                self.mark_good(rec["addr"])
+                ka.last_success = rec.get("last_success", time.time())

@@ -1,0 +1,170 @@
+"""PEX reactor: peer-address exchange + dialing to keep the switch full
+(reference: p2p/pex/pex_reactor.go).
+
+Every peer gets asked for addresses on an interval; requests are
+answered from the address book; an ensure-peers loop dials book picks
+while the switch is below its outbound target.  Seed-mode crawling is a
+config flag on the same machinery: answer and hang up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ...utils.log import get_logger
+from ...wire import p2p_pb as pb
+from ..conn.connection import StreamDescriptor
+from ..reactor import Reactor
+from .addrbook import AddrBook
+
+PEX_STREAM = 0x00
+
+REQUEST_INTERVAL = 120.0  # pex_reactor.go defaultEnsurePeersPeriod-ish
+ENSURE_PEERS_PERIOD = 30.0
+MIN_REQUEST_INTERVAL = 20.0  # rate-limit incoming requests per peer
+
+
+class PexReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        seed_mode: bool = False,
+        ensure_period: float = ENSURE_PEERS_PERIOD,
+        request_interval: float = REQUEST_INTERVAL,
+        target_outbound: int = 10,
+    ):
+        super().__init__("PexReactor")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.ensure_period = ensure_period
+        self.request_interval = request_interval
+        self.target_outbound = target_outbound
+        self.logger = get_logger("pex")
+        self._last_request_from: dict[str, float] = {}
+        self._requested: set[str] = set()
+        self._mtx = threading.Lock()
+
+    def stream_descriptors(self) -> list[StreamDescriptor]:
+        return [StreamDescriptor(id=PEX_STREAM, priority=1, send_queue_capacity=10)]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def on_start(self) -> None:
+        threading.Thread(
+            target=self._ensure_peers_routine, daemon=True, name="pex-ensure"
+        ).start()
+
+    # --------------------------------------------------------------- peers
+
+    def add_peer(self, peer) -> None:
+        # learn the peer's self-reported address; dialed peers are vetted
+        addr = peer.get("dial_addr")
+        if addr:
+            self.book.add_address(addr, src=peer.id)
+            self.book.mark_good(addr)
+        elif peer.node_info.listen_addr:
+            # inbound peer: record its claimed listen address as unvetted
+            host = peer.node_info.listen_addr
+            host = host[len("tcp://"):] if host.startswith("tcp://") else host
+            if not host.startswith("0.0.0.0") and ":" in host:
+                self.book.add_address(f"{peer.id}@{host}", src=peer.id)
+        if peer.has_channel(PEX_STREAM):
+            threading.Thread(
+                target=self._request_routine, args=(peer,), daemon=True
+            ).start()
+
+    def remove_peer(self, peer, reason: str = "") -> None:
+        with self._mtx:
+            self._last_request_from.pop(peer.id, None)
+            self._requested.discard(peer.id)
+
+    # ------------------------------------------------------------- receive
+
+    def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
+        msg = pb.PexMessage.decode(msg_bytes)
+        if msg.pex_request is not None:
+            now = time.monotonic()
+            with self._mtx:
+                last = self._last_request_from.get(peer.id, 0.0)
+                if now - last < MIN_REQUEST_INTERVAL:
+                    self.logger.info(f"peer {peer.id[:8]} over-requests PEX")
+                    return
+                self._last_request_from[peer.id] = now
+            selection = self.book.get_selection()
+            peer.try_send(
+                PEX_STREAM,
+                pb.PexMessage(
+                    pex_addrs=pb.PexAddrs(
+                        addrs=[pb.PexAddress(url=a) for a in selection]
+                    )
+                ).encode(),
+            )
+            if self.seed_mode and self.switch is not None:
+                # seeds serve addresses then disconnect (pex_reactor.go
+                # seed mode)
+                self.switch.stop_peer(peer, "seed: served addresses")
+        elif msg.pex_addrs is not None:
+            with self._mtx:
+                solicited = peer.id in self._requested
+                self._requested.discard(peer.id)
+            if not solicited:
+                return  # unsolicited address dumps are spam
+            for a in msg.pex_addrs.addrs or []:
+                if a.url:
+                    self.book.add_address(a.url, src=peer.id)
+
+    # ------------------------------------------------------------ routines
+
+    def _request_routine(self, peer) -> None:
+        while self.is_running() and peer.is_running():
+            with self._mtx:
+                self._requested.add(peer.id)
+            peer.try_send(
+                PEX_STREAM,
+                pb.PexMessage(pex_request=pb.PexRequest()).encode(),
+            )
+            deadline = time.monotonic() + self.request_interval
+            while time.monotonic() < deadline:
+                if not (self.is_running() and peer.is_running()):
+                    return
+                time.sleep(0.5)
+
+    def _ensure_peers_routine(self) -> None:
+        """Dial book addresses while below the outbound target
+        (pex_reactor.go ensurePeers)."""
+        while self.is_running():
+            try:
+                self._ensure_peers()
+                self.book.save()  # addrbook.go dumpAddressInterval
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"ensure peers: {e}")
+            deadline = time.monotonic() + self.ensure_period
+            while time.monotonic() < deadline:
+                if not self.is_running():
+                    return
+                time.sleep(0.5)
+
+    def _ensure_peers(self) -> None:
+        if self.switch is None:
+            return
+        out = sum(1 for p in self.switch.peers.list() if p.outbound)
+        need = self.target_outbound - out
+        if need <= 0:
+            return
+        connected = {p.id for p in self.switch.peers.list()}
+        tried = set()
+        for _ in range(need * 3):
+            addr = self.book.pick_address()
+            if addr is None or addr in tried:
+                break
+            tried.add(addr)
+            pid = addr.split("@", 1)[0]
+            if pid in connected or pid == self.switch.transport.node_key.id():
+                continue
+            self.logger.info(f"pex dialing {addr}")
+            self.book.mark_attempt(addr)
+            self.switch.dial_peer_async(addr)
+            need -= 1
+            if need <= 0:
+                break

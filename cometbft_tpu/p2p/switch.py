@@ -29,7 +29,15 @@ class SwitchError(Exception):
 
 
 class Switch(Service):
-    def __init__(self, transport: TCPTransport, max_peers: int = MAX_PEERS):
+    def __init__(
+        self,
+        transport: TCPTransport,
+        max_peers: int = MAX_PEERS,
+        send_rate: int | None = None,
+        recv_rate: int | None = None,
+    ):
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         super().__init__("Switch")
         self.transport = transport
         self.reactors: dict[str, Reactor] = {}
@@ -181,6 +189,8 @@ class Switch(Service):
             on_error=self._on_peer_error,
             outbound=outbound,
             persistent=persistent,
+            send_rate=self.send_rate,
+            recv_rate=self.recv_rate,
         )
         if addr:
             peer.set("dial_addr", addr)

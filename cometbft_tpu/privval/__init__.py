@@ -1,5 +1,12 @@
 """Validator signing with double-sign protection (reference: privval/)."""
 
+from .signer import (
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
 from .file_pv import (
     FilePV,
     FilePVKey,
@@ -11,6 +18,11 @@ from .file_pv import (
 )
 
 __all__ = [
+    "SignerListenerEndpoint",
+    "SignerClient",
+    "RetrySignerClient",
+    "SignerServer",
+    "RemoteSignerError",
     "FilePV",
     "FilePVKey",
     "FilePVLastSignState",

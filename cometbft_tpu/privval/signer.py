@@ -1,0 +1,444 @@
+"""Remote signer: validator keys in a separate process/HSM
+(reference: privval/signer_listener_endpoint.go, signer_client.go,
+signer_server.go, retry_signer_client.go).
+
+Topology matches the reference: the NODE listens on
+priv_validator_laddr; the SIGNER dials in and serves signing requests
+over varint-delimited protobuf.  SignerClient implements the
+PrivValidator surface (get_pub_key / sign_vote / sign_proposal) against
+the connected signer; the HRS double-sign protection lives with the key
+holder (the signer's FilePV), exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..utils.log import get_logger
+from ..wire import privval_pb as pb
+from ..wire.proto import encode_varint
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def decode_varint_stream(conn) -> int | None:
+    """Read one varint length prefix off a conn (protoio reader)."""
+    shift, out = 0, 0
+    while True:
+        b = conn.read(1)
+        if not b:
+            return None
+        out |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            return out
+        shift += 7
+        if shift > 63:
+            raise RemoteSignerError("varint overflow")
+
+
+def _send_msg(conn, msg: pb.PrivvalMessage) -> None:
+    raw = msg.encode()
+    conn.write(encode_varint(len(raw)) + raw)
+
+
+def _recv_msg(conn) -> pb.PrivvalMessage | None:
+    n = decode_varint_stream(conn)
+    if n is None:
+        return None
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return pb.PrivvalMessage.decode(buf)
+
+
+class _PlainConn:
+    """socket -> read/write duplex (unix-socket style deployments where
+    filesystem permissions are the auth boundary)."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def write(self, data: bytes):
+        self._sock.sendall(data)
+        return len(data)
+
+    def read(self, n: int) -> bytes:
+        return self._sock.recv(n)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class SignerListenerEndpoint:
+    """Node side: accept the signer's inbound connection and do locked
+    request/response over it (signer_listener_endpoint.go).
+
+    With identity_key set, every inbound connection runs the STS
+    handshake (SecretConnection, like the reference's tcp:// listeners)
+    and, when authorized_keys is given, the signer's identity pubkey must
+    be in it — an unauthorized dialer cannot displace the real signer."""
+
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 5.0,
+        ping_period: float = 10.0,
+        identity_key=None,
+        authorized_keys: list[bytes] | None = None,
+    ):
+        host, _, port = addr.rpartition(":")
+        self._listener = socket.create_server((host or "127.0.0.1", int(port)))
+        self.listen_addr = (
+            f"{self._listener.getsockname()[0]}:{self._listener.getsockname()[1]}"
+        )
+        self.timeout = timeout
+        self.ping_period = ping_period
+        self.identity_key = identity_key
+        self.authorized_keys = authorized_keys
+        self.logger = get_logger("privval-listener")
+        if identity_key is None:
+            self.logger.error(
+                "privval listener running UNENCRYPTED: use identity_key "
+                "(SecretConnection) for anything beyond localhost tests"
+            )
+        self._mtx = threading.Lock()
+        self._conn = None
+        self._conn_ready = threading.Event()
+        self._stopped = False
+        threading.Thread(target=self._accept_routine, daemon=True).start()
+        threading.Thread(target=self._ping_routine, daemon=True).start()
+
+    def _accept_routine(self) -> None:
+        while not self._stopped:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.settimeout(self.timeout)
+            try:
+                conn = self._secure(sock)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"signer handshake rejected: {e}")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            with self._mtx:
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                self._conn = conn
+            self._conn_ready.set()
+            self.logger.info("remote signer connected")
+
+    def _secure(self, sock: socket.socket):
+        if self.identity_key is None:
+            return _PlainConn(sock)
+        from ..p2p.conn.secret_connection import make_secret_connection
+
+        conn = make_secret_connection(sock, self.identity_key)
+        if self.authorized_keys is not None and (
+            conn.remote_pub.data not in self.authorized_keys
+        ):
+            conn.close()
+            raise RemoteSignerError(
+                f"signer identity {conn.remote_pub.data.hex()[:16]} not in "
+                "the authorized key list"
+            )
+        return conn
+
+    def _ping_routine(self) -> None:
+        while not self._stopped:
+            time.sleep(self.ping_period)
+            try:
+                self.request(pb.PrivvalMessage(ping_request=pb.PingRequest()))
+            except RemoteSignerError:
+                pass
+
+    def wait_for_signer(self, timeout: float = 30.0) -> bool:
+        return self._conn_ready.wait(timeout)
+
+    def request(self, msg: pb.PrivvalMessage) -> pb.PrivvalMessage:
+        with self._mtx:
+            conn = self._conn
+            if conn is None:
+                raise RemoteSignerError("no signer connected")
+            try:
+                _send_msg(conn, msg)
+                resp = _recv_msg(conn)
+            except OSError as e:
+                self._drop(conn)
+                raise RemoteSignerError(f"signer connection failed: {e}") from e
+            if resp is None:
+                self._drop(conn)
+                raise RemoteSignerError("signer connection closed")
+            return resp
+
+    def _drop(self, conn) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if self._conn is conn:
+            self._conn = None
+            self._conn_ready.clear()
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mtx:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+class SignerClient:
+    """PrivValidator over a SignerListenerEndpoint (signer_client.go)."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._pub_key = None
+
+    # PrivValidator surface -------------------------------------------------
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            resp = self.endpoint.request(
+                pb.PrivvalMessage(
+                    pub_key_request=pb.PubKeyRequest(chain_id=self.chain_id)
+                )
+            )
+            r = resp.pub_key_response
+            if r is None:
+                raise RemoteSignerError(f"unexpected response {resp.which()}")
+            if r.error is not None:
+                raise RemoteSignerError(r.error.description)
+            from ..crypto import ed25519
+
+            self._pub_key = ed25519.PubKey(r.pub_key_bytes)
+        return self._pub_key
+
+    # `key` facade so ConsensusState's address lookups keep working
+    @property
+    def key(self):
+        class _K:
+            priv_key = None
+
+            def __init__(k, pub):
+                k.pub = pub
+
+        pub = self.get_pub_key()
+
+        class _PK:
+            def pub_key(self):
+                return pub
+
+        k = _K(pub)
+        k.priv_key = _PK()
+        return k
+
+    def sign_vote(self, chain_id: str, vote: Vote, sign_extension: bool = False) -> None:
+        resp = self.endpoint.request(
+            pb.PrivvalMessage(
+                sign_vote_request=pb.SignVoteRequest(
+                    vote=vote.to_proto(),
+                    chain_id=chain_id,
+                    skip_extension_signing=not sign_extension,
+                )
+            )
+        )
+        r = resp.signed_vote_response
+        if r is None:
+            raise RemoteSignerError(f"unexpected response {resp.which()}")
+        if r.error is not None:
+            raise RemoteSignerError(r.error.description)
+        signed = Vote.from_proto(r.vote)
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+        vote.extension_signature = signed.extension_signature
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        resp = self.endpoint.request(
+            pb.PrivvalMessage(
+                sign_proposal_request=pb.SignProposalRequest(
+                    proposal=proposal.to_proto(), chain_id=chain_id
+                )
+            )
+        )
+        r = resp.signed_proposal_response
+        if r is None:
+            raise RemoteSignerError(f"unexpected response {resp.which()}")
+        if r.error is not None:
+            raise RemoteSignerError(r.error.description)
+        signed = Proposal.from_proto(r.proposal)
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+
+class RetrySignerClient:
+    """Retrying facade (retry_signer_client.go)."""
+
+    def __init__(self, client: SignerClient, retries: int = 5, delay: float = 0.2):
+        self.client = client
+        self.retries = retries
+        self.delay = delay
+
+    def _retry(self, fn, *args, **kwargs):
+        last = None
+        for _ in range(self.retries):
+            try:
+                return fn(*args, **kwargs)
+            except RemoteSignerError as e:
+                last = e
+                time.sleep(self.delay)
+        raise last
+
+    def get_pub_key(self):
+        return self._retry(self.client.get_pub_key)
+
+    @property
+    def key(self):
+        return self.client.key
+
+    def sign_vote(self, chain_id, vote, sign_extension=False):
+        return self._retry(self.client.sign_vote, chain_id, vote, sign_extension)
+
+    def sign_proposal(self, chain_id, proposal):
+        return self._retry(self.client.sign_proposal, chain_id, proposal)
+
+
+class SignerServer:
+    """Signer side: dial the node and serve its requests against a local
+    FilePV (signer_server.go + signer_dialer_endpoint.go)."""
+
+    def __init__(self, addr: str, chain_id: str, priv_validator, identity_key=None):
+        self.addr = addr
+        self.chain_id = chain_id
+        self.pv = priv_validator
+        # identity for the SecretConnection handshake; defaults to the
+        # validator key itself (operators can use a dedicated conn key)
+        self.identity_key = identity_key
+        self.logger = get_logger("signer-server")
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _run(self) -> None:
+        while not self._stopped:
+            try:
+                host, _, port = self.addr.rpartition(":")
+                sock = socket.create_connection((host or "127.0.0.1", int(port)), 5.0)
+            except OSError:
+                time.sleep(0.5)
+                continue
+            self.logger.info(f"connected to node at {self.addr}")
+            try:
+                if self.identity_key is not None:
+                    from ..p2p.conn.secret_connection import (
+                        make_secret_connection,
+                    )
+
+                    conn = make_secret_connection(sock, self.identity_key)
+                else:
+                    conn = _PlainConn(sock)
+                self._serve(conn)
+            except OSError as e:
+                self.logger.error(f"signer connection lost: {e}")
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _serve(self, conn) -> None:
+        if isinstance(conn, _PlainConn):
+            conn._sock.settimeout(None)
+        else:
+            conn._sock.settimeout(None)
+        while not self._stopped:
+            req = _recv_msg(conn)
+            if req is None:
+                return
+            _send_msg(conn, self._handle(req))
+
+    def _handle(self, req: pb.PrivvalMessage) -> pb.PrivvalMessage:
+        """signer_requestHandler.go DefaultValidationRequestHandler."""
+        which = req.which()
+        if which == "ping_request":
+            return pb.PrivvalMessage(ping_response=pb.PingResponse())
+        if which == "pub_key_request":
+            if req.pub_key_request.chain_id != self.chain_id:
+                return pb.PrivvalMessage(
+                    pub_key_response=pb.PubKeyResponse(
+                        error=pb.RemoteSignerError(
+                            code=1, description="chain id mismatch"
+                        )
+                    )
+                )
+            pub = self.pv.key.priv_key.pub_key()
+            return pb.PrivvalMessage(
+                pub_key_response=pb.PubKeyResponse(
+                    pub_key_bytes=pub.data, pub_key_type="ed25519"
+                )
+            )
+        if which == "sign_vote_request":
+            r = req.sign_vote_request
+            try:
+                vote = Vote.from_proto(r.vote)
+                self.pv.sign_vote(
+                    r.chain_id, vote, sign_extension=not r.skip_extension_signing
+                )
+                return pb.PrivvalMessage(
+                    signed_vote_response=pb.SignedVoteResponse(vote=vote.to_proto())
+                )
+            except Exception as e:  # noqa: BLE001
+                return pb.PrivvalMessage(
+                    signed_vote_response=pb.SignedVoteResponse(
+                        error=pb.RemoteSignerError(code=2, description=str(e))
+                    )
+                )
+        if which == "sign_proposal_request":
+            r = req.sign_proposal_request
+            try:
+                proposal = Proposal.from_proto(r.proposal)
+                self.pv.sign_proposal(r.chain_id, proposal)
+                return pb.PrivvalMessage(
+                    signed_proposal_response=pb.SignedProposalResponse(
+                        proposal=proposal.to_proto()
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                return pb.PrivvalMessage(
+                    signed_proposal_response=pb.SignedProposalResponse(
+                        error=pb.RemoteSignerError(code=3, description=str(e))
+                    )
+                )
+        return pb.PrivvalMessage(
+            pub_key_response=pb.PubKeyResponse(
+                error=pb.RemoteSignerError(
+                    code=4, description=f"unsupported request {which}"
+                )
+            )
+        )

@@ -13,11 +13,13 @@ import time
 from ..mempool.mempool import MempoolError
 from ..types.event_bus import EventQueryTx
 from ..wire import abci_pb as abci
+from ..indexer import tx_hash
 from .serializers import (
     b64,
     block_id_json,
     block_json,
     commit_json,
+    events_json,
     header_json,
     hex_up,
     tx_result_json,
@@ -185,6 +187,89 @@ class Environment:
             "validators": [validator_json(v) for v in sel],
             "count": str(len(sel)),
             "total": str(vals.size()),
+        }
+
+    # ----------------------------------------------------------- indexer
+
+    def tx(self, hash="") -> dict:
+        """rpc/core/tx.go Tx: lookup by hash in the tx indexer."""
+        h = bytes.fromhex(hash) if isinstance(hash, str) else hash
+        rec = self.node.tx_indexer.get(h)
+        if rec is None:
+            raise RPCError(-32603, f"tx {h.hex()} not found")
+        return self._tx_record_json(h, rec)
+
+    def tx_search(self, query="", page=1, per_page=30) -> dict:
+        """rpc/core/tx.go TxSearch over the kv indexer."""
+        try:
+            recs = self.node.tx_indexer.search(query, limit=10_000)
+        except ValueError as e:
+            raise RPCError(-32602, f"invalid query: {e}") from e
+        page = max(1, int(page or 1))
+        per_page = min(100, max(1, int(per_page or 30)))
+        start = (page - 1) * per_page
+        sel = recs[start : start + per_page]
+        import base64 as _b64
+
+        return {
+            "txs": [
+                self._tx_record_json(tx_hash(_b64.b64decode(r["tx"])), r)
+                for r in sel
+            ],
+            "total_count": str(len(recs)),
+        }
+
+    def block_search(self, query="", page=1, per_page=30) -> dict:
+        try:
+            heights = self.node.block_indexer.search(query, limit=10_000)
+        except ValueError as e:
+            raise RPCError(-32602, f"invalid query: {e}") from e
+        page = max(1, int(page or 1))
+        per_page = min(100, max(1, int(per_page or 30)))
+        sel = heights[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        blocks = []
+        for h in sel:
+            meta = self.block_store.load_block_meta(h)
+            blk = self.block_store.load_block(h)
+            if meta is None or blk is None:
+                continue
+            blocks.append(
+                {
+                    "block_id": {"hash": hex_up(meta.block_id.hash)},
+                    "block": block_json(blk),
+                }
+            )
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
+    def block_results(self, height=None) -> dict:
+        """rpc/core/blocks.go BlockResults from the stored
+        FinalizeBlockResponse."""
+        h = self._height_or_latest(height)
+        resp = self.node.state_store.load_finalize_block_response(h)
+        if resp is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": str(h),
+            "txs_results": [tx_result_json(r) for r in (resp.tx_results or [])],
+            "finalize_block_events": events_json(resp.events or []),
+            "validator_updates": [
+                {
+                    "pub_key_type": vu.pub_key_type,
+                    "power": str(vu.power),
+                }
+                for vu in (resp.validator_updates or [])
+            ],
+            "app_hash": hex_up(resp.app_hash),
+        }
+
+    @staticmethod
+    def _tx_record_json(h: bytes, rec: dict) -> dict:
+        return {
+            "hash": hex_up(h),
+            "height": str(rec["height"]),
+            "index": rec["index"],
+            "tx_result": rec["result"],
+            "tx": rec["tx"],
         }
 
     # ------------------------------------------------------------ abci
@@ -356,7 +441,11 @@ ROUTES = {
     "net_info": ("", Environment.net_info),
     "genesis": ("", Environment.genesis),
     "block": ("height", Environment.block),
+    "block_results": ("height", Environment.block_results),
     "commit": ("height", Environment.commit),
+    "tx": ("hash", Environment.tx),
+    "tx_search": ("query,page,per_page", Environment.tx_search),
+    "block_search": ("query,page,per_page", Environment.block_search),
     "validators": ("height,page,per_page", Environment.validators),
     "abci_info": ("", Environment.abci_info),
     "abci_query": ("path,data,height,prove", Environment.abci_query),

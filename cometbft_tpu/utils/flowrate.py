@@ -64,26 +64,35 @@ class Monitor:
 
 class Limiter(Monitor):
     """Monitor + blocking throttle to `limit` bytes/sec (flowrate's
-    Limit(want, rate, block=true) usage in MConnection send/recv loops)."""
+    Limit(want, rate, block=true) usage in MConnection send/recv loops).
+
+    Token bucket with ~one second of burst capacity: idle time earns
+    credit only up to `limit` bytes, so a connection that sat quiet for
+    an hour cannot cash the backlog in as an unthrottled flood (the
+    since-start quota the first version used had exactly that hole)."""
 
     def __init__(self, limit: int, window: float = 1.0):
         super().__init__(window)
         self.limit = limit
+        self._tokens = float(limit)
+        self._refill_at = time.monotonic()
 
     def throttle(self, n: int) -> None:
-        """Account n bytes and sleep long enough to keep the average rate
-        at or under the limit."""
+        """Account n bytes; sleep until the bucket covers them."""
         if self.limit <= 0:  # unlimited
             self.update(n)
             return
         now = time.monotonic()
         with self._mtx:
+            self._tokens = min(
+                float(self.limit),
+                self._tokens + (now - self._refill_at) * self.limit,
+            )
+            self._refill_at = now
+            self._tokens -= n
             self._total += n
-            elapsed = now - self._start
-            # time at which `total` bytes are allowed to have passed
-            allowed_at = self._total / self.limit
-            sleep = allowed_at - elapsed
             self._last = now
-            self._rate = self.limit if sleep > 0 else self._total / max(elapsed, 1e-9)
+            sleep = -self._tokens / self.limit if self._tokens < 0 else 0.0
+            self._rate = float(self.limit) if sleep > 0 else self._rate
         if sleep > 0:
             time.sleep(min(sleep, 10.0))

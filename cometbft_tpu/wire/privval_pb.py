@@ -1,0 +1,98 @@
+"""Remote-signer wire messages (field layout mirrors
+proto/cometbft/privval/v1/types.proto of the reference).
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+from .types_pb import Proposal, Vote
+
+
+class RemoteSignerError(Message):
+    FIELDS = [
+        Field(1, "code", "varint"),
+        Field(2, "description", "string"),
+    ]
+
+
+class PubKeyRequest(Message):
+    FIELDS = [Field(1, "chain_id", "string")]
+
+
+class PubKeyResponse(Message):
+    FIELDS = [
+        Field(2, "error", "message", RemoteSignerError),
+        Field(3, "pub_key_bytes", "bytes"),
+        Field(4, "pub_key_type", "string"),
+    ]
+
+
+class SignVoteRequest(Message):
+    FIELDS = [
+        Field(1, "vote", "message", Vote),
+        Field(2, "chain_id", "string"),
+        Field(3, "skip_extension_signing", "bool"),
+    ]
+
+
+class SignedVoteResponse(Message):
+    FIELDS = [
+        Field(1, "vote", "message", Vote, emit_default=True),
+        Field(2, "error", "message", RemoteSignerError),
+    ]
+
+
+class SignProposalRequest(Message):
+    FIELDS = [
+        Field(1, "proposal", "message", Proposal),
+        Field(2, "chain_id", "string"),
+    ]
+
+
+class SignedProposalResponse(Message):
+    FIELDS = [
+        Field(1, "proposal", "message", Proposal, emit_default=True),
+        Field(2, "error", "message", RemoteSignerError),
+    ]
+
+
+class SignBytesRequest(Message):
+    FIELDS = [Field(1, "value", "bytes")]
+
+
+class SignBytesResponse(Message):
+    FIELDS = [
+        Field(1, "signature", "bytes"),
+        Field(2, "error", "message", RemoteSignerError),
+    ]
+
+
+class PingRequest(Message):
+    FIELDS = []
+
+
+class PingResponse(Message):
+    FIELDS = []
+
+
+class PrivvalMessage(Message):
+    """The oneof envelope on the signer socket."""
+
+    FIELDS = [
+        Field(1, "pub_key_request", "message", PubKeyRequest),
+        Field(2, "pub_key_response", "message", PubKeyResponse),
+        Field(3, "sign_vote_request", "message", SignVoteRequest),
+        Field(4, "signed_vote_response", "message", SignedVoteResponse),
+        Field(5, "sign_proposal_request", "message", SignProposalRequest),
+        Field(6, "signed_proposal_response", "message", SignedProposalResponse),
+        Field(7, "ping_request", "message", PingRequest),
+        Field(8, "ping_response", "message", PingResponse),
+        Field(9, "sign_bytes_request", "message", SignBytesRequest),
+        Field(10, "sign_bytes_response", "message", SignBytesResponse),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None

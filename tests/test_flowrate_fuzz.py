@@ -1,0 +1,153 @@
+"""Flow-rate limiting on MConnection + the connection fuzzer
+(reference: p2p/transport/tcp/conn/connection_test.go rate tests,
+p2p/internal/fuzz/fuzz.go)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.p2p.conn.connection import MConnection, StreamDescriptor
+from cometbft_tpu.p2p.fuzz import FuzzedConnection
+from cometbft_tpu.utils.flowrate import Limiter
+
+
+class PipeConn:
+    """In-memory duplex pipe; .peer is the other end."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._cond = threading.Condition()
+        self._closed = False
+        self.peer: "PipeConn" = None
+
+    @classmethod
+    def pair(cls):
+        a, b = cls(), cls()
+        a.peer, b.peer = b, a
+        return a, b
+
+    def write(self, data: bytes):
+        with self.peer._cond:
+            if self.peer._closed:
+                raise ConnectionError("closed")
+            self.peer._buf += data
+            self.peer._cond.notify_all()
+        return len(data)
+
+    def read(self, n: int) -> bytes:
+        with self._cond:
+            while not self._buf and not self._closed:
+                self._cond.wait(0.2)
+            if self._closed and not self._buf:
+                return b""
+            out = bytes(self._buf[:n])
+            del self._buf[:n]
+            return out
+
+    def close(self):
+        for c in (self, self.peer):
+            with c._cond:
+                c._closed = True
+                c._cond.notify_all()
+
+
+def test_limiter_enforces_rate():
+    lim = Limiter(100_000)  # 100 KB/s
+    t0 = time.monotonic()
+    for _ in range(10):
+        lim.throttle(30_000)  # 300 KB total -> >= ~2s at 100 KB/s
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 1.5, f"throttle too permissive: {elapsed:.2f}s"
+
+
+def _mk_conn(conn, received, send_rate=0, recv_rate=0):
+    return MConnection(
+        conn,
+        [StreamDescriptor(id=1, priority=1, send_queue_capacity=200)],
+        on_receive=lambda sid, msg: received.append(msg),
+        send_rate=send_rate,
+        recv_rate=recv_rate,
+    )
+
+
+def test_mconnection_send_rate_limits_throughput():
+    a, b = PipeConn.pair()
+    got = []
+    ma = _mk_conn(a, [], send_rate=200_000)  # 200 KB/s
+    mb = _mk_conn(b, got)
+    ma.start(); mb.start()
+    try:
+        payload = b"x" * 10_000
+        t0 = time.monotonic()
+        for _ in range(80):  # 800 KB: burst covers 200 KB, rest at 200 KB/s
+            assert ma.send(1, payload)
+        deadline = time.monotonic() + 20
+        while len(got) < 80 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        assert len(got) == 80
+        assert elapsed >= 2.0, f"sender not throttled: {elapsed:.2f}s"
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_fuzzed_connection_corruption_is_detected():
+    """A corrupting link must surface as a connection error, not silent
+    garbage acceptance."""
+    a, b = PipeConn.pair()
+    errors = []
+    got = []
+    fuzzed = FuzzedConnection(a, prob_corrupt=0.5, seed=7)
+    ma = MConnection(
+        fuzzed,
+        [StreamDescriptor(id=1, priority=1, send_queue_capacity=100)],
+        on_receive=lambda sid, msg: None,
+    )
+    mb = MConnection(
+        b,
+        [StreamDescriptor(id=1, priority=1, send_queue_capacity=100)],
+        on_receive=lambda sid, msg: got.append(msg),
+        on_error=lambda e: errors.append(e),
+    )
+    ma.start(); mb.start()
+    try:
+        for i in range(200):
+            if not ma.is_running():
+                break
+            ma.try_send(1, b"payload-%d" % i)
+            time.sleep(0.002)
+        deadline = time.monotonic() + 5
+        while not errors and time.monotonic() < deadline and mb.is_running():
+            time.sleep(0.05)
+        # either the receiver detected garbage (typical) or every
+        # delivered message survived intact (rare but possible)
+        assert errors or all(g.startswith(b"payload-") for g in got)
+        assert errors, "corruption never detected by the receiver"
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_fuzzed_connection_delay_still_delivers():
+    a, b = PipeConn.pair()
+    got = []
+    ma = MConnection(
+        FuzzedConnection(a, prob_sleep=0.3, max_sleep=0.01, seed=3),
+        [StreamDescriptor(id=1, priority=1, send_queue_capacity=100)],
+        on_receive=lambda sid, msg: None,
+    )
+    mb = MConnection(
+        b,
+        [StreamDescriptor(id=1, priority=1, send_queue_capacity=100)],
+        on_receive=lambda sid, msg: got.append(msg),
+    )
+    ma.start(); mb.start()
+    try:
+        for i in range(30):
+            assert ma.send(1, b"m%d" % i)
+        deadline = time.monotonic() + 10
+        while len(got) < 30 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(got) == 30
+    finally:
+        ma.stop(); mb.stop()

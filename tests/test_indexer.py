@@ -1,0 +1,100 @@
+"""Tx/block indexers + indexer service (reference: state/txindex/kv/
+kv_test.go, indexer_service_test.go)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.indexer import (
+    BlockIndexer,
+    IndexerService,
+    TxIndexer,
+    tx_hash,
+)
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.types.event_bus import EventBus
+from cometbft_tpu.wire import abci_pb as apb
+
+
+def _result(code=0, events=None):
+    return apb.ExecTxResult(
+        code=code,
+        data=b"",
+        log="",
+        events=[
+            apb.Event(
+                type=t,
+                attributes=[
+                    apb.EventAttribute(key=k, value=v) for k, v in attrs
+                ],
+            )
+            for t, attrs in (events or [])
+        ],
+    )
+
+
+def test_tx_indexer_index_get_search():
+    idx = TxIndexer(MemDB())
+    txs = [b"alpha=1", b"beta=2", b"gamma=3"]
+    for i, tx in enumerate(txs):
+        idx.index(
+            5, i, tx, _result(),
+            {"transfer.sender": [f"addr{i}"], "transfer.amount": [str(10 * i)]},
+        )
+    idx.index(6, 0, b"delta=4", _result(), {"transfer.sender": ["addr1"]})
+
+    rec = idx.get(tx_hash(b"beta=2"))
+    assert rec is not None and rec["height"] == 5 and rec["index"] == 1
+
+    # event '=' condition hits the secondary index
+    hits = idx.search("transfer.sender='addr1'")
+    assert len(hits) == 2 and {r["height"] for r in hits} == {5, 6}
+
+    # AND with a height bound
+    hits = idx.search("transfer.sender='addr1' AND tx.height=6")
+    assert len(hits) == 1 and hits[0]["height"] == 6
+
+    # range condition over an attribute
+    hits = idx.search("transfer.amount>5")
+    assert {r["index"] for r in hits} == {1, 2}
+
+    # by hash
+    hits = idx.search(f"tx.hash='{tx_hash(b'gamma=3').hex().upper()}'")
+    assert len(hits) == 1 and hits[0]["index"] == 2
+
+
+def test_block_indexer_search():
+    idx = BlockIndexer(MemDB())
+    idx.index(10, {"rewards.amount": ["5"], "block.proposer": ["aa"]})
+    idx.index(11, {"rewards.amount": ["7"], "block.proposer": ["bb"]})
+    idx.index(12, {"block.proposer": ["aa"]})
+    assert idx.has(11) and not idx.has(13)
+    assert idx.search("block.proposer='aa'") == [10, 12]
+    assert idx.search("rewards.amount>5") == [11]
+    assert idx.search("block.height=12") == [12]
+
+
+def test_indexer_service_feeds_from_event_bus():
+    bus = EventBus()
+    txi, bli = TxIndexer(MemDB()), BlockIndexer(MemDB())
+    svc = IndexerService(txi, bli, bus)
+    svc.start()
+    try:
+        bus.publish_tx(
+            7, 0, b"k=v",
+            _result(events=[("transfer", [("sender", "s1")])]),
+        )
+        bus.publish_new_block_events(
+            7, [apb.Event(type="mint", attributes=[apb.EventAttribute(key="amt", value="3")])], 1
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (
+            txi.get(tx_hash(b"k=v")) is None or not bli.has(7)
+        ):
+            time.sleep(0.02)
+        rec = txi.get(tx_hash(b"k=v"))
+        assert rec is not None and rec["height"] == 7
+        assert txi.search("transfer.sender='s1'")
+        assert bli.search("mint.amt=3") == [7]
+    finally:
+        svc.stop()

@@ -138,6 +138,26 @@ def test_node_runs_and_serves_rpc(tmp_path):
         assert vals["total"] == "1" and len(vals["validators"]) == 1
         info = rpc.abci_info()
         assert int(info["response"]["last_block_height"]) >= committed_h
+
+        # indexer-backed endpoints: tx by hash + tx_search by height.
+        # indexing is asynchronous (IndexerService pump thread) — poll.
+        tx_h = res["hash"]
+        got = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                got = rpc.call("tx", hash=tx_h)
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert got is not None, "tx never appeared in the indexer"
+        assert got["height"] == str(committed_h)
+        assert base64.b64decode(got["tx"]) == b"rpc=works"
+        found = rpc.call("tx_search", query=f"tx.height={committed_h}")
+        assert int(found["total_count"]) >= 1
+        assert any(t["hash"] == tx_h for t in found["txs"])
+        br = rpc.call("block_results", height=committed_h)
+        assert br["txs_results"][0]["code"] == 0
     finally:
         node.stop()
 

@@ -1,0 +1,100 @@
+"""PEX: address book buckets/eviction/persistence and address exchange
+over real switches (reference: p2p/pex/addrbook_test.go,
+pex_reactor_test.go)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import TCPTransport
+
+
+def _addr(i: int) -> str:
+    return f"{'%02x' % i * 20}@10.0.0.{i % 250 + 1}:26656"
+
+
+def test_addrbook_add_pick_mark():
+    book = AddrBook()
+    assert book.pick_address() is None
+    for i in range(1, 50):
+        assert book.add_address(_addr(i), src="tester")
+    assert not book.add_address(_addr(1), src="tester")  # dup
+    assert book.size() == 49
+    picked = book.pick_address()
+    assert picked is not None and book.has(picked)
+
+    # promotion to old buckets on success
+    book.mark_good(_addr(5))
+    ka = book._lookup(_addr(5))
+    assert ka.bucket_type == "old"
+    # repeated failures make an address bad and removable
+    for _ in range(3):
+        book.mark_attempt(_addr(7))
+    assert book._lookup(_addr(7)).is_bad()
+    book.mark_bad(_addr(7))
+    assert not book.has(_addr(7))
+
+
+def test_addrbook_selection_and_persistence(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path)
+    for i in range(1, 40):
+        book.add_address(_addr(i), src="s")
+    book.mark_good(_addr(3))
+    sel = book.get_selection(10)
+    assert len(sel) == 10 and len(set(sel)) == 10
+    book.save()
+
+    book2 = AddrBook(path)
+    assert book2.size() == book.size()
+    assert book2._lookup(_addr(3)).bucket_type == "old"
+
+
+def _pex_switch(idx: int, book: AddrBook, ensure=0.3, req=0.3):
+    nk = NodeKey.generate(bytes([idx]) * 32)
+    info = NodeInfo(node_id=nk.id(), network="pex-net", moniker=f"p{idx}")
+    sw = Switch(TCPTransport(nk, info))
+    reactor = PexReactor(book, ensure_period=ensure, request_interval=req)
+    sw.add_reactor("PEX", reactor)
+    addr = sw.transport.listen("127.0.0.1:0")
+    return sw, reactor, nk, addr
+
+
+@pytest.mark.slow
+def test_pex_discovers_and_dials_unknown_peer():
+    """C knows only B; A is only in B's book.  Via PEX, C must learn A's
+    address and the ensure-peers loop must dial it."""
+    sw_a, _, nk_a, addr_a = _pex_switch(31, AddrBook())
+    book_b = AddrBook()
+    sw_b, _, nk_b, addr_b = _pex_switch(32, book_b)
+    book_c = AddrBook()
+    sw_c, _, nk_c, addr_c = _pex_switch(33, book_c)
+    try:
+        for sw in (sw_a, sw_b, sw_c):
+            sw.start()
+        # B knows A (vetted: B actually dials A)
+        sw_b.dial_peer_async(f"{nk_a.id()}@{addr_a}")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and sw_b.num_peers() < 1:
+            time.sleep(0.05)
+        assert sw_b.num_peers() == 1
+
+        # C joins knowing only B
+        book_c.add_address(f"{nk_b.id()}@{addr_b}", src="config")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sw_c.peers.get(nk_a.id()) is not None:
+                break
+            time.sleep(0.1)
+        assert book_c.has(f"{nk_a.id()}@{addr_a}"), "C never learned A via PEX"
+        assert sw_c.peers.get(nk_a.id()) is not None, "C never dialed A"
+    finally:
+        for sw in (sw_a, sw_b, sw_c):
+            try:
+                sw.stop()
+            except Exception:
+                pass

@@ -1,0 +1,183 @@
+"""Remote signer privval: a separate signer process holds the key and
+the node signs over a socket (reference: privval/signer_client_test.go,
+signer_listener_endpoint_test.go)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.privval import (
+    FilePV,
+    FilePVKey,
+    FilePVLastSignState,
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from cometbft_tpu.privval.file_pv import DoubleSignError
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.wire.canonical import Timestamp
+
+GENESIS_NS = 1_700_000_000 * 1_000_000_000
+PRECOMMIT = 2
+
+
+def _pv(seed=b"\x71"):
+    return FilePV(
+        key=FilePVKey(ed25519.PrivKey.from_seed(seed * 32)),
+        last_sign_state=FilePVLastSignState(),
+    )
+
+
+def _pair(chain_id="rs-chain", authorized=True):
+    pv = _pv()
+    node_identity = ed25519.PrivKey.from_seed(b"\x72" * 32)
+    signer_identity = ed25519.PrivKey.from_seed(b"\x73" * 32)
+    ep = SignerListenerEndpoint(
+        "127.0.0.1:0",
+        ping_period=60,
+        identity_key=node_identity,
+        authorized_keys=[signer_identity.pub_key().data] if authorized else None,
+    )
+    server = SignerServer(ep.listen_addr, chain_id, pv, identity_key=signer_identity)
+    server.start()
+    assert ep.wait_for_signer(10), "signer never dialed in"
+    return pv, ep, server, SignerClient(ep, chain_id)
+
+
+def test_remote_pubkey_and_vote_signing():
+    pv, ep, server, client = _pair()
+    try:
+        assert client.get_pub_key().data == pv.key.priv_key.pub_key().data
+
+        bid = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(1, b"\xbb" * 32))
+        # HRS order: proposal (step 1) before the precommit (step 3)
+        prop = Proposal(
+            height=5, round=0, pol_round=-1, block_id=bid,
+            timestamp=Timestamp.from_unix_ns(GENESIS_NS),
+        )
+        client.sign_proposal("rs-chain", prop)
+        assert prop.signature and pv.key.priv_key.pub_key().verify_signature(
+            prop.sign_bytes("rs-chain"), prop.signature
+        )
+
+        vote = Vote(
+            type=PRECOMMIT, height=5, round=0, block_id=bid,
+            timestamp=Timestamp.from_unix_ns(GENESIS_NS),
+            validator_address=pv.key.priv_key.pub_key().address(),
+            validator_index=0,
+        )
+        client.sign_vote("rs-chain", vote)
+        assert vote.signature and pv.key.priv_key.pub_key().verify_signature(
+            vote.sign_bytes("rs-chain"), vote.signature
+        )
+    finally:
+        server.stop()
+        ep.close()
+
+
+def test_remote_signer_enforces_double_sign_protection():
+    """The HRS last-sign state lives with the key: a conflicting vote at
+    the same height/round/step comes back as an error."""
+    pv, ep, server, client = _pair()
+    try:
+        mk = lambda h: Vote(
+            type=PRECOMMIT, height=7, round=0,
+            block_id=BlockID(hash=h, part_set_header=PartSetHeader(1, b"\xcc" * 32)),
+            timestamp=Timestamp.from_unix_ns(GENESIS_NS),
+            validator_address=pv.key.priv_key.pub_key().address(),
+            validator_index=0,
+        )
+        client.sign_vote("rs-chain", mk(b"\x01" * 32))
+        with pytest.raises(RemoteSignerError):
+            client.sign_vote("rs-chain", mk(b"\x02" * 32))
+    finally:
+        server.stop()
+        ep.close()
+
+
+def test_unauthorized_signer_rejected():
+    """A dialer whose identity key is not in the authorized list never
+    becomes the signer."""
+    node_identity = ed25519.PrivKey.from_seed(b"\x74" * 32)
+    good = ed25519.PrivKey.from_seed(b"\x75" * 32)
+    ep = SignerListenerEndpoint(
+        "127.0.0.1:0",
+        ping_period=60,
+        identity_key=node_identity,
+        authorized_keys=[good.pub_key().data],
+    )
+    intruder = SignerServer(
+        ep.listen_addr, "rs-chain", _pv(b"\x76"),
+        identity_key=ed25519.PrivKey.from_seed(b"\x77" * 32),
+    )
+    intruder.start()
+    try:
+        assert not ep.wait_for_signer(2), "unauthorized signer was accepted"
+    finally:
+        intruder.stop()
+        ep.close()
+
+
+def test_chain_id_mismatch_rejected():
+    pv, ep, server, client = _pair()
+    try:
+        bad = SignerClient(ep, "other-chain")
+        with pytest.raises(RemoteSignerError):
+            bad.get_pub_key()
+    finally:
+        server.stop()
+        ep.close()
+
+
+@pytest.mark.slow
+def test_node_runs_with_remote_signer(tmp_path):
+    """A full node with priv_validator_laddr produces blocks while the
+    key never leaves the signer (node.go:388-394)."""
+    import socket
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_node_rpc import _mk_home, _test_cfg
+
+    from cometbft_tpu.node import Node
+
+    home = _mk_home(tmp_path, "rsnode", chain_id="rs-live")
+    cfg = _test_cfg(home)
+    # reserve a port for the signer endpoint
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    cfg.base.priv_validator_laddr = f"127.0.0.1:{port}"
+
+    # the signer holds the SAME key the genesis names (init generated it)
+    signer_pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+    )
+    # SignerServer redials until the node's listener is up
+    # the node requires a SecretConnection; the signer authenticates with
+    # its validator key as the connection identity
+    server = SignerServer(
+        f"127.0.0.1:{port}", "rs-live", signer_pv,
+        identity_key=signer_pv.key.priv_key,
+    )
+    server.start()
+    node = Node(cfg)  # blocks until the signer connects
+    node.start()
+    try:
+        deadline = time.monotonic() + 90
+        while (
+            node.consensus_state.state.last_block_height < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert node.consensus_state.state.last_block_height >= 2
+    finally:
+        node.stop()
+        server.stop()
