@@ -196,6 +196,50 @@ def cmd_testnet(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """commands/rollback.go: overwrite state height n with n-1."""
+    from .node import default_db_provider
+    from .state.rollback import rollback
+    from .state.store import StateStore
+    from .store.block_store import BlockStore
+    from .store.db import PrefixDB
+
+    cfg = load_config(args.home)
+    db = default_db_provider(cfg)
+    try:
+        height, app_hash = rollback(
+            BlockStore(PrefixDB(db, b"bs/")),
+            StateStore(PrefixDB(db, b"ss/")),
+            remove_block=args.hard,
+        )
+    finally:
+        db.close()
+    print(f"rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """commands/inspect: serve RPC over the stores, no consensus
+    (internal/inspect)."""
+    from .node import InspectNode
+
+    cfg = load_config(args.home)
+    if args.rpc_laddr is not None:
+        cfg.rpc.laddr = args.rpc_laddr
+    node = InspectNode(cfg)
+    node.start()
+    print(f"inspect RPC on {node.rpc_server.listen_addr} (ctrl-c to stop)")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -222,6 +266,15 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("gen-node-key").set_defaults(fn=cmd_gen_node_key)
     sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("unsafe-reset-all").set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("rollback", help="roll engine state back one height")
+    sp.add_argument("--hard", action="store_true",
+                    help="also remove the last block from the store")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("inspect", help="RPC over the stores, no consensus")
+    sp.add_argument("--rpc-laddr", default=None, dest="rpc_laddr")
+    sp.set_defaults(fn=cmd_inspect)
 
     sp = sub.add_parser("testnet", help="generate a localnet")
     sp.add_argument("--v", type=int, default=4)

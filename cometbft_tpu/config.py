@@ -21,7 +21,9 @@ DEFAULT_HOME = os.path.expanduser("~/.cometbft-tpu")
 class BaseConfig:
     moniker: str = "node"
     proxy_app: str = "kvstore"  # "kvstore" | "noop" | tcp://addr (socket)
-    db_backend: str = "sqlite"  # "sqlite" | "memdb"
+    # "native" = the C++ log-structured engine (native/kvstore.cc, the
+    # analogue of the reference's pebble backend); "sqlite" | "memdb"
+    db_backend: str = "native"
     block_sync: bool = True
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
@@ -124,7 +126,7 @@ class Config:
         return self._abs("config/config.toml")
 
     def validate_basic(self) -> None:
-        if self.base.db_backend not in ("sqlite", "memdb"):
+        if self.base.db_backend not in ("native", "sqlite", "memdb"):
             raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
         if self.statesync.enable and not (
             self.statesync.trust_height > 0 and self.statesync.trust_hash

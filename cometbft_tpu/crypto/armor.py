@@ -1,0 +1,76 @@
+"""ASCII armor for key export (reference: crypto/armor/ — OpenPGP-style
+blocks, RFC 4880 framing with CRC-24 checksum).
+"""
+
+from __future__ import annotations
+
+import base64
+
+_CRC24_INIT = 0xB704CE
+_CRC24_POLY = 0x1864CFB
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+class ArmorError(Exception):
+    pass
+
+
+def encode_armor(block_type: str, headers: dict[str, str], data: bytes) -> str:
+    """armor.EncodeArmor."""
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    lines.extend(b64[i : i + 64] for i in range(0, len(b64), 64))
+    lines.append("=" + base64.b64encode(_crc24(data).to_bytes(3, "big")).decode())
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(text: str) -> tuple[str, dict[str, str], bytes]:
+    """armor.DecodeArmor -> (block_type, headers, data)."""
+    lines = [ln.rstrip("\r") for ln in text.strip().split("\n")]
+    if not lines or not lines[0].startswith("-----BEGIN ") or not lines[0].endswith("-----"):
+        raise ArmorError("missing BEGIN line")
+    block_type = lines[0][len("-----BEGIN "):-len("-----")]
+    end = f"-----END {block_type}-----"
+    if lines[-1] != end:
+        raise ArmorError("missing or mismatched END line")
+    body = lines[1:-1]
+    headers: dict[str, str] = {}
+    i = 0
+    while i < len(body) and body[i]:
+        if ":" not in body[i]:
+            break
+        k, _, v = body[i].partition(":")
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(body) and not body[i]:
+        i += 1
+    data_lines = []
+    checksum = None
+    for ln in body[i:]:
+        if ln.startswith("="):
+            checksum = ln[1:]
+        elif ln:
+            data_lines.append(ln)
+    try:
+        data = base64.b64decode("".join(data_lines), validate=True)
+    except Exception as e:  # noqa: BLE001
+        raise ArmorError(f"bad base64 payload: {e}") from e
+    if checksum is not None:
+        want = base64.b64decode(checksum)
+        if _crc24(data).to_bytes(3, "big") != want:
+            raise ArmorError("checksum mismatch")
+    return block_type, headers, data

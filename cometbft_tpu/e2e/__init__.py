@@ -1,0 +1,15 @@
+"""E2E harness: process-level testnets with perturbations + the ABCI
+conformance grammar (reference: test/e2e/)."""
+
+from .grammar import GrammarError, RecordingApp, check_execution
+from .runner import E2ENode, Manifest, NodeSpec, Runner
+
+__all__ = [
+    "Runner",
+    "Manifest",
+    "NodeSpec",
+    "E2ENode",
+    "RecordingApp",
+    "check_execution",
+    "GrammarError",
+]

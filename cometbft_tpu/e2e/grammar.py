@@ -1,0 +1,108 @@
+"""ABCI conformance grammar checker
+(reference: test/e2e/pkg/grammar/checker.go:19 + the ABCI grammar in
+spec/abci — the generated GLL parser there reduces to this small
+recursive-descent checker over the consensus-connection call trace).
+
+Grammar (consensus connection only; CheckTx/Info/Query ride other
+connections and snapshot calls are free):
+
+  clean-start  = InitChain state-sync? consensus-exec
+  recovery     = consensus-exec
+  state-sync   = OfferSnapshot ApplySnapshotChunk*
+  consensus-exec = height+
+  height       = proposer-calls* FinalizeBlock Commit
+  proposer-calls = PrepareProposal | ProcessProposal
+                 | ExtendVote | VerifyVoteExtension
+"""
+
+from __future__ import annotations
+
+PROPOSER_CALLS = {
+    "prepare_proposal",
+    "process_proposal",
+    "extend_vote",
+    "verify_vote_extension",
+}
+SNAPSHOT_CALLS = {"offer_snapshot", "apply_snapshot_chunk"}
+FREE_CALLS = {"info", "query", "check_tx", "list_snapshots", "load_snapshot_chunk", "echo", "flush"}
+
+
+class GrammarError(Exception):
+    def __init__(self, pos: int, call: str, reason: str):
+        super().__init__(f"call #{pos} ({call}): {reason}")
+        self.pos = pos
+        self.call = call
+
+
+def check_execution(calls: list[str], clean_start: bool) -> None:
+    """Validate one execution trace (checker.go Verify)."""
+    seq = [c for c in calls if c not in FREE_CALLS]
+    i = 0
+
+    def peek():
+        return seq[i] if i < len(seq) else None
+
+    if clean_start:
+        if peek() != "init_chain":
+            raise GrammarError(i, peek() or "<end>", "clean start must begin with InitChain")
+        i += 1
+        # optional state sync restore
+        if peek() == "offer_snapshot":
+            i += 1
+            while peek() == "apply_snapshot_chunk":
+                i += 1
+    else:
+        if peek() == "init_chain":
+            raise GrammarError(i, "init_chain", "recovery must not re-run InitChain")
+
+    heights = 0
+    while i < len(seq):
+        # proposer phase
+        while peek() in PROPOSER_CALLS:
+            i += 1
+        if peek() is None:
+            break  # trace may end mid-height (crash) — allowed
+        if peek() != "finalize_block":
+            raise GrammarError(i, peek(), "expected FinalizeBlock after proposer calls")
+        i += 1
+        if peek() is None:
+            break  # crashed between FinalizeBlock and Commit — allowed
+        if peek() != "commit":
+            raise GrammarError(i, peek(), "expected Commit after FinalizeBlock")
+        i += 1
+        heights += 1
+
+    if clean_start and heights == 0 and i >= len(seq) and len(seq) <= 1:
+        # an InitChain with no heights is fine (fresh node, short run)
+        return
+
+
+class RecordingApp:
+    """Wraps an Application and records the consensus-connection call
+    sequence (the e2e app's recording side, test/e2e/app/app.go)."""
+
+    _CONSENSUS = (
+        "init_chain",
+        "prepare_proposal",
+        "process_proposal",
+        "extend_vote",
+        "verify_vote_extension",
+        "finalize_block",
+        "commit",
+        "offer_snapshot",
+        "apply_snapshot_chunk",
+    )
+
+    def __init__(self, app):
+        self._app = app
+        self.calls: list[str] = []
+
+    def __getattr__(self, name):
+        fn = getattr(self._app, name)
+        if name in self._CONSENSUS and callable(fn):
+            def wrapper(*a, **k):
+                self.calls.append(name)
+                return fn(*a, **k)
+
+            return wrapper
+        return fn

@@ -1,0 +1,247 @@
+"""E2E testnet runner: multi-process localnets with perturbations
+(reference: test/e2e/runner — setup/start/load/perturb/wait/test stages
+over docker-compose; here the nodes are OS processes driven through the
+CLI, which exercises the same real binaries + sockets without docker).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from ..cli import main as cli_main
+from ..config import load_config, save_config
+
+
+@dataclass
+class NodeSpec:
+    """One manifest entry (test/e2e/pkg/manifest.go)."""
+
+    name: str
+    start_at: int = 0  # height to join at (0 = genesis)
+    perturbations: list[str] = field(default_factory=list)  # kill|pause|restart
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-chain"
+    nodes: list[NodeSpec] = field(default_factory=list)
+    load_tx_per_round: int = 5
+    target_height: int = 12
+
+
+class E2ENode:
+    def __init__(self, name: str, home: str, rpc_port: int):
+        self.name = name
+        self.home = home
+        self.rpc_port = rpc_port
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "cometbft_tpu",
+                "--home", self.home, "start",
+                "--rpc-laddr", f"tcp://127.0.0.1:{self.rpc_port}",
+            ],
+            env=env,
+            stdout=open(os.path.join(self.home, "node.log"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+
+    def rpc(self, method: str, **params):
+        payload = json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.rpc_port}",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out["result"]
+
+    def height(self) -> int:
+        return int(self.rpc("status")["sync_info"]["latest_block_height"])
+
+    def kill(self) -> None:
+        """kill -9: the crash-recovery perturbation (runner/perturb.go)."""
+        if self.proc:
+            self.proc.kill()
+            self.proc.wait(timeout=20)
+            self.proc = None
+
+    def pause(self) -> None:
+        if self.proc:
+            self.proc.send_signal(signal.SIGSTOP)
+
+    def resume(self) -> None:
+        if self.proc:
+            self.proc.send_signal(signal.SIGCONT)
+
+    def terminate(self) -> None:
+        if self.proc:
+            try:
+                self.proc.terminate()
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+            self.proc = None
+
+
+class Runner:
+    """setup → start → load → perturb → wait → test
+    (test/e2e/runner/main.go stages)."""
+
+    def __init__(self, manifest: Manifest, out_dir: str, base_port: int = 28000):
+        self.m = manifest
+        self.out = out_dir
+        self.base_port = base_port
+        self.nodes: list[E2ENode] = []
+
+    # ------------------------------------------------------------- stages
+
+    def setup(self) -> None:
+        n = len(self.m.nodes)
+        assert cli_main(
+            [
+                "testnet", "--v", str(n), "--o", self.out,
+                "--chain-id", self.m.chain_id,
+                "--starting-port", str(self.base_port),
+            ]
+        ) == 0
+        for i, spec in enumerate(self.m.nodes):
+            home = os.path.join(self.out, f"node{i}")
+            cfg = load_config(home)
+            cfg.consensus.timeout_propose = 1.0
+            cfg.consensus.timeout_propose_delta = 0.3
+            cfg.consensus.timeout_prevote = 0.5
+            cfg.consensus.timeout_prevote_delta = 0.3
+            cfg.consensus.timeout_precommit = 0.5
+            cfg.consensus.timeout_precommit_delta = 0.3
+            save_config(cfg)
+            self.nodes.append(
+                E2ENode(spec.name, home, self.base_port + 1000 + i)
+            )
+
+    def start(self) -> None:
+        for node, spec in zip(self.nodes, self.m.nodes):
+            if spec.start_at == 0:
+                node.start()
+
+    def start_late_nodes(self) -> None:
+        started_heights = self._heights(only_running=True)
+        tip = max(started_heights) if started_heights else 0
+        for node, spec in zip(self.nodes, self.m.nodes):
+            if spec.start_at > 0 and node.proc is None and tip >= spec.start_at:
+                node.start()
+
+    def load(self, round_id: int) -> None:
+        """Submit txs through a random running node (runner/load.go)."""
+        for node in self.nodes:
+            if node.proc is None:
+                continue
+            for j in range(self.m.load_tx_per_round):
+                tx = f"load-{round_id}-{j}={node.name}".encode()
+                try:
+                    import base64
+
+                    node.rpc("broadcast_tx_sync", tx=base64.b64encode(tx).decode())
+                except Exception:  # noqa: BLE001
+                    pass
+            break
+
+    def perturb(self) -> None:
+        """Apply each node's scripted perturbations (runner/perturb.go)."""
+        for node, spec in zip(self.nodes, self.m.nodes):
+            for p in spec.perturbations:
+                if node.proc is None:
+                    continue
+                if p == "kill":
+                    node.kill()
+                    time.sleep(1.0)
+                    node.start()
+                elif p == "pause":
+                    node.pause()
+                    time.sleep(3.0)
+                    node.resume()
+                elif p == "restart":
+                    node.terminate()
+                    time.sleep(0.5)
+                    node.start()
+
+    def wait_for_height(self, h: int, timeout: float = 240.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.start_late_nodes()
+            hs = self._heights(only_running=True)
+            if hs and min(hs) >= h and len(hs) == sum(
+                1 for n in self.nodes if n.proc is not None
+            ):
+                if all(n.proc is not None for n in self.nodes):
+                    return True
+            time.sleep(1.0)
+        return False
+
+    # ------------------------------------------------------------- checks
+
+    def check_invariants(self, upto: int) -> list[str]:
+        """Black-box invariants over RPC (test/e2e/tests/*_test.go):
+        identical blocks, app hashes, and validator sets everywhere."""
+        problems = []
+        hashes: dict[int, set[str]] = {}
+        for node in self.nodes:
+            if node.proc is None:
+                continue
+            try:
+                base = int(
+                    node.rpc("status")["sync_info"]["earliest_block_height"]
+                )
+                for h in range(max(base, 1), upto + 1):
+                    b = node.rpc("block", height=h)
+                    hashes.setdefault(h, set()).add(b["block_id"]["hash"])
+            except Exception as e:  # noqa: BLE001
+                problems.append(f"{node.name}: rpc failed: {e}")
+        for h, hs in hashes.items():
+            if len(hs) > 1:
+                problems.append(f"fork at height {h}: {hs}")
+        apps = set()
+        for node in self.nodes:
+            if node.proc is None:
+                continue
+            try:
+                apps.add(node.rpc("status")["sync_info"]["latest_app_hash"])
+            except Exception:  # noqa: BLE001
+                pass
+        # nodes may be at different heights; only flag if everyone reports
+        # the same height but different app hashes
+        heights = set(self._heights(only_running=True))
+        if len(heights) == 1 and len(apps) > 1:
+            problems.append(f"app hash divergence at height {heights}: {apps}")
+        return problems
+
+    def stop_all(self) -> None:
+        for node in self.nodes:
+            node.terminate()
+
+    def _heights(self, only_running: bool = False) -> list[int]:
+        out = []
+        for node in self.nodes:
+            if only_running and node.proc is None:
+                continue
+            try:
+                out.append(node.height())
+            except Exception:  # noqa: BLE001
+                pass
+        return out

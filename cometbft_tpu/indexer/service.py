@@ -26,8 +26,14 @@ class IndexerService(Service):
         self._threads: list[threading.Thread] = []
 
     def on_start(self) -> None:
-        tx_sub = self.event_bus.subscribe("indexer-tx", EventQueryTx)
-        blk_sub = self.event_bus.subscribe("indexer-blk", EventQueryNewBlockEvents)
+        # unbuffered: committed txs must never be shed from the index
+        # (indexer_service.go uses SubscribeUnbuffered for the same reason)
+        tx_sub = self.event_bus.pubsub.subscribe(
+            "indexer-tx", EventQueryTx, unbuffered=True
+        )
+        blk_sub = self.event_bus.pubsub.subscribe(
+            "indexer-blk", EventQueryNewBlockEvents, unbuffered=True
+        )
         for name, sub, fn in (
             ("indexer-tx", tx_sub, self._index_tx),
             ("indexer-blk", blk_sub, self._index_block),

@@ -14,16 +14,12 @@ import json
 import struct
 import threading
 
-from ..crypto import hash as tmhash
+from ..types.tx import tx_hash
 from ..utils.pubsub import Query
 
 _REC = b"txm/"
 _EVT = b"txe/"
 _HGT = b"txh/"
-
-
-def tx_hash(tx: bytes) -> bytes:
-    return tmhash.sum(tx)
 
 
 class TxIndexer:
@@ -77,6 +73,14 @@ class TxIndexer:
         condition, then full predicate match (kv.go Search)."""
         if isinstance(query, str):
             query = Query(query)
+        # tx.hash values are stored uppercase; match case-insensitively
+        if any(k == "tx.hash" for k, _, _ in query.conditions):
+            norm = Query(query.expr)
+            norm.conditions = [
+                (k, op, v.upper() if k == "tx.hash" and v else v)
+                for k, op, v in query.conditions
+            ]
+            query = norm
         candidates = self._candidates(query)
         out = []
         for h in candidates:

@@ -51,6 +51,10 @@ def default_db_provider(cfg: Config) -> DB:
     if cfg.base.db_backend == "memdb":
         return MemDB()
     os.makedirs(cfg.db_dir(), exist_ok=True)
+    if cfg.base.db_backend == "native":
+        from .store.native_db import NativeDB
+
+        return NativeDB(os.path.join(cfg.db_dir(), "cometbft.kvlog"))
     return SQLiteDB(os.path.join(cfg.db_dir(), "cometbft.db"))
 
 
@@ -119,6 +123,9 @@ class Node:
             self.tx_indexer, self.block_indexer, self.event_bus
         )
 
+        # ---- node identity (also the privval listener's conn identity)
+        self.node_key = NodeKey.load_or_gen(config.node_key_file())
+
         # ---- privval (node.go:388): file-based, or a remote signer
         # dialing into priv_validator_laddr
         self.signer_endpoint = None
@@ -130,7 +137,6 @@ class Node:
             )
 
             laddr = _strip_tcp(config.base.priv_validator_laddr)
-            self.node_key = NodeKey.load_or_gen(config.node_key_file())
             self.signer_endpoint = SignerListenerEndpoint(
                 laddr, identity_key=self.node_key.priv_key
             )
@@ -192,6 +198,13 @@ class Node:
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
+        # ---- background pruner (node.go pruner wiring)
+        from .state.pruner import Pruner
+
+        self.pruner = Pruner(
+            PrefixDB(self.db, b"pr/"), self.state_store, self.block_store
+        )
+
         # ---- executor (node.go:458)
         self.block_executor = BlockExecutor(
             self.state_store,
@@ -200,6 +213,7 @@ class Node:
             ev_pool=self.evidence_pool,
             block_store=self.block_store,
             event_bus=self.event_bus,
+            pruner=self.pruner,
         )
 
         # ---- blocksync reactor (node.go:478)
@@ -247,7 +261,6 @@ class Node:
         )
 
         # ---- transport + switch (setup.go:411,485)
-        self.node_key = NodeKey.load_or_gen(config.node_key_file())
         self.node_info = NodeInfo(
             node_id=self.node_key.id(),
             listen_addr=config.p2p.laddr,
@@ -287,6 +300,13 @@ class Node:
 
         self.listen_addr: str | None = None
         self.rpc_server = None  # attached by start() when configured
+
+        # ---- metrics (node.go:983 Prometheus server; metricsgen sets)
+        from .utils.metrics import NodeMetrics, Registry
+
+        self.metrics_registry = Registry()
+        self.metrics = NodeMetrics(self.metrics_registry)
+        self._metrics_httpd = None
 
     # ---------------------------------------------------------------- util
 
@@ -329,6 +349,7 @@ class Node:
     def start(self) -> None:
         """node.go:598 OnStart."""
         self.indexer_service.start()
+        self.pruner.start()
         self.listen_addr = self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.switch.start()
         peers = [
@@ -354,11 +375,106 @@ class Node:
                 pass
         if self.pex_reactor is not None:
             self.addr_book.save()
+        self._start_metrics()
         self.logger.info(
             f"node {self.node_key.id()[:8]} started: p2p {self.listen_addr}"
         )
 
+    def _start_metrics(self) -> None:
+        """Event-fed + sampled metrics, optionally served on the
+        Prometheus listener (node.go:983)."""
+        import threading
+        import time as _time
+
+        from .types import validation as _validation
+        from .types.event_bus import EventQueryNewBlock
+
+        # the hook is process-global: install at start, clear at stop so
+        # multi-node processes don't cross-pollinate registries
+        self._verify_observer = self.metrics.verify_commit_seconds.observe
+        _validation.VERIFY_LATENCY_OBSERVER = self._verify_observer
+        sub = self.event_bus.subscribe("metrics", EventQueryNewBlock)
+        last_block_time = [None]
+
+        def pump():
+            import queue as _q
+
+            while self.switch.is_running():
+                try:
+                    msg, _ = sub.get(timeout=0.5)
+                except _q.Empty:
+                    continue
+                blk = msg.data["block"]
+                m = self.metrics
+                m.consensus_height.set(blk.header.height)
+                m.consensus_num_txs.set(len(blk.data.txs))
+                m.consensus_total_txs.inc(len(blk.data.txs))
+                m.consensus_validators.set(
+                    self.consensus_state.state.validators.size()
+                )
+                t = blk.header.time.unix_ns()
+                if last_block_time[0] is not None:
+                    m.consensus_block_interval.observe(
+                        (t - last_block_time[0]) / 1e9
+                    )
+                last_block_time[0] = t
+
+        def sample():
+            while self.switch.is_running():
+                self.metrics.mempool_size.set(self.mempool.size())
+                self.metrics.mempool_size_bytes.set(self.mempool.size_bytes())
+                self.metrics.p2p_peers.set(self.switch.num_peers())
+                rs = self.consensus_state.get_round_state()
+                self.metrics.consensus_rounds.set(max(rs.round, 0))
+                _time.sleep(2.0)
+
+        threading.Thread(target=pump, daemon=True, name="metrics-pump").start()
+        threading.Thread(target=sample, daemon=True, name="metrics-sample").start()
+
+        if self.config.instrumentation.prometheus:
+            from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+            registry = self.metrics_registry
+
+            class H(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+
+                def do_GET(self):
+                    body = registry.expose_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            addr = self.config.instrumentation.prometheus_listen_addr
+            host, _, port = addr.rpartition(":")
+            self._metrics_httpd = ThreadingHTTPServer(
+                (host or "0.0.0.0", int(port)), H
+            )
+            threading.Thread(
+                target=self._metrics_httpd.serve_forever,
+                daemon=True,
+                name="prometheus",
+            ).start()
+            self.logger.info(f"Prometheus metrics on {addr}")
+
     def stop(self) -> None:
+        from .types import validation as _validation
+
+        if _validation.VERIFY_LATENCY_OBSERVER is getattr(
+            self, "_verify_observer", None
+        ):
+            _validation.VERIFY_LATENCY_OBSERVER = None
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.shutdown()
+                self._metrics_httpd.server_close()
+            except Exception:  # noqa: BLE001
+                pass
         if self.rpc_server is not None:
             try:
                 self.rpc_server.stop()
@@ -370,6 +486,8 @@ class Node:
             pass
         if self.indexer_service.is_running():
             self.indexer_service.stop()
+        if self.pruner.is_running():
+            self.pruner.stop()
         if self.signer_endpoint is not None:
             self.signer_endpoint.close()
         if self.pex_reactor is not None:
@@ -381,3 +499,85 @@ class Node:
 
     def is_running(self) -> bool:
         return self.switch.is_running()
+
+
+class InspectNode:
+    """A crippled node serving RPC straight off the stores — consensus
+    never runs (reference: internal/inspect; `cometbft inspect`).  For
+    post-mortem debugging of a halted chain."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.logger = get_logger("inspect")
+        self.genesis = GenesisDoc.load(config.genesis_file())
+        self.db = default_db_provider(config)
+        self.block_store = BlockStore(PrefixDB(self.db, b"bs/"))
+        self.state_store = StateStore(PrefixDB(self.db, b"ss/"))
+        from .indexer import BlockIndexer, TxIndexer
+
+        self.tx_indexer = TxIndexer(PrefixDB(self.db, b"txi/"))
+        self.block_indexer = BlockIndexer(PrefixDB(self.db, b"bli/"))
+        state = self.state_store.load()
+        if state is None:
+            raise RuntimeError("no state to inspect")
+
+        # the shims Environment dereferences
+        class _CS:
+            pass
+
+        self.consensus_state = _CS()
+        self.consensus_state.state = state
+
+        class _Reactor:
+            wait_sync = False
+
+        self.consensus_reactor = _Reactor()
+
+        class _Pool:
+            @staticmethod
+            def is_running():
+                return False
+
+        class _BS:
+            pool = _Pool()
+
+        self.blocksync_reactor = _BS()
+        from .mempool import NopMempool
+
+        self.mempool = NopMempool()
+        self.event_bus = EventBus()
+        self.node_key = NodeKey.load_or_gen(config.node_key_file())
+        self.node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            network=self.genesis.chain_id,
+            moniker=config.base.moniker,
+        )
+        self.priv_validator = None
+
+        class _Peers:
+            @staticmethod
+            def list():
+                return []
+
+        class _Switch:
+            peers = _Peers()
+
+            @staticmethod
+            def is_running():
+                return False
+
+        self.switch = _Switch()
+        self.listen_addr = None
+        self.app_conns = None  # abci_* endpoints will error: no app here
+        self.rpc_server = None
+
+    def start(self) -> None:
+        from .rpc.server import RPCServer
+
+        self.rpc_server = RPCServer(self)
+        self.rpc_server.start(_strip_tcp(self.config.rpc.laddr))
+
+    def stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.db.close()

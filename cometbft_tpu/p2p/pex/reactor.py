@@ -156,8 +156,10 @@ class PexReactor(Reactor):
         tried = set()
         for _ in range(need * 3):
             addr = self.book.pick_address()
-            if addr is None or addr in tried:
+            if addr is None:
                 break
+            if addr in tried:
+                continue  # re-picked: keep spending the dial budget
             tried.add(addr)
             pid = addr.split("@", 1)[0]
             if pid in connected or pid == self.switch.transport.node_key.id():

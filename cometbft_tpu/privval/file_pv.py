@@ -63,19 +63,15 @@ class FilePVKey:
     def save(self) -> None:
         if not self.file_path:
             return
+        from ..utils import amino_json
+
         _atomic_write(
             self.file_path,
-            json.dumps(
+            amino_json.marshal(
                 {
                     "address": self.address.hex().upper(),
-                    "pub_key": {
-                        "type": "tendermint/PubKeyEd25519",
-                        "value": base64.b64encode(self.pub_key.data).decode(),
-                    },
-                    "priv_key": {
-                        "type": "tendermint/PrivKeyEd25519",
-                        "value": base64.b64encode(self.priv_key.data).decode(),
-                    },
+                    "pub_key": self.pub_key,
+                    "priv_key": self.priv_key,
                 },
                 indent=2,
             ),
@@ -83,10 +79,11 @@ class FilePVKey:
 
     @classmethod
     def load(cls, file_path: str) -> "FilePVKey":
+        from ..utils import amino_json
+
         with open(file_path) as f:
-            d = json.load(f)
-        raw = base64.b64decode(d["priv_key"]["value"])
-        return cls(ed25519.PrivKey(raw), file_path)
+            d = amino_json.unmarshal(f.read())
+        return cls(d["priv_key"], file_path)
 
 
 class FilePVLastSignState:

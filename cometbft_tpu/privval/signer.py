@@ -27,6 +27,13 @@ class RemoteSignerError(Exception):
     pass
 
 
+class SignerTransportError(RemoteSignerError):
+    """Connection-level failure: retryable.  Signer-side rejections
+    (double-sign refusals, chain-id mismatches) stay plain
+    RemoteSignerError and are permanent — the reference's retry client
+    only retries transport errors (retry_signer_client.go)."""
+
+
 def decode_varint_stream(conn) -> int | None:
     """Read one varint length prefix off a conn (protoio reader)."""
     shift, out = 0, 0
@@ -164,7 +171,7 @@ class SignerListenerEndpoint:
             time.sleep(self.ping_period)
             try:
                 self.request(pb.PrivvalMessage(ping_request=pb.PingRequest()))
-            except RemoteSignerError:
+            except SignerTransportError:
                 pass
 
     def wait_for_signer(self, timeout: float = 30.0) -> bool:
@@ -174,16 +181,16 @@ class SignerListenerEndpoint:
         with self._mtx:
             conn = self._conn
             if conn is None:
-                raise RemoteSignerError("no signer connected")
+                raise SignerTransportError("no signer connected")
             try:
                 _send_msg(conn, msg)
                 resp = _recv_msg(conn)
             except OSError as e:
                 self._drop(conn)
-                raise RemoteSignerError(f"signer connection failed: {e}") from e
+                raise SignerTransportError(f"signer connection failed: {e}") from e
             if resp is None:
                 self._drop(conn)
-                raise RemoteSignerError("signer connection closed")
+                raise SignerTransportError("signer connection closed")
             return resp
 
     def _drop(self, conn) -> None:
@@ -304,9 +311,11 @@ class RetrySignerClient:
         for _ in range(self.retries):
             try:
                 return fn(*args, **kwargs)
-            except RemoteSignerError as e:
+            except SignerTransportError as e:
                 last = e
                 time.sleep(self.delay)
+            # signer-side rejections (double-sign protection etc.) are
+            # permanent: surface immediately
         raise last
 
     def get_pub_key(self):
@@ -337,6 +346,7 @@ class SignerServer:
         self.logger = get_logger("signer-server")
         self._stopped = False
         self._thread: threading.Thread | None = None
+        self._active = None  # the live conn, closed by stop()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -344,6 +354,12 @@ class SignerServer:
 
     def stop(self) -> None:
         self._stopped = True
+        active = self._active
+        if active is not None:
+            try:
+                active.close()
+            except OSError:
+                pass
 
     def _run(self) -> None:
         while not self._stopped:
@@ -363,20 +379,19 @@ class SignerServer:
                     conn = make_secret_connection(sock, self.identity_key)
                 else:
                     conn = _PlainConn(sock)
+                self._active = conn
                 self._serve(conn)
-            except OSError as e:
+            except Exception as e:  # noqa: BLE001 - never kill the dial loop
                 self.logger.error(f"signer connection lost: {e}")
             finally:
+                self._active = None
                 try:
                     sock.close()
                 except OSError:
                     pass
 
     def _serve(self, conn) -> None:
-        if isinstance(conn, _PlainConn):
-            conn._sock.settimeout(None)
-        else:
-            conn._sock.settimeout(None)
+        conn._sock.settimeout(None)
         while not self._stopped:
             req = _recv_msg(conn)
             if req is None:

@@ -280,6 +280,7 @@ class BlockExecutor:
         ev_pool=None,
         block_store=None,
         event_bus: EventBus | None = None,
+        pruner=None,
     ):
         self.store = state_store
         self.proxy_app = proxy_app
@@ -287,6 +288,7 @@ class BlockExecutor:
         self.ev_pool = ev_pool or EmptyEvidencePool()
         self.block_store = block_store
         self.event_bus = event_bus or NopEventBus()
+        self.pruner = pruner
         self.logger = get_logger("executor")
 
     # -------------------------------------------------------- proposing
@@ -424,12 +426,17 @@ class BlockExecutor:
         self.store.save(new_state)
 
         if retain_height > 0 and self.block_store is not None:
-            try:
-                pruned = self.block_store.prune_blocks(retain_height)
-                self.store.prune_states(retain_height, h)
-                self.logger.info(f"pruned {pruned} blocks below {retain_height}")
-            except Exception as e:  # noqa: BLE001 - pruning is best-effort
-                self.logger.error(f"pruning failed: {e}")
+            if self.pruner is not None:
+                # defer to the background pruner (state/pruner.go): the
+                # commit path only records the app's permission
+                self.pruner.set_app_block_retain_height(retain_height)
+            else:
+                try:
+                    pruned = self.block_store.prune_blocks(retain_height)
+                    self.store.prune_states(retain_height, h)
+                    self.logger.info(f"pruned {pruned} blocks below {retain_height}")
+                except Exception as e:  # noqa: BLE001 - pruning is best-effort
+                    self.logger.error(f"pruning failed: {e}")
 
         self._fire_events(block, block_id, fb_resp, validator_updates)
         return new_state

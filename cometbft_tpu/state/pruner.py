@@ -1,0 +1,107 @@
+"""Background pruner: reconciles retain heights and prunes stores
+(reference: state/pruner.go, 520 LoC).
+
+Two requesters can hold data back: the application (retain_height from
+its Commit responses) and a data companion (set over the privileged
+pruning API).  The service prunes blocks + state snapshots up to the
+minimum of the registered retain heights, in the background, so the
+commit path never blocks on compaction.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+
+_APP_RETAIN = b"prune/app_block_retain"
+_COMPANION_RETAIN = b"prune/companion_block_retain"
+
+
+class Pruner(Service):
+    def __init__(
+        self,
+        db,
+        state_store,
+        block_store,
+        interval: float = 10.0,
+    ):
+        super().__init__("Pruner")
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.interval = interval
+        self.logger = get_logger("pruner")
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------ retain heights
+
+    def _get(self, key: bytes) -> int:
+        raw = self.db.get(key)
+        return struct.unpack(">q", raw)[0] if raw else 0
+
+    def _set(self, key: bytes, height: int) -> None:
+        self.db.set(key, struct.pack(">q", height))
+
+    def set_app_block_retain_height(self, height: int) -> None:
+        """From the app's Commit response (pruner.go SetApplicationBlockRetainHeight)."""
+        if height > self._get(_APP_RETAIN):
+            self._set(_APP_RETAIN, height)
+            self._wake.set()
+
+    def set_companion_block_retain_height(self, height: int) -> None:
+        """From the privileged pruning service."""
+        if height > self._get(_COMPANION_RETAIN):
+            self._set(_COMPANION_RETAIN, height)
+            self._wake.set()
+
+    def app_block_retain_height(self) -> int:
+        return self._get(_APP_RETAIN)
+
+    def companion_block_retain_height(self) -> int:
+        return self._get(_COMPANION_RETAIN)
+
+    def effective_retain_height(self) -> int:
+        """min of the registered holders; 0 = nothing prunable yet."""
+        app = self._get(_APP_RETAIN)
+        comp = self._get(_COMPANION_RETAIN)
+        if app == 0:
+            return 0  # the app never allowed pruning
+        return min(app, comp) if comp else app
+
+    # ------------------------------------------------------------- service
+
+    def on_start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._routine, daemon=True, name="pruner"
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._wake.set()
+
+    def _routine(self) -> None:
+        while self.is_running():
+            self._wake.wait(self.interval)
+            self._wake.clear()
+            if not self.is_running():
+                return
+            try:
+                self.prune_once()
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"pruning failed: {e}")
+
+    def prune_once(self) -> int:
+        """One reconciliation pass; returns blocks pruned."""
+        retain = self.effective_retain_height()
+        if retain <= self.block_store.base:
+            return 0
+        retain = min(retain, self.block_store.height)  # never prune the tip past it
+        pruned = self.block_store.prune_blocks(retain)
+        if pruned:
+            self.state_store.prune_states(retain, self.block_store.height)
+            self.logger.info(f"pruned {pruned} blocks below height {retain}")
+        return pruned

@@ -1,0 +1,87 @@
+"""Roll the engine state back one height (reference: state/rollback.go).
+
+For recovering from an app that needs to re-execute the last block (or
+from a non-deterministic commit): rebuilds state at height n-1 from the
+stored blocks/validators/params and overwrites the latest state.  The
+application's own state is NOT touched — pair with the app's rollback.
+"""
+
+from __future__ import annotations
+
+from ..types.block import BlockID, Header
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback(block_store, state_store, remove_block: bool = False) -> tuple[int, bytes]:
+    """Returns (new_height, app_hash)."""
+    invalid_state = state_store.load()
+    if invalid_state is None:
+        raise RollbackError("no state found")
+    height = block_store.height
+
+    # a crash can leave the block store one ahead of the state store:
+    # the pending block is the only thing to discard (rollback.go:28)
+    if height == invalid_state.last_block_height + 1:
+        if remove_block:
+            block_store.delete_latest_block()
+        return invalid_state.last_block_height, invalid_state.app_hash
+
+    if height != invalid_state.last_block_height:
+        raise RollbackError(
+            f"statestore height ({invalid_state.last_block_height}) is not "
+            f"one below or equal to blockstore height ({height})"
+        )
+
+    rollback_height = invalid_state.last_block_height - 1
+    rollback_meta = block_store.load_block_meta(rollback_height)
+    if rollback_meta is None:
+        raise RollbackError(f"block at height {rollback_height} not found")
+    latest_meta = block_store.load_block_meta(invalid_state.last_block_height)
+    if latest_meta is None:
+        raise RollbackError(
+            f"block at height {invalid_state.last_block_height} not found"
+        )
+
+    previous_last_validators = state_store.load_validators(rollback_height)
+    if previous_last_validators is None:
+        raise RollbackError(f"no validators stored for height {rollback_height}")
+    previous_params = state_store.load_consensus_params(rollback_height + 1)
+    if previous_params is None:
+        raise RollbackError(f"no params stored for height {rollback_height + 1}")
+
+    next_height = rollback_height + 1
+    val_change = min(
+        invalid_state.last_height_validators_changed, next_height + 1
+    )
+    params_change = invalid_state.last_height_consensus_params_changed
+    if params_change > rollback_height:
+        params_change = rollback_height + 1
+
+    rb_header = Header.from_proto(rollback_meta.header)
+    latest_header = Header.from_proto(latest_meta.header)
+
+    from .state import State
+
+    rolled_back = State(
+        chain_id=invalid_state.chain_id,
+        initial_height=invalid_state.initial_height,
+        last_block_height=rb_header.height,
+        last_block_id=BlockID.from_proto(rollback_meta.block_id),
+        last_block_time=rb_header.time,
+        next_validators=invalid_state.validators.copy(),
+        validators=invalid_state.last_validators.copy(),
+        last_validators=previous_last_validators,
+        last_height_validators_changed=val_change,
+        consensus_params=previous_params,
+        last_height_consensus_params_changed=params_change,
+        last_results_hash=latest_header.last_results_hash,
+        app_hash=latest_header.app_hash,
+        app_version=previous_params.version.app,
+    )
+    state_store.save(rolled_back)
+    if remove_block:
+        block_store.delete_latest_block()
+    return rolled_back.last_block_height, rolled_back.app_hash

@@ -88,6 +88,24 @@ class BlockStore:
     def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
         self._db.set(_h(b"SC:", height), seen_commit.to_proto().encode())
 
+    def delete_latest_block(self) -> None:
+        """Drop the newest block (store.go DeleteLatestBlock; rollback)."""
+        with self._mtx:
+            height = self.height
+            if height == 0:
+                raise ValueError("block store is empty")
+            meta = self.load_block_meta(height)
+            deletes = [_h(b"H:", height), _h(b"SC:", height), _h(b"EC:", height), _h(b"C:", height - 1)]
+            if meta is not None and meta.block_id is not None:
+                deletes.append(b"BH:" + meta.block_id.hash)
+                total = (meta.block_id.part_set_header or pb.PartSetHeader()).total
+                for i in range(total):
+                    deletes.append(_h(b"P:", height) + struct.pack(">I", i))
+            self.height = height - 1
+            if self.height < self.base:
+                self.base = self.height
+            self._db.write_batch(self._save_state(), deletes)
+
     # ------------------------------------------------------------- load
 
     def load_block_meta(self, height: int) -> pb.BlockMeta | None:

@@ -1,0 +1,176 @@
+"""ctypes binding for the native C++ storage engine (native/kvstore.cc).
+
+The native engine is the analogue of the reference's pebble backend
+(db/pebbledb.go): an ordered, batched, crash-safe persistent KV store —
+append-only CRC-framed value log + in-memory ordered index, compacted in
+place.  Batches are fsync'd, so the per-height write unit is durable the
+way the reference's pebble WAL makes it.
+
+The shared object is built from source on first use when missing (the
+repo ships no binaries); `make -C native` does the same.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .db import DB
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libcometkv.so"))
+
+_lib = None
+_lib_mtx = threading.Lock()
+
+
+class NativeDBError(Exception):
+    pass
+
+
+def _load_lib():
+    global _lib
+    with _lib_mtx:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            src = os.path.join(_NATIVE_DIR, "kvstore.cc")
+            if not os.path.exists(src):
+                raise NativeDBError(f"native source missing: {src}")
+            subprocess.run(
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-O2", "-fPIC", "-std=c++17", "-shared",
+                    "-o", _SO_PATH, src,
+                ],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_get.restype = ctypes.c_int64
+        lib.kv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.kv_free.argtypes = [ctypes.c_void_p]
+        lib.kv_has.restype = ctypes.c_int
+        lib.kv_has.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_write_batch.restype = ctypes.c_int
+        lib.kv_write_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.kv_range.restype = ctypes.c_void_p
+        lib.kv_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+        ]
+        lib.kv_iter_next.restype = ctypes.c_int
+        lib.kv_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kv_iter_close.argtypes = [ctypes.c_void_p]
+        lib.kv_size.restype = ctypes.c_uint64
+        lib.kv_size.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeDB(DB):
+    """DB interface over the C++ engine."""
+
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise NativeDBError(f"failed to open native store at {path}")
+
+    def get(self, key: bytes) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.kv_get(self._h, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.kv_free(out)
+
+    def has(self, key: bytes) -> bool:
+        return bool(self._lib.kv_has(self._h, key, len(key)))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.write_batch([(key, value)])
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch([], [key])
+
+    def write_batch(self, sets, deletes=()) -> None:
+        buf = bytearray()
+        for k, v in sets:
+            buf += bytes([1])
+            buf += len(k).to_bytes(4, "little")
+            buf += len(v).to_bytes(4, "little")
+            buf += k
+            buf += v
+        for k in deletes:
+            buf += bytes([2])
+            buf += len(k).to_bytes(4, "little")
+            buf += (0).to_bytes(4, "little")
+            buf += k
+        if not buf:
+            return
+        if not self._lib.kv_write_batch(self._h, bytes(buf), len(buf)):
+            raise NativeDBError("batch write failed")
+
+    def _iter(self, start, end, reverse):
+        it = self._lib.kv_range(
+            self._h,
+            start or b"", len(start or b""),
+            end or b"", len(end or b""),
+            1 if reverse else 0,
+        )
+        try:
+            kp = ctypes.POINTER(ctypes.c_uint8)()
+            vp = ctypes.POINTER(ctypes.c_uint8)()
+            kn = ctypes.c_uint64()
+            vn = ctypes.c_uint64()
+            while self._lib.kv_iter_next(
+                it, ctypes.byref(kp), ctypes.byref(kn),
+                ctypes.byref(vp), ctypes.byref(vn),
+            ):
+                k = ctypes.string_at(kp, kn.value)
+                v = ctypes.string_at(vp, vn.value)
+                self._lib.kv_free(kp)
+                self._lib.kv_free(vp)
+                yield k, v
+        finally:
+            self._lib.kv_iter_close(it)
+
+    def iterator(self, start=None, end=None):
+        return self._iter(start, end, False)
+
+    def reverse_iterator(self, start=None, end=None):
+        return self._iter(start, end, True)
+
+    def compact(self) -> None:
+        if not self._lib.kv_compact(self._h):
+            raise NativeDBError("compaction failed")
+
+    def size(self) -> int:
+        return int(self._lib.kv_size(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None

@@ -20,6 +20,10 @@ from .validators import ValidatorSet
 
 BATCH_VERIFY_THRESHOLD = 2  # validation.go:15
 
+# optional latency observer (seconds) installed by the node's metrics
+# wiring; covers the device batch-verify call specifically
+VERIFY_LATENCY_OBSERVER = None
+
 
 class CommitVerificationError(Exception):
     pass
@@ -241,7 +245,14 @@ def _verify_commit_batch(
     if not batch_sig_idxs:
         return  # everything came from the cache
 
-    ok, valid_sigs = bv.verify()
+    if VERIFY_LATENCY_OBSERVER is not None:
+        import time as _time
+
+        _t0 = _time.perf_counter()
+        ok, valid_sigs = bv.verify()
+        VERIFY_LATENCY_OBSERVER(_time.perf_counter() - _t0)
+    else:
+        ok, valid_sigs = bv.verify()
     if ok:
         if cache is not None:
             for i, idx in enumerate(batch_sig_idxs):

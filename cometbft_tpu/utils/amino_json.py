@@ -1,0 +1,109 @@
+"""Amino-compatible JSON with a type registry
+(reference: libs/json/{types,encoder,decoder}.go).
+
+Registered Go-style interface implementations serialize as
+    {"type": "<registered name>", "value": <json>}
+so genesis docs, privval files, and RPC payloads stay byte-compatible
+with the reference's tooling.  Unregistered values pass through the
+plain JSON encoder.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Callable
+
+_BY_NAME: dict[str, tuple[type, Callable, Callable]] = {}
+_BY_TYPE: dict[type, str] = {}
+
+
+class AminoJSONError(Exception):
+    pass
+
+
+def register_type(
+    cls: type, name: str, encode: Callable[[Any], Any], decode: Callable[[Any], Any]
+) -> None:
+    """libs/json RegisterType: bind cls <-> its amino type name."""
+    if name in _BY_NAME:
+        raise AminoJSONError(f"type name {name!r} already registered")
+    if cls in _BY_TYPE:
+        raise AminoJSONError(f"class {cls.__name__} already registered")
+    _BY_NAME[name] = (cls, encode, decode)
+    _BY_TYPE[cls] = name
+
+
+def marshal(value: Any, indent: int | None = None) -> str:
+    return json.dumps(_encode(value), indent=indent)
+
+
+def unmarshal(data: str | bytes) -> Any:
+    return _decode(json.loads(data))
+
+
+def _encode(value: Any) -> Any:
+    t = type(value)
+    if t in _BY_TYPE:
+        name = _BY_TYPE[t]
+        _, enc, _ = _BY_NAME[name]
+        return {"type": name, "value": _encode(enc(value))}
+    if isinstance(value, bytes):
+        return base64.b64encode(value).decode()
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    return value
+
+
+def _decode(value: Any) -> Any:
+    if isinstance(value, dict):
+        if set(value.keys()) == {"type", "value"} and value["type"] in _BY_NAME:
+            _, _, dec = _BY_NAME[value["type"]]
+            return dec(_decode(value["value"]))
+        return {k: _decode(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_decode(v) for v in value]
+    return value
+
+
+# ---------------------------------------------------------------- registry
+# The names the reference registers (crypto/encoding/codec.go + privval):
+
+
+def _register_crypto() -> None:
+    from ..crypto import ed25519
+
+    register_type(
+        ed25519.PubKey,
+        "tendermint/PubKeyEd25519",
+        lambda k: base64.b64encode(k.data).decode(),
+        lambda v: ed25519.PubKey(base64.b64decode(v)),
+    )
+    register_type(
+        ed25519.PrivKey,
+        "tendermint/PrivKeyEd25519",
+        lambda k: base64.b64encode(k.data).decode(),
+        lambda v: ed25519.PrivKey(base64.b64decode(v)),
+    )
+    try:
+        from ..crypto import secp256k1
+
+        register_type(
+            secp256k1.PubKey,
+            "tendermint/PubKeySecp256k1",
+            lambda k: base64.b64encode(k.data).decode(),
+            lambda v: secp256k1.PubKey(base64.b64decode(v)),
+        )
+        register_type(
+            secp256k1.PrivKey,
+            "tendermint/PrivKeySecp256k1",
+            lambda k: base64.b64encode(k.data).decode(),
+            lambda v: secp256k1.PrivKey(base64.b64decode(v)),
+        )
+    except ImportError:
+        pass
+
+
+_register_crypto()

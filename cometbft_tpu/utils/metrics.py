@@ -1,0 +1,204 @@
+"""Metrics registry + Prometheus text exposition
+(reference: libs/metrics + scripts/metricsgen codegen output, e.g.
+internal/consensus/metrics.go:19).
+
+A process-global Registry of counters/gauges/histograms with label
+support; subsystems declare their metric sets declaratively (the
+analogue of the reference's struct-tag codegen) and the node exposes
+/metrics in the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name = name
+        self.help = help_
+        self._mtx = threading.Lock()
+        if registry is not None:
+            registry._register(self)
+
+    @staticmethod
+    def _label_key(labels: dict | None) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    @staticmethod
+    def _fmt_labels(key: tuple) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        k = self._label_key(labels)
+        with self._mtx:
+            self._values[k] = self._values.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._mtx:
+            return self._values.get(self._label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        with self._mtx:
+            items = sorted(self._values.items())
+        return [
+            f"{self.name}{self._fmt_labels(k)} {v}"
+            for k, v in (items or [((), 0.0)])
+        ]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        with self._mtx:
+            self._values[self._label_key(labels)] = float(v)
+
+    def add(self, n: float, **labels) -> None:
+        k = self._label_key(labels)
+        with self._mtx:
+            self._values[k] = self._values.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._mtx:
+            return self._values.get(self._label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        with self._mtx:
+            items = sorted(self._values.items())
+        return [
+            f"{self.name}{self._fmt_labels(k)} {v}"
+            for k, v in (items or [((), 0.0)])
+        ]
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS, registry=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        k = self._label_key(labels)
+        with self._mtx:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            # per-bucket increments; the cumulative form is produced at
+            # expose time.  bisect_left = first bucket bound >= v; values
+            # above every bound only count toward +Inf/sum/count.
+            idx = bisect_left(self.buckets, v)
+            if idx < len(self.buckets):
+                counts[idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + v
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def expose(self) -> list[str]:
+        out = []
+        with self._mtx:
+            keys = sorted(self._counts) or [()]
+            for k in keys:
+                counts = self._counts.get(k, [0] * len(self.buckets))
+                cum = 0
+                for b, c in zip(self.buckets, counts):
+                    cum += c
+                    lk = k + (("le", str(b)),)
+                    out.append(f"{self.name}_bucket{self._fmt_labels(lk)} {cum}")
+                lk = k + (("le", "+Inf"),)
+                out.append(
+                    f"{self.name}_bucket{self._fmt_labels(lk)} "
+                    f"{self._totals.get(k, 0)}"
+                )
+                out.append(
+                    f"{self.name}_sum{self._fmt_labels(k)} {self._sums.get(k, 0.0)}"
+                )
+                out.append(
+                    f"{self.name}_count{self._fmt_labels(k)} {self._totals.get(k, 0)}"
+                )
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "cometbft"):
+        self.namespace = namespace
+        self._metrics: list[_Metric] = []
+        self._mtx = threading.Lock()
+
+    def _register(self, m: _Metric) -> None:
+        with self._mtx:
+            self._metrics.append(m)
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return Counter(f"{self.namespace}_{name}", help_, registry=self)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return Gauge(f"{self.namespace}_{name}", help_, registry=self)
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return Histogram(
+            f"{self.namespace}_{name}", help_, buckets, registry=self
+        )
+
+    def expose_text(self) -> str:
+        """Prometheus text format v0.0.4."""
+        lines = []
+        with self._mtx:
+            metrics = list(self._metrics)
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class NodeMetrics:
+    """The node's metric set (the named subset of the reference's
+    per-package metricsgen output that the QA dashboards read)."""
+
+    def __init__(self, registry: Registry):
+        r = registry
+        # consensus (internal/consensus/metrics.go:19)
+        self.consensus_height = r.gauge("consensus_height", "Current height")
+        self.consensus_rounds = r.gauge("consensus_rounds", "Round of the current height")
+        self.consensus_validators = r.gauge("consensus_validators", "Validator set size")
+        self.consensus_block_interval = r.histogram(
+            "consensus_block_interval_seconds",
+            "Time between this and the last block",
+            buckets=(0.5, 1, 2, 3, 5, 7, 10, 15, 30),
+        )
+        self.consensus_num_txs = r.gauge("consensus_num_txs", "Txs in the latest block")
+        self.consensus_total_txs = r.counter("consensus_total_txs", "Total committed txs")
+        # mempool
+        self.mempool_size = r.gauge("mempool_size", "Pending txs")
+        self.mempool_size_bytes = r.gauge("mempool_size_bytes", "Pending tx bytes")
+        # p2p
+        self.p2p_peers = r.gauge("p2p_peers", "Connected peers")
+        # verification plane (ours: the TPU hot path)
+        self.verify_commit_seconds = r.histogram(
+            "verify_commit_seconds",
+            "VerifyCommit latency (batch verifier path)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+        )

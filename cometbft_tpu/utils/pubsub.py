@@ -98,7 +98,11 @@ class PubSub:
         self._subs: dict[tuple[str, str], Subscription] = {}
         self._mtx = threading.RLock()
 
-    def subscribe(self, subscriber: str, query: Query | str) -> Subscription:
+    def subscribe(
+        self, subscriber: str, query: Query | str, unbuffered: bool = False
+    ) -> Subscription:
+        """unbuffered=True gives an unbounded queue for subscribers that
+        must never shed (the indexer; pubsub.go SubscribeUnbuffered)."""
         if isinstance(query, str):
             query = Query(query)
         key = (subscriber, query.expr)
@@ -106,6 +110,8 @@ class PubSub:
             if key in self._subs:
                 raise ValueError(f"already subscribed: {key}")
             sub = Subscription(subscriber, query)
+            if unbuffered:
+                sub.out = queue.Queue(maxsize=0)
             self._subs[key] = sub
             return sub
 

@@ -1,0 +1,306 @@
+// Native storage engine for the block/state stores.
+//
+// Reference parity target: the role pebble plays in the reference
+// (db/pebbledb.go — an ordered, batched, persistent KV store).  Design
+// here is a single-writer log-structured store: an append-only value log
+// with CRC-framed records, an in-memory ordered index (std::map) rebuilt
+// from the log on open, and periodic compaction that rewrites the live
+// set.  That matches this engine's actual workload — blocks and state
+// snapshots are written once per height in one batch, read by key or by
+// short ordered range scans, and pruned from the tail — without dragging
+// in a full LSM tree.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  // CRC-32 (Castagnoli polynomial, bitwise; cold path only)
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) {
+    crc ^= data[i];
+    for (int k = 0; k < 8; k++)
+      crc = (crc >> 1) ^ (0x82F63B78u & (0u - (crc & 1u)));
+  }
+  return crc ^ 0xFFFFFFFFu;
+}
+
+struct Record {
+  uint8_t type;  // 1 = set, 2 = delete
+  std::string key;
+  std::string value;
+};
+
+void put_u32(std::string& out, uint32_t v) {
+  out.push_back(static_cast<char>(v & 0xff));
+  out.push_back(static_cast<char>((v >> 8) & 0xff));
+  out.push_back(static_cast<char>((v >> 16) & 0xff));
+  out.push_back(static_cast<char>((v >> 24) & 0xff));
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& path) : path_(path) {
+    Load();
+    log_ = std::fopen(path_.c_str(), "ab");
+  }
+
+  ~KVStore() {
+    if (log_) std::fclose(log_);
+  }
+
+  bool ok() const { return log_ != nullptr; }
+
+  void Get(const std::string& key, std::string** out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = index_.find(key);
+    *out = (it == index_.end()) ? nullptr : new std::string(it->second);
+  }
+
+  bool Has(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    return index_.count(key) != 0;
+  }
+
+  // one durable batch (fsync'd): the per-height write unit
+  bool WriteBatch(const std::vector<Record>& recs) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string buf;
+    for (const auto& r : recs) EncodeRecord(r, buf);
+    if (std::fwrite(buf.data(), 1, buf.size(), log_) != buf.size()) return false;
+    if (std::fflush(log_) != 0) return false;
+    for (const auto& r : recs) {
+      if (r.type == 1)
+        index_[r.key] = r.value;
+      else
+        index_.erase(r.key);
+      dead_ += (r.type == 2) ? 1 : 0;
+    }
+    writes_since_compact_ += recs.size();
+    if (writes_since_compact_ > 200000 && dead_ * 4 > index_.size()) Compact();
+    return true;
+  }
+
+  // ordered iteration [start, end) — collected under the lock so the
+  // caller gets a stable snapshot
+  void Range(const std::string& start, const std::string& end, bool reverse,
+             std::vector<std::pair<std::string, std::string>>* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto lo = start.empty() ? index_.begin() : index_.lower_bound(start);
+    auto hi = end.empty() ? index_.end() : index_.lower_bound(end);
+    for (auto it = lo; it != hi; ++it) out->push_back(*it);
+    if (reverse) std::reverse(out->begin(), out->end());
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return index_.size();
+  }
+
+  bool Compact() {
+    // rewrite only the live set; callers hold mu_
+    std::string tmp = path_ + ".compact";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    std::string buf;
+    for (const auto& kv : index_) {
+      buf.clear();
+      EncodeRecord(Record{1, kv.first, kv.second}, buf);
+      if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+        std::fclose(f);
+        std::remove(tmp.c_str());
+        return false;
+      }
+    }
+    std::fflush(f);
+    std::fclose(f);
+    std::fclose(log_);
+    if (std::rename(tmp.c_str(), path_.c_str()) != 0) {
+      log_ = std::fopen(path_.c_str(), "ab");
+      return false;
+    }
+    log_ = std::fopen(path_.c_str(), "ab");
+    dead_ = 0;
+    writes_since_compact_ = 0;
+    return true;
+  }
+
+  bool CompactNow() {
+    std::lock_guard<std::mutex> g(mu_);
+    return Compact();
+  }
+
+ private:
+  static void EncodeRecord(const Record& r, std::string& out) {
+    // [crc32 of payload][payload len][payload: type|klen|key|value]
+    std::string payload;
+    payload.push_back(static_cast<char>(r.type));
+    put_u32(payload, static_cast<uint32_t>(r.key.size()));
+    payload += r.key;
+    payload += r.value;
+    put_u32(out, crc32c(reinterpret_cast<const uint8_t*>(payload.data()),
+                        payload.size()));
+    put_u32(out, static_cast<uint32_t>(payload.size()));
+    out += payload;
+  }
+
+  void Load() {
+    FILE* f = std::fopen(path_.c_str(), "rb");
+    if (!f) return;
+    std::vector<uint8_t> hdr(8);
+    std::vector<uint8_t> payload;
+    long good_end = 0;
+    while (true) {
+      if (std::fread(hdr.data(), 1, 8, f) != 8) break;
+      uint32_t crc = get_u32(hdr.data());
+      uint32_t len = get_u32(hdr.data() + 4);
+      if (len > (1u << 30)) break;  // corrupt length
+      payload.resize(len);
+      if (std::fread(payload.data(), 1, len, f) != len) break;
+      if (crc32c(payload.data(), len) != crc) break;  // torn tail: stop
+      uint8_t type = payload[0];
+      uint32_t klen = get_u32(payload.data() + 1);
+      if (5 + klen > len) break;
+      std::string key(reinterpret_cast<char*>(payload.data() + 5), klen);
+      if (type == 1) {
+        index_[key] = std::string(
+            reinterpret_cast<char*>(payload.data() + 5 + klen), len - 5 - klen);
+      } else {
+        index_.erase(key);
+      }
+      good_end = std::ftell(f);
+    }
+    std::fclose(f);
+    // truncate any torn tail so the append log stays well-formed
+    if (good_end >= 0) {
+      FILE* t = std::fopen(path_.c_str(), "rb+");
+      if (t) {
+#ifdef _WIN32
+#else
+        if (std::ftell(t) != good_end) {
+          // use ftruncate via fileno
+          (void)!ftruncate(fileno(t), good_end);
+        }
+#endif
+        std::fclose(t);
+      }
+    }
+  }
+
+  std::string path_;
+  FILE* log_ = nullptr;
+  std::map<std::string, std::string> index_;
+  std::mutex mu_;
+  size_t dead_ = 0;
+  size_t writes_since_compact_ = 0;
+};
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> items;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  auto* s = new KVStore(path);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(void* h) { delete static_cast<KVStore*>(h); }
+
+// returns value length, -1 when missing; caller frees with kv_free
+int64_t kv_get(void* h, const uint8_t* key, uint32_t klen, uint8_t** out) {
+  std::string* v = nullptr;
+  static_cast<KVStore*>(h)->Get(std::string((const char*)key, klen), &v);
+  if (!v) return -1;
+  int64_t n = static_cast<int64_t>(v->size());
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(v->size()));
+  std::memcpy(buf, v->data(), v->size());
+  *out = buf;
+  delete v;
+  return n;
+}
+
+void kv_free(uint8_t* p) { std::free(p); }
+
+int kv_has(void* h, const uint8_t* key, uint32_t klen) {
+  return static_cast<KVStore*>(h)->Has(std::string((const char*)key, klen));
+}
+
+// batch format from python: repeated [type u8][klen u32][vlen u32][key][value]
+int kv_write_batch(void* h, const uint8_t* data, uint64_t len) {
+  std::vector<Record> recs;
+  uint64_t off = 0;
+  while (off + 9 <= len) {
+    Record r;
+    r.type = data[off];
+    uint32_t klen = get_u32(data + off + 1);
+    uint32_t vlen = get_u32(data + off + 5);
+    off += 9;
+    if (off + klen + vlen > len) return 0;
+    r.key.assign((const char*)data + off, klen);
+    off += klen;
+    r.value.assign((const char*)data + off, vlen);
+    off += vlen;
+    recs.push_back(std::move(r));
+  }
+  if (off != len) return 0;
+  return static_cast<KVStore*>(h)->WriteBatch(recs) ? 1 : 0;
+}
+
+void* kv_range(void* h, const uint8_t* start, uint32_t slen, const uint8_t* end,
+               uint32_t elen, int reverse) {
+  auto* it = new Iter();
+  static_cast<KVStore*>(h)->Range(std::string((const char*)start, slen),
+                                  std::string((const char*)end, elen),
+                                  reverse != 0, &it->items);
+  return it;
+}
+
+// 1 if a pair was produced; buffers freed with kv_free
+int kv_iter_next(void* ih, uint8_t** key, uint64_t* klen, uint8_t** val,
+                 uint64_t* vlen) {
+  auto* it = static_cast<Iter*>(ih);
+  if (it->pos >= it->items.size()) return 0;
+  const auto& kv = it->items[it->pos++];
+  *klen = kv.first.size();
+  *vlen = kv.second.size();
+  uint8_t* kb = static_cast<uint8_t*>(std::malloc(kv.first.size()));
+  std::memcpy(kb, kv.first.data(), kv.first.size());
+  uint8_t* vb = static_cast<uint8_t*>(std::malloc(kv.second.size()));
+  std::memcpy(vb, kv.second.data(), kv.second.size());
+  *key = kb;
+  *val = vb;
+  return 1;
+}
+
+void kv_iter_close(void* ih) { delete static_cast<Iter*>(ih); }
+
+uint64_t kv_size(void* h) { return static_cast<KVStore*>(h)->Size(); }
+
+int kv_compact(void* h) { return static_cast<KVStore*>(h)->CompactNow() ? 1 : 0; }
+
+}  // extern "C"

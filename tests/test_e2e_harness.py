@@ -1,0 +1,128 @@
+"""E2E harness: ABCI grammar conformance + a perturbed multi-process
+localnet (reference: test/e2e/pkg/grammar/checker_test.go + runner)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.e2e import (
+    GrammarError,
+    Manifest,
+    NodeSpec,
+    RecordingApp,
+    Runner,
+    check_execution,
+)
+
+
+# ---------------------------------------------------------------- grammar
+
+
+def test_grammar_accepts_clean_start():
+    check_execution(
+        ["info", "init_chain"]
+        + ["prepare_proposal", "process_proposal", "finalize_block", "commit"] * 3,
+        clean_start=True,
+    )
+    # with state sync restore
+    check_execution(
+        ["init_chain", "offer_snapshot", "apply_snapshot_chunk",
+         "finalize_block", "commit"],
+        clean_start=True,
+    )
+    # crash mid-height: trace may end after FinalizeBlock
+    check_execution(
+        ["init_chain", "process_proposal", "finalize_block"], clean_start=True
+    )
+
+
+def test_grammar_accepts_recovery():
+    check_execution(
+        ["finalize_block", "commit", "process_proposal", "finalize_block", "commit"],
+        clean_start=False,
+    )
+
+
+def test_grammar_rejects_violations():
+    with pytest.raises(GrammarError):
+        check_execution(["prepare_proposal"], clean_start=True)  # no InitChain
+    with pytest.raises(GrammarError):
+        check_execution(
+            ["init_chain", "commit"], clean_start=True
+        )  # commit before finalize
+    with pytest.raises(GrammarError):
+        check_execution(
+            ["init_chain", "finalize_block", "finalize_block"], clean_start=True
+        )  # double finalize without commit
+    with pytest.raises(GrammarError):
+        check_execution(["init_chain"], clean_start=False)  # re-InitChain
+
+
+def test_recording_app_traces_consensus_calls():
+    from cometbft_tpu.abci import KVStoreApplication
+    from cometbft_tpu.abci.kvstore import default_lanes
+    from cometbft_tpu.proxy import local_client_creator, new_app_conns
+    from cometbft_tpu.wire import abci_pb as pb
+
+    rec = RecordingApp(KVStoreApplication(lanes=default_lanes()))
+    conns = new_app_conns(local_client_creator(rec))
+    conns.start()
+    try:
+        conns.consensus.init_chain(pb.InitChainRequest(chain_id="g"))
+        conns.consensus.finalize_block(
+            pb.FinalizeBlockRequest(height=1, txs=[], hash=b"\x01" * 32)
+        )
+        conns.consensus.commit()
+        check_execution(rec.calls, clean_start=True)
+        assert rec.calls == ["init_chain", "finalize_block", "commit"]
+    finally:
+        conns.stop()
+
+
+# ----------------------------------------------------------------- runner
+
+
+@pytest.mark.slow
+def test_perturbed_localnet_keeps_invariants(tmp_path):
+    """4-process localnet: one node joins late, one gets kill -9'd and
+    restarted, one paused — the chain stays fork-free and every node
+    converges (the runner's perturbation stages, runner/perturb.go)."""
+    m = Manifest(
+        chain_id="e2e-perturb",
+        nodes=[
+            NodeSpec("stable0"),
+            NodeSpec("killed", perturbations=["kill"]),
+            NodeSpec("paused", perturbations=["pause"]),
+            NodeSpec("late", start_at=4),
+        ],
+        target_height=10,
+    )
+    r = Runner(m, str(tmp_path / "net"), base_port=29250)
+    r.setup()
+    r.start()
+    try:
+        # reach some height, apply load + perturbations while running
+        deadline = time.monotonic() + 240
+        perturbed = False
+        round_id = 0
+        while time.monotonic() < deadline:
+            r.start_late_nodes()
+            hs = r._heights(only_running=True)
+            if hs and max(hs) >= 5 and not perturbed:
+                r.perturb()
+                perturbed = True
+            r.load(round_id)
+            round_id += 1
+            if hs and min(hs) >= m.target_height and all(
+                n.proc is not None for n in r.nodes
+            ) and len(hs) == len(r.nodes):
+                break
+            time.sleep(2.0)
+        assert perturbed, "perturbations never applied"
+        heights = r._heights(only_running=True)
+        assert len(heights) == 4, f"nodes lost: {heights}"
+        assert min(heights) >= m.target_height, f"stalled: {heights}"
+        problems = r.check_invariants(upto=m.target_height)
+        assert not problems, problems
+    finally:
+        r.stop_all()

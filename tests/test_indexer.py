@@ -58,8 +58,10 @@ def test_tx_indexer_index_get_search():
     hits = idx.search("transfer.amount>5")
     assert {r["index"] for r in hits} == {1, 2}
 
-    # by hash
+    # by hash — either case matches (values are stored uppercase)
     hits = idx.search(f"tx.hash='{tx_hash(b'gamma=3').hex().upper()}'")
+    assert len(hits) == 1 and hits[0]["index"] == 2
+    hits = idx.search(f"tx.hash='{tx_hash(b'gamma=3').hex()}'")
     assert len(hits) == 1 and hits[0]["index"] == 2
 
 

@@ -1,0 +1,155 @@
+"""Pruner service, rollback, inspect mode, and the metrics registry
+(reference: state/pruner.go, state/rollback.go, internal/inspect,
+metricsgen output)."""
+
+import pytest
+
+from cometbft_tpu.state.pruner import Pruner
+from cometbft_tpu.state.rollback import RollbackError, rollback
+from cometbft_tpu.store.db import MemDB, PrefixDB
+from cometbft_tpu.utils.metrics import NodeMetrics, Registry
+
+from test_execution import GENESIS_NS, Harness
+
+NS = 1_000_000_000
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.stop()
+
+
+def _grow(h, n):
+    for i in range(n):
+        h.step(1 + i, GENESIS_NS + (1 + i) * 2 * NS)
+
+
+def test_pruner_prunes_to_min_retain(harness):
+    _grow(harness, 10)
+    p = Pruner(MemDB(), harness.state_store, harness.block_store)
+    assert p.prune_once() == 0  # app never allowed pruning
+    p.set_app_block_retain_height(8)
+    p.set_companion_block_retain_height(6)
+    assert p.effective_retain_height() == 6  # companion holds data back
+    assert p.prune_once() == 5  # blocks 1..5 dropped
+    assert harness.block_store.base == 6
+    assert harness.block_store.load_block(5) is None
+    assert harness.block_store.load_block(6) is not None
+    # companion catches up: prune to the app's height
+    p.set_companion_block_retain_height(8)
+    assert p.prune_once() == 2
+    assert harness.block_store.base == 8
+
+
+def test_rollback_state_one_height(harness):
+    _grow(harness, 6)
+    st = harness.state_store.load()
+    assert st.last_block_height == 6
+    h, app_hash = rollback(harness.block_store, harness.state_store)
+    assert h == 5
+    st2 = harness.state_store.load()
+    assert st2.last_block_height == 5
+    # the rolled-back state still carries the agreed results of block 6's
+    # header (app hash only lands in the following header)
+    b6 = harness.block_store.load_block_meta(6)
+    assert st2.app_hash == b6.header.app_hash
+    # store (6) is now one ahead of state (5): the next call is the
+    # discard-pending-block case and, with remove_block, drops block 6
+    h2, _ = rollback(harness.block_store, harness.state_store, remove_block=True)
+    assert h2 == 5 and harness.block_store.height == 5
+    # now a true rollback again: 5 -> 4
+    h3, _ = rollback(harness.block_store, harness.state_store)
+    assert h3 == 4 and harness.state_store.load().last_block_height == 4
+
+
+def test_rollback_discards_pending_block(harness):
+    """Crash between SaveBlock and state save: store is one ahead; a hard
+    rollback drops the orphaned block (rollback.go:28)."""
+    _grow(harness, 4)
+    from cometbft_tpu.wire.canonical import Timestamp
+
+    block, ps = harness.propose(5, harness.last_commit_ts)
+    bid, commit = harness.commit_for(
+        block, ps, Timestamp.from_unix_ns(GENESIS_NS + 11 * NS)
+    )
+    harness.block_store.save_block(block, ps, commit)  # no state save
+    h, _ = rollback(harness.block_store, harness.state_store, remove_block=True)
+    assert h == 4 and harness.block_store.height == 4
+
+
+def test_block_store_delete_latest(harness):
+    _grow(harness, 3)
+    assert harness.block_store.height == 3
+    harness.block_store.delete_latest_block()
+    assert harness.block_store.height == 2
+    assert harness.block_store.load_block(3) is None
+    assert harness.block_store.load_block(2) is not None
+
+
+def test_metrics_registry_exposition():
+    r = Registry(namespace="test")
+    c = r.counter("events_total", "Events seen")
+    g = r.gauge("height", "Current height")
+    h = r.histogram("latency_seconds", "Latency", buckets=(0.1, 1.0))
+    c.inc()
+    c.inc(2, kind="vote")
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)  # above every bucket: only +Inf/count/sum
+    text = r.expose_text()
+    assert "# TYPE test_events_total counter" in text
+    assert "test_events_total 1.0" in text
+    assert 'test_events_total{kind="vote"} 2.0' in text
+    assert "test_height 42.0" in text
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_latency_seconds_count 3" in text
+    node_metrics = NodeMetrics(Registry())  # the full named set constructs
+    assert node_metrics.consensus_height is not None
+
+
+def test_inspect_mode_serves_stores(tmp_path):
+    """inspect: RPC over the stores with no consensus running."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_node_rpc import _mk_home, _test_cfg
+
+    from cometbft_tpu.node import InspectNode, Node
+    from cometbft_tpu.rpc import HTTPClient
+    import time
+
+    home = _mk_home(tmp_path, "insp", chain_id="insp-chain")
+    cfg = _test_cfg(home)
+    cfg.base.db_backend = "sqlite"  # stores must survive the node
+    node = Node(cfg)
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while (
+            node.consensus_state.state.last_block_height < 3
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert node.consensus_state.state.last_block_height >= 3
+    finally:
+        node.stop()
+
+    cfg2 = _test_cfg(home)
+    cfg2.base.db_backend = "sqlite"
+    insp = InspectNode(cfg2)
+    insp.start()
+    try:
+        rpc = HTTPClient(insp.rpc_server.listen_addr)
+        st = rpc.status()
+        assert int(st["sync_info"]["latest_block_height"]) >= 3
+        blk = rpc.block(2)
+        assert blk["block"]["header"]["height"] == "2"
+        cm = rpc.commit(2)
+        assert cm["signed_header"]["commit"]["height"] == "2"
+    finally:
+        insp.stop()
