@@ -88,10 +88,22 @@ def block_json(b) -> dict:
     }
 
 
+_AMINO_PUBKEY_NAMES = {
+    "ed25519": "tendermint/PubKeyEd25519",
+    "secp256k1": "tendermint/PubKeySecp256k1",
+    "bls12_381": "cometbft/PubKeyBls12_381",
+    "secp256k1eth": "cometbft/PubKeySecp256k1eth",
+}
+
+
 def validator_json(v) -> dict:
+    kt = v.pub_key.type
     return {
         "address": hex_up(v.address),
-        "pub_key": {"type": "tendermint/PubKeyEd25519", "value": b64(v.pub_key.bytes())},
+        "pub_key": {
+            "type": _AMINO_PUBKEY_NAMES.get(kt, kt),
+            "value": b64(v.pub_key.bytes()),
+        },
         "voting_power": str(v.voting_power),
         "proposer_priority": str(v.proposer_priority),
     }

@@ -104,6 +104,40 @@ def _register_crypto() -> None:
         )
     except ImportError:
         pass
+    try:
+        from ..crypto import secp256k1eth
+
+        register_type(
+            secp256k1eth.PubKey,
+            "cometbft/PubKeySecp256k1eth",
+            lambda k: base64.b64encode(k.data).decode(),
+            lambda v: secp256k1eth.PubKey(base64.b64decode(v)),
+        )
+        register_type(
+            secp256k1eth.PrivKey,
+            "cometbft/PrivKeySecp256k1eth",
+            lambda k: base64.b64encode(k.data).decode(),
+            lambda v: secp256k1eth.PrivKey(base64.b64decode(v)),
+        )
+    except ImportError:
+        pass
+    try:
+        from ..crypto import bls12381
+
+        register_type(
+            bls12381.PubKey,
+            "cometbft/PubKeyBls12_381",
+            lambda k: base64.b64encode(k.data).decode(),
+            lambda v: bls12381.PubKey(base64.b64decode(v)),
+        )
+        register_type(
+            bls12381.PrivKey,
+            "cometbft/PrivKeyBls12_381",
+            lambda k: base64.b64encode(k.bytes()).decode(),
+            lambda v: bls12381.PrivKey.from_bytes(base64.b64decode(v)),
+        )
+    except ImportError:
+        pass
 
 
 _register_crypto()

@@ -79,3 +79,85 @@ def test_amino_json_registered_types():
     rt = amino_json.unmarshal(amino_json.marshal(doc))
     assert isinstance(rt["validators"][0], ed25519.PubKey)
     assert rt["note"] == "hi"
+
+
+def test_keccak256_known_vectors():
+    """Ethereum Keccak-256 (original padding) — the empty-input digest is
+    the canonical Ethereum empty hash."""
+    from cometbft_tpu.crypto.keccak import keccak256
+
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block (> 136-byte rate) input exercises absorption
+    assert len(keccak256(b"z" * 1000)) == 32
+
+
+def test_secp256k1eth_sign_verify_recover():
+    from cometbft_tpu.crypto import secp256k1eth as eth
+
+    sk = eth.PrivKey.from_seed(b"eth-test-1")
+    pk = sk.pub_key()
+    assert len(pk.data) == 65 and pk.data[0] == 4
+    assert len(pk.address()) == 20
+    msg = b"pay 1 wei"
+    sig = sk.sign(msg)
+    assert len(sig) == 65 and sig[64] in (0, 1)
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg + b"!", sig)
+    # recovery returns exactly the signing key
+    from cometbft_tpu.crypto.keccak import keccak256
+
+    assert eth.recover_pubkey(keccak256(msg), sig) == pk.data
+    # lower-S enforced
+    import cometbft_tpu.crypto.secp256k1 as s1
+
+    s = int.from_bytes(sig[32:64], "big")
+    high = sig[:32] + (s1.N - s).to_bytes(32, "big") + bytes([sig[64] ^ 1])
+    assert not pk.verify_signature(msg, high)
+
+
+def test_eth_address_known_vector():
+    """d=1: the Ethereum address of the generator-point key is the
+    well-known 0x7e5f4552091a69125d5dfcb7b8c2659029395bdf."""
+    from cometbft_tpu.crypto import secp256k1eth as eth
+
+    sk = eth.PrivKey((1).to_bytes(32, "big"))
+    assert sk.pub_key().address().hex() == (
+        "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+    )
+
+
+def test_pubkey_proto_all_key_types():
+    from cometbft_tpu.crypto import encoding, secp256k1eth as eth
+
+    for sk in (
+        ed25519.PrivKey.from_seed(b"\x01" * 32),
+        secp256k1.PrivKey.from_seed(b"proto"),
+        eth.PrivKey.from_seed(b"proto"),
+    ):
+        pk = sk.pub_key()
+        back = encoding.pubkey_from_proto(encoding.pubkey_to_proto(pk))
+        assert back.type == pk.type
+        assert (back.data if hasattr(back, "data") else back.bytes()) == (
+            pk.data if hasattr(pk, "data") else pk.bytes()
+        )
+
+
+def test_amino_json_new_key_types():
+    from cometbft_tpu.crypto import bls12381 as bls, secp256k1eth as eth
+
+    epk = eth.PrivKey.from_seed(b"amino").pub_key()
+    s = amino_json.marshal(epk)
+    assert '"cometbft/PubKeySecp256k1eth"' in s
+    back = amino_json.unmarshal(s)
+    assert isinstance(back, eth.PubKey) and back.data == epk.data
+
+    bpk = bls.PrivKey.from_secret(b"amino").pub_key()
+    s2 = amino_json.marshal(bpk)
+    assert '"cometbft/PubKeyBls12_381"' in s2
+    back2 = amino_json.unmarshal(s2)
+    assert isinstance(back2, bls.PubKey) and back2.data == bpk.data
