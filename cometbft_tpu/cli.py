@@ -240,6 +240,63 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """commands/light.go: run a light-client proxy daemon that verifies
+    everything it serves against a primary + witnesses."""
+    from .light.client import Client, TrustOptions
+    from .light.rpc import HTTPProvider, LightProxy, VerifyingClient
+    from .light.store import LightStore
+    from .rpc.client import HTTPClient
+    from .store.db import MemDB, new_db
+
+    rpc = HTTPClient(args.primary)
+    primary = HTTPProvider(args.chain_id, rpc)
+    witnesses = [
+        HTTPProvider(args.chain_id, HTTPClient(w))
+        for w in (args.witnesses.split(",") if args.witnesses else [])
+        if w
+    ]
+    if args.home and args.home != DEFAULT_HOME:
+        os.makedirs(args.home, exist_ok=True)
+        db = new_db("light", backend="sqlite", db_dir=args.home)
+    else:
+        db = MemDB()
+    if bool(args.trusted_height) != bool(args.trusted_hash):
+        print("light: --trusted-height and --trusted-hash must be given together",
+              file=sys.stderr)
+        return 1
+    if args.trusted_height:
+        trust = TrustOptions(
+            period_ns=int(args.trusting_period * 1e9),
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        )
+    else:
+        # trust-on-first-use from the primary's height 1 (dev convenience;
+        # production should pin --trusted-height/--trusted-hash)
+        lb1 = primary.light_block(1)
+        trust = TrustOptions(
+            period_ns=int(args.trusting_period * 1e9),
+            height=1,
+            hash=lb1.signed_header.header.hash(),
+        )
+    lc = Client(args.chain_id, trust, primary=primary, witnesses=witnesses,
+                store=LightStore(db))
+    proxy = LightProxy(VerifyingClient(rpc, lc))
+    proxy.start(args.laddr)
+    print(f"light proxy for {args.chain_id} on {proxy.listen_addr} "
+          f"(primary {args.primary}; ctrl-c to stop)")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -282,6 +339,17 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--chain-id", default=None)
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("light", help="light-client verifying RPC proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary node RPC host:port")
+    sp.add_argument("--witnesses", default="", help="comma-separated RPC addrs")
+    sp.add_argument("--laddr", default="127.0.0.1:8888")
+    sp.add_argument("--trusted-height", type=int, default=0, dest="trusted_height")
+    sp.add_argument("--trusted-hash", default="", dest="trusted_hash")
+    sp.add_argument("--trusting-period", type=float, default=168 * 3600,
+                    dest="trusting_period", help="seconds (default 1 week)")
+    sp.set_defaults(fn=cmd_light)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
 

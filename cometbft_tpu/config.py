@@ -78,6 +78,10 @@ class StatesyncConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     max_open_connections: int = 900
+    # data-companion services (block/block-results/version/pruning) —
+    # the reference's grpc_laddr + grpc_privileged_laddr, served here
+    # over the varint-proto socket transport (rpc/services.py)
+    companion_laddr: str = ""
 
 
 @dataclass

@@ -33,6 +33,23 @@ class BlockIndexer:
         with self._mtx:
             self.db.write_batch(sets, [])
 
+    def prune(self, retain_height: int) -> int:
+        """Delete all entries below retain_height (companion pruning
+        service's block-indexer retain height).  Returns heights pruned."""
+        deletes = []
+        end_h = struct.pack(">q", retain_height)
+        pruned = 0
+        for key, _ in self.db.iterator(_REC, _REC + end_h):
+            deletes.append(key)
+            pruned += 1
+        # event keys end with "/" + 8-byte big-endian height
+        for key, hb in self.db.iterator(_EVT, _EVT + b"\xff"):
+            if hb < end_h:
+                deletes.append(key)
+        with self._mtx:
+            self.db.write_batch([], deletes)
+        return pruned
+
     def search(self, query: Query | str, limit: int = 100) -> list[int]:
         if isinstance(query, str):
             query = Query(query)

@@ -126,6 +126,26 @@ class TxIndexer:
                 out.append(h)
         return out
 
+    # ------------------------------------------------------------- prune
+
+    def prune(self, retain_height: int) -> int:
+        """Delete all entries for txs below retain_height (the companion
+        pruning service's tx-indexer retain height).  Returns txs pruned."""
+        deletes = []
+        hashes = set()
+        end = _HGT + struct.pack(">q", retain_height)
+        for key, h in self.db.iterator(_HGT, end):
+            deletes.append(key)
+            hashes.add(h)
+            deletes.append(_REC + h)
+        # event keys end with "/" + 12-byte (height, index) + "/" + 32-byte hash
+        for key, h in self.db.iterator(_EVT, _EVT + b"\xff"):
+            if h in hashes:
+                deletes.append(key)
+        with self._mtx:
+            self.db.write_batch([], deletes)
+        return len(hashes)
+
 
 class NullTxIndexer:
     def index(self, *a, **k) -> None:

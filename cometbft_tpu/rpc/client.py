@@ -72,8 +72,10 @@ class HTTPClient:
     def abci_info(self):
         return self.call("abci_info")
 
-    def abci_query(self, path: str, data: bytes):
-        return self.call("abci_query", path=path, data=data.hex())
+    def abci_query(self, path: str, data: bytes, height: int = 0, prove: bool = False):
+        return self.call(
+            "abci_query", path=path, data=data.hex(), height=height, prove=prove
+        )
 
     def broadcast_tx_sync(self, tx: bytes):
         return self.call("broadcast_tx_sync", tx=tx)

@@ -1,0 +1,463 @@
+"""Data-companion services: block, block-results, version, and the
+privileged pruning service (reference: rpc/grpc/server/services/
+{blockservice,blockresultservice,versionservice,pruningservice}).
+
+The reference serves these over gRPC; grpcio is not available in this
+image, so they ride the same varint-delimited proto socket framing the
+ABCI and privval sidecars use (abci/client/socket_client.go pattern),
+with a method-routed envelope (wire/services_pb.ServiceRequest) and
+server-streaming support for GetLatestHeight
+(blockservice/service.go:79 streams a height per committed block).
+Functionally equivalent for a data companion; the transport is the
+documented substitution.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+from ..wire import services_pb as pb
+from ..wire.proto import decode_varint, encode_varint
+
+_MAX_MSG = 64 * 1024 * 1024
+
+
+def _read_frame(rfile) -> bytes | None:
+    """Read one varint-length-delimited frame from a buffered stream."""
+    raw = b""
+    while True:
+        b1 = rfile.read(1)
+        if not b1:
+            return None
+        raw += b1
+        if not b1[0] & 0x80:
+            break
+        if len(raw) > 10:
+            raise ValueError("varint too long")
+    n, _ = decode_varint(raw)
+    if n > _MAX_MSG:
+        raise ValueError("service frame too large")
+    data = rfile.read(n)
+    if len(data) < n:
+        return None
+    return data
+
+
+def _write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(encode_varint(len(payload)) + payload)
+
+
+class CompanionServiceServer(Service):
+    """Hosts the four companion services against live node components.
+
+    block_store / state_store are required; pruner, tx_indexer,
+    block_indexer, event_bus are optional (methods needing an absent
+    component return an error, matching the reference's per-service
+    enablement in config)."""
+
+    def __init__(
+        self,
+        addr: str,
+        block_store,
+        state_store,
+        pruner=None,
+        tx_indexer=None,
+        block_indexer=None,
+        event_bus=None,
+        node_version: str = "",
+        abci_version: str = "2.1.0",
+        p2p_version: int = 9,
+        block_version: int = 11,
+    ):
+        super().__init__("CompanionServices")
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self.block_store = block_store
+        self.state_store = state_store
+        self.pruner = pruner
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self.versions = (node_version, abci_version, p2p_version, block_version)
+        self.logger = get_logger("services")
+        self._listener: socket.socket | None = None
+        self._conns: set[socket.socket] = set()
+        self._mtx = threading.Lock()
+
+    @property
+    def laddr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def on_start(self) -> None:
+        self._listener = socket.create_server((self._host, self._port))
+        self._port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True, name="svc-accept").start()
+
+    def on_stop(self) -> None:
+        if self._listener:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._mtx:
+            for c in list(self._conns):
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def _accept(self) -> None:
+        while self.is_running():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._mtx:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        send_mtx = threading.Lock()  # streams + replies interleave
+        try:
+            while self.is_running():
+                frame = _read_frame(rfile)
+                if frame is None:
+                    return
+                req = pb.ServiceRequest.decode(frame)
+                if req.method == "block.GetLatestHeight":
+                    threading.Thread(
+                        target=self._stream_latest_height,
+                        args=(conn, send_mtx, req.id),
+                        daemon=True,
+                    ).start()
+                    continue
+                resp = self._dispatch(req)
+                with send_mtx:
+                    _write_frame(conn, resp.encode())
+        except (OSError, ValueError) as e:
+            self.logger.debug(f"service conn closed: {e}")
+        finally:
+            with self._mtx:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ dispatch
+
+    def _dispatch(self, req: pb.ServiceRequest) -> pb.ServiceResponse:
+        try:
+            handler = _HANDLERS.get(req.method)
+            if handler is None:
+                return pb.ServiceResponse(
+                    id=req.id, error=f"unknown method {req.method!r}"
+                )
+            out = handler(self, req.payload)
+            return pb.ServiceResponse(id=req.id, payload=out.encode())
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            return pb.ServiceResponse(id=req.id, error=str(e))
+
+    # ---- block service (blockservice/service.go)
+
+    def _get_by_height(self, payload: bytes) -> pb.GetByHeightResponse:
+        height = pb.GetByHeightRequest.decode(payload).height
+        if height == 0:
+            height = self.block_store.height
+        base = self.block_store.base
+        if height < base or height > self.block_store.height:
+            raise ValueError(
+                f"height {height} not in store range [{base},{self.block_store.height}]"
+            )
+        meta = self.block_store.load_block_meta(height)
+        block = self.block_store.load_block(height)
+        if meta is None or block is None:
+            raise ValueError(f"block {height} not found")
+        return pb.GetByHeightResponse(
+            block_id=meta.block_id, block=block.to_proto()
+        )
+
+    def _stream_latest_height(self, conn, send_mtx, req_id: int) -> None:
+        """One response now, then one per NewBlock event
+        (blockservice/service.go:79 GetLatestHeight stream).  The
+        subscriber name is globally unique (req ids are per-connection),
+        and the subscription is torn down when the conn dies — the write
+        failure surfaces as OSError on the next block."""
+        import queue as _q
+        import uuid
+
+        sub = None
+        subscriber = f"svc-latest-{uuid.uuid4().hex[:12]}"
+        try:
+            with send_mtx:
+                _write_frame(
+                    conn,
+                    pb.ServiceResponse(
+                        id=req_id,
+                        payload=pb.GetLatestHeightResponse(
+                            height=self.block_store.height
+                        ).encode(),
+                    ).encode(),
+                )
+            if self.event_bus is None:
+                return
+            from ..types.event_bus import EventQueryNewBlock
+
+            sub = self.event_bus.subscribe(subscriber, EventQueryNewBlock)
+            while self.is_running():
+                try:
+                    msg, _events = sub.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                height = msg.data["block"].header.height
+                with send_mtx:
+                    _write_frame(
+                        conn,
+                        pb.ServiceResponse(
+                            id=req_id,
+                            payload=pb.GetLatestHeightResponse(height=height).encode(),
+                        ).encode(),
+                    )
+        except (OSError, ValueError):
+            return
+        finally:
+            if sub is not None:
+                try:
+                    self.event_bus.unsubscribe(subscriber, EventQueryNewBlock)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ---- block-results service (blockresultservice/service.go)
+
+    def _get_block_results(self, payload: bytes) -> pb.GetBlockResultsResponse:
+        height = pb.GetBlockResultsRequest.decode(payload).height
+        if height == 0:
+            height = self.block_store.height
+        resp = self.state_store.load_finalize_block_response(height)
+        if resp is None:
+            raise ValueError(f"no block results for height {height}")
+        return pb.GetBlockResultsResponse(
+            height=height,
+            tx_results=list(resp.tx_results or []),
+            finalize_block_events=list(resp.events or []),
+            validator_updates=list(resp.validator_updates or []),
+            app_hash=resp.app_hash,
+        )
+
+    # ---- version service (versionservice/service.go)
+
+    def _get_version(self, payload: bytes) -> pb.GetVersionResponse:
+        node, abci, p2p, block = self.versions
+        return pb.GetVersionResponse(node=node, abci=abci, p2p=p2p, block=block)
+
+    # ---- pruning service (pruningservice/service.go) — privileged
+
+    def _need_pruner(self):
+        if self.pruner is None:
+            raise ValueError("pruning service not enabled")
+        return self.pruner
+
+    def _set_block_retain(self, payload: bytes) -> pb.Empty:
+        h = pb.SetBlockRetainHeightRequest.decode(payload).height
+        self._need_pruner().set_companion_block_retain_height(h)
+        return pb.Empty()
+
+    def _get_block_retain(self, payload: bytes) -> pb.GetBlockRetainHeightResponse:
+        p = self._need_pruner()
+        return pb.GetBlockRetainHeightResponse(
+            app_retain_height=p.app_block_retain_height(),
+            pruning_service_retain_height=p.companion_block_retain_height(),
+        )
+
+    def _set_block_results_retain(self, payload: bytes) -> pb.Empty:
+        h = pb.SetBlockResultsRetainHeightRequest.decode(payload).height
+        self._need_pruner().set_block_results_retain_height(h)
+        return pb.Empty()
+
+    def _get_block_results_retain(
+        self, payload: bytes
+    ) -> pb.GetBlockResultsRetainHeightResponse:
+        return pb.GetBlockResultsRetainHeightResponse(
+            pruning_service_retain_height=(
+                self._need_pruner().block_results_retain_height()
+            )
+        )
+
+    def _set_tx_indexer_retain(self, payload: bytes) -> pb.Empty:
+        h = pb.SetTxIndexerRetainHeightRequest.decode(payload).height
+        self._need_pruner().set_tx_indexer_retain_height(h)
+        return pb.Empty()
+
+    def _get_tx_indexer_retain(
+        self, payload: bytes
+    ) -> pb.GetTxIndexerRetainHeightResponse:
+        return pb.GetTxIndexerRetainHeightResponse(
+            height=self._need_pruner().tx_indexer_retain_height()
+        )
+
+    def _set_block_indexer_retain(self, payload: bytes) -> pb.Empty:
+        h = pb.SetBlockIndexerRetainHeightRequest.decode(payload).height
+        self._need_pruner().set_block_indexer_retain_height(h)
+        return pb.Empty()
+
+    def _get_block_indexer_retain(
+        self, payload: bytes
+    ) -> pb.GetBlockIndexerRetainHeightResponse:
+        return pb.GetBlockIndexerRetainHeightResponse(
+            height=self._need_pruner().block_indexer_retain_height()
+        )
+
+
+_HANDLERS = {
+    "block.GetByHeight": CompanionServiceServer._get_by_height,
+    "block_results.GetBlockResults": CompanionServiceServer._get_block_results,
+    "version.GetVersion": CompanionServiceServer._get_version,
+    "pruning.SetBlockRetainHeight": CompanionServiceServer._set_block_retain,
+    "pruning.GetBlockRetainHeight": CompanionServiceServer._get_block_retain,
+    "pruning.SetBlockResultsRetainHeight": CompanionServiceServer._set_block_results_retain,
+    "pruning.GetBlockResultsRetainHeight": CompanionServiceServer._get_block_results_retain,
+    "pruning.SetTxIndexerRetainHeight": CompanionServiceServer._set_tx_indexer_retain,
+    "pruning.GetTxIndexerRetainHeight": CompanionServiceServer._get_tx_indexer_retain,
+    "pruning.SetBlockIndexerRetainHeight": CompanionServiceServer._set_block_indexer_retain,
+    "pruning.GetBlockIndexerRetainHeight": CompanionServiceServer._get_block_indexer_retain,
+}
+
+
+class CompanionServiceClient:
+    """Typed client for the companion services (the data-companion side).
+
+    Thread-compatible for request/response; GetLatestHeight streaming
+    owns the connection while active."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._next_id = 1
+        self._mtx = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _call(self, method: str, req_msg) -> bytes:
+        with self._mtx:
+            rid = self._next_id
+            self._next_id += 1
+            _write_frame(
+                self._sock,
+                pb.ServiceRequest(
+                    id=rid, method=method, payload=req_msg.encode()
+                ).encode(),
+            )
+            frame = _read_frame(self._rfile)
+            if frame is None:
+                raise ConnectionError("service connection closed")
+            resp = pb.ServiceResponse.decode(frame)
+        if resp.id != rid:
+            # a stray stream frame on a shared connection — decoding it as
+            # this call's response type would return garbage silently
+            raise RuntimeError(
+                f"response id {resp.id} != request id {rid}; do not mix "
+                "unary calls with an active latest_height_stream on one client"
+            )
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return resp.payload
+
+    # block
+    def get_by_height(self, height: int = 0) -> pb.GetByHeightResponse:
+        return pb.GetByHeightResponse.decode(
+            self._call("block.GetByHeight", pb.GetByHeightRequest(height=height))
+        )
+
+    def latest_height_stream(self):
+        """Generator of heights; consumes the connection."""
+        with self._mtx:
+            rid = self._next_id
+            self._next_id += 1
+            _write_frame(
+                self._sock,
+                pb.ServiceRequest(
+                    id=rid,
+                    method="block.GetLatestHeight",
+                    payload=pb.GetLatestHeightRequest().encode(),
+                ).encode(),
+            )
+        while True:
+            frame = _read_frame(self._rfile)
+            if frame is None:
+                return
+            resp = pb.ServiceResponse.decode(frame)
+            if resp.error:
+                raise RuntimeError(resp.error)
+            yield pb.GetLatestHeightResponse.decode(resp.payload).height
+
+    # block results
+    def get_block_results(self, height: int = 0) -> pb.GetBlockResultsResponse:
+        return pb.GetBlockResultsResponse.decode(
+            self._call(
+                "block_results.GetBlockResults",
+                pb.GetBlockResultsRequest(height=height),
+            )
+        )
+
+    # version
+    def get_version(self) -> pb.GetVersionResponse:
+        return pb.GetVersionResponse.decode(
+            self._call("version.GetVersion", pb.GetVersionRequest())
+        )
+
+    # pruning
+    def set_block_retain_height(self, height: int) -> None:
+        self._call(
+            "pruning.SetBlockRetainHeight",
+            pb.SetBlockRetainHeightRequest(height=height),
+        )
+
+    def get_block_retain_height(self) -> pb.GetBlockRetainHeightResponse:
+        return pb.GetBlockRetainHeightResponse.decode(
+            self._call("pruning.GetBlockRetainHeight", pb.Empty())
+        )
+
+    def set_block_results_retain_height(self, height: int) -> None:
+        self._call(
+            "pruning.SetBlockResultsRetainHeight",
+            pb.SetBlockResultsRetainHeightRequest(height=height),
+        )
+
+    def get_block_results_retain_height(self) -> int:
+        return pb.GetBlockResultsRetainHeightResponse.decode(
+            self._call("pruning.GetBlockResultsRetainHeight", pb.Empty())
+        ).pruning_service_retain_height
+
+    def set_tx_indexer_retain_height(self, height: int) -> None:
+        self._call(
+            "pruning.SetTxIndexerRetainHeight",
+            pb.SetTxIndexerRetainHeightRequest(height=height),
+        )
+
+    def get_tx_indexer_retain_height(self) -> int:
+        return pb.GetTxIndexerRetainHeightResponse.decode(
+            self._call("pruning.GetTxIndexerRetainHeight", pb.Empty())
+        ).height
+
+    def set_block_indexer_retain_height(self, height: int) -> None:
+        self._call(
+            "pruning.SetBlockIndexerRetainHeight",
+            pb.SetBlockIndexerRetainHeightRequest(height=height),
+        )
+
+    def get_block_indexer_retain_height(self) -> int:
+        return pb.GetBlockIndexerRetainHeightResponse.decode(
+            self._call("pruning.GetBlockIndexerRetainHeight", pb.Empty())
+        ).height

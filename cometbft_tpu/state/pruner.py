@@ -18,6 +18,9 @@ from ..utils.service import Service
 
 _APP_RETAIN = b"prune/app_block_retain"
 _COMPANION_RETAIN = b"prune/companion_block_retain"
+_BLOCK_RESULTS_RETAIN = b"prune/block_results_retain"
+_TX_INDEXER_RETAIN = b"prune/tx_indexer_retain"
+_BLOCK_INDEXER_RETAIN = b"prune/block_indexer_retain"
 
 
 class Pruner(Service):
@@ -27,15 +30,22 @@ class Pruner(Service):
         state_store,
         block_store,
         interval: float = 10.0,
+        tx_indexer=None,
+        block_indexer=None,
     ):
         super().__init__("Pruner")
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
         self.interval = interval
         self.logger = get_logger("pruner")
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
+        # last retain heights actually applied, so idle passes skip the
+        # full index scans (the reference tracks the same watermark)
+        self._applied: dict[bytes, int] = {}
 
     # ------------------------------------------------------ retain heights
 
@@ -63,6 +73,33 @@ class Pruner(Service):
 
     def companion_block_retain_height(self) -> int:
         return self._get(_COMPANION_RETAIN)
+
+    # companion-managed retain heights for results + indexers
+    # (reference: pruningservice/service.go Set/Get*RetainHeight)
+
+    def set_block_results_retain_height(self, height: int) -> None:
+        if height > self._get(_BLOCK_RESULTS_RETAIN):
+            self._set(_BLOCK_RESULTS_RETAIN, height)
+            self._wake.set()
+
+    def block_results_retain_height(self) -> int:
+        return self._get(_BLOCK_RESULTS_RETAIN)
+
+    def set_tx_indexer_retain_height(self, height: int) -> None:
+        if height > self._get(_TX_INDEXER_RETAIN):
+            self._set(_TX_INDEXER_RETAIN, height)
+            self._wake.set()
+
+    def tx_indexer_retain_height(self) -> int:
+        return self._get(_TX_INDEXER_RETAIN)
+
+    def set_block_indexer_retain_height(self, height: int) -> None:
+        if height > self._get(_BLOCK_INDEXER_RETAIN):
+            self._set(_BLOCK_INDEXER_RETAIN, height)
+            self._wake.set()
+
+    def block_indexer_retain_height(self) -> int:
+        return self._get(_BLOCK_INDEXER_RETAIN)
 
     def effective_retain_height(self) -> int:
         """min of the registered holders; 0 = nothing prunable yet."""
@@ -96,12 +133,40 @@ class Pruner(Service):
 
     def prune_once(self) -> int:
         """One reconciliation pass; returns blocks pruned."""
+        pruned = 0
         retain = self.effective_retain_height()
-        if retain <= self.block_store.base:
-            return 0
-        retain = min(retain, self.block_store.height)  # never prune the tip past it
-        pruned = self.block_store.prune_blocks(retain)
-        if pruned:
-            self.state_store.prune_states(retain, self.block_store.height)
-            self.logger.info(f"pruned {pruned} blocks below height {retain}")
+        if retain > self.block_store.base:
+            retain = min(retain, self.block_store.height)  # never prune the tip
+            pruned = self.block_store.prune_blocks(retain)
+            if pruned:
+                self.state_store.prune_states(retain, self.block_store.height)
+                self.logger.info(f"pruned {pruned} blocks below height {retain}")
+        br = min(self.block_results_retain_height(), self.block_store.height)
+        if br > 0 and self._applied.get(_BLOCK_RESULTS_RETAIN) != br:
+            n = self.state_store.prune_finalize_block_responses(br)
+            self._applied[_BLOCK_RESULTS_RETAIN] = br
+            if n:
+                self.logger.info(f"pruned {n} block results below height {br}")
+        ti = self.tx_indexer_retain_height()
+        if (
+            ti > 0
+            and self._applied.get(_TX_INDEXER_RETAIN) != ti
+            and self.tx_indexer is not None
+            and hasattr(self.tx_indexer, "prune")
+        ):
+            n = self.tx_indexer.prune(ti)
+            self._applied[_TX_INDEXER_RETAIN] = ti
+            if n:
+                self.logger.info(f"pruned {n} indexed txs below height {ti}")
+        bi = self.block_indexer_retain_height()
+        if (
+            bi > 0
+            and self._applied.get(_BLOCK_INDEXER_RETAIN) != bi
+            and self.block_indexer is not None
+            and hasattr(self.block_indexer, "prune")
+        ):
+            n = self.block_indexer.prune(bi)
+            self._applied[_BLOCK_INDEXER_RETAIN] = bi
+            if n:
+                self.logger.info(f"pruned {n} indexed blocks below height {bi}")
         return pruned

@@ -152,6 +152,19 @@ class StateStore:
 
     # ------------------------------------------------------------- prune
 
+    def prune_finalize_block_responses(self, retain_height: int) -> int:
+        """Delete only the FinalizeBlock responses below retain_height —
+        the block-results retain height is tracked separately from the
+        block retain height (state/pruner.go block-results pruning)."""
+        deletes = []
+        start = _hkey(_ABCI_RESPONSES_PREFIX, 0)
+        end = _hkey(_ABCI_RESPONSES_PREFIX, retain_height)
+        for key, _ in self._db.iterator(start, end):
+            deletes.append(key)
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
+
     def prune_states(self, retain_height: int, current_height: int) -> int:
         """Delete state artifacts below retain_height (state/pruner.go)."""
         pruned = 0

@@ -1,0 +1,134 @@
+"""Data-companion service wire messages (field layouts mirror
+proto/cometbft/services/{block,block_results,version,pruning}/v1 of the
+reference).  Served over the varint-framed socket transport
+(rpc/services.py) instead of gRPC/HTTP2 — grpcio is not available in
+this image; the framing is the same one the ABCI and privval sidecar
+protocols use.
+"""
+
+from __future__ import annotations
+
+from .proto import Field, Message
+from .types_pb import BlockProto, BlockID
+from .abci_pb import ExecTxResult, Event, ValidatorUpdate
+
+
+# ---- block service (services/block/v1/block_service.proto)
+
+
+class GetByHeightRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetByHeightResponse(Message):
+    FIELDS = [
+        Field(1, "block_id", "message", BlockID),
+        Field(2, "block", "message", BlockProto),
+    ]
+
+
+class GetLatestHeightRequest(Message):
+    FIELDS = []
+
+
+class GetLatestHeightResponse(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+# ---- block-results service (services/block_results/v1)
+
+
+class GetBlockResultsRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetBlockResultsResponse(Message):
+    FIELDS = [
+        Field(1, "height", "varint"),
+        Field(2, "tx_results", "message", ExecTxResult, repeated=True),
+        Field(3, "finalize_block_events", "message", Event, repeated=True),
+        Field(4, "validator_updates", "message", ValidatorUpdate, repeated=True),
+        Field(5, "app_hash", "bytes"),
+    ]
+
+
+# ---- version service (services/version/v1)
+
+
+class GetVersionRequest(Message):
+    FIELDS = []
+
+
+class GetVersionResponse(Message):
+    FIELDS = [
+        Field(1, "node", "string"),
+        Field(2, "abci", "string"),
+        Field(3, "p2p", "varint"),
+        Field(4, "block", "varint"),
+    ]
+
+
+# ---- pruning service (services/pruning/v1) — privileged
+
+
+class SetBlockRetainHeightRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetBlockRetainHeightResponse(Message):
+    FIELDS = [
+        Field(1, "app_retain_height", "varint"),
+        Field(2, "pruning_service_retain_height", "varint"),
+    ]
+
+
+class SetBlockResultsRetainHeightRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetBlockResultsRetainHeightResponse(Message):
+    FIELDS = [Field(1, "pruning_service_retain_height", "varint")]
+
+
+class SetTxIndexerRetainHeightRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetTxIndexerRetainHeightResponse(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class SetBlockIndexerRetainHeightRequest(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class GetBlockIndexerRetainHeightResponse(Message):
+    FIELDS = [Field(1, "height", "varint")]
+
+
+class Empty(Message):
+    FIELDS = []
+
+
+# ---- envelope: method-routed request/response with stream support
+
+
+class ServiceRequest(Message):
+    """One call frame: method name + encoded payload.  id correlates
+    responses; a server-streaming method keeps emitting responses with
+    the same id until cancel or disconnect."""
+
+    FIELDS = [
+        Field(1, "id", "varint"),
+        Field(2, "method", "string"),
+        Field(3, "payload", "bytes"),
+    ]
+
+
+class ServiceResponse(Message):
+    FIELDS = [
+        Field(1, "id", "varint"),
+        Field(2, "error", "string"),
+        Field(3, "payload", "bytes"),
+        Field(4, "end_stream", "varint"),
+    ]

@@ -1,0 +1,159 @@
+"""Data-companion services: block / block-results / version / privileged
+pruning over the socket-proto transport (reference:
+rpc/grpc/server/services/*; transport substitution documented in
+rpc/services.py)."""
+
+import threading
+
+import pytest
+
+from cometbft_tpu.rpc.services import CompanionServiceClient, CompanionServiceServer
+from cometbft_tpu.state.pruner import Pruner
+from cometbft_tpu.store.db import MemDB
+
+from test_execution import GENESIS_NS, Harness
+
+NS = 1_000_000_000
+
+
+@pytest.fixture
+def net():
+    h = Harness()
+    for i in range(6):
+        h.step(1 + i, GENESIS_NS + (1 + i) * 2 * NS)
+    pruner = Pruner(MemDB(), h.state_store, h.block_store)
+    srv = CompanionServiceServer(
+        "127.0.0.1:0",
+        h.block_store,
+        h.state_store,
+        pruner=pruner,
+        event_bus=h.event_bus,
+        node_version="0.1.0-test",
+    )
+    srv.start()
+    cli = CompanionServiceClient(srv.laddr)
+    yield h, srv, cli, pruner
+    cli.close()
+    srv.stop()
+    h.stop()
+
+
+def test_version_service(net):
+    _, _, cli, _ = net
+    v = cli.get_version()
+    assert v.node == "0.1.0-test"
+    assert v.abci and v.block > 0 and v.p2p > 0
+
+
+def test_block_service_get_by_height(net):
+    h, _, cli, _ = net
+    resp = cli.get_by_height(3)
+    assert resp.block.header.height == 3
+    assert resp.block_id.hash == h.block_store.load_block_meta(3).block_id.hash
+    # height 0 = latest
+    assert cli.get_by_height(0).block.header.height == 6
+    with pytest.raises(RuntimeError, match="not in store range"):
+        cli.get_by_height(99)
+
+
+def test_block_results_service(net):
+    h, _, cli, _ = net
+    r = cli.get_block_results(4)
+    assert r.height == 4
+    assert r.app_hash == h.state_store.load_finalize_block_response(4).app_hash
+    with pytest.raises(RuntimeError):
+        cli.get_block_results(77)
+
+
+def test_latest_height_stream_follows_new_blocks(net):
+    h, _, cli, _ = net
+    heights = []
+    done = threading.Event()
+
+    def consume():
+        for height in cli.latest_height_stream():
+            heights.append(height)
+            if len(heights) >= 2:
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    # first response arrives immediately with the current height
+    for _ in range(50):
+        if heights:
+            break
+        threading.Event().wait(0.05)
+    assert heights and heights[0] == 6
+    h.step(7, GENESIS_NS + 7 * 2 * NS)  # fires NewBlock on the event bus
+    assert done.wait(5.0), f"stream never advanced: {heights}"
+    assert heights[1] == 7
+
+
+def test_pruning_service_retain_heights(net):
+    h, _, cli, pruner = net
+    cli.set_block_retain_height(4)
+    got = cli.get_block_retain_height()
+    assert got.pruning_service_retain_height == 4
+    assert got.app_retain_height == 0
+    # app never allowed pruning -> nothing prunable yet
+    assert pruner.prune_once() == 0
+    pruner.set_app_block_retain_height(5)
+    assert pruner.prune_once() == 3  # blocks 1..3 (min(4,5))
+    assert h.block_store.base == 4
+
+    # block results prune independently, above the block retain height
+    cli.set_block_results_retain_height(6)
+    assert cli.get_block_results_retain_height() == 6
+    pruner.prune_once()
+    assert h.state_store.load_finalize_block_response(5) is None
+    assert h.state_store.load_finalize_block_response(6) is not None
+
+    cli.set_tx_indexer_retain_height(2)
+    cli.set_block_indexer_retain_height(2)
+    assert cli.get_tx_indexer_retain_height() == 2
+    assert cli.get_block_indexer_retain_height() == 2
+
+
+def test_unknown_method_errors(net):
+    _, srv, cli, _ = net
+    from cometbft_tpu.wire import services_pb as spb
+
+    with pytest.raises(RuntimeError, match="unknown method"):
+        cli._call("no.SuchMethod", spb.Empty())
+
+
+def test_indexer_prune():
+    """TxIndexer/BlockIndexer prune drops records, height keys, and event
+    keys below the retain height and keeps everything above it."""
+    from cometbft_tpu.indexer.block import BlockIndexer
+    from cometbft_tpu.indexer.tx import TxIndexer
+    from cometbft_tpu.types.tx import tx_hash
+    from cometbft_tpu.wire import abci_pb as apb
+
+    txi = TxIndexer(MemDB())
+    txs = {}
+    for height in (1, 2, 3):
+        tx = b"tx-%d" % height
+        txs[height] = tx
+        txi.index(
+            height,
+            0,
+            tx,
+            apb.ExecTxResult(code=0),
+            {"tx.event": ["v%d" % height]},
+        )
+    assert txi.prune(3) == 2
+    assert txi.get(tx_hash(txs[1])) is None
+    assert txi.get(tx_hash(txs[2])) is None
+    assert txi.get(tx_hash(txs[3])) is not None
+    assert txi.search("tx.event = 'v2'") == []
+    assert len(txi.search("tx.event = 'v3'")) == 1
+
+    bli = BlockIndexer(MemDB())
+    for height in (1, 2, 3):
+        bli.index(height, {"block.event": ["b%d" % height]})
+    assert bli.prune(3) == 2
+    assert not bli.has(1) and not bli.has(2) and bli.has(3)
+    assert bli.search("block.event = 'b1'") == []
+    assert bli.search("block.event = 'b3'") == [3]

@@ -1,0 +1,142 @@
+"""Light-verified RPC client + light proxy against a live node
+(reference: light/rpc/client.go, light/proxy, light/provider/http)."""
+
+import base64
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.config import Config  # noqa: F401 (fixture helpers import)
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.rpc import (
+    HTTPProvider,
+    LightProxy,
+    VerificationFailed,
+    VerifyingClient,
+    commit_from_json,
+    header_from_json,
+    validator_set_from_json,
+)
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.node import Node
+from cometbft_tpu.rpc import HTTPClient
+
+from test_node_rpc import _mk_home, _test_cfg, _wait
+
+
+@pytest.fixture
+def live_node(tmp_path):
+    home = _mk_home(tmp_path, "lp", chain_id="light-rpc-chain")
+    node = Node(_test_cfg(home))
+    node.start()
+    rpc = HTTPClient(node.rpc_server.listen_addr)
+    assert _wait(lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 3)
+    yield node, rpc
+    node.stop()
+
+
+def _light_client(rpc):
+    provider = HTTPProvider("light-rpc-chain", rpc)
+    lb1 = provider.light_block(1)
+    return Client(
+        "light-rpc-chain",
+        TrustOptions(
+            period_ns=3600 * 10**9,
+            height=1,
+            hash=lb1.signed_header.header.hash(),
+        ),
+        primary=provider,
+        witnesses=[],
+        store=LightStore(MemDB()),
+    )
+
+
+@pytest.mark.slow
+def test_json_parsers_roundtrip(live_node):
+    _, rpc = live_node
+    c = rpc.commit(2)
+    hdr = header_from_json(c["signed_header"]["header"])
+    cmt = commit_from_json(c["signed_header"]["commit"])
+    assert hdr.height == 2 and cmt.height == 2
+    # parsed header re-hashes to the node's own block id for that height
+    blk_meta_hash = bytes.fromhex(rpc.block(2)["block_id"]["hash"])
+    assert hdr.hash() == blk_meta_hash
+    assert cmt.block_id.hash == blk_meta_hash
+    vs = validator_set_from_json(rpc.validators(2)["validators"])
+    assert vs.hash() == hdr.validators_hash
+
+
+@pytest.mark.slow
+def test_verifying_client_accepts_honest_node(live_node):
+    _, rpc = live_node
+    vc = VerifyingClient(rpc, _light_client(rpc))
+    h = int(rpc.status()["sync_info"]["latest_block_height"])
+    assert vc.block(h)["block"]["header"]["height"] == str(h)
+    assert vc.commit(h - 1)["signed_header"]["commit"]["height"] == str(h - 1)
+    vc.validators(h)  # raises on mismatch
+
+
+@pytest.mark.slow
+def test_verifying_client_rejects_forged_block(live_node):
+    _, rpc = live_node
+
+    class LyingRPC:
+        """Proxies everything but rewrites block headers."""
+
+        def __getattr__(self, name):
+            return getattr(rpc, name)
+
+        def block(self, height=None):
+            resp = rpc.block(height)
+            resp["block"]["header"]["app_hash"] = "AB" * 32  # forged state root
+            return resp
+
+    vc = VerifyingClient(LyingRPC(), _light_client(rpc))
+    with pytest.raises(VerificationFailed, match="header hash"):
+        vc.block(2)
+
+
+@pytest.mark.slow
+def test_verified_tx_inclusion(live_node):
+    node, rpc = live_node
+    res = rpc.broadcast_tx_commit(b"light=proof")
+    height = int(res["height"])
+    txhash = res["hash"]
+    vc = VerifyingClient(rpc, _light_client(rpc))
+    got = vc.tx(txhash)
+    assert base64.b64decode(got["tx"]) == b"light=proof"
+    assert int(got["height"]) == height
+
+
+@pytest.mark.slow
+def test_light_proxy_serves_verified_responses(live_node):
+    _, rpc = live_node
+    vc = VerifyingClient(rpc, _light_client(rpc))
+    proxy = LightProxy(vc)
+    proxy.start("127.0.0.1:0")
+    try:
+        def call(method, **params):
+            req = json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+            ).encode()
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{proxy.listen_addr}",
+                    data=req,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=10,
+            ) as f:
+                return json.loads(f.read())
+
+        out = call("block", height=2)
+        assert out["result"]["block"]["header"]["height"] == "2"
+        out = call("validators", height=2)
+        assert out["result"]["validators"]
+        out = call("nope")
+        assert out["error"]["code"] == -32601
+    finally:
+        proxy.stop()
