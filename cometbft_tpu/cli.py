@@ -6,6 +6,10 @@
     python -m cometbft_tpu gen-node-key / gen-validator
     python -m cometbft_tpu unsafe-reset-all
     python -m cometbft_tpu testnet --v 4 [--o DIR]
+    python -m cometbft_tpu rollback / inspect
+    python -m cometbft_tpu light CHAIN_ID --primary HOST:PORT
+    python -m cometbft_tpu debug dump|kill [--rpc-laddr ...]
+    python -m cometbft_tpu config get|set|migrate [KEY [VALUE]]
     python -m cometbft_tpu version
 """
 
@@ -297,6 +301,142 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_debug_dump(args) -> int:
+    """commands/debug/dump.go: capture node state snapshots (RPC state,
+    consensus dump, metrics, thread/heap profiles) into a tarball."""
+    import io
+    import json as _json
+    import tarfile
+    import urllib.request
+
+    def fetch_rpc(method, **params):
+        from .rpc.client import HTTPClient
+
+        return HTTPClient(args.rpc_laddr).call(method, **params)
+
+    def fetch_http(url):
+        with urllib.request.urlopen(url, timeout=5) as f:
+            return f.read()
+
+    artifacts: dict[str, bytes] = {}
+    for name, method in (
+        ("status.json", "status"),
+        ("net_info.json", "net_info"),
+        ("consensus_state.json", "consensus_state"),
+        ("unconfirmed_txs.json", "unconfirmed_txs"),
+    ):
+        try:
+            artifacts[name] = _json.dumps(fetch_rpc(method), indent=1).encode()
+        except Exception as e:  # noqa: BLE001
+            artifacts[name] = f"error: {e}".encode()
+    if args.metrics_laddr:
+        try:
+            artifacts["metrics.txt"] = fetch_http(
+                f"http://{args.metrics_laddr}/metrics"
+            )
+        except Exception as e:  # noqa: BLE001
+            artifacts["metrics.txt"] = f"error: {e}".encode()
+    if args.pprof_laddr:
+        for name, path in (
+            ("threads.txt", "/debug/threads"),
+            ("heap.txt", "/debug/heap"),
+        ):
+            try:
+                artifacts[name] = fetch_http(f"http://{args.pprof_laddr}{path}")
+            except Exception as e:  # noqa: BLE001
+                artifacts[name] = f"error: {e}".encode()
+    cfg_path = os.path.join(args.home, "config", "config.toml")
+    if os.path.exists(cfg_path):
+        artifacts["config.toml"] = open(cfg_path, "rb").read()
+
+    out = args.out or "cometbft-debug-dump.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, data in artifacts.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    print(f"wrote {out} ({len(artifacts)} artifacts)")
+    return 0
+
+
+def cmd_debug_kill(args) -> int:
+    """commands/debug/kill.go: dump state, then SIGABRT the node.  The
+    kill happens even if artifact collection fails — the point is to
+    abort a stuck node."""
+    try:
+        rc = cmd_debug_dump(args)
+    except Exception as e:  # noqa: BLE001
+        print(f"dump failed ({e}); killing anyway", file=sys.stderr)
+        rc = 1
+    try:
+        os.kill(args.pid, signal.SIGABRT)
+        print(f"sent SIGABRT to {args.pid}")
+    except ProcessLookupError:
+        print(f"no such process {args.pid}", file=sys.stderr)
+        return 1
+    return rc
+
+
+def _config_resolve(cfg, dotted: str):
+    """'section.key' or a bare top-level key (the [base] section has no
+    TOML header, so its keys appear bare in the file)."""
+    section, _, key = dotted.partition(".")
+    if not key:
+        section, key = "base", section
+    obj = getattr(cfg, section, None)
+    if obj is None or not hasattr(obj, key):
+        return None, None
+    return obj, key
+
+
+def cmd_config(args) -> int:
+    """commands/config + internal/confix: get/set/migrate TOML config."""
+    cfg_path = os.path.join(args.home, "config", "config.toml")
+    if args.action == "migrate":
+        # load whatever keys the old file has, re-emit the full current
+        # template with those values preserved (confix migrations)
+        cfg = load_config(args.home)
+        save_config(cfg)
+        print(f"migrated {cfg_path} to the current format")
+        return 0
+    cfg = load_config(args.home)
+    obj, key = _config_resolve(cfg, args.key)
+    if obj is None:
+        print(f"unknown key {args.key!r}", file=sys.stderr)
+        return 1
+    if args.action == "get":
+        print(getattr(obj, key))
+        return 0
+    if args.action == "set":
+        cur = getattr(obj, key)
+        val: object = args.value
+        try:
+            if isinstance(cur, bool):
+                val = args.value.lower() in ("1", "true", "yes", "on")
+            elif isinstance(cur, int):
+                val = int(args.value)
+            elif isinstance(cur, float):
+                val = float(args.value)
+        except ValueError:
+            print(
+                f"bad value {args.value!r} for {args.key} "
+                f"(expected {type(cur).__name__})",
+                file=sys.stderr,
+            )
+            return 1
+        setattr(obj, key, val)
+        try:
+            cfg.validate_basic()  # never persist a config that won't load
+        except ValueError as e:
+            print(f"refusing to save invalid config: {e}", file=sys.stderr)
+            return 1
+        save_config(cfg)
+        print(f"{args.key} = {val}")
+        return 0
+    print(f"unknown config action {args.action!r}", file=sys.stderr)
+    return 1
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -350,6 +490,28 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--trusting-period", type=float, default=168 * 3600,
                     dest="trusting_period", help="seconds (default 1 week)")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("debug", help="capture node debug state")
+    dsub = sp.add_subparsers(dest="debug_cmd", required=True)
+    dp = dsub.add_parser("dump", help="dump node state to a tarball")
+    dp.add_argument("--rpc-laddr", default="127.0.0.1:26657", dest="rpc_laddr")
+    dp.add_argument("--metrics-laddr", default="", dest="metrics_laddr")
+    dp.add_argument("--pprof-laddr", default="", dest="pprof_laddr")
+    dp.add_argument("--out", default="")
+    dp.set_defaults(fn=cmd_debug_dump)
+    dk = dsub.add_parser("kill", help="dump state then SIGABRT the node")
+    dk.add_argument("pid", type=int)
+    dk.add_argument("--rpc-laddr", default="127.0.0.1:26657", dest="rpc_laddr")
+    dk.add_argument("--metrics-laddr", default="", dest="metrics_laddr")
+    dk.add_argument("--pprof-laddr", default="", dest="pprof_laddr")
+    dk.add_argument("--out", default="")
+    dk.set_defaults(fn=cmd_debug_kill)
+
+    sp = sub.add_parser("config", help="get/set/migrate config.toml")
+    sp.add_argument("action", choices=["get", "set", "migrate"])
+    sp.add_argument("key", nargs="?", default="")
+    sp.add_argument("value", nargs="?", default="")
+    sp.set_defaults(fn=cmd_config)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
 

@@ -88,6 +88,11 @@ class RPCConfig:
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
+    # separate opt-in listener for /debug/threads + /debug/heap — kept
+    # off the metrics port so scraping never exposes stack/heap contents
+    # (the reference likewise gates pprof behind its own pprof_laddr,
+    # config.go pprof_laddr)
+    pprof_laddr: str = ""
 
 
 @dataclass

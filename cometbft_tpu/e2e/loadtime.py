@@ -1,0 +1,196 @@
+"""Load generation + latency reporting (reference: test/loadtime —
+payload/payload.go timestamped payloads, cmd/load broadcaster,
+cmd/report latency aggregation keyed by the tx-embedded timestamps).
+
+Payloads embed their creation time, a connection index, a rate tag, and
+zero padding up to the requested size; the reporter recovers latency as
+(block time - payload time) for every committed payload, grouped by the
+generation parameters — so a report can be produced from the chain
+alone, with no shared clock between generator and reporter beyond the
+nodes' own block timestamps.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+_MAGIC = b"lt1"  # loadtime payload, version 1
+
+
+def payload_bytes(
+    size: int,
+    conn: int = 0,
+    rate: int = 0,
+    experiment_id: str = "",
+    now_ns: int | None = None,
+    seq: int = 0,
+) -> bytes:
+    """A self-describing tx of exactly `size` bytes (payload.go NewBytes),
+    shaped as `lt1<hex(json)>=<padding>` so it passes kv-style apps that
+    demand a single key=value separator (the metadata is hex to keep the
+    JSON's colons out of the tx).  seq keeps concurrently-generated
+    payloads distinct so the mempool cache never dedups two load txs."""
+    body = {
+        "t": now_ns if now_ns is not None else time.time_ns(),
+        "c": conn,
+        "r": rate,
+        "id": experiment_id,
+        "s": seq,
+    }
+    raw = (
+        _MAGIC
+        + json.dumps(body, separators=(",", ":")).encode().hex().encode()
+        + b"="
+    )
+    if len(raw) >= size:
+        return raw + b"0"  # never truncate metadata; value must be non-empty
+    return raw + b"0" * (size - len(raw))
+
+
+def payload_from_bytes(tx: bytes) -> dict | None:
+    """Parse a loadtime payload, or None (payload.go FromBytes).  Strict:
+    anything lt1-prefixed that does not decode to a payload dict is not a
+    payload — report() trusts the returned shape."""
+    if not tx.startswith(_MAGIC) or b"=" not in tx:
+        return None
+    try:
+        p = json.loads(bytes.fromhex(tx[len(_MAGIC):].split(b"=")[0].decode()))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(p, dict) or not isinstance(p.get("t"), int):
+        return None
+    return p
+
+
+@dataclass
+class LoadResult:
+    sent: int = 0
+    accepted: int = 0
+    rejected: int = 0
+    errors: list[str] = field(default_factory=list)
+
+
+class LoadGenerator:
+    """Broadcasts timestamped payloads at a target rate over N
+    connections (cmd/load with -c/-r/-T flags)."""
+
+    def __init__(
+        self,
+        rpc_client_factory,
+        connections: int = 1,
+        rate: int = 100,
+        size: int = 1024,
+        experiment_id: str | None = None,
+    ):
+        self.factory = rpc_client_factory
+        self.connections = connections
+        self.rate = rate
+        self.size = size
+        self.experiment_id = experiment_id or uuid.uuid4().hex[:12]
+        self._seq = 0
+        self._seq_mtx = threading.Lock()
+
+    def _next_seq(self) -> int:
+        with self._seq_mtx:
+            self._seq += 1
+            return self._seq
+
+    def run(self, duration_s: float) -> LoadResult:
+        result = LoadResult()
+        res_mtx = threading.Lock()
+
+        def conn_worker(conn_idx: int) -> None:
+            rpc = self.factory()
+            deadline = time.monotonic() + duration_s
+            interval = 1.0 / max(self.rate, 1)
+            next_send = time.monotonic()
+            while time.monotonic() < deadline:
+                tx = payload_bytes(
+                    self.size,
+                    conn=conn_idx,
+                    rate=self.rate,
+                    experiment_id=self.experiment_id,
+                    seq=self._next_seq(),
+                )
+                try:
+                    resp = rpc.broadcast_tx_sync(tx)
+                    with res_mtx:
+                        result.sent += 1
+                        if resp.get("code", 0) == 0:
+                            result.accepted += 1
+                        else:
+                            result.rejected += 1
+                except Exception as e:  # noqa: BLE001 — load must not stop
+                    with res_mtx:
+                        result.sent += 1
+                        result.rejected += 1
+                        if len(result.errors) < 10:
+                            result.errors.append(str(e))
+                next_send += interval
+                sleep = next_send - time.monotonic()
+                if sleep > 0:
+                    time.sleep(sleep)
+
+        threads = [
+            threading.Thread(target=conn_worker, args=(i,), daemon=True)
+            for i in range(self.connections)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return result
+
+
+def report(rpc, from_height: int = 1, to_height: int = 0) -> dict:
+    """Scan committed blocks and aggregate payload latencies per
+    experiment id (cmd/report: mean/min/max/stddev, all from chain data).
+    """
+    if to_height == 0:
+        to_height = int(rpc.status()["sync_info"]["latest_block_height"])
+    per_exp: dict[str, list[float]] = {}
+    tx_count = 0
+    first_t = None
+    last_t = None
+    import base64
+    import datetime
+
+    for h in range(from_height, to_height + 1):
+        blk = rpc.block(h)["block"]
+        bt = blk["header"]["time"]
+        base_s, _, frac = bt.rstrip("Z").partition(".")
+        dt = datetime.datetime.strptime(base_s, "%Y-%m-%dT%H:%M:%S").replace(
+            tzinfo=datetime.timezone.utc
+        )
+        block_ns = int(dt.timestamp()) * 10**9 + int((frac or "0").ljust(9, "0")[:9])
+        for tx_b64 in blk["data"]["txs"]:
+            p = payload_from_bytes(base64.b64decode(tx_b64))
+            if p is None:
+                continue
+            tx_count += 1
+            lat_s = (block_ns - p["t"]) / 1e9
+            per_exp.setdefault(p.get("id", ""), []).append(lat_s)
+            first_t = min(first_t, p["t"]) if first_t else p["t"]
+            last_t = max(last_t, block_ns) if last_t else block_ns
+    experiments = {}
+    for exp, lats in per_exp.items():
+        experiments[exp] = {
+            "count": len(lats),
+            "min_s": round(min(lats), 4),
+            "max_s": round(max(lats), 4),
+            "avg_s": round(statistics.fmean(lats), 4),
+            "stddev_s": round(statistics.pstdev(lats), 4) if len(lats) > 1 else 0.0,
+        }
+    wall = (last_t - first_t) / 1e9 if first_t and last_t and last_t > first_t else 0
+    return {
+        "from_height": from_height,
+        "to_height": to_height,
+        "payload_txs": tx_count,
+        "throughput_txs_per_s": round(tx_count / wall, 2) if wall else 0.0,
+        "experiments": experiments,
+    }

@@ -312,6 +312,7 @@ class Node:
         self.metrics_registry = Registry()
         self.metrics = NodeMetrics(self.metrics_registry)
         self._metrics_httpd = None
+        self._pprof_httpd = None
 
     # ---------------------------------------------------------------- util
 
@@ -482,6 +483,42 @@ class Node:
             ).start()
             self.logger.info(f"Prometheus metrics on {addr}")
 
+        if self.config.instrumentation.pprof_laddr:
+            # separate opt-in profiling listener (node.go:1004-1018 puts
+            # pprof on its own pprof_laddr, never the metrics port)
+            from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+            from .utils.debugdump import heap_summary, thread_dump
+
+            class P(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+
+                def do_GET(self):
+                    if self.path.startswith("/debug/threads"):
+                        body = thread_dump().encode()
+                    elif self.path.startswith("/debug/heap"):
+                        body = heap_summary().encode()
+                    else:
+                        body = b"endpoints: /debug/threads /debug/heap\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            paddr = self.config.instrumentation.pprof_laddr
+            phost, _, pport = paddr.rpartition(":")
+            self._pprof_httpd = ThreadingHTTPServer(
+                (phost or "127.0.0.1", int(pport)), P
+            )
+            threading.Thread(
+                target=self._pprof_httpd.serve_forever,
+                daemon=True,
+                name="pprof",
+            ).start()
+            self.logger.info(f"debug/profiling endpoints on {paddr}")
+
     def stop(self) -> None:
         from .types import validation as _validation
 
@@ -493,6 +530,12 @@ class Node:
             try:
                 self._metrics_httpd.shutdown()
                 self._metrics_httpd.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._pprof_httpd is not None:
+            try:
+                self._pprof_httpd.shutdown()
+                self._pprof_httpd.server_close()
             except Exception:  # noqa: BLE001
                 pass
         if self.rpc_server is not None:

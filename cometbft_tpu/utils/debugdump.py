@@ -1,0 +1,53 @@
+"""Process introspection for the debug endpoints and `debug dump`
+(reference: cmd/cometbft/commands/debug — goroutine/heap profiles via
+net/http/pprof; the Python equivalents are frame dumps over
+sys._current_frames and gc/tracemalloc summaries).
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import threading
+import traceback
+
+
+def thread_dump() -> str:
+    """Stack trace of every live thread — the goroutine-profile
+    analogue."""
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = [f"{len(frames)} threads\n"]
+    for tid, frame in frames.items():
+        t = by_id.get(tid)
+        name = t.name if t else "?"
+        daemon = " daemon" if (t and t.daemon) else ""
+        out.append(f"--- thread {tid} [{name}]{daemon} ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def heap_summary(top: int = 25) -> str:
+    """Heap profile analogue: tracemalloc top allocations when tracing
+    is on (PYTHONTRACEMALLOC=1), else gc object-type census."""
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[:top]
+        total = sum(s.size for s in snap.statistics("filename"))
+        out = [f"tracemalloc: {total / 1e6:.1f} MB traced\n"]
+        out.extend(str(s) for s in stats)
+        return "\n".join(out)
+    counts: dict[str, int] = {}
+    for obj in gc.get_objects():
+        name = type(obj).__name__
+        counts[name] = counts.get(name, 0) + 1
+    top_types = sorted(counts.items(), key=lambda kv: -kv[1])[:top]
+    out = [
+        "tracemalloc off (set PYTHONTRACEMALLOC=1 for allocation sites); "
+        f"gc census of {sum(counts.values())} objects:\n"
+    ]
+    out.extend(f"{n:>9}  {t}" for t, n in top_types)
+    return "\n".join(out)

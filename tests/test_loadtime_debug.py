@@ -1,0 +1,145 @@
+"""Loadtime payloads/generator/report, debug dump endpoints + CLI,
+config get/set/migrate (reference: test/loadtime, commands/debug,
+internal/confix)."""
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from cometbft_tpu.cli import main as cli_main
+from cometbft_tpu.config import load_config
+from cometbft_tpu.e2e.loadtime import (
+    LoadGenerator,
+    payload_bytes,
+    payload_from_bytes,
+    report,
+)
+from cometbft_tpu.utils.debugdump import heap_summary, thread_dump
+
+
+def test_payload_roundtrip_and_padding():
+    tx = payload_bytes(512, conn=3, rate=200, experiment_id="exp1", seq=9)
+    assert len(tx) == 512
+    p = payload_from_bytes(tx)
+    assert p["c"] == 3 and p["r"] == 200 and p["id"] == "exp1" and p["s"] == 9
+    assert payload_from_bytes(b"not a payload") is None
+    # sub-minimum size never truncates metadata
+    small = payload_bytes(8, seq=1)
+    assert payload_from_bytes(small) is not None
+
+
+def test_thread_and_heap_dumps():
+    td = thread_dump()
+    assert "MainThread" in td and "threads" in td
+    hs = heap_summary()
+    assert "gc census" in hs or "tracemalloc" in hs
+
+
+def test_config_get_set_migrate(tmp_path):
+    home = str(tmp_path / "cfg")
+    assert cli_main(["--home", home, "init", "--chain-id", "c"]) == 0
+    # get
+    assert cli_main(["--home", home, "config", "get", "mempool.size"]) == 0
+    # set + verify persisted
+    assert cli_main(["--home", home, "config", "set", "mempool.size", "777"]) == 0
+    assert load_config(home).mempool.size == 777
+    assert cli_main(
+        ["--home", home, "config", "set", "instrumentation.prometheus", "true"]
+    ) == 0
+    assert load_config(home).instrumentation.prometheus is True
+    # unknown key errors
+    assert cli_main(["--home", home, "config", "get", "nope.key"]) == 1
+    # migrate: strip the file down to one section, migrate restores the rest
+    cfg_path = os.path.join(home, "config", "config.toml")
+    open(cfg_path, "w").write('[mempool]\nsize = 555\n')
+    assert cli_main(["--home", home, "config", "migrate"]) == 0
+    migrated = load_config(home)
+    assert migrated.mempool.size == 555  # preserved
+    assert migrated.p2p.laddr  # restored from defaults
+    text = open(cfg_path).read()
+    assert "[consensus]" in text and "[p2p]" in text
+
+
+@pytest.mark.slow
+def test_load_generation_and_report_against_live_node(tmp_path):
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.rpc import HTTPClient
+
+    from test_node_rpc import _mk_home, _test_cfg, _wait
+
+    home = _mk_home(tmp_path, "load", chain_id="load-chain")
+    node = Node(_test_cfg(home))
+    node.start()
+    try:
+        rpc = HTTPClient(node.rpc_server.listen_addr)
+        assert _wait(
+            lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 1
+        )
+        gen = LoadGenerator(
+            lambda: HTTPClient(node.rpc_server.listen_addr),
+            connections=2,
+            rate=20,
+            size=256,
+        )
+        res = gen.run(3.0)
+        assert res.sent > 0 and res.accepted > 0, res.errors
+        # wait for the load to commit
+        assert _wait(
+            lambda: report(rpc)["payload_txs"] >= res.accepted * 0.5, timeout=60
+        )
+        rep = report(rpc)
+        exp = rep["experiments"][gen.experiment_id]
+        assert exp["count"] > 0
+        # latencies are (block time - payload time); block time is the
+        # proposer's BFT timestamp, so sub-second negatives are normal
+        assert exp["min_s"] > -5 and exp["avg_s"] < 60
+        assert rep["throughput_txs_per_s"] > 0
+    finally:
+        node.stop()
+
+
+@pytest.mark.slow
+def test_debug_dump_cli_against_live_node(tmp_path):
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.rpc import HTTPClient
+
+    from test_node_rpc import _mk_home, _test_cfg, _wait
+
+    home = _mk_home(tmp_path, "dbg", chain_id="dbg-chain")
+    cfg = _test_cfg(home)
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+    cfg.instrumentation.pprof_laddr = "127.0.0.1:0"
+    node = Node(cfg)
+    node.start()
+    try:
+        rpc = HTTPClient(node.rpc_server.listen_addr)
+        assert _wait(
+            lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 1
+        )
+        maddr = "%s:%d" % node._metrics_httpd.server_address
+        paddr = "%s:%d" % node._pprof_httpd.server_address
+        out = str(tmp_path / "dump.tar.gz")
+        rc = cli_main(
+            [
+                "--home", home,
+                "debug", "dump",
+                "--rpc-laddr", node.rpc_server.listen_addr,
+                "--metrics-laddr", maddr,
+                "--pprof-laddr", paddr,
+                "--out", out,
+            ]
+        )
+        assert rc == 0 and os.path.exists(out)
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+            assert {"status.json", "consensus_state.json", "threads.txt",
+                    "metrics.txt", "config.toml"} <= set(names)
+            status = json.load(tar.extractfile("status.json"))
+            assert status["node_info"]["network"] == "dbg-chain"
+            threads = tar.extractfile("threads.txt").read().decode()
+            assert "MainThread" in threads
+    finally:
+        node.stop()
