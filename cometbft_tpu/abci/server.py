@@ -42,14 +42,11 @@ class SocketServer(Service):
         ).start()
 
     def on_stop(self) -> None:
-        if self._listener:
-            self._listener.close()
+        from ..utils.netutil import close_socket
+
+        close_socket(self._listener)
         for c in self._conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            c.close()
+            close_socket(c)
 
     def _accept_routine(self) -> None:
         while True:

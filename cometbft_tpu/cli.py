@@ -408,6 +408,9 @@ def cmd_config(args) -> int:
         print(getattr(obj, key))
         return 0
     if args.action == "set":
+        if args.value is None:
+            print(f"config set {args.key}: missing value", file=sys.stderr)
+            return 1
         cur = getattr(obj, key)
         val: object = args.value
         try:
@@ -510,7 +513,7 @@ def main(argv: list[str] | None = None) -> int:
     sp = sub.add_parser("config", help="get/set/migrate config.toml")
     sp.add_argument("action", choices=["get", "set", "migrate"])
     sp.add_argument("key", nargs="?", default="")
-    sp.add_argument("value", nargs="?", default="")
+    sp.add_argument("value", nargs="?", default=None)
     sp.set_defaults(fn=cmd_config)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)

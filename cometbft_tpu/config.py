@@ -33,7 +33,10 @@ class BaseConfig:
     priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     log_level: str = "info"
-    tx_index: str = "kv"  # "kv" | "null" (config.go TxIndexConfig)
+    tx_index: str = "kv"  # "kv" | "null" | "psql" (config.go TxIndexConfig)
+    # for tx_index = "psql": a DB conn string — postgres when psycopg2 is
+    # installed, or "sqlite:///path" (indexer/sink.py SQLEventSink)
+    psql_conn: str = ""
 
 
 @dataclass
@@ -137,6 +140,10 @@ class Config:
     def validate_basic(self) -> None:
         if self.base.db_backend not in ("native", "sqlite", "memdb"):
             raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        if self.base.tx_index not in ("kv", "null", "psql"):
+            raise ValueError(f"unknown tx_index {self.base.tx_index!r}")
+        if self.base.tx_index == "psql" and not self.base.psql_conn:
+            raise ValueError("tx_index = \"psql\" requires psql_conn")
         if self.statesync.enable and not (
             self.statesync.trust_height > 0 and self.statesync.trust_hash
         ):

@@ -799,7 +799,10 @@ class ConsensusState(Service):
 
         self.block_exec.validate_block(self.state, block)
 
+        from ..utils.fail import fail_point
+
         precommits = rs.votes.precommits(rs.commit_round)
+        fail_point("before save_block")  # state.go:1872
         if self.block_store.height < block.header.height:
             ext_enabled = self.state.consensus_params.feature.vote_extensions_enabled(
                 height
@@ -813,9 +816,11 @@ class ConsensusState(Service):
                     block, block_parts, precommits.make_commit()
                 )
 
+        fail_point("before WAL end_height")  # state.go:1889
         self.wal.write_sync(
             wal_pb.WALMessageProto(end_height=wal_pb.EndHeightProto(height=height))
         )
+        fail_point("after WAL end_height")  # state.go:1912
 
         state_copy = self.state.copy()
         new_state = self.block_exec.apply_verified_block(state_copy, bid, block)

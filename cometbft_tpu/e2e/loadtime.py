@@ -105,7 +105,13 @@ class LoadGenerator:
         res_mtx = threading.Lock()
 
         def conn_worker(conn_idx: int) -> None:
-            rpc = self.factory()
+            try:
+                rpc = self.factory()
+            except Exception as e:  # noqa: BLE001 — surface, don't vanish
+                with res_mtx:
+                    if len(result.errors) < 10:
+                        result.errors.append(f"conn {conn_idx}: {e}")
+                return
             deadline = time.monotonic() + duration_s
             interval = 1.0 / max(self.rate, 1)
             next_send = time.monotonic()
@@ -151,8 +157,13 @@ def report(rpc, from_height: int = 1, to_height: int = 0) -> dict:
     """Scan committed blocks and aggregate payload latencies per
     experiment id (cmd/report: mean/min/max/stddev, all from chain data).
     """
+    status = rpc.status()["sync_info"]
     if to_height == 0:
-        to_height = int(rpc.status()["sync_info"]["latest_block_height"])
+        to_height = int(status["latest_block_height"])
+    # pruned chains: blocks below the store base are gone (the Go
+    # reporter likewise iterates from store.Base())
+    earliest = int(status.get("earliest_block_height", 1) or 1)
+    from_height = max(from_height, earliest)
     per_exp: dict[str, list[float]] = {}
     tx_count = 0
     first_t = None

@@ -1,0 +1,224 @@
+"""SQL event sink (reference: state/indexer/sink/psql/psql.go +
+schema.sql): block and tx events written to a relational database for
+external observability, alongside (or instead of) the KV indexers.
+
+The reference binds PostgreSQL; this sink speaks the DB-API so it runs
+on psycopg2 when present and on sqlite3 (tests, single-box deployments)
+otherwise — same four-table schema: blocks, tx_results, events,
+attributes.  Queries stay the operator's job (the reference's psql sink
+deliberately implements no read path either, psql.go "the query methods
+are not implemented").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+SCHEMA = [
+    """CREATE TABLE IF NOT EXISTS blocks (
+        rowid      INTEGER PRIMARY KEY {autoinc},
+        height     BIGINT NOT NULL,
+        chain_id   TEXT NOT NULL,
+        created_at TEXT NOT NULL,
+        UNIQUE (height, chain_id)
+    )""",
+    """CREATE TABLE IF NOT EXISTS tx_results (
+        rowid      INTEGER PRIMARY KEY {autoinc},
+        block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+        tx_index   INTEGER NOT NULL,
+        created_at TEXT NOT NULL,
+        tx_hash    TEXT NOT NULL,
+        tx_result  BLOB NOT NULL,
+        UNIQUE (block_id, tx_index)
+    )""",
+    """CREATE TABLE IF NOT EXISTS events (
+        rowid    INTEGER PRIMARY KEY {autoinc},
+        block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+        tx_id    BIGINT REFERENCES tx_results(rowid),
+        type     TEXT NOT NULL
+    )""",
+    """CREATE TABLE IF NOT EXISTS attributes (
+        event_id      BIGINT NOT NULL REFERENCES events(rowid),
+        key           TEXT NOT NULL,
+        composite_key TEXT NOT NULL,
+        value         TEXT
+    )""",
+]
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class SQLEventSink:
+    """Write-only sink with the reference's schema.
+
+    conn_factory returns a new DB-API connection; paramstyle is
+    autodetected ('?' for sqlite3, '%s' for psycopg2)."""
+
+    def __init__(self, conn_factory, chain_id: str, paramstyle: str | None = None):
+        self.chain_id = chain_id
+        self._conn = conn_factory()
+        self._mtx = threading.Lock()
+        mod = type(self._conn).__module__.split(".")[0]
+        self._ph = paramstyle or ("%s" if "psycopg" in mod else "?")
+        autoinc = "AUTOINCREMENT" if self._ph == "?" else ""
+        cur = self._conn.cursor()
+        for stmt in SCHEMA:
+            cur.execute(stmt.format(autoinc=autoinc))
+        self._conn.commit()
+
+    @classmethod
+    def from_conn_string(cls, conn_str: str, chain_id: str) -> "SQLEventSink":
+        """psql.go NewEventSink: a postgres conn string — or a sqlite
+        path prefixed ``sqlite://`` when psycopg2 is unavailable."""
+        if conn_str.startswith("sqlite://"):
+            import sqlite3
+
+            path = conn_str[len("sqlite://"):]
+            return cls(
+                lambda: sqlite3.connect(path, check_same_thread=False), chain_id
+            )
+        try:
+            import psycopg2  # noqa: F401 — optional, not in this image
+        except ImportError as e:
+            raise RuntimeError(
+                "psycopg2 not available; use a sqlite:// conn string"
+            ) from e
+        import psycopg2 as pg
+
+        return cls(lambda: pg.connect(conn_str), chain_id)
+
+    # ------------------------------------------------------------- writes
+
+    def _insert(self, cur, table: str, cols: list[str], vals: list) -> int:
+        ph = ", ".join([self._ph] * len(vals))
+        sql = f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})"
+        if self._ph == "%s":
+            sql += " RETURNING rowid"
+            cur.execute(sql, vals)
+            return cur.fetchone()[0]
+        cur.execute(sql, vals)
+        return cur.lastrowid
+
+    def _write_events(
+        self, cur, block_rowid: int, tx_rowid, events: dict[str, list[str]]
+    ) -> None:
+        """events come as the flattened {"type.key": [values]} map the
+        EventBus produces; type/key split on the last dot."""
+        by_type: dict[str, list[tuple[str, str]]] = {}
+        for composite, values in events.items():
+            etype, _, key = composite.rpartition(".")
+            for v in values:
+                by_type.setdefault(etype or "", []).append((key, v))
+        for etype, attrs in by_type.items():
+            event_id = self._insert(
+                cur,
+                "events",
+                ["block_id", "tx_id", "type"],
+                [block_rowid, tx_rowid, etype],
+            )
+            for key, v in attrs:
+                composite = f"{etype}.{key}" if etype else key
+                self._insert(
+                    cur,
+                    "attributes",
+                    ["event_id", "key", "composite_key", "value"],
+                    [event_id, key, composite, v],
+                )
+
+    def index_block_events(self, height: int, events: dict[str, list[str]]) -> None:
+        """psql.go IndexBlockEvents: the block row + its events."""
+        with self._mtx:
+            cur = self._conn.cursor()
+            block_rowid = self._block_rowid(cur, height)
+            if block_rowid is None:
+                block_rowid = self._insert(
+                    cur,
+                    "blocks",
+                    ["height", "chain_id", "created_at"],
+                    [height, self.chain_id, _utcnow()],
+                )
+            self._write_events(cur, block_rowid, None, events)
+            self._conn.commit()
+
+    def index_tx(
+        self,
+        height: int,
+        index: int,
+        tx_hash: bytes,
+        tx_result_bytes: bytes,
+        events: dict[str, list[str]],
+    ) -> None:
+        """psql.go IndexTxEvents: tx_results row + its events."""
+        with self._mtx:
+            cur = self._conn.cursor()
+            block_rowid = self._block_rowid(cur, height)
+            if block_rowid is None:
+                block_rowid = self._insert(
+                    cur,
+                    "blocks",
+                    ["height", "chain_id", "created_at"],
+                    [height, self.chain_id, _utcnow()],
+                )
+            tx_rowid = self._insert(
+                cur,
+                "tx_results",
+                ["block_id", "tx_index", "created_at", "tx_hash", "tx_result"],
+                [block_rowid, index, _utcnow(), tx_hash.hex().upper(), tx_result_bytes],
+            )
+            self._write_events(cur, block_rowid, tx_rowid, events)
+            self._conn.commit()
+
+    def _block_rowid(self, cur, height: int):
+        cur.execute(
+            f"SELECT rowid FROM blocks WHERE height = {self._ph} "
+            f"AND chain_id = {self._ph}",
+            [height, self.chain_id],
+        )
+        row = cur.fetchone()
+        return row[0] if row else None
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TxSinkAdapter:
+    """SQLEventSink behind the TxIndexer write interface, so
+    IndexerService can fan out to KV and SQL sinks together
+    (indexer_service.go supports multiple sinks).  Write-only."""
+
+    def __init__(self, sink: SQLEventSink):
+        self.sink = sink
+
+    def index(self, height, index, tx, result, events) -> None:
+        from ..types.tx import tx_hash
+
+        encoded = result.encode() if hasattr(result, "encode") else b""
+        self.sink.index_tx(height, index, tx_hash(tx), encoded, events or {})
+
+    def get(self, h):
+        return None
+
+    def search(self, query, limit: int = 100):
+        return []
+
+
+class BlockSinkAdapter:
+    """SQLEventSink behind the BlockIndexer write interface."""
+
+    def __init__(self, sink: SQLEventSink):
+        self.sink = sink
+
+    def index(self, height, events) -> None:
+        self.sink.index_block_events(height, events or {})
+
+    def has(self, height: int) -> bool:
+        return False
+
+    def search(self, query, limit: int = 100):
+        return []

@@ -116,6 +116,18 @@ class Node:
         if config.base.tx_index == "kv":
             self.tx_indexer = TxIndexer(PrefixDB(self.db, b"txi/"))
             self.block_indexer = BlockIndexer(PrefixDB(self.db, b"bli/"))
+        elif config.base.tx_index == "psql":
+            from .indexer.sink import (
+                BlockSinkAdapter,
+                SQLEventSink,
+                TxSinkAdapter,
+            )
+
+            sink = SQLEventSink.from_conn_string(
+                config.base.psql_conn, self.genesis.chain_id
+            )
+            self.tx_indexer = TxSinkAdapter(sink)
+            self.block_indexer = BlockSinkAdapter(sink)
         else:
             self.tx_indexer = NullTxIndexer()
             self.block_indexer = NullBlockIndexer()

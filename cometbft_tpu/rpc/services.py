@@ -98,17 +98,12 @@ class CompanionServiceServer(Service):
         threading.Thread(target=self._accept, daemon=True, name="svc-accept").start()
 
     def on_stop(self) -> None:
-        if self._listener:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        from ..utils.netutil import close_socket
+
+        close_socket(self._listener)
         with self._mtx:
             for c in list(self._conns):
-                try:
-                    c.close()
-                except OSError:
-                    pass
+                close_socket(c)
 
     def _accept(self) -> None:
         while self.is_running():
@@ -196,6 +191,12 @@ class CompanionServiceServer(Service):
         sub = None
         subscriber = f"svc-latest-{uuid.uuid4().hex[:12]}"
         try:
+            # subscribe BEFORE the initial frame: a block that commits
+            # between the two would otherwise be missed forever
+            if self.event_bus is not None:
+                from ..types.event_bus import EventQueryNewBlock
+
+                sub = self.event_bus.subscribe(subscriber, EventQueryNewBlock)
             with send_mtx:
                 _write_frame(
                     conn,
@@ -206,11 +207,8 @@ class CompanionServiceServer(Service):
                         ).encode(),
                     ).encode(),
                 )
-            if self.event_bus is None:
+            if sub is None:
                 return
-            from ..types.event_bus import EventQueryNewBlock
-
-            sub = self.event_bus.subscribe(subscriber, EventQueryNewBlock)
             while self.is_running():
                 try:
                     msg, _events = sub.get(timeout=1.0)

@@ -409,7 +409,11 @@ class BlockExecutor:
                 f"app returned {len(fb_resp.tx_results)} tx results, "
                 f"block has {len(block.data.txs)} txs"
             )
+        from ..utils.fail import fail_point
+
+        fail_point("after FinalizeBlock")  # execution.go:267
         self.store.save_finalize_block_response(h, fb_resp)
+        fail_point("after SaveFinalizeBlockResponse")  # execution.go:274
 
         validator_updates = validate_validator_updates(
             fb_resp.validator_updates, state.consensus_params

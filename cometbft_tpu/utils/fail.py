@@ -1,0 +1,39 @@
+"""Env-indexed crash points (reference: internal/fail/fail.go:47).
+
+Each call to fail_point() increments a process-global counter; when the
+counter reaches ``FAIL_TEST_INDEX`` the process exits immediately with
+status 75 (os._exit — no cleanup, no flushes: a real crash).  Sprinkled
+through the commit path (consensus/state.py, state/execution.py) so the
+crash-at-every-step recovery tests can kill a node between any two
+persistence operations and assert WAL + handshake replay recover it
+(reference sites: state.go:1872,1889,1912, execution.go:267,274;
+exercised by replay_test.go).
+
+Zero cost when FAIL_TEST_INDEX is unset (one env read at import).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+EXIT_CODE = 75  # distinct from normal exits so tests can assert the crash
+
+_target = int(os.environ.get("FAIL_TEST_INDEX", "-1"))
+_counter = 0
+
+
+def fail_point(label: str = "") -> None:
+    """Crash here if this is the FAIL_TEST_INDEX'th fail point."""
+    global _counter
+    if _target < 0:
+        return
+    _counter += 1
+    if _counter == _target:
+        print(f"FAIL_TEST_INDEX={_target} hit at {label!r}", file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(EXIT_CODE)
+
+
+def points_hit() -> int:
+    return _counter

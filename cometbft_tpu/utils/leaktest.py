@@ -1,0 +1,58 @@
+"""Thread-leak detection (reference: go.mod:20 fortytw2/leaktest — the
+goroutine-leak analogue; Go's -race has no Python equivalent, so the
+raceable surface is covered by leak checks + the deadlock watchdog).
+
+check_threads() snapshots live threads around a block and fails if new
+ones outlive it; watchdog() dumps every thread's stack if a block runs
+past its deadline (faulthandler), turning silent deadlocks into
+actionable tracebacks in CI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class ThreadLeakError(AssertionError):
+    pass
+
+
+@contextlib.contextmanager
+def check_threads(grace_s: float = 3.0, allow: tuple[str, ...] = ()):
+    """Fail if threads started inside the block are still alive after it
+    (after up to grace_s of settling — stop() paths run on timeouts).
+
+    allow: name prefixes exempt from the check (e.g. interpreter-owned
+    pools)."""
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + grace_s
+    leaked: list[threading.Thread] = []
+    while time.monotonic() < deadline:
+        leaked = [
+            t
+            for t in threading.enumerate()
+            if t.ident not in before
+            and t.is_alive()
+            and not any(t.name.startswith(p) for p in allow)
+        ]
+        if not leaked:
+            return
+        time.sleep(0.1)
+    names = ", ".join(f"{t.name}({t.ident})" for t in leaked)
+    raise ThreadLeakError(f"{len(leaked)} thread(s) leaked: {names}")
+
+
+@contextlib.contextmanager
+def watchdog(timeout_s: float = 60.0):
+    """Dump all thread stacks to stderr if the block exceeds timeout_s
+    (the hung-test analogue of `cometbft debug kill`'s goroutine dump)."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(timeout_s, exit=False)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()

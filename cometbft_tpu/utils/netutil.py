@@ -1,0 +1,22 @@
+"""Socket helpers shared by every socket-owning service."""
+
+from __future__ import annotations
+
+import socket
+
+
+def close_socket(sock: socket.socket | None) -> None:
+    """shutdown(SHUT_RDWR) then close().  close() alone does not wake a
+    thread blocked in accept()/recv() on Linux — the fd stays blocked
+    until traffic arrives — so every service teardown must shutdown
+    first or it strands its IO threads."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass

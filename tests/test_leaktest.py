@@ -1,0 +1,84 @@
+"""Thread-leak checks over service lifecycles (reference: leaktest usage
+across the Go test suite) — services must not strand threads after
+stop()."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.store.db import MemDB
+from cometbft_tpu.utils.leaktest import ThreadLeakError, check_threads, watchdog
+
+
+def test_check_threads_catches_leak():
+    stop = threading.Event()
+    with pytest.raises(ThreadLeakError, match="leaker"):
+        with check_threads(grace_s=0.5):
+            threading.Thread(
+                target=stop.wait, name="leaker", daemon=True
+            ).start()
+    stop.set()
+
+
+def test_check_threads_passes_on_clean_exit():
+    with check_threads():
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+
+
+def test_watchdog_noop_on_fast_block():
+    with watchdog(30):
+        time.sleep(0.01)
+
+
+def test_pubsub_and_indexer_service_stop_clean():
+    from cometbft_tpu.indexer.block import BlockIndexer
+    from cometbft_tpu.indexer.service import IndexerService
+    from cometbft_tpu.indexer.tx import TxIndexer
+    from cometbft_tpu.types.event_bus import EventBus
+
+    with check_threads():
+        bus = EventBus()
+        svc = IndexerService(TxIndexer(MemDB()), BlockIndexer(MemDB()), bus)
+        svc.start()
+        time.sleep(0.2)
+        svc.stop()
+
+
+def test_pruner_stops_clean():
+    from cometbft_tpu.state.pruner import Pruner
+
+    class _Stores:
+        base = 0
+        height = 0
+
+        def prune_blocks(self, h):
+            return 0
+
+    with check_threads():
+        p = Pruner(MemDB(), _Stores(), _Stores(), interval=0.2)
+        p.start()
+        time.sleep(0.3)
+        p.stop()
+
+
+def test_companion_server_stops_clean():
+    from cometbft_tpu.rpc.services import (
+        CompanionServiceClient,
+        CompanionServiceServer,
+    )
+
+    class _BS:
+        height = 0
+        base = 0
+
+    with check_threads():
+        srv = CompanionServiceServer("127.0.0.1:0", _BS(), None)
+        srv.start()
+        cli = CompanionServiceClient(srv.laddr)
+        v = cli.get_version()
+        assert v.block > 0
+        cli.close()
+        srv.stop()

@@ -1,0 +1,105 @@
+"""SQL event sink (reference: state/indexer/sink/psql): reference
+schema, block + tx event rows, IndexerService fan-in, node wiring."""
+
+import sqlite3
+
+import pytest
+
+from cometbft_tpu.indexer.sink import (
+    BlockSinkAdapter,
+    SQLEventSink,
+    TxSinkAdapter,
+)
+from cometbft_tpu.wire import abci_pb as apb
+
+
+@pytest.fixture
+def sink():
+    s = SQLEventSink(
+        lambda: sqlite3.connect(":memory:", check_same_thread=False), "sink-chain"
+    )
+    yield s
+    s.close()
+
+
+def test_schema_created(sink):
+    cur = sink._conn.cursor()
+    cur.execute("SELECT name FROM sqlite_master WHERE type='table'")
+    tables = {r[0] for r in cur.fetchall()}
+    assert {"blocks", "tx_results", "events", "attributes"} <= tables
+
+
+def test_block_events_rows(sink):
+    sink.index_block_events(5, {"rewards.amount": ["17"], "minted": ["1"]})
+    cur = sink._conn.cursor()
+    cur.execute("SELECT height, chain_id FROM blocks")
+    assert cur.fetchall() == [(5, "sink-chain")]
+    cur.execute(
+        "SELECT e.type, a.key, a.composite_key, a.value FROM events e "
+        "JOIN attributes a ON a.event_id = e.rowid ORDER BY a.composite_key"
+    )
+    rows = cur.fetchall()
+    assert ("rewards", "amount", "rewards.amount", "17") in rows
+    assert ("", "minted", "minted", "1") in rows
+
+
+def test_tx_rows_and_block_dedup(sink):
+    res = apb.ExecTxResult(code=0, log="ok")
+    sink.index_tx(7, 0, b"\xab" * 32, res.encode(), {"transfer.to": ["bob"]})
+    sink.index_tx(7, 1, b"\xcd" * 32, res.encode(), {"transfer.to": ["carol"]})
+    cur = sink._conn.cursor()
+    cur.execute("SELECT COUNT(*) FROM blocks")
+    assert cur.fetchone()[0] == 1  # one block row for both txs
+    cur.execute("SELECT tx_index, tx_hash FROM tx_results ORDER BY tx_index")
+    rows = cur.fetchall()
+    assert rows[0] == (0, "AB" * 32) and rows[1] == (1, "CD" * 32)
+    # events link to their tx rows
+    cur.execute("SELECT COUNT(*) FROM events WHERE tx_id IS NOT NULL")
+    assert cur.fetchone()[0] == 2
+    # decoded tx_result round-trips
+    cur.execute("SELECT tx_result FROM tx_results WHERE tx_index = 0")
+    back = apb.ExecTxResult.decode(cur.fetchone()[0])
+    assert back.log == "ok"
+
+
+def test_sqlite_conn_string(tmp_path):
+    s = SQLEventSink.from_conn_string(
+        f"sqlite://{tmp_path}/events.db", "cs-chain"
+    )
+    s.index_block_events(1, {"a.b": ["c"]})
+    s.close()
+    db = sqlite3.connect(f"{tmp_path}/events.db")
+    assert db.execute("SELECT COUNT(*) FROM blocks").fetchone()[0] == 1
+
+
+def test_adapters_via_indexer_service(sink):
+    """The sink rides the same IndexerService the KV indexers use."""
+    from cometbft_tpu.indexer.service import IndexerService
+    from cometbft_tpu.types.event_bus import EventBus
+
+    bus = EventBus()
+    svc = IndexerService(TxSinkAdapter(sink), BlockSinkAdapter(sink), bus)
+    svc.start()
+    try:
+        bus.publish_new_block_events(
+            3, [apb.Event(type="epoch", attributes=[
+                apb.EventAttribute(key="n", value="3")])], 1
+        )
+        res = apb.ExecTxResult(code=0)
+        bus.publish_tx(3, 0, b"k=v", res)
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cur = sink._conn.cursor()
+            cur.execute("SELECT COUNT(*) FROM tx_results")
+            if cur.fetchone()[0] >= 1:
+                break
+            time.sleep(0.05)
+        cur = sink._conn.cursor()
+        cur.execute("SELECT COUNT(*) FROM tx_results")
+        assert cur.fetchone()[0] == 1
+        cur.execute("SELECT value FROM attributes WHERE composite_key='epoch.n'")
+        assert cur.fetchone() == ("3",)
+    finally:
+        svc.stop()
