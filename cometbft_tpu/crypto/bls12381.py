@@ -913,13 +913,27 @@ def fast_aggregate_verify(pubkeys: list["PubKey"], msg: bytes, agg_sig: bytes) -
     (pop_verify) — without PoP an attacker can register
     pk_rogue = x*G1 - pk_victim and forge an "aggregate" the victim never
     signed (the rogue-key attack; draft-irtf-cfrg-bls-signature §3.3).
-    Callers MUST check PoPs at key-registration time."""
+    Callers MUST check PoPs at key-registration time.
+
+    With COMETBFT_TPU_BLS_DEVICE=1 the pubkey sum (the data-parallel
+    part) tree-reduces on the accelerator (ops/bls381.aggregate_g1);
+    pairings always run on host (SURVEY §7 staging)."""
     if not pubkeys:
         return False
-    acc = (_FP.one, _FP.one, _FP.zero)
-    for pk in pubkeys:
-        acc = _jac_add(_FP, acc, _from_affine(_FP, pk._aff))
-    agg_aff = _to_affine(_FP, acc)
+    import os as _os
+
+    agg_aff = None
+    if _os.environ.get("COMETBFT_TPU_BLS_DEVICE") == "1" and len(pubkeys) >= 8:
+        from ..ops import bls381 as _dev
+
+        # pass the already-validated affine points; re-decompressing the
+        # bytes would redo a host square root per validator
+        agg_aff = _dev.aggregate_pubkeys_device([pk._aff for pk in pubkeys])
+    else:
+        acc = (_FP.one, _FP.one, _FP.zero)
+        for pk in pubkeys:
+            acc = _jac_add(_FP, acc, _from_affine(_FP, pk._aff))
+        agg_aff = _to_affine(_FP, acc)
     try:
         s = _g2_decompress(agg_sig)
     except ValueError:

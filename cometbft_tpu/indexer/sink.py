@@ -15,25 +15,26 @@ from __future__ import annotations
 import threading
 import time
 
+# {pk} / {blob} swap per SQL dialect (sqlite vs postgres)
 SCHEMA = [
     """CREATE TABLE IF NOT EXISTS blocks (
-        rowid      INTEGER PRIMARY KEY {autoinc},
+        rowid      {pk},
         height     BIGINT NOT NULL,
         chain_id   TEXT NOT NULL,
         created_at TEXT NOT NULL,
         UNIQUE (height, chain_id)
     )""",
     """CREATE TABLE IF NOT EXISTS tx_results (
-        rowid      INTEGER PRIMARY KEY {autoinc},
+        rowid      {pk},
         block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
         tx_index   INTEGER NOT NULL,
         created_at TEXT NOT NULL,
         tx_hash    TEXT NOT NULL,
-        tx_result  BLOB NOT NULL,
+        tx_result  {blob} NOT NULL,
         UNIQUE (block_id, tx_index)
     )""",
     """CREATE TABLE IF NOT EXISTS events (
-        rowid    INTEGER PRIMARY KEY {autoinc},
+        rowid    {pk},
         block_id BIGINT NOT NULL REFERENCES blocks(rowid),
         tx_id    BIGINT REFERENCES tx_results(rowid),
         type     TEXT NOT NULL
@@ -62,11 +63,17 @@ class SQLEventSink:
         self._conn = conn_factory()
         self._mtx = threading.Lock()
         mod = type(self._conn).__module__.split(".")[0]
-        self._ph = paramstyle or ("%s" if "psycopg" in mod else "?")
-        autoinc = "AUTOINCREMENT" if self._ph == "?" else ""
+        self._pg = "psycopg" in mod
+        self._ph = paramstyle or ("%s" if self._pg else "?")
+        pk = (
+            "BIGSERIAL PRIMARY KEY"
+            if self._pg
+            else "INTEGER PRIMARY KEY AUTOINCREMENT"
+        )
+        blob = "BYTEA" if self._pg else "BLOB"
         cur = self._conn.cursor()
         for stmt in SCHEMA:
-            cur.execute(stmt.format(autoinc=autoinc))
+            cur.execute(stmt.format(pk=pk, blob=blob))
         self._conn.commit()
 
     @classmethod
@@ -92,15 +99,22 @@ class SQLEventSink:
 
     # ------------------------------------------------------------- writes
 
-    def _insert(self, cur, table: str, cols: list[str], vals: list) -> int:
+    def _insert(
+        self, cur, table: str, cols: list[str], vals: list, want_id: bool = True
+    ) -> int | None:
         ph = ", ".join([self._ph] * len(vals))
         sql = f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})"
-        if self._ph == "%s":
-            sql += " RETURNING rowid"
+        if self._pg:
+            # postgres has no implicit rowid; only id-bearing tables can
+            # RETURNING (attributes has no rowid column)
+            if want_id:
+                sql += " RETURNING rowid"
+                cur.execute(sql, vals)
+                return cur.fetchone()[0]
             cur.execute(sql, vals)
-            return cur.fetchone()[0]
+            return None
         cur.execute(sql, vals)
-        return cur.lastrowid
+        return cur.lastrowid if want_id else None
 
     def _write_events(
         self, cur, block_rowid: int, tx_rowid, events: dict[str, list[str]]
@@ -126,6 +140,7 @@ class SQLEventSink:
                     "attributes",
                     ["event_id", "key", "composite_key", "value"],
                     [event_id, key, composite, v],
+                    want_id=False,
                 )
 
     def index_block_events(self, height: int, events: dict[str, list[str]]) -> None:

@@ -176,23 +176,15 @@ class CombBatchVerifier:
         ).reshape(n, 64)
         idx = np.asarray(self._rows, dtype=np.int64)
 
-        r_all = np.zeros((V, 32), dtype=np.uint8)
-        s_all = np.zeros((V, 32), dtype=np.uint8)
-        dig_all = np.zeros((V, 64), dtype=np.uint8)
-        r_all[idx] = sig_arr[:, :32]
-        s_all[idx] = sig_arr[:, 32:]
-        dig_all[idx] = dig_arr
+        # one packed (V, 128) row: R | s | SHA-512 digest — a single
+        # host->device transfer per call, sliced apart on device
+        packed = np.zeros((V, 128), dtype=np.uint8)
+        packed[idx, :32] = sig_arr[:, :32]
+        packed[idx, 32:64] = sig_arr[:, 32:]
+        packed[idx, 64:] = dig_arr
 
         fn = self._verify_fn()
-        ok_all = np.asarray(
-            fn(
-                self._entry.tables,
-                self._entry.valid,
-                jnp.asarray(r_all),
-                jnp.asarray(s_all),
-                jnp.asarray(dig_all),
-            )
-        )
+        ok_all = np.asarray(fn(self._entry.tables, self._entry.valid, jnp.asarray(packed)))
         res = [bool(ok_all[i]) for i in idx]
         return all(res), res
 
@@ -205,8 +197,15 @@ class CombBatchVerifier:
             bt = comb.get_b_tables()
 
             @jax.jit
-            def run(tables, valid, r, s, dig):
-                return comb.verify_cached(tables, valid, r, s, dig, bt)
+            def run(tables, valid, packed):
+                return comb.verify_cached(
+                    tables,
+                    valid,
+                    packed[:, :32],
+                    packed[:, 32:64],
+                    packed[:, 64:],
+                    bt,
+                )
 
             self._entry.verify_fn = run
         return self._entry.verify_fn

@@ -26,7 +26,10 @@ def check_threads(grace_s: float = 3.0, allow: tuple[str, ...] = ()):
 
     allow: name prefixes exempt from the check (e.g. interpreter-owned
     pools)."""
-    before = {t.ident for t in threading.enumerate()}
+    # hold strong references to the Thread OBJECTS — idents (and ids of
+    # collected objects) are reused after a thread exits, so an ident set
+    # can mistake a leak for a pre-existing thread
+    before = list(threading.enumerate())
     yield
     deadline = time.monotonic() + grace_s
     leaked: list[threading.Thread] = []
@@ -34,7 +37,7 @@ def check_threads(grace_s: float = 3.0, allow: tuple[str, ...] = ()):
         leaked = [
             t
             for t in threading.enumerate()
-            if t.ident not in before
+            if t not in before
             and t.is_alive()
             and not any(t.name.startswith(p) for p in allow)
         ]

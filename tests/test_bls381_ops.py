@@ -1,0 +1,145 @@
+"""Device BLS12-381 field + G1 kernels, differential against the
+host implementation (reference native #3: blst's C/asm field+group;
+SURVEY §2.1).
+
+Slow tier: the unrolled Montgomery-reduction graphs take minutes to
+compile on the CPU backend (cached across runs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import bls12381 as host
+from cometbft_tpu.ops import bls381 as dev
+
+pytestmark = pytest.mark.slow
+
+rng = np.random.default_rng(11)
+
+
+def _rand_fp(n):
+    return [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % host.P
+            for _ in range(n)]
+
+
+def _limbs(vals):
+    return jnp.asarray(
+        np.stack([dev.to_limbs(v) for v in vals]), dtype=jnp.int32
+    )
+
+
+def test_field_mul_differential():
+    n = 64
+    a = [int.from_bytes(rng.bytes(48), "big") % host.P for _ in range(n)]
+    b = [int.from_bytes(rng.bytes(48), "big") % host.P for _ in range(n)]
+    out = jax.jit(dev.mul)(_limbs(a), _limbs(b))
+    got = dev.from_limbs(np.asarray(out))
+    for i in range(n):
+        assert got[i] == a[i] * b[i] % host.P, i
+
+
+def test_field_sub_and_carry_chain():
+    n = 32
+    a = [int.from_bytes(rng.bytes(48), "big") % host.P for _ in range(n)]
+    b = [int.from_bytes(rng.bytes(48), "big") % host.P for _ in range(n)]
+
+    @jax.jit
+    def chain(a_, b_):
+        d = dev.sub(a_, b_)
+        return dev.mul(d, d)  # (a-b)^2: exercises mul after sub output
+
+    got = dev.from_limbs(np.asarray(chain(_limbs(a), _limbs(b))))
+    for i in range(n):
+        assert got[i] == (a[i] - b[i]) ** 2 % host.P, i
+
+
+def test_g1_double_and_add_differential():
+    n = 16
+    pts = []
+    for i in range(n):
+        k = int.from_bytes(rng.bytes(32), "big") % host.R or 1
+        aff = host._to_affine(
+            host._FP, host._jac_mul(host._FP, host._from_affine(host._FP, host.G1_GEN), k)
+        )
+        pts.append(aff)
+    X = _limbs([p[0] for p in pts])
+    Y = _limbs([p[1] for p in pts])
+    Z = _limbs([1] * n)
+
+    dX, dY, dZ = jax.jit(dev.g1_double)(X, Y, Z)
+    for i in range(n):
+        want = host._to_affine(
+            host._FP, host._jac_dbl(host._FP, (pts[i][0], pts[i][1], 1))
+        )
+        got = _affine(dX, dY, dZ, i)
+        assert got == want, i
+
+    # pairwise adds: pts[i] + pts[n-1-i]
+    X2 = _limbs([p[0] for p in reversed(pts)])
+    Y2 = _limbs([p[1] for p in reversed(pts)])
+    aX, aY, aZ = jax.jit(dev.g1_add)(X, Y, Z, X2, Y2, Z)
+    for i in range(n):
+        q = pts[n - 1 - i]
+        want = host._to_affine(
+            host._FP,
+            host._jac_add(
+                host._FP, (pts[i][0], pts[i][1], 1), (q[0], q[1], 1)
+            ),
+        )
+        got = _affine(aX, aY, aZ, i)
+        assert got == want, i
+
+
+def test_g1_add_edge_cases():
+    g = host.G1_GEN
+    neg = (g[0], (-g[1]) % host.P)
+    X = _limbs([g[0], g[0], 0])
+    Y = _limbs([g[1], g[1], 0])
+    Z = _limbs([1, 1, 0])
+    X2 = _limbs([g[0], neg[0], g[0]])
+    Y2 = _limbs([g[1], neg[1], g[1]])
+    Z2 = _limbs([1, 1, 1])
+    aX, aY, aZ = jax.jit(dev.g1_add)(X, Y, Z, X2, Y2, Z2)
+    # row 0: P + P = 2P (doubling branch)
+    want_dbl = host._to_affine(host._FP, host._jac_dbl(host._FP, (g[0], g[1], 1)))
+    assert _affine(aX, aY, aZ, 0) == want_dbl
+    # row 1: P + (-P) = infinity
+    assert int(dev.from_limbs(np.asarray(aZ))[1]) == 0
+    # row 2: infinity + P = P
+    assert _affine(aX, aY, aZ, 2) == g
+
+
+def test_aggregate_matches_host_sum():
+    sks = [host.PrivKey.from_secret(b"agg381-%d" % i) for i in range(7)]
+    pks = [sk.pub_key() for sk in sks]
+    got = dev.aggregate_pubkeys_device([pk.data for pk in pks])
+    acc = (host._FP.one, host._FP.one, host._FP.zero)
+    for pk in pks:
+        acc = host._jac_add(host._FP, acc, host._from_affine(host._FP, pk._aff))
+    want = host._to_affine(host._FP, acc)
+    assert got == want
+
+
+def _affine(X, Y, Z, i):
+    x = int(dev.from_limbs(np.asarray(X))[i])
+    y = int(dev.from_limbs(np.asarray(Y))[i])
+    z = int(dev.from_limbs(np.asarray(Z))[i])
+    if z == 0:
+        return None
+    zi = pow(z, host.P - 2, host.P)
+    return (x * zi * zi % host.P, y * zi * zi % host.P * zi % host.P)
+
+
+def test_fast_aggregate_verify_device_path(monkeypatch):
+    """The env-gated device aggregation produces the same verdicts as
+    the host sum inside fast_aggregate_verify."""
+    monkeypatch.setenv("COMETBFT_TPU_BLS_DEVICE", "1")
+    sks = [host.PrivKey.from_secret(b"devagg-%d" % i) for i in range(8)]
+    pks = [sk.pub_key() for sk in sks]
+    msg = b"device-aggregate"
+    agg = host.aggregate_signatures([sk.sign(msg) for sk in sks])
+    assert host.fast_aggregate_verify(pks, msg, agg)
+    partial = host.aggregate_signatures([sk.sign(msg) for sk in sks[:7]])
+    assert not host.fast_aggregate_verify(pks, msg, partial)

@@ -21,12 +21,11 @@ def _fake_entry(pubs, good_rows=None):
     e.index = {pk: i for i, pk in enumerate(pubs)}
     e.size = len(pubs)
 
-    def fake_verify(tables, valid, r, s, dig):
-        r = np.asarray(r)
-        assert r.shape == (len(pubs), 32) and np.asarray(dig).shape == (
-            len(pubs),
-            64,
-        )
+    def fake_verify(tables, valid, packed):
+        packed = np.asarray(packed)
+        assert packed.shape == (len(pubs), 128)
+        r, dig = packed[:, :32], packed[:, 64:]
+        assert r.shape == (len(pubs), 32) and dig.shape == (len(pubs), 64)
         populated = r.any(axis=1)
         ok = populated.copy()
         if good_rows is not None:
