@@ -1,0 +1,61 @@
+"""Randomized testnet manifest generator (reference:
+test/e2e/generator/generate.go, 520 LoC — explores the config space so
+CI exercises combinations no hand-written manifest covers).
+
+Deterministic per seed: generate(seed) always returns the same
+manifest, so a failing CI run is reproducible by seed alone (the
+reference CLI takes -seed the same way).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .runner import Manifest, NodeSpec
+
+# weighted choices mirroring generate.go's testnetCombinations shape
+_TOPOLOGIES = [(2, 0.2), (3, 0.3), (4, 0.4), (5, 0.1)]
+_PERTURBATIONS = ["kill", "pause", "restart", None, None, None]
+
+
+def _weighted(rng: random.Random, pairs):
+    r = rng.random()
+    acc = 0.0
+    for val, w in pairs:
+        acc += w
+        if r <= acc:
+            return val
+    return pairs[-1][0]
+
+
+def generate(seed: int) -> Manifest:
+    """One random manifest: 2-5 validators, up to one late-starting
+    node, random perturbations, randomized load + target height."""
+    rng = random.Random(seed)
+    n = _weighted(rng, _TOPOLOGIES)
+    nodes = []
+    late_slot = rng.randrange(n) if n >= 3 and rng.random() < 0.5 else -1
+    for i in range(n):
+        perturbations = []
+        p = rng.choice(_PERTURBATIONS)
+        # never perturb the late node and at most half the net
+        if p and i != late_slot and sum(bool(s.perturbations) for s in nodes) < n // 2:
+            perturbations = [p]
+        nodes.append(
+            NodeSpec(
+                name=f"node{i:02d}",
+                start_at=rng.randint(3, 6) if i == late_slot else 0,
+                perturbations=perturbations,
+            )
+        )
+    return Manifest(
+        chain_id=f"gen-{seed}",
+        nodes=nodes,
+        load_tx_per_round=rng.choice([0, 2, 5, 10]),
+        target_height=rng.randint(8, 14),
+    )
+
+
+def generate_batch(group_seed: int, count: int) -> list[Manifest]:
+    """A reproducible batch (generator CLI's -g groups)."""
+    return [generate(group_seed * 1000 + i) for i in range(count)]

@@ -153,6 +153,127 @@ class Environment:
             "block": block_json(blk),
         }
 
+    def header(self, height=None) -> dict:
+        """rpc/core/blocks.go Header."""
+        h = self._height_or_latest(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no header at height {h}")
+        return {"header": header_json(_hdr(meta))}
+
+    def header_by_hash(self, hash="") -> dict:
+        """rpc/core/blocks.go HeaderByHash."""
+        blk = self.block_store.load_block_by_hash(_parse_hash(hash))
+        if blk is None:
+            raise RPCError(-32603, f"no header with hash {hash}")
+        return {"header": header_json(blk.header)}
+
+    def block_by_hash(self, hash="") -> dict:
+        """rpc/core/blocks.go BlockByHash."""
+        blk = self.block_store.load_block_by_hash(_parse_hash(hash))
+        if blk is None:
+            raise RPCError(-32603, f"no block with hash {hash}")
+        return self.block(blk.header.height)
+
+    def blockchain(self, minHeight=0, maxHeight=0) -> dict:  # noqa: N803 — wire names
+        """rpc/core/blocks.go BlockchainInfo: metas newest-first, capped
+        at 20 (the reference's limit)."""
+        latest = self.block_store.height
+        base = self.block_store.base
+        maxh = min(int(maxHeight) or latest, latest)
+        minh = max(int(minHeight) or base, base)
+        if minh > maxh:
+            raise RPCError(
+                -32602,
+                f"min height {minh} can't be greater than max height {maxh}",
+            )
+        minh = max(minh, maxh - 19)
+        metas = []
+        for h in range(maxh, minh - 1, -1):
+            meta = self.block_store.load_block_meta(h)
+            if meta is None:
+                continue
+            metas.append(
+                {
+                    "block_id": {
+                        "hash": hex_up(meta.block_id.hash),
+                        "parts": {
+                            "total": meta.block_id.part_set_header.total,
+                            "hash": hex_up(meta.block_id.part_set_header.hash),
+                        },
+                    },
+                    "block_size": str(getattr(meta, "block_size", 0)),
+                    "header": header_json(_hdr(meta)),
+                    "num_txs": str(getattr(meta, "num_txs", 0)),
+                }
+            )
+        return {"last_height": str(latest), "block_metas": metas}
+
+    def check_tx(self, tx: bytes) -> dict:
+        """rpc/core/mempool.go CheckTx: run CheckTx without adding to the
+        mempool."""
+        from ..wire import abci_pb as apb
+
+        res = self.node.app_conns.mempool.check_tx(apb.CheckTxRequest(tx=tx))
+        return {
+            "code": res.code,
+            "data": b64(res.data) if res.data else None,
+            "log": res.log,
+            "gas_wanted": str(res.gas_wanted),
+            "gas_used": str(res.gas_used),
+        }
+
+    def broadcast_evidence(self, evidence="") -> dict:
+        """rpc/core/evidence.go BroadcastEvidence: base64 proto-encoded
+        Evidence (the JSON-RPC carries the deterministic proto bytes)."""
+        import base64 as _b64
+
+        from ..types.evidence import evidence_from_proto
+        from ..wire import types_pb as tpb
+
+        try:
+            raw = _b64.b64decode(evidence)
+            ev = evidence_from_proto(tpb.EvidenceProto.decode(raw))
+        except Exception as e:  # noqa: BLE001
+            raise RPCError(-32602, f"invalid evidence: {e}") from e
+        pool = getattr(self.node, "evidence_pool", None)
+        if pool is None:
+            raise RPCError(-32603, "evidence pool not available")
+        try:
+            pool.add_evidence(ev)
+        except Exception as e:  # noqa: BLE001
+            raise RPCError(-32603, f"evidence rejected: {e}") from e
+        return {"hash": hex_up(ev.hash())}
+
+    def dump_consensus_state(self) -> dict:
+        """rpc/core/consensus.go DumpConsensusState: the deep round-state
+        dump incl. per-peer state."""
+        out = self.consensus_state()
+        peers = []
+        for p in self.node.switch.peers.list() if self.node.switch else []:
+            peers.append(
+                {
+                    "node_address": p.id,
+                    "peer_state": {"connected": True},
+                }
+            )
+        out["peers"] = peers
+        rs = self.node.consensus_state.get_round_state()
+        votes = []
+        if rs.votes:
+            for rnd in sorted(rs.votes.round_vote_sets):
+                pv = rs.votes.prevotes(rnd)
+                pc = rs.votes.precommits(rnd)
+                votes.append(
+                    {
+                        "round": rnd,
+                        "prevotes_bit_array": _bits(pv),
+                        "precommits_bit_array": _bits(pc),
+                    }
+                )
+        out["round_state"]["height_vote_set"] = votes
+        return out
+
     def commit(self, height=None) -> dict:
         h = self._height_or_latest(height)
         meta = self.block_store.load_block_meta(h)
@@ -429,6 +550,23 @@ class Environment:
         }
 
 
+def _parse_hash(h: str) -> bytes:
+    """Accept plain or 0x-prefixed hex; malformed input is a -32602."""
+    if h.startswith("0x"):
+        h = h[2:]
+    try:
+        return bytes.fromhex(h)
+    except ValueError as e:
+        raise RPCError(-32602, f"invalid hash {h!r}: {e}") from e
+
+
+def _bits(vote_set) -> str:
+    """'xx_x_' bit-array rendering of who voted (bits.go String)."""
+    if vote_set is None:
+        return ""
+    return "".join("x" if b else "_" for b in vote_set.votes_bit_array)
+
+
 def _hdr(meta):
     from ..types.block import Header
 
@@ -441,7 +579,11 @@ ROUTES = {
     "net_info": ("", Environment.net_info),
     "genesis": ("", Environment.genesis),
     "block": ("height", Environment.block),
+    "block_by_hash": ("hash", Environment.block_by_hash),
     "block_results": ("height", Environment.block_results),
+    "blockchain": ("minHeight,maxHeight", Environment.blockchain),
+    "header": ("height", Environment.header),
+    "header_by_hash": ("hash", Environment.header_by_hash),
     "commit": ("height", Environment.commit),
     "tx": ("hash", Environment.tx),
     "tx_search": ("query,page,per_page", Environment.tx_search),
@@ -452,8 +594,11 @@ ROUTES = {
     "broadcast_tx_async": ("tx", Environment.broadcast_tx_async),
     "broadcast_tx_sync": ("tx", Environment.broadcast_tx_sync),
     "broadcast_tx_commit": ("tx", Environment.broadcast_tx_commit),
+    "check_tx": ("tx", Environment.check_tx),
+    "broadcast_evidence": ("evidence", Environment.broadcast_evidence),
     "unconfirmed_txs": ("limit", Environment.unconfirmed_txs),
     "num_unconfirmed_txs": ("", Environment.num_unconfirmed_txs),
     "consensus_state": ("", Environment.consensus_state),
+    "dump_consensus_state": ("", Environment.dump_consensus_state),
     "consensus_params": ("height", Environment.consensus_params),
 }

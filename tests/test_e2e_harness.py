@@ -126,3 +126,56 @@ def test_perturbed_localnet_keeps_invariants(tmp_path):
         assert not problems, problems
     finally:
         r.stop_all()
+
+
+# ------------------------------------------------------------- generator
+
+
+def test_generator_deterministic_and_valid():
+    """generate(seed) is reproducible and explores the config space
+    within the runner's constraints (generator/generate.go)."""
+    from cometbft_tpu.e2e.generator import generate, generate_batch
+
+    a, b = generate(42), generate(42)
+    assert [n.__dict__ for n in a.nodes] == [n.__dict__ for n in b.nodes]
+    assert a.chain_id == b.chain_id and a.target_height == b.target_height
+
+    seen_sizes, seen_perts, seen_late = set(), set(), False
+    for m in generate_batch(7, 40):
+        assert 2 <= len(m.nodes) <= 5
+        assert 8 <= m.target_height <= 14
+        seen_sizes.add(len(m.nodes))
+        perturbed = 0
+        for spec in m.nodes:
+            if spec.perturbations:
+                perturbed += 1
+                assert spec.perturbations[0] in ("kill", "pause", "restart")
+                assert spec.start_at == 0  # late nodes are never perturbed
+            if spec.start_at:
+                seen_late = True
+                assert 3 <= spec.start_at <= 6
+            seen_perts.update(spec.perturbations)
+        assert perturbed <= len(m.nodes) // 2
+    assert len(seen_sizes) >= 3  # the space actually gets explored
+    assert seen_perts and seen_late
+
+
+@pytest.mark.slow
+def test_generated_manifest_runs(tmp_path):
+    """A seed-picked random manifest runs end-to-end through the runner
+    with its invariants (the reference CI runs generated manifests the
+    same way)."""
+    from cometbft_tpu.e2e.generator import generate
+    from cometbft_tpu.e2e.runner import Runner
+
+    m = generate(3)  # deterministic: small net
+    m.target_height = 6  # keep CI time bounded
+    r = Runner(m, str(tmp_path / "gen-net"), base_port=28400)
+    try:
+        r.setup()
+        r.start()
+        assert r.wait_for_height(m.target_height), "net never reached target"
+        errs = r.check_invariants(m.target_height)
+        assert not errs, errs
+    finally:
+        r.stop_all()

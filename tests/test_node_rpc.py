@@ -221,3 +221,80 @@ def test_late_node_driven_entirely_over_http(tmp_path):
             node_b.stop()
     finally:
         node_a.stop()
+
+
+@pytest.mark.slow
+def test_extended_rpc_routes(tmp_path):
+    """header/blockchain/by-hash/check_tx/dump_consensus_state/
+    broadcast_evidence (rpc/core/{blocks,mempool,consensus,evidence}.go)."""
+    home = _mk_home(tmp_path, "ext", chain_id="ext-chain")
+    node = Node(_test_cfg(home))
+    node.start()
+    try:
+        rpc = HTTPClient(node.rpc_server.listen_addr)
+        assert _wait(lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 3)
+
+        hd = rpc.call("header", height=2)
+        assert hd["header"]["height"] == "2"
+        blk = rpc.block(2)
+        h_hex = blk["block_id"]["hash"]
+        assert rpc.call("header_by_hash", hash=h_hex)["header"]["height"] == "2"
+        assert (
+            rpc.call("block_by_hash", hash=h_hex)["block"]["header"]["height"]
+            == "2"
+        )
+
+        bc = rpc.call("blockchain", minHeight=1, maxHeight=3)
+        assert int(bc["last_height"]) >= 3
+        hs = [int(m["header"]["height"]) for m in bc["block_metas"]]
+        assert hs == sorted(hs, reverse=True) and set(hs) == {1, 2, 3}
+
+        ct = rpc.call("check_tx", tx="Y2hlY2s9bWU=")  # check=me
+        assert ct["code"] == 0
+
+        dcs = rpc.call("dump_consensus_state")
+        assert "round_state" in dcs and "peers" in dcs
+
+        # broadcast_evidence: a real double-sign from this chain's key
+        import base64 as b64mod
+
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.evidence import (
+            DuplicateVoteEvidence,
+            evidence_to_proto,
+        )
+        from cometbft_tpu.types.block import BlockID, PartSetHeader, Timestamp
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.wire.canonical import PRECOMMIT_TYPE
+
+        cfg = load_config(home)
+        pv = FilePV.load_or_generate(
+            cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+        )
+        sk = pv.key.priv_key
+        addr = sk.pub_key().address()
+        height = 1
+        # the pool checks evidence time == the block time at that height
+        meta1 = node.block_store.load_block_meta(height)
+        ts = Timestamp.from_unix_ns(
+            meta1.header.time.seconds * 10**9 + meta1.header.time.nanos
+        )
+
+        def mk_vote(tag):
+            return Vote(
+                type=PRECOMMIT_TYPE, height=height, round=0,
+                block_id=BlockID(hash=tag * 32,
+                                 part_set_header=PartSetHeader(1, tag * 32)),
+                timestamp=ts, validator_address=addr, validator_index=0,
+            )
+
+        va, vb = mk_vote(b"\xaa"), mk_vote(b"\xbb")
+        va.signature = sk.sign(va.sign_bytes("ext-chain"))
+        vb.signature = sk.sign(vb.sign_bytes("ext-chain"))
+        vals = node.state_store.load_validators(height)
+        ev = DuplicateVoteEvidence.from_votes(va, vb, ts, vals)
+        raw = b64mod.b64encode(evidence_to_proto(ev).encode()).decode()
+        out = rpc.call("broadcast_evidence", evidence=raw)
+        assert out["hash"] == ev.hash().hex().upper()
+    finally:
+        node.stop()
