@@ -410,6 +410,27 @@ class Commit:
         """The canonical bytes validator val_idx signed (block.go:921)."""
         return self.get_vote(val_idx).sign_bytes(chain_id)
 
+    def vote_sign_bytes_fn(self, chain_id: str):
+        """idx -> sign bytes, with the per-flag canonical prefixes
+        encoded once — the batch-assembly fast path for a whole commit
+        (10k encodes collapse to 10k timestamp splices)."""
+        from ..wire.canonical import PRECOMMIT_TYPE, make_vote_sign_bytes_batch
+
+        for_block = make_vote_sign_bytes_batch(
+            chain_id, PRECOMMIT_TYPE, self.height, self.round,
+            self.block_id.to_canonical(),
+        )
+        for_nil = make_vote_sign_bytes_batch(
+            chain_id, PRECOMMIT_TYPE, self.height, self.round, None,
+        )
+
+        def fn(val_idx: int) -> bytes:
+            cs = self.signatures[val_idx]
+            maker = for_block if cs.for_block() else for_nil
+            return maker(cs.timestamp)
+
+        return fn
+
     def hash(self) -> bytes:
         """Merkle root over proto-encoded CommitSigs (block.go:988)."""
         if self._hash is None:

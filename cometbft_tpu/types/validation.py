@@ -204,6 +204,7 @@ def _verify_commit_batch(
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0
+    sign_bytes_at = commit.vote_sign_bytes_fn(chain_id)
 
     for idx, cs in enumerate(commit.signatures):
         if ignore_sig(cs):
@@ -220,7 +221,7 @@ def _verify_commit_batch(
                 )
             seen_vals[val_idx] = idx
 
-        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        sign_bytes = sign_bytes_at(idx)
 
         cache_hit = False
         if cache is not None:
@@ -261,7 +262,7 @@ def _verify_commit_batch(
                     cs.signature,
                     SignatureCacheValue(
                         validator_address=cs.validator_address,
-                        vote_sign_bytes=commit.vote_sign_bytes(chain_id, idx),
+                        vote_sign_bytes=sign_bytes_at(idx),
                     ),
                 )
         return
@@ -279,7 +280,7 @@ def _verify_commit_batch(
                 cs.signature,
                 SignatureCacheValue(
                     validator_address=cs.validator_address,
-                    vote_sign_bytes=commit.vote_sign_bytes(chain_id, idx),
+                    vote_sign_bytes=sign_bytes_at(idx),
                 ),
             )
     raise CommitVerificationError(
@@ -301,6 +302,7 @@ def _verify_commit_single(
     """(validation.go:413) — the sequential fallback."""
     seen_vals: dict[int, int] = {}
     tallied = 0
+    sign_bytes_at = commit.vote_sign_bytes_fn(chain_id)
     for idx, cs in enumerate(commit.signatures):
         if ignore_sig(cs):
             continue
@@ -325,7 +327,7 @@ def _verify_commit_single(
         if val.pub_key is None:
             raise CommitVerificationError(f"validator {val} has a nil PubKey at index {idx}")
 
-        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        sign_bytes = sign_bytes_at(idx)
 
         cache_hit = False
         if cache is not None:

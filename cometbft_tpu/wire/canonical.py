@@ -11,7 +11,7 @@ whole message varint-length-delimited (protoio MarshalDelimited).
 
 from __future__ import annotations
 
-from .proto import Message, Field, encode_delimited
+from .proto import Message, Field, encode_delimited, encode_varint
 
 # SignedMsgType enum (types.proto SIGNED_MSG_TYPE_*)
 UNKNOWN_TYPE = 0
@@ -115,6 +115,54 @@ def vote_sign_bytes(
         chain_id=chain_id,
     )
     return encode_delimited(cv)
+
+
+class _CanonicalVotePrefix(Message):
+    """Fields 1-4 of CanonicalVote — everything before the timestamp.
+    Derived from CanonicalVote.FIELDS so an edit there cannot silently
+    diverge this consensus-critical fast path."""
+
+    FIELDS = [f for f in CanonicalVote.FIELDS if f.num < 5]
+
+
+class _CanonicalVoteSuffix(Message):
+    FIELDS = [f for f in CanonicalVote.FIELDS if f.num > 5]
+
+
+_TS_TAG = bytes([5 << 3 | 2])  # field 5, length-delimited
+
+
+def make_vote_sign_bytes_batch(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: CanonicalBlockID | None,
+):
+    """Returns sign_bytes(timestamp) closing over the once-encoded
+    prefix (fields 1-4) and suffix (chain_id): only the ~13-byte
+    timestamp message re-encodes per signature.  For a 10k-validator
+    commit this is the difference between 10k full canonical encodes
+    and 10k tiny splices on the batch-assembly hot path
+    (types/validation.go:324 does the full encode per sig).
+    Byte-identical to vote_sign_bytes (differential-tested)."""
+    prefix = _CanonicalVotePrefix(
+        type=msg_type, height=height, round=round_, block_id=block_id
+    ).encode()
+    suffix = _CanonicalVoteSuffix(chain_id=chain_id).encode()
+
+    def sign_bytes(timestamp: Timestamp) -> bytes:
+        ts_payload = timestamp.encode()
+        body = (
+            prefix
+            + _TS_TAG
+            + encode_varint(len(ts_payload))
+            + ts_payload
+            + suffix
+        )
+        return encode_varint(len(body)) + body
+
+    return sign_bytes
 
 
 def proposal_sign_bytes(

@@ -102,3 +102,34 @@ def test_header_hash_golden():
     # nil ValidatorsHash yields nil (second reference case)
     h.validators_hash = b""
     assert h.hash() is None
+
+
+def test_vote_sign_bytes_fast_path_byte_identical():
+    """The spliced batch encoder (Commit.vote_sign_bytes_fn) must produce
+    exactly the bytes of the full canonical encode for every flag and
+    timestamp shape — sign-bytes are consensus-critical."""
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+        Timestamp,
+    )
+
+    bid = BlockID(hash=b"\x17" * 32, part_set_header=PartSetHeader(7, b"\x23" * 32))
+    sigs = [
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x01" * 20,
+                  Timestamp.from_unix_ns(1_700_000_000_123_456_789), b"s" * 64),
+        CommitSig(BLOCK_ID_FLAG_NIL, b"\x02" * 20,
+                  Timestamp.from_unix_ns(0), b"s" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x03" * 20,
+                  Timestamp(seconds=5, nanos=0), b"s" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x04" * 20,
+                  Timestamp(seconds=0, nanos=999_999_999), b"s" * 64),
+    ]
+    commit = Commit(height=12345, round=3, block_id=bid, signatures=sigs)
+    fast = commit.vote_sign_bytes_fn("splice-chain")
+    for idx in range(len(sigs)):
+        assert fast(idx) == commit.vote_sign_bytes("splice-chain", idx), idx
