@@ -185,8 +185,8 @@ class CombBatchVerifier:
 
         fn = self._verify_fn()
         ok_all = np.asarray(fn(self._entry.tables, self._entry.valid, jnp.asarray(packed)))
-        res = [bool(ok_all[i]) for i in idx]
-        return all(res), res
+        picked = ok_all[idx]
+        return bool(picked.all()), picked.tolist()
 
     def _verify_fn(self):
         if self._entry.verify_fn is None:
