@@ -1,0 +1,81 @@
+"""Phase profiler for the comb-cached VerifyCommit kernel: table build,
+scalar reduce, R decompression, A/B comb loops, single field ops — run on
+the real chip to direct optimization (numbers recorded in BASELINE.md)."""
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from cometbft_tpu.ops import comb, ed25519 as E, field as F, scalar
+from cometbft_tpu.crypto import ed25519 as host
+
+V = 10_000
+TDIR = "/tmp/combprof"
+rng = np.random.default_rng(7)
+keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(V)]
+pubs = [k.pub_key().data for k in keys]
+
+tp, vp = os.path.join(TDIR,"tables.npy"), os.path.join(TDIR,"valid.npy")
+if os.path.exists(tp):
+    t0=time.time()
+    tables = jnp.asarray(np.load(tp, mmap_mode="r"))
+    valid = jnp.asarray(np.load(vp))
+    tables.block_until_ready()
+    print("tables loaded from disk", round(time.time()-t0,1), "s", flush=True)
+else:
+    t0=time.time()
+    a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(-1,32)
+    tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
+    tables.block_until_ready()
+    print("tables built", round(time.time()-t0,1), "s", flush=True)
+
+r_all=np.zeros((V,32),np.uint8); s_all=np.zeros((V,32),np.uint8); dig_all=np.zeros((V,64),np.uint8)
+for i,sk in enumerate(keys):
+    msg=b"m%d"%i; sig=sk.sign(msg)
+    r_all[i]=np.frombuffer(sig[:32],np.uint8); s_all[i]=np.frombuffer(sig[32:],np.uint8)
+    dig_all[i]=np.frombuffer(hashlib.sha512(sig[:32]+pubs[i]+msg).digest(),np.uint8)
+ra,sa,da = jnp.asarray(r_all), jnp.asarray(s_all), jnp.asarray(dig_all)
+bt = comb.get_b_tables()
+
+def timeit(name, f, *args):
+    t0=time.perf_counter()
+    o = f(*args); jax.tree_util.tree_map(lambda x: x.block_until_ready(), o)
+    compile_s = time.perf_counter()-t0
+    ts=[]
+    for _ in range(5):
+        t0=time.perf_counter(); o=f(*args); jax.tree_util.tree_map(lambda x: x.block_until_ready(), o); ts.append(time.perf_counter()-t0)
+    print(f"{name}: {1e3*min(ts):.1f} ms   (first {compile_s:.1f}s)", flush=True)
+
+timeit("full verify_cached", jax.jit(comb.verify_cached), tables, valid, ra, sa, da, bt)
+
+timeit("scalar+nibbles", jax.jit(lambda d: comb.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(d, scalar.NL_X)), comb.NPOS_A)), da)
+timeit("decompress R", jax.jit(lambda r: E.decompress(r)[0].x), ra)
+
+@jax.jit
+def a_loop(tables, dig):
+    k_dig = comb.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(dig, scalar.NL_X)), comb.NPOS_A)
+    def a_body(i, acc):
+        slab = lax.dynamic_index_in_dim(tables, i, axis=1, keepdims=False)
+        d = lax.dynamic_index_in_dim(k_dig, i, axis=-1, keepdims=False)
+        onehot=(d[:,None]==jnp.arange(comb.NENT_A,dtype=jnp.int32)).astype(jnp.int32)
+        sel=jnp.einsum("vj,vjck->vck",onehot,slab,precision=lax.Precision.HIGHEST)
+        return E.add_niels(acc, E.Niels(sel[:,0],sel[:,1],sel[:,2]))
+    return lax.fori_loop(0, comb.NPOS_A, a_body, E.identity((dig.shape[0],))).x
+timeit("A loop", a_loop, tables, da)
+
+@jax.jit
+def b_loop(bt, s):
+    s_dig = scalar.bytes_to_limbs(s, comb.NPOS_B)
+    def b_body(i, acc):
+        slab = lax.dynamic_index_in_dim(bt, i, axis=0, keepdims=False)
+        d = lax.dynamic_index_in_dim(s_dig, i, axis=-1, keepdims=False)
+        onehot=(d[:,None]==jnp.arange(comb.NENT_B,dtype=jnp.int32)).astype(jnp.float32)
+        sel=(jnp.matmul(onehot,slab,precision=lax.Precision.HIGHEST).astype(jnp.int32).reshape(-1,3,F.NLIMBS))
+        return E.add_niels(acc, E.Niels(sel[:,0],sel[:,1],sel[:,2]))
+    return lax.fori_loop(0, comb.NPOS_B, b_body, E.identity((s.shape[0],))).x
+timeit("B loop", b_loop, bt, sa)
+
+x = jnp.ones((V, F.NLIMBS), jnp.int32)
+timeit("1 field mul", jax.jit(F.mul), x, x)
+nl = E.Niels(x, x, x)
+timeit("1 add_niels", jax.jit(lambda p, a,b,c: E.add_niels(p, E.Niels(a,b,c)).x), E.identity((V,)), x,x,x)

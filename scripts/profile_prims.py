@@ -1,10 +1,12 @@
-"""Micro-benchmarks: primitive op throughput + H2D bandwidth on the chip."""
+"""Slope-based micro-benchmarks: vary inner iteration count and diff, so
+fixed dispatch/tunnel overhead cancels out."""
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from functools import partial
 
 cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -16,101 +18,82 @@ from cometbft_tpu.ops import field as F
 N = 16384
 
 
-def bench(fn, *args, iters=5, label=""):
+def timeit(fn, *args, iters=3):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _ = np.asarray(out.ravel()[0])
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{label}: {dt*1e3:.2f} ms", flush=True)
-    return dt
+        _ = np.asarray(out.ravel()[0])
+    return (time.perf_counter() - t0) / iters
 
-
-# H2D bandwidth
-for sz in (1 << 20, 4 << 20, 16 << 20):
-    buf = np.random.randint(0, 255, size=sz, dtype=np.uint8)
-    jnp.asarray(buf).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(3):
-        jnp.asarray(buf).block_until_ready()
-    dt = (time.perf_counter() - t0) / 3
-    print(f"H2D {sz>>20} MiB: {dt*1e3:.1f} ms = {sz/dt/1e6:.0f} MB/s", flush=True)
-
-# chained int32 multiplies (VPU int path)
-x32 = jnp.asarray(np.random.randint(1, 1000, size=(N, 128), dtype=np.int32))
 
 @jax.jit
-def chain_i32(x):
-    def body(_, a):
-        return (a * a) & 0xFFFF | 1
-    return lax.fori_loop(0, 256, body, x)
+def noop(x):
+    return x[:1, :1]
 
-d = bench(chain_i32, x32, label="int32 mul+and chain 256x (N,128)")
-print(f"  -> {256*N*128/d/1e9:.1f} G int32-mul/s", flush=True)
+x32 = jnp.asarray(np.random.randint(1, 1000, size=(N, 128), dtype=np.int32))
+print(f"noop round-trip: {timeit(noop, x32)*1e3:.2f} ms", flush=True)
 
-# chained f32 FMA
+
+@partial(jax.jit, static_argnums=1)
+def chain_i32(x, n):
+    return lax.fori_loop(0, n, lambda _, a: (a * a) & 0xFFFF | 1, x)
+
+t1 = timeit(chain_i32, x32, 256)
+t2 = timeit(chain_i32, x32, 4096)
+rate = (4096 - 256) * N * 128 / (t2 - t1)
+print(f"int32 mul: lo={t1*1e3:.1f} hi={t2*1e3:.1f} ms -> {rate/1e9:.1f} G/s", flush=True)
+
 xf = jnp.asarray(np.random.uniform(1.0, 1.001, size=(N, 128)).astype(np.float32))
 
-@jax.jit
-def chain_f32(x):
-    def body(_, a):
-        return a * a + 0.25
-    return lax.fori_loop(0, 256, body, x)
+@partial(jax.jit, static_argnums=1)
+def chain_f32(x, n):
+    return lax.fori_loop(0, n, lambda _, a: a * a + 0.25, x)
 
-d = bench(chain_f32, xf, label="f32 fma chain 256x (N,128)")
-print(f"  -> {256*N*128/d/1e9:.1f} G f32-fma/s", flush=True)
+t1 = timeit(chain_f32, xf, 256)
+t2 = timeit(chain_f32, xf, 4096)
+rate = (4096 - 256) * N * 128 / (t2 - t1)
+print(f"f32 fma: lo={t1*1e3:.1f} hi={t2*1e3:.1f} ms -> {rate/1e9:.1f} G/s", flush=True)
 
-# bf16->f32 matmul MXU reference
-a = jnp.asarray(np.random.randn(4096, 4096).astype(np.float32))
+ab = jnp.asarray(np.random.randn(2048, 2048)).astype(jnp.bfloat16)
 
-@jax.jit
-def mm(a):
-    return a @ a
+@partial(jax.jit, static_argnums=1)
+def mmb(a, n):
+    def body(_, b):
+        return (b @ a).astype(jnp.bfloat16) * jnp.bfloat16(1e-3)
+    return lax.fori_loop(0, n, body, a)
 
-d = bench(mm, a, label="f32 matmul 4096^3")
-print(f"  -> {2*4096**3/d/1e12:.1f} TFLOP/s", flush=True)
+t1 = timeit(mmb, ab, 4)
+t2 = timeit(mmb, ab, 64)
+rate = (64 - 4) * 2 * 2048**3 / (t2 - t1)
+print(f"bf16 mm 2048: lo={t1*1e3:.1f} hi={t2*1e3:.1f} ms -> {rate/1e12:.1f} TF/s", flush=True)
 
-# our field mul chained
 fx = jnp.asarray(np.random.randint(0, 2000, size=(N, 22), dtype=np.int32))
 
-@jax.jit
-def chain_fmul(x):
-    def body(_, a):
-        return F.mul(a, a)
-    return lax.fori_loop(0, 64, body, x)
+@partial(jax.jit, static_argnums=1)
+def chain_fmul(x, n):
+    return lax.fori_loop(0, n, lambda _, a: F.mul(a, a), x)
 
-d = bench(chain_fmul, fx, label="field mul chain 64x (N,22)")
-print(f"  -> {64*N/d/1e6:.2f} M fieldmul/s; {d/64/N*1e9:.1f} ns/fieldmul-row", flush=True)
+t1 = timeit(chain_fmul, fx, 64)
+t2 = timeit(chain_fmul, fx, 1024)
+per = (t2 - t1) / (1024 - 64) / N
+print(f"field mul: lo={t1*1e3:.1f} hi={t2*1e3:.1f} ms -> {per*1e9:.2f} ns/row-mul", flush=True)
 
-# field squaring chain for comparison
-@jax.jit
-def chain_fsq(x):
-    def body(_, a):
-        return F.square(a)
-    return lax.fori_loop(0, 64, body, x)
+# Straus window-step cost estimate: 3700 muls/sig target check
+print(f"  => 10k sigs x 3700 muls ~= {3700*10000*per*1e3:.0f} ms", flush=True)
 
-bench(chain_fsq, fx, label="field square chain 64x (N,22)")
+# point double and add-niels chain for direct cost
+from cometbft_tpu.ops import ed25519 as E
 
-# int16 mul chain (does VPU do int16 better?)
-x16 = jnp.asarray(np.random.randint(1, 100, size=(N, 128), dtype=np.int16))
+pt = E.identity((N,))
 
-@jax.jit
-def chain_i16(x):
-    def body(_, a):
-        return (a * a) & 0xFF | 1
-    return lax.fori_loop(0, 256, body, x)
+@partial(jax.jit, static_argnums=1)
+def chain_dbl(p, n):
+    return lax.fori_loop(0, n, lambda _, q: E.double(q), p)
 
-d = bench(chain_i16, x16, label="int16 mul chain 256x (N,128)")
-print(f"  -> {256*N*128/d/1e9:.1f} G int16-mul/s", flush=True)
-
-# elementwise int32 multiply, one shot over big array (memory bound check)
-big = jnp.asarray(np.random.randint(0, 1000, size=(N, 2048), dtype=np.int32))
-
-@jax.jit
-def one_mul(x):
-    return x * x
-
-d = bench(one_mul, big, label="single int32 mul (N,2048)")
-print(f"  -> {N*2048*4*2/d/1e9:.0f} GB/s effective", flush=True)
+t1 = timeit(lambda p, n: chain_dbl(p, n).x, pt, 32)
+t2 = timeit(lambda p, n: chain_dbl(p, n).x, pt, 256)
+per = (t2 - t1) / (256 - 32) / N
+print(f"point double: lo={t1*1e3:.1f} hi={t2*1e3:.1f} ms -> {per*1e9:.1f} ns/row-double", flush=True)
+print(f"  => 256 doubles x 16384 = {256*16384*per*1e3:.0f} ms", flush=True)
