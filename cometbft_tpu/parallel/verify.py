@@ -45,12 +45,12 @@ from ..ops import ed25519 as E
 from ..ops import merkle as M
 
 
-def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active):
-    """Batch Ed25519 verify with the batch axis sharded over mesh axis "sig".
-
-    Returns (all_valid: bool scalar, valid: (N,) bool fully replicated).
-    N must be divisible by the mesh size (callers pad to bucket sizes).
-    """
+@functools.lru_cache(maxsize=8)
+def _verify_fn(mesh: Mesh):
+    """jit-wrapped sharded verifier, cached per mesh — without the jit
+    every call re-traces the whole kernel and nothing reaches the
+    persistent compile cache (this made the un-jitted path effectively
+    un-runnable on the CPU backend)."""
     axis = mesh.axis_names[0]
 
     def local(a, r, s, blocks, active):
@@ -60,13 +60,42 @@ def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_acti
         all_ok = jax.lax.all_gather(ok, axis, tiled=True)
         return total_bad == 0, all_ok
 
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(), P()),
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P()),
+        )
     )
-    return fn(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
+
+
+def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active):
+    """Batch Ed25519 verify with the batch axis sharded over mesh axis "sig".
+
+    Returns (all_valid: bool scalar, valid: (N,) bool fully replicated).
+    N must be divisible by the mesh size (callers pad to bucket sizes).
+    """
+    return _verify_fn(mesh)(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
+
+
+@functools.lru_cache(maxsize=8)
+def _merkle_fn(mesh: Mesh):
+    axis = mesh.axis_names[0]
+
+    def local(blocks, active):
+        sub = M.root_from_leaves(blocks, active)  # (32,)
+        roots = jax.lax.all_gather(sub, axis)  # (D, 32)
+        return M.root_from_leaf_hashes(roots)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(),
+        )
+    )
 
 
 def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):
@@ -77,20 +106,7 @@ def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):
     result).  Exactly the reference's power-of-two split (tree.go:101)
     when n/D is a power of two — which callers guarantee by padding.
     """
-    axis = mesh.axis_names[0]
-
-    def local(blocks, active):
-        sub = M.root_from_leaves(blocks, active)  # (32,)
-        roots = jax.lax.all_gather(sub, axis)  # (D, 32)
-        return M.root_from_leaf_hashes(roots)
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=P(),
-    )
-    return fn(leaf_blocks, leaf_active)
+    return _merkle_fn(mesh)(leaf_blocks, leaf_active)
 
 
 def commit_verification_step(

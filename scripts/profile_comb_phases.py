@@ -1,7 +1,7 @@
 """Phase profiler for the comb-cached VerifyCommit kernel: table build,
 scalar reduce, R decompression, A/B comb loops, single field ops — run on
 the real chip to direct optimization (numbers recorded in BASELINE.md)."""
-import sys, os
+import sys, os, time, hashlib
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax, jax.numpy as jnp
@@ -16,7 +16,7 @@ keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(V)]
 pubs = [k.pub_key().data for k in keys]
 
 tp, vp = os.path.join(TDIR,"tables.npy"), os.path.join(TDIR,"valid.npy")
-if os.path.exists(tp):
+if os.path.exists(tp) and os.path.exists(vp):
     t0=time.time()
     tables = jnp.asarray(np.load(tp, mmap_mode="r"))
     valid = jnp.asarray(np.load(vp))
@@ -28,6 +28,11 @@ else:
     tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
     tables.block_until_ready()
     print("tables built", round(time.time()-t0,1), "s", flush=True)
+    if os.environ.get("COMBPROF_SAVE") == "1":
+        # 2.7 GB device->host fetch: minutes over the tunnel, so opt-in
+        os.makedirs(TDIR, exist_ok=True)
+        np.save(tp, np.asarray(tables))
+        np.save(vp, np.asarray(valid))
 
 r_all=np.zeros((V,32),np.uint8); s_all=np.zeros((V,32),np.uint8); dig_all=np.zeros((V,64),np.uint8)
 for i,sk in enumerate(keys):
