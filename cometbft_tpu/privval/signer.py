@@ -16,6 +16,10 @@ import socket
 import threading
 import time
 
+from ..p2p.conn.secret_connection import (
+    SecretConnectionError,
+    make_secret_connection,
+)
 from ..types.proposal import Proposal
 from ..types.vote import Vote
 from ..utils.log import get_logger
@@ -153,8 +157,6 @@ class SignerListenerEndpoint:
     def _secure(self, sock: socket.socket):
         if self.identity_key is None:
             return _PlainConn(sock)
-        from ..p2p.conn.secret_connection import make_secret_connection
-
         conn = make_secret_connection(sock, self.identity_key)
         if self.authorized_keys is not None and (
             conn.remote_pub.data not in self.authorized_keys
@@ -185,9 +187,17 @@ class SignerListenerEndpoint:
             try:
                 _send_msg(conn, msg)
                 resp = _recv_msg(conn)
-            except OSError as e:
+            except (OSError, SecretConnectionError) as e:
+                # SecretConnectionError surfaces when the peer closes
+                # mid-frame (e.g. teardown racing the ping routine)
                 self._drop(conn)
                 raise SignerTransportError(f"signer connection failed: {e}") from e
+            except (RemoteSignerError, ValueError):
+                # parse failure mid-stream (varint overflow or proto
+                # decode error): the framing is desynced; a kept
+                # connection would feed garbage to every later call
+                self._drop(conn)
+                raise
             if resp is None:
                 self._drop(conn)
                 raise SignerTransportError("signer connection closed")
