@@ -192,12 +192,15 @@ class SignerListenerEndpoint:
                 # mid-frame (e.g. teardown racing the ping routine)
                 self._drop(conn)
                 raise SignerTransportError(f"signer connection failed: {e}") from e
-            except (RemoteSignerError, ValueError):
+            except (RemoteSignerError, ValueError) as e:
                 # parse failure mid-stream (varint overflow or proto
-                # decode error): the framing is desynced; a kept
-                # connection would feed garbage to every later call
+                # decode error): the framing is desynced — drop the conn
+                # and classify as TRANSPORT failure (retryable: the
+                # signer redials, and the ping loop must survive it)
                 self._drop(conn)
-                raise
+                raise SignerTransportError(
+                    f"signer stream desynced: {e}"
+                ) from e
             if resp is None:
                 self._drop(conn)
                 raise SignerTransportError("signer connection closed")

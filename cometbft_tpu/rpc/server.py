@@ -102,8 +102,17 @@ class RPCServer:
     def _handle_jsonrpc(self, handler, body: bytes) -> None:
         try:
             req = json.loads(body or b"{}")
-        except json.JSONDecodeError as e:
+        except ValueError as e:
+            # UnicodeDecodeError (non-UTF8 bodies) is a ValueError but
+            # NOT a JSONDecodeError — catch the whole family or garbage
+            # input kills the connection instead of getting a -32700
             self._reply(handler, None, error={"code": -32700, "message": str(e)})
+            return
+        if not isinstance(req, dict):
+            self._reply(
+                handler, None,
+                error={"code": -32600, "message": "request must be an object"},
+            )
             return
         rid = req.get("id", -1)
         method = req.get("method", "")
