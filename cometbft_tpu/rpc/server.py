@@ -117,6 +117,14 @@ class RPCServer:
         rid = req.get("id", -1)
         method = req.get("method", "")
         params = req.get("params") or {}
+        if not isinstance(method, str):
+            # non-string method (list/object) would TypeError on the
+            # dict lookup and kill the connection
+            self._reply(
+                handler, rid,
+                error={"code": -32600, "message": "method must be a string"},
+            )
+            return
         route = ROUTES.get(method)
         if route is None:
             self._reply(

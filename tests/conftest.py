@@ -31,3 +31,16 @@ _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cpu_crypto_backend(monkeypatch):
+    """Force the sequential host verifier (storage/domain-logic tests
+    that don't exercise the kernel).  A fixture, NOT a module-level
+    os.environ write: pytest imports every test module at collection
+    time, so module-level env mutation leaks into the whole suite and
+    silently reroutes other files' verifier paths."""
+    monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")

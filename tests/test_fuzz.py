@@ -27,29 +27,31 @@ def test_fuzz_mempool_check_tx():
     app = KVStoreApplication(lanes=default_lanes())
     conns = new_app_conns(local_client_creator(app))
     conns.start()
-    mp = CListMempool(
-        MempoolConfig(),
-        conns.mempool,
-        lane_priorities=default_lanes(),
-        default_lane="default",
-    )
-    rng = np.random.default_rng(SEED)
-    admitted = 0
-    for i in range(300):
-        n = int(rng.integers(0, 200))
-        tx = bytes(rng.integers(0, 256, n, dtype=np.uint8))
-        try:
-            mp.check_tx(tx)
-            admitted += 1
-        except MempoolError:
-            pass  # rejection is fine; crashing is not
-    assert mp.size() == admitted > 0  # '=' bytes appear often enough
-    # exact duplicates dedup via the cache
-    dup = b"fuzz=dup"
-    mp.check_tx(dup)
-    with pytest.raises(MempoolError):
+    try:
+        mp = CListMempool(
+            MempoolConfig(),
+            conns.mempool,
+            lane_priorities=default_lanes(),
+            default_lane="default",
+        )
+        rng = np.random.default_rng(SEED)
+        admitted = 0
+        for i in range(300):
+            n = int(rng.integers(0, 200))
+            tx = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+            try:
+                mp.check_tx(tx)
+                admitted += 1
+            except MempoolError:
+                pass  # rejection is fine; crashing is not
+        assert mp.size() == admitted > 0  # '=' bytes appear often enough
+        # exact duplicates dedup via the cache
+        dup = b"fuzz=dup"
         mp.check_tx(dup)
-    conns.stop()
+        with pytest.raises(MempoolError):
+            mp.check_tx(dup)
+    finally:
+        conns.stop()
 
 
 def test_fuzz_secret_connection_roundtrip():
@@ -60,17 +62,24 @@ def test_fuzz_secret_connection_roundtrip():
     from cometbft_tpu.p2p.conn.secret_connection import make_secret_connection
 
     a_sock, b_sock = socket.socketpair()
+    # timeouts: a framing regression must FAIL the test, not hang CI
+    a_sock.settimeout(30)
+    b_sock.settimeout(30)
     ka = ed25519.PrivKey.from_seed(b"\x0a" * 32)
     kb = ed25519.PrivKey.from_seed(b"\x0b" * 32)
     out = {}
 
     def responder():
-        out["b"] = make_secret_connection(b_sock, kb)
+        try:
+            out["b"] = make_secret_connection(b_sock, kb)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            out["err"] = e
 
     t = threading.Thread(target=responder)
     t.start()
     conn_a = make_secret_connection(a_sock, ka)
     t.join(10)
+    assert "err" not in out, f"responder handshake failed: {out.get('err')}"
     conn_b = out["b"]
 
     rng = np.random.default_rng(SEED)

@@ -6,7 +6,11 @@ import os
 
 import pytest
 
-os.environ.setdefault("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+
+@pytest.fixture(autouse=True)
+def _cpu_backend(cpu_crypto_backend):
+    """See conftest.cpu_crypto_backend."""
+
 
 from cometbft_tpu.crypto import ed25519 as host
 import cometbft_tpu.types as T

@@ -4,11 +4,13 @@ test_batch_verify.py), vote sets, part sets, genesis
 (reference test models: types/validator_set_test.go,
 types/validation_test.go, types/vote_set_test.go)."""
 
-import os
-
 import pytest
 
-os.environ.setdefault("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+
+@pytest.fixture(autouse=True)
+def _cpu_backend(cpu_crypto_backend):
+    """See conftest.cpu_crypto_backend."""
+
 
 from cometbft_tpu.crypto import ed25519 as host
 import cometbft_tpu.types as T
