@@ -247,7 +247,11 @@ class RPCServer:
                     break
                 try:
                     msg = json.loads(req)
-                except json.JSONDecodeError:
+                except ValueError:
+                    continue
+                if not isinstance(msg, dict):
+                    # valid JSON but not an object: same guard as the
+                    # HTTP path, or '[1]' kills the whole WS connection
                     continue
                 method = msg.get("method")
                 rid = msg.get("id", -1)

@@ -78,7 +78,8 @@ def test_fuzz_secret_connection_roundtrip():
     t = threading.Thread(target=responder)
     t.start()
     conn_a = make_secret_connection(a_sock, ka)
-    t.join(10)
+    t.join(35)  # must outlast the 30s socket timeouts
+    assert not t.is_alive(), "responder handshake still running"
     assert "err" not in out, f"responder handshake failed: {out.get('err')}"
     conn_b = out["b"]
 
