@@ -139,8 +139,6 @@ class ConsensusReactor(Reactor):
         self.cs = cs
         self.wait_sync = wait_sync  # blocksync still running
         self.logger = get_logger("cs-reactor")
-        self._peer_states: dict[str, PeerState] = {}
-        self._mtx = threading.Lock()
         # the state machine tells us what to flood
         cs.broadcast_hook = self._on_internal_msg
         cs.on_new_round_step = self._on_new_round_step
@@ -174,13 +172,14 @@ class ConsensusReactor(Reactor):
     # ------------------------------------------------------------- peers
 
     def init_peer(self, peer) -> None:
-        ps = PeerState(peer)
-        peer.set("consensus_peer_state", ps)
-        with self._mtx:
-            self._peer_states[peer.id] = ps
+        # per-CONNECTION state, stored on the peer object itself: an id-keyed
+        # dict races on reconnect (the old connection's remove_peer pops the
+        # new connection's state, after which every message from that peer is
+        # silently dropped — observed as a permanent catchup stall)
+        peer.set("consensus_peer_state", PeerState(peer))
 
     def add_peer(self, peer) -> None:
-        ps = self._peer_states.get(peer.id)
+        ps = peer.get("consensus_peer_state")
         if ps is None:
             return
         if not peer.has_channel(STATE_STREAM):
@@ -198,8 +197,7 @@ class ConsensusReactor(Reactor):
         ).start()
 
     def remove_peer(self, peer, reason: str = "") -> None:
-        with self._mtx:
-            self._peer_states.pop(peer.id, None)
+        pass  # state lives on the peer object; it dies with the connection
 
     # ----------------------------------------------------------- receive
 
@@ -212,7 +210,7 @@ class ConsensusReactor(Reactor):
             return
         msg = pb.ConsensusMessage.decode(msg_bytes)
         which = msg.which()
-        ps: PeerState = self._peer_states.get(peer.id)
+        ps: PeerState = peer.get("consensus_peer_state")
         if ps is None:
             return
         if which == "new_round_step":
@@ -286,7 +284,7 @@ class ConsensusReactor(Reactor):
                 proposal=pb.ProposalMsg(proposal=msg.proposal.to_proto())
             ).encode()
             for peer in self.switch.peers.list():
-                ps = self._peer_states.get(peer.id)
+                ps = peer.get("consensus_peer_state")
                 if ps is not None:
                     ps.set_has_proposal(msg.proposal)
                 peer.try_send(DATA_STREAM, wire)
@@ -297,7 +295,7 @@ class ConsensusReactor(Reactor):
                 )
             ).encode()
             for peer in self.switch.peers.list():
-                ps = self._peer_states.get(peer.id)
+                ps = peer.get("consensus_peer_state")
                 if ps is not None:
                     ps.set_has_block_part(msg.height, msg.round, msg.part.index)
                 peer.try_send(DATA_STREAM, wire)
@@ -307,7 +305,7 @@ class ConsensusReactor(Reactor):
     def _broadcast_vote(self, vote: Vote) -> None:
         wire = pb.ConsensusMessage(vote=pb.VoteMsg(vote=vote.to_proto())).encode()
         for peer in self.switch.peers.list():
-            ps = self._peer_states.get(peer.id)
+            ps = peer.get("consensus_peer_state")
             if ps is not None and ps.has_vote(vote):
                 continue
             # Mark as held only if the peer is AT this height — a peer on
@@ -502,9 +500,21 @@ class ConsensusReactor(Reactor):
         Cycles through prevotes / precommits / POL-prevotes at the current
         height, and the stored commit when the peer trails us."""
         sleep = self.cs.config.peer_query_maj23_sleep_duration
+        ticks = 0
         while peer.is_running() and self.is_running():
             try:
                 rs = self.cs.get_round_state()
+                # Re-announce our round state: the one-shot send in
+                # add_peer can race connection setup and drop, and a node
+                # parked in the commit step never re-broadcasts — leaving
+                # every peer thinking we're at height 0 and never serving
+                # catchup votes/parts (observed as a permanent post-restart
+                # stall in the perturbed e2e net).  Cheap self-healing:
+                # resend whenever the peer may not know us, and every few
+                # ticks regardless.
+                ticks += 1
+                if ps.height == 0 or ticks % 5 == 0:
+                    self._send_round_step(peer)
                 if rs.votes is not None and ps.height == rs.height:
                     # query for the PEER's round (reactor.go:720 uses
                     # prs.Round): a peer stuck in an earlier round needs

@@ -130,6 +130,12 @@ class Runner:
             cfg.consensus.timeout_prevote_delta = 0.3
             cfg.consensus.timeout_precommit = 0.5
             cfg.consensus.timeout_precommit_delta = 0.3
+            # thread-dump endpoint: when a node wedges mid-testnet the
+            # runner (and a human) can pull /debug/threads (perturb.go's
+            # cometbft debug equivalent)
+            cfg.instrumentation.pprof_laddr = (
+                f"127.0.0.1:{self.base_port + 2000 + i}"
+            )
             save_config(cfg)
             self.nodes.append(
                 E2ENode(spec.name, home, self.base_port + 1000 + i)
@@ -230,6 +236,27 @@ class Runner:
         if len(heights) == 1 and len(apps) > 1:
             problems.append(f"app hash divergence at height {heights}: {apps}")
         return problems
+
+    def dump_stalled(self, target_height: int) -> None:
+        """Print /debug/threads of every node behind target — turns a
+        CI stall into an actionable trace (debug kill's goroutine dump)."""
+        for i, node in enumerate(self.nodes):
+            if node.proc is None:
+                print(f"[dump] {node.name}: not running")
+                continue
+            try:
+                h = node.height()
+            except Exception as e:  # noqa: BLE001
+                print(f"[dump] {node.name}: rpc dead: {e}")
+                h = -1
+            if h >= target_height:
+                continue
+            try:
+                url = f"http://127.0.0.1:{self.base_port + 2000 + i}/debug/threads"
+                with urllib.request.urlopen(url, timeout=5) as f:
+                    print(f"[dump] {node.name} stalled at {h}:\n{f.read().decode()}")
+            except Exception as e:  # noqa: BLE001
+                print(f"[dump] {node.name}: pprof unreachable: {e}")
 
     def stop_all(self) -> None:
         for node in self.nodes:

@@ -256,6 +256,8 @@ class RPCServer:
                 method = msg.get("method")
                 rid = msg.get("id", -1)
                 params = msg.get("params") or {}
+                if not isinstance(params, dict):
+                    params = {}  # same leniency as the HTTP path
                 if method == "subscribe":
                     q = params.get("query", "")
                     try:

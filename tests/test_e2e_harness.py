@@ -124,6 +124,8 @@ def test_perturbed_localnet_keeps_invariants(tmp_path):
             time.sleep(2.0)
         assert perturbed, "perturbations never applied"
         heights = r._heights(only_running=True)
+        if len(heights) < 4 or (heights and min(heights) < m.target_height):
+            r.dump_stalled(m.target_height)  # make CI stalls diagnosable
         assert len(heights) == 4, f"nodes lost: {heights}"
         assert min(heights) >= m.target_height, f"stalled: {heights}"
         problems = r.check_invariants(upto=m.target_height)
