@@ -105,6 +105,18 @@ def build_a_tables(a_enc):
     return tables, valid
 
 
+_BUILD_A_JIT = None
+
+
+def build_a_tables_jit(a_enc):
+    """Process-wide jitted build_a_tables so every call site (cache build,
+    incremental churn, benches) shares one compiled program per shape."""
+    global _BUILD_A_JIT
+    if _BUILD_A_JIT is None:
+        _BUILD_A_JIT = jax.jit(build_a_tables)
+    return _BUILD_A_JIT(a_enc)
+
+
 def _normalize_to_niels(tx, ty, tz):
     """Extended (pos, ent, V, 22) coords -> stacked affine Niels
     (3, pos, ent, V, 22): (y+x, y-x, 2dxy).

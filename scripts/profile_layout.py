@@ -1,0 +1,132 @@
+"""Layout experiment: is the (V, 22) limbs-minor layout wasting TPU lanes?
+
+TPU vregs tile (8 sublanes x 128 lanes) over the two minor dims.  With
+field elements shaped (V, 22) the 22-limb axis sits on the 128-lane minor
+dim (83% lane waste); transposed (22, V) puts V on lanes (full) and limbs
+on sublanes (22 -> 24, 8% waste).  This script times a chain of field
+muls in both layouts on whatever backend is live, to decide whether the
+limbs-first refactor of ops/field.py is worth it.
+
+Run:  python scripts/profile_layout.py [V] [CHAIN]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.ops import field as F
+
+V = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+CHAIN = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+
+RADIX, BITS, MASK = F.RADIX, F.BITS, F.MASK
+FOLD, FOLD2_SHIFTED, NLIMBS = F.FOLD, F.FOLD2_SHIFTED, F.NLIMBS
+
+
+# ---------------- transposed (limbs-first) field mul, inline ----------------
+
+def _convT(a, b):
+    c = jnp.zeros((2 * NLIMBS - 1,) + a.shape[1:], jnp.int32)
+    for i in range(NLIMBS):
+        c = c.at[i : i + NLIMBS].add(a * b[i])
+    return c
+
+
+def _carry_roundT(c):
+    q = lax.shift_right_arithmetic(c + (RADIX >> 1), BITS)
+    c = c - lax.shift_left(q, BITS)
+    carry_in = jnp.pad(q[:-1], [(1, 0)] + [(0, 0)] * (q.ndim - 1))
+    return c + carry_in, q[-1]
+
+
+def _fold_topT(c, q):
+    v = q * 19
+    c = c.at[0].add((v & 7) * (1 << 9))
+    c = c.at[1].add(lax.shift_right_arithmetic(v, 3))
+    return c
+
+
+def carryT(a, rounds=3):
+    c = a
+    for _ in range(rounds):
+        c, top = _carry_roundT(c)
+        c = _fold_topT(c, top)
+    return c
+
+
+def _reduce_convT(c):
+    lo = c[:NLIMBS]
+    hi = jnp.pad(c[NLIMBS:], [(0, 3)] + [(0, 0)] * (c.ndim - 1))
+    for _ in range(3):
+        hi, _ = _carry_roundT(hi)
+    lo = lo + hi[:NLIMBS] * FOLD
+    lo = lo.at[1].add(hi[NLIMBS] * FOLD2_SHIFTED)
+    lo = lo.at[2].add(hi[NLIMBS + 1] * FOLD2_SHIFTED)
+    return carryT(lo, rounds=3)
+
+
+def mulT(a, b):
+    return _reduce_convT(_convT(a, b))
+
+
+# --------------------------------------------------------------- harness
+
+def bench(name, fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    first = time.perf_counter() - t0
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    per_mul = 1e3 * min(ts) / CHAIN
+    print(
+        f"{name}: {1e3 * min(ts):8.2f} ms total  {per_mul:7.4f} ms/mul "
+        f"(compile {first:.1f}s)",
+        flush=True,
+    )
+    return min(ts)
+
+
+def main():
+    print(f"backend={jax.default_backend()} devices={jax.devices()} "
+          f"V={V} chain={CHAIN}", flush=True)
+    rng = np.random.default_rng(0)
+    a_np = rng.integers(0, 2048, size=(V, NLIMBS), dtype=np.int32)
+    b_np = rng.integers(0, 2048, size=(V, NLIMBS), dtype=np.int32)
+
+    a = jnp.asarray(a_np)
+    b = jnp.asarray(b_np)
+    aT = jnp.asarray(a_np.T.copy())
+    bT = jnp.asarray(b_np.T.copy())
+
+    @jax.jit
+    def chain_cur(x, y):
+        return lax.fori_loop(0, CHAIN, lambda _, v: F.mul(v, y), x)
+
+    @jax.jit
+    def chain_T(x, y):
+        return lax.fori_loop(0, CHAIN, lambda _, v: mulT(v, y), x)
+
+    t_cur = bench("limbs-minor (V,22)", chain_cur, a, b)
+    t_T = bench("limbs-first (22,V)", chain_T, aT, bT)
+
+    # correctness cross-check on a few rows
+    got = np.asarray(chain_T(aT, bT)).T
+    want = np.asarray(chain_cur(a, b))
+    assert np.array_equal(
+        np.asarray([F.from_limbs(r) % F.P for r in got[:8]]),
+        np.asarray([F.from_limbs(r) % F.P for r in want[:8]]),
+    ), "transposed mul disagrees with field.mul"
+    print(f"speedup (cur/T): {t_cur / t_T:.2f}x ; results agree", flush=True)
+
+
+if __name__ == "__main__":
+    main()

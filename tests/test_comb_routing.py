@@ -21,17 +21,24 @@ def _fake_entry(pubs, good_rows=None):
     e.index = {pk: i for i, pk in enumerate(pubs)}
     e.size = len(pubs)
 
-    def fake_verify(tables, valid, packed):
+    def fake_verify(tables, valid, packed, active):
         packed = np.asarray(packed)
-        assert packed.shape == (len(pubs), 128)
-        r, dig = packed[:, :32], packed[:, 64:]
-        assert r.shape == (len(pubs), 32) and dig.shape == (len(pubs), 64)
+        active = np.asarray(active)
+        V = len(pubs)
+        nb = (packed.shape[1] - 64) // 128
+        assert packed.shape == (V, 64 + nb * 128) and nb >= 1
+        assert active.shape == (V,)
+        r, blocks = packed[:, :32], packed[:, 64:]
         populated = r.any(axis=1)
+        # scattered rows carry padded R||A||M blocks; the 0x80 pad marker
+        # guarantees a populated block region even for empty messages
+        assert (blocks.any(axis=1) == (active > 0)).all()
         ok = populated.copy()
         if good_rows is not None:
-            for i in range(len(pubs)):
+            for i in range(V):
                 ok[i] = ok[i] and (i in good_rows)
-        return ok
+        mask = active > 0
+        return np.packbits(ok & mask), bool((ok | ~mask).all())
 
     e.verify_fn = fake_verify
     return e
@@ -102,6 +109,47 @@ def test_cache_keying_and_eviction():
             c._entries.popitem(last=False)
     assert c.get(fps[0]) is None  # evicted (LRU)
     assert c.get(fps[1]) is not None and c.get(fps[2]) is not None
+
+
+def test_incremental_churn_reuses_rows(monkeypatch):
+    """A validator-set change must rebuild only the new keys: unchanged
+    validators' table rows are gathered from the previous entry (possibly
+    reordered), fresh keys go through the build kernel in a padded bucket."""
+    import jax.numpy as jnp
+
+    import cometbft_tpu.ops.comb as comb_ops
+
+    built_batches = []
+
+    def fake_build(a):
+        a = np.asarray(a)
+        built_batches.append(a.shape[0])
+        # marker table: every row filled with the pubkey's first byte
+        t = jnp.asarray(
+            np.broadcast_to(a[:, :1, None], (a.shape[0], 4, 2)).astype(np.int32)
+        )
+        return t, jnp.ones((a.shape[0],), bool)
+
+    monkeypatch.setattr(comb_ops, "build_a_tables_jit", fake_build)
+
+    c = cv.ValsetCombCache()
+    pk = lambda x: bytes([x]) * 32
+    e1 = c.ensure([pk(1), pk(2), pk(3)])
+    assert built_batches == [3]
+    assert np.asarray(e1.tables)[:, 0, 0].tolist() == [1, 2, 3]
+
+    # churn: drop 3, add 9, reorder — only the fresh key is built (padded
+    # to a power-of-two bucket of 1), other rows gathered from e1
+    e2 = c.ensure([pk(2), pk(9), pk(1)])
+    assert built_batches == [3, 1]
+    assert np.asarray(e2.tables)[:, 0, 0].tolist() == [2, 9, 1]
+    assert np.asarray(e2.valid).tolist() == [True, True, True]
+    assert e2.index == {pk(2): 0, pk(9): 1, pk(1): 2}
+
+    # three fresh keys pad to a 4-bucket; reused row still gathered
+    e3 = c.ensure([pk(1), pk(5), pk(6), pk(7)])
+    assert built_batches == [3, 1, 4]
+    assert np.asarray(e3.tables)[:, 0, 0].tolist() == [1, 5, 6, 7]
 
 
 def test_validator_set_pubkeys_cache_invalidation():
