@@ -37,7 +37,13 @@ import time
 import numpy as np
 
 GO_CPU_US_PER_SIG = 27.5
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240") or 240)
+
+
+def _probe_timeout_s() -> int:
+    try:
+        return int(os.environ.get("BENCH_PROBE_TIMEOUT", "240") or 240)
+    except ValueError:
+        return 240
 
 REPORT: dict = {
     "metric": "verify_commit_p50_10k_ms",
@@ -77,13 +83,14 @@ def probe_backend() -> None:
             stderr=devnull,
             text=True,
         )
-        deadline = time.monotonic() + PROBE_TIMEOUT_S
+        timeout_s = _probe_timeout_s()
+        deadline = time.monotonic() + timeout_s
         while proc.poll() is None and time.monotonic() < deadline:
             time.sleep(0.5)
         if proc.poll() is None:
             proc.kill()
             REPORT["error"] = (
-                f"backend-unavailable: jax.devices() hung >{PROBE_TIMEOUT_S}s "
+                f"backend-unavailable: jax.devices() hung >{timeout_s}s "
                 "(wedged device tunnel)"
             )
             emit_and_exit()

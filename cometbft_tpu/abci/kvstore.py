@@ -126,7 +126,15 @@ class KVStoreApplication(Application):
         lanes: dict[str, int] | None = default_lanes(),
         snapshot_interval: int = 0,
         snapshot_keep: int = 4,
+        merkle_state: bool = False,
     ):
+        # merkle_state=True commits the app hash to a Merkle root over the
+        # sorted kv pairs and serves ValueOp proofs on Query(prove=True),
+        # so a light client can verify abci_query responses end-to-end
+        # (light/rpc.py).  Default off: the plain mode mirrors the
+        # reference example app's size-derived 8-byte app hash
+        # (abci/example/kvstore/kvstore.go), which ships no proofs.
+        self.merkle_state = merkle_state
         self.db = db if db is not None else MemDB()
         self.lane_priorities = dict(lanes) if lanes else {}
         self._mtx = threading.RLock()
@@ -162,7 +170,67 @@ class KVStoreApplication(Application):
         self.db.set(STATE_KEY, json.dumps({"size": self.size, "height": self.height}).encode())
 
     def app_hash(self) -> bytes:
+        if self.merkle_state:
+            return self._state_root()
         return _size_hash(self.size)
+
+    def _state_leaves(self) -> list[bytes]:
+        """Sorted kv pairs as leaves in ValueOp form: key || sha256(value)
+        (crypto/merkle.py ValueOp.run re-derives exactly this), unambiguous
+        because the value hash is fixed-width.
+
+        Includes the txs staged by the in-flight FinalizeBlock: the app
+        hash returned for block h must commit to block h's writes, which
+        only reach the db at Commit (the root would otherwise lag one
+        block and no proof would ever match header h+1)."""
+        import hashlib
+
+        pairs = {
+            k[len(KV_PREFIX):]: v for k, v in _iter_prefix(self.db, KV_PREFIX)
+        }
+        for tx in self.staged_txs:
+            key, value = parse_tx(tx)
+            pairs[key.encode()] = value.encode()
+        return [
+            k + hashlib.sha256(v).digest() for k, v in sorted(pairs.items())
+        ]
+
+    def _state_root(self) -> bytes:
+        from ..crypto import merkle
+
+        return merkle.hash_from_byte_slices(self._state_leaves(), device=False)
+
+    def _query_proof(self, key: bytes):
+        """ValueOp proof that key=value is in the state root.
+
+        The ProofOps chain is one simple:v op (crypto/merkle.py ValueOp);
+        the light client verifies it against the NEXT header's app_hash
+        (light/rpc.py abci_query)."""
+        from ..crypto import merkle
+        from ..wire import types_pb as tpb
+
+        leaves = self._state_leaves()
+        target = None
+        for i, leaf in enumerate(leaves):
+            if leaf[:-32] == key:
+                target = i
+                break
+        if target is None:
+            return None
+        _, proofs = merkle.proofs_from_byte_slices(leaves)
+        p = proofs[target]
+        vop = tpb.ValueOpProto(
+            key=key,
+            proof=tpb.Proof(
+                total=p.total,
+                index=p.index,
+                leaf_hash=p.leaf_hash,
+                aunts=list(p.aunts),
+            ),
+        )
+        return tpb.ProofOps(
+            ops=[tpb.ProofOpProto(type="simple:v", key=key, data=vop.encode())]
+        )
 
     # -------------------------------------------------------- info/query
 
@@ -185,11 +253,23 @@ class KVStoreApplication(Application):
                 v = self.db.get(VALIDATOR_PREFIX.encode() + req.data)
                 return pb.QueryResponse(key=req.data, value=v or b"", height=self.height)
             v = self.db.get(KV_PREFIX + req.data)
+            if req.prove and self.merkle_state:
+                # value and proof must come from one snapshot: between
+                # FinalizeBlock(h) and Commit(h) the app hash (and thus
+                # _state_leaves) already includes the staged writes, so
+                # the served value must too, or the proof can't verify
+                for tx in self.staged_txs:
+                    key, value = parse_tx(tx)
+                    if key.encode() == req.data:
+                        v = value.encode()
             if v is None:
                 return pb.QueryResponse(code=CodeTypeOK, log="does not exist", height=self.height)
-            return pb.QueryResponse(
+            resp = pb.QueryResponse(
                 code=CodeTypeOK, log="exists", key=req.data, value=v, height=self.height
             )
+            if req.prove and self.merkle_state:
+                resp.proof_ops = self._query_proof(req.data)
+            return resp
 
     # ----------------------------------------------------------- mempool
 

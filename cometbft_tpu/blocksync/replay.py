@@ -1,0 +1,56 @@
+"""Streamed commit-replay pipeline: the blocksync catch-up fast path.
+
+Reference hot path: internal/blocksync/reactor.go:547 — a catching-up
+node verifies one historical commit per replayed block with
+VerifyCommitLight, serially on CPU.  On TPU the same stream pipelines:
+device calls are asynchronous, so while the chip verifies block i the
+host assembles block i+1's packed rows, and results are drained a few
+blocks behind submission (double buffering).  With the validator set's
+comb tables resident (models/comb_verifier.py) each block costs one
+~V*130-byte transfer + one kernel dispatch; the doubling chains and
+pubkey decompressions that dominate cold verification are gone.
+
+The pipeline is a thin scheduler over CombBatchVerifier.submit()/
+collect() — all assembly, transfer, and readback logic lives in
+models/comb_verifier.py, so blocksync replay can never diverge from the
+consensus verifier's semantics.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Iterator
+
+
+class CommitStreamVerifier:
+    """Pipelines comb-cached commit verification over a block stream.
+
+    entry: a models/comb_verifier cache entry for the validator set the
+    stream's commits were signed by (blocksync knows the set in advance —
+    it fetched the headers first).  depth: how many device calls may be
+    in flight before the oldest is drained (2 = classic double buffer).
+    """
+
+    def __init__(self, entry, depth: int = 2):
+        self._entry = entry
+        self._depth = max(1, depth)
+        self._inflight: deque = deque()
+
+    def run(
+        self, commits: Iterable[list[tuple[bytes, bytes, bytes]]]
+    ) -> Iterator[tuple[bool, list[bool]]]:
+        """Stream commits (each a list of (pubkey, msg, sig)) through the
+        pipeline, yielding (all_ok, per_signature) in order."""
+        from ..models.comb_verifier import CombBatchVerifier
+
+        for items in commits:
+            bv = CombBatchVerifier(self._entry)
+            for pub, msg, sig in items:
+                bv.add(pub, msg, sig)
+            self._inflight.append((bv, bv.submit()))
+            while len(self._inflight) > self._depth:
+                done, ticket = self._inflight.popleft()
+                yield done.collect(ticket)
+        while self._inflight:
+            done, ticket = self._inflight.popleft()
+            yield done.collect(ticket)

@@ -222,6 +222,77 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_reindex_event(args) -> int:
+    """commands/reindex_event.go: offline re-index of block + tx events
+    from the stores into the event sinks, for when the index backend was
+    dropped or replaced.  Requires stored FinalizeBlock responses (do not
+    discard ABCI responses if you want to use this)."""
+    from .indexer import BlockIndexer, TxIndexer
+    from .node import default_db_provider
+    from .state.store import StateStore
+    from .store.block_store import BlockStore
+    from .store.db import PrefixDB
+    from .types.event_bus import abci_events_to_map
+
+    cfg = load_config(args.home)
+    db = default_db_provider(cfg)
+    try:
+        bs = BlockStore(PrefixDB(db, b"bs/"))
+        ss = StateStore(PrefixDB(db, b"ss/"))
+        if bs.height == 0:
+            print("event re-index failed: block store is empty")
+            return 1
+        start = args.start_height or bs.base
+        end = args.end_height or bs.height
+        if start < bs.base or end > bs.height or start > end:
+            print(
+                f"event re-index failed: invalid range [{start}, {end}] "
+                f"(store has [{bs.base}, {bs.height}])"
+            )
+            return 1
+        if cfg.base.tx_index == "kv":
+            tx_indexer = TxIndexer(PrefixDB(db, b"txi/"))
+            block_indexer = BlockIndexer(PrefixDB(db, b"bli/"))
+        elif cfg.base.tx_index == "psql":
+            from .indexer.sink import BlockSinkAdapter, SQLEventSink, TxSinkAdapter
+            from .types.genesis import GenesisDoc
+
+            # rows must carry the same chain_id the node writes, or
+            # chain-scoped queries would never see re-indexed events
+            chain_id = GenesisDoc.load(cfg.genesis_file()).chain_id
+            sink = SQLEventSink.from_conn_string(cfg.base.psql_conn, chain_id)
+            tx_indexer = TxSinkAdapter(sink)
+            block_indexer = BlockSinkAdapter(sink)
+        else:
+            print("event re-index failed: indexer is disabled (tx_index = null)")
+            return 1
+        done = 0
+        for h in range(start, end + 1):
+            blk = bs.load_block(h)
+            resp = ss.load_finalize_block_response(h)
+            if blk is None or resp is None:
+                print(f"event re-index failed: height {h} not available")
+                return 1
+            results = resp.tx_results or []
+            if len(results) != len(blk.data.txs):
+                print(
+                    f"event re-index failed: height {h} has "
+                    f"{len(blk.data.txs)} txs but {len(results)} stored results"
+                )
+                return 1
+            block_indexer.index(h, abci_events_to_map(resp.events or []))
+            for i, tx in enumerate(blk.data.txs):
+                res = results[i]
+                tx_indexer.index(
+                    h, i, tx, res, abci_events_to_map(res.events or [])
+                )
+            done += 1
+        print(f"event re-index finished: {done} heights [{start}, {end}]")
+    finally:
+        db.close()
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """commands/inspect: serve RPC over the stores, no consensus
     (internal/inspect)."""
@@ -471,6 +542,13 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--hard", action="store_true",
                     help="also remove the last block from the store")
     sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser(
+        "reindex-event", help="re-index block/tx events from the stores"
+    )
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
 
     sp = sub.add_parser("inspect", help="RPC over the stores, no consensus")
     sp.add_argument("--rpc-laddr", default=None, dest="rpc_laddr")

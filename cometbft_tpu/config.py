@@ -81,10 +81,15 @@ class StatesyncConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     max_open_connections: int = 900
-    # data-companion services (block/block-results/version/pruning) —
-    # the reference's grpc_laddr + grpc_privileged_laddr, served here
-    # over the varint-proto socket transport (rpc/services.py)
+    # enables dial_seeds/dial_peers (reference config.go RPCConfig.Unsafe)
+    unsafe: bool = False
+    # data-companion services — the reference's grpc_laddr (public
+    # block/block-results/version) and grpc_privileged_laddr (pruning
+    # retain-height API), served over the varint-proto socket transport
+    # (rpc/services.py).  Separate listeners so the pruning API can be
+    # firewalled independently of the read-only services.
     companion_laddr: str = ""
+    companion_privileged_laddr: str = ""
 
 
 @dataclass

@@ -418,6 +418,14 @@ class ConsensusState(Service):
         if rs.round < round:
             validators = validators.copy()
             validators.increment_proposer_priority(round - rs.round)
+        from ..utils.metrics import hub as _mhub
+
+        m = _mhub()
+        now = time.monotonic()
+        if getattr(self, "_round_started_at", None) is not None:
+            m.cs_round_duration.observe(now - self._round_started_at)
+        self._round_started_at = now
+        m.cs_validators_power.set(validators.total_voting_power())
         self._update_round_step(round, STEP_NEW_ROUND)
         rs.validators = validators
         if round != 0:
@@ -535,6 +543,9 @@ class ConsensusState(Service):
                 MsgInfo(BlockPartMessage(height, round, block_parts.get_part(i)), "", 0)
             )
         self.logger.info(f"signed proposal {height}/{round} {bid.hash.hex()[:12]}")
+        from ..utils.metrics import hub as _mhub
+
+        _mhub().cs_proposal_create_count.inc()
 
     def _load_last_extended_commit(self, height: int):
         if height == self.state.initial_height:
@@ -585,11 +596,15 @@ class ConsensusState(Service):
             proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
         ):
             raise ConsensusError("invalid proposal POLRound")
+        from ..utils.metrics import hub as _mhub
+
         proposer = rs.validators.get_proposer()
         if not proposer.pub_key.verify_signature(
             proposal.sign_bytes(self.state.chain_id), proposal.signature
         ):
+            _mhub().cs_proposal_receive_count.inc(status="rejected")
             raise ConsensusError("invalid proposal signature")
+        _mhub().cs_proposal_receive_count.inc(status="accepted")
         rs.proposal = proposal
         rs.proposal_receive_time_ns = receive_time_ns
         if rs.proposal_block_parts is None:
@@ -802,6 +817,7 @@ class ConsensusState(Service):
         from ..utils.fail import fail_point
 
         precommits = rs.votes.precommits(rs.commit_round)
+        commit = precommits.make_commit()
         fail_point("before save_block")  # state.go:1872
         if self.block_store.height < block.header.height:
             ext_enabled = self.state.consensus_params.feature.vote_extensions_enabled(
@@ -812,15 +828,29 @@ class ConsensusState(Service):
                     block, block_parts, precommits.make_extended_commit()
                 )
             else:
-                self.block_store.save_block(
-                    block, block_parts, precommits.make_commit()
-                )
+                self.block_store.save_block(block, block_parts, commit)
 
         fail_point("before WAL end_height")  # state.go:1889
         self.wal.write_sync(
             wal_pb.WALMessageProto(end_height=wal_pb.EndHeightProto(height=height))
         )
         fail_point("after WAL end_height")  # state.go:1912
+
+        # metricsgen set: absentees + block size (metrics.go RecordConsMetrics)
+        from ..utils.metrics import hub as _mhub
+
+        m = _mhub()
+        missing = 0
+        missing_power = 0
+        for i, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                missing += 1
+                _, v = rs.validators.get_by_index(i)
+                if v is not None:
+                    missing_power += v.voting_power
+        m.cs_missing_validators.set(missing)
+        m.cs_missing_validators_power.set(missing_power)
+        m.cs_block_size_bytes.set(block_parts.byte_size)
 
         state_copy = self.state.copy()
         new_state = self.block_exec.apply_verified_block(state_copy, bid, block)
@@ -852,6 +882,9 @@ class ConsensusState(Service):
         rs = self.rs
         # precommit from the previous height (late commit vote)
         if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            from ..utils.metrics import hub as _mhub
+
+            _mhub().cs_late_votes.inc(vote_type="precommit")
             if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
                 return
             if rs.last_commit.add_vote(vote):

@@ -573,13 +573,80 @@ def _final_exp(f):
     return f12_pow(g, _HARD_EXP)
 
 
+import threading as _threading
+
+_NATIVE = None  # ctypes handle to native/libbls381.so, or False if absent
+_NATIVE_MTX = _threading.Lock()
+
+
+def _native_pairing_lib():
+    """The C pairing core (native/bls381.cc) — the framework's blst
+    analogue.  Built on demand like the native storage engine, under a
+    process-wide lock with an atomic rename so concurrent first
+    verifications never race the compiler or load a half-written .so;
+    loading or building failures fall back to the pure-Python pairing."""
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    with _NATIVE_MTX:
+        if _NATIVE is not None:
+            return _NATIVE or None
+        import ctypes
+        import os
+        import subprocess
+
+        native_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "native",
+        )
+        so = os.path.join(native_dir, "libbls381.so")
+        try:
+            if not os.path.exists(so):
+                tmp = so + f".build.{os.getpid()}"
+                subprocess.run(
+                    [
+                        os.environ.get("CXX", "g++"),
+                        "-O2", "-fPIC", "-std=c++17", "-shared",
+                        "-o", tmp, os.path.join(native_dir, "bls381.cc"),
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, so)  # atomic: other processes see old/none
+            lib = ctypes.CDLL(so)
+            lib.bls381_pairing_product_is_one.restype = ctypes.c_int
+            _NATIVE = lib
+        except Exception:  # noqa: BLE001 — pure-Python path still works
+            _NATIVE = False
+    return _NATIVE or None
+
+
+def _limbs6(x: int) -> list[int]:
+    return [(x >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(6)]
+
+
 def _pairings_product_is_one(pairs) -> bool:
     """True iff prod e(Pi, Qi) == 1, for (g1_affine, g2_affine) pairs.
     Infinity on either side contributes the identity."""
+    live = [(p, q) for p, q in pairs if p is not None and q is not None]
+    lib = _native_pairing_lib()
+    if lib is not None and live:
+        import ctypes
+
+        g1 = []
+        g2 = []
+        for p_aff, q_aff in live:
+            g1 += _limbs6(p_aff[0]) + _limbs6(p_aff[1])
+            (x0, x1), (y0, y1) = q_aff
+            g2 += _limbs6(x0) + _limbs6(x1) + _limbs6(y0) + _limbs6(y1)
+        r = lib.bls381_pairing_product_is_one(
+            (ctypes.c_uint64 * len(g1))(*g1),
+            (ctypes.c_uint64 * len(g2))(*g2),
+            len(live),
+        )
+        return r == 1
     f = F12_ONE
-    for p_aff, q_aff in pairs:
-        if p_aff is None or q_aff is None:
-            continue
+    for p_aff, q_aff in live:
         f = f12_mul(f, _miller(q_aff, p_aff))
     return _final_exp(f) == F12_ONE
 
@@ -679,14 +746,12 @@ def _map_to_curve_svdw(u):
     tv2 = f2_add(F2_ONE, tv1)
     tv1 = f2_sub(F2_ONE, tv1)
     tv3 = f2_mul(tv1, tv2)
-    if tv3 == F2_ZERO:
-        # exceptional case: fall back to x = Z (g(Z) square branch)
-        x = _SVDW_Z
-        y = f2_sqrt(g(x))
-        if _sgn0_fp2(u) != _sgn0_fp2(y):
-            y = f2_neg(y)
-        return (x, y)
-    tv3 = f2_inv(tv3)
+    # RFC 9380 straight-line convention: inv0 (1/0 = 0).  In the
+    # exceptional case tv3 == 0 the candidates degenerate to x1 = x2 =
+    # -Z/2 and x3 = Z, of which at least one is square by the SvdW Z
+    # selection criteria — no special-case branch (the old x = Z fallback
+    # crashed when g(Z) happened to be non-square).
+    tv3 = f2_inv(tv3) if tv3 != F2_ZERO else F2_ZERO
     tv4 = f2_mul(f2_mul(f2_mul(u, tv1), tv3), _SVDW_C3)
     x1 = f2_sub(_SVDW_C2, tv4)
     x2 = f2_add(_SVDW_C2, tv4)

@@ -11,10 +11,12 @@ Every result that commits to chain state is cross-checked:
     validators_hash (client.go Validators).
   - tx: the tx bytes must Merkle-prove into the verified header's
     data_hash (client.go Tx with inclusion proof).
-  - abci_query: served only when the response height is within verified
-    range; Merkle proof-op verification applies when the app supplies
-    proofs (the bundled kvstore does not, so prove=True responses
-    without proofs are rejected rather than trusted, erring safe).
+  - abci_query: prove=True is forced and the ValueOp proof chain must
+    verify against the app_hash of the NEXT verified header; responses
+    without a verifiable proof are rejected (fail closed, matching
+    light/rpc/client.go:129-134).  The kvstore app serves proofs when
+    constructed with merkle_state=True; the plain parity-mode kvstore
+    ships none, so verified queries against it error rather than trust.
 """
 
 from __future__ import annotations
@@ -259,17 +261,65 @@ class VerifyingClient:
         return resp
 
     def abci_query(self, path: str, data: bytes, height: int = 0) -> dict:
-        resp = self.rpc.abci_query(path, data, height=height)
-        rh = int(resp["response"].get("height", 0) or 0)
-        if rh:
-            # anchoring: the response height must be verifiable
-            self._verified_header(rh)
-        if resp["response"].get("proof_ops"):
-            # proof-op chain verification against the app hash of the
-            # NEXT header (app hash lands one height later)
-            raise VerificationFailed(
-                "proof-op verification not wired for this app"
-            )
+        """Fail-closed verified query (reference: light/rpc/client.go:110-160
+        ABCIQueryWithOptions forces opts.Prove and errors when the proof is
+        missing or unverifiable).
+
+        prove=True is always requested; the response's ValueOp proof chain
+        is verified against the app hash of the NEXT verified header (the
+        app hash of state at height h lands in header h+1).  Responses
+        without a verifiable proof — including apps that ship no proofs,
+        like the plain kvstore — are rejected, never trusted."""
+        from ..crypto import merkle
+        from ..wire import types_pb as tpb
+
+        resp = self.rpc.abci_query(path, data, height=height, prove=True)
+        r = resp["response"]
+        # Everything a byzantine server controls parses inside this try:
+        # malformed heights, base64, or proof bytes must surface as the
+        # same fail-closed VerificationFailed as a wrong proof.
+        try:
+            if int(r.get("code", 0) or 0) != 0:
+                return resp  # app-level error: nothing state-bearing to trust
+            rh = int(r.get("height", 0) or 0)
+            if rh <= 0:
+                raise VerificationFailed("abci_query: response carries no height")
+            value = base64.b64decode(r.get("value") or "")
+            key = base64.b64decode(r.get("key") or "")
+            ops_json = (r.get("proof_ops") or {}).get("ops") or []
+            if not value:
+                raise VerificationFailed(
+                    "abci_query: empty value (absence proofs not supported)"
+                )
+            if not ops_json:
+                raise VerificationFailed(
+                    "abci_query: response carries no proof (fail closed)"
+                )
+            ops: list[merkle.ProofOp] = []
+            for op in ops_json:
+                if op.get("type") != "simple:v":
+                    raise VerificationFailed(
+                        f"abci_query: unregistered proof op {op.get('type')!r}"
+                    )
+                vop = tpb.ValueOpProto.decode(base64.b64decode(op["data"]))
+                proof = merkle.Proof(
+                    total=vop.proof.total,
+                    index=vop.proof.index,
+                    leaf_hash=vop.proof.leaf_hash,
+                    aunts=list(vop.proof.aunts),
+                )
+                ops.append(merkle.ValueOp(base64.b64decode(op["key"]), proof))
+        except VerificationFailed:
+            raise
+        except Exception as e:  # noqa: BLE001 — fail closed on any garbage
+            raise VerificationFailed(f"abci_query: malformed response: {e}") from e
+        # the proven root is the app hash of the NEXT header
+        hdr = self._verified_header(rh + 1)
+        keypath = merkle.key_path_to_string([key])
+        try:
+            merkle.ProofOperators(ops).verify_value(hdr.app_hash, keypath, value)
+        except Exception as e:  # noqa: BLE001
+            raise VerificationFailed(f"abci_query: proof invalid: {e}") from e
         return resp
 
 

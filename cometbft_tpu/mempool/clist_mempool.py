@@ -172,8 +172,11 @@ class CListMempool(Mempool):
             )
         if self.pre_check:
             self.pre_check(tx)
+        from ..utils.metrics import hub as _mhub
+
         key = key_of(tx)
         if not self.cache.push(key):
+            _mhub().mp_already_received_txs.inc()
             # record the additional sender for dedup accounting, then reject
             with self._mtx:
                 lane = self._tx_index.get(key)
@@ -195,7 +198,10 @@ class CListMempool(Mempool):
     def _handle_check_result(
         self, tx: bytes, key: bytes, sender: str, res: pb.CheckTxResponse
     ) -> None:
+        from ..utils.metrics import hub as _mhub
+
         if res.code != 0:
+            _mhub().mp_failed_txs.inc()
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
             raise AppCheckError(code=res.code, log=res.log, codespace=res.codespace)
@@ -210,7 +216,9 @@ class CListMempool(Mempool):
                 or self._bytes + len(tx) > self.config.max_txs_bytes
             ):
                 self.cache.remove(key)
+                _mhub().mp_evicted_txs.inc()
                 raise MempoolFullError(len(self._tx_index), self._bytes)
+            _mhub().mp_tx_size_bytes.observe(len(tx))
             entry = TxEntry(
                 tx=tx,
                 key=key,
@@ -347,6 +355,9 @@ class CListMempool(Mempool):
                 self._txs_available.clear()
 
     def _recheck(self, entries: list[TxEntry]) -> None:
+        from ..utils.metrics import hub as _mhub
+
+        _mhub().mp_recheck_times.inc(len(entries))
         for entry in entries:
             try:
                 res = self.proxy_app.check_tx(

@@ -31,14 +31,52 @@ import numpy as np
 
 
 class _CacheEntry:
-    __slots__ = ("tables", "valid", "index", "size", "verify_fn")
+    __slots__ = ("tables", "valid", "index", "size", "vpad", "mesh", "verify_fn")
 
-    def __init__(self, tables, valid, index: dict[bytes, int]):
-        self.tables = tables  # device (V, 64, 16, 3, 22) int32
-        self.valid = valid  # device (V,) bool
+    def __init__(self, tables, valid, index: dict[bytes, int], mesh=None):
+        self.tables = tables  # device (64, 16, 3, 22, Vpad) int32 — V minor
+        self.valid = valid  # device (Vpad,) bool
         self.index = index  # pubkey bytes -> row
         self.size = len(index)
+        self.vpad = int(tables.shape[-1])  # size padded to the mesh width
+        self.mesh = mesh  # jax Mesh when the sharded path is active
         self.verify_fn = None  # jitted verify, bound at first use
+
+
+def active_mesh():
+    """Device mesh for the sharded comb path.
+
+    COMETBFT_TPU_MESH = N (N > 1) shards comb tables + signature rows
+    over the first N devices (parallel/verify.sharded_verify_cached);
+    unset/<=1 keeps the single-device program.  Resolved once per
+    process — consensus builds one cache per validator set and the mesh
+    must be identical across entries.
+    """
+    global _MESH
+    if _MESH is _UNSET:
+        import os
+
+        n = int(os.environ.get("COMETBFT_TPU_MESH", "0") or 0)
+        if n <= 1:
+            _MESH = None
+        else:
+            from ..parallel import make_mesh
+
+            _MESH = make_mesh(n)
+    return _MESH
+
+
+_UNSET = object()
+_MESH = _UNSET
+
+
+def set_active_mesh(mesh) -> None:
+    """Explicitly bind (or clear, with None) the comb-path mesh —
+    overrides the COMETBFT_TPU_MESH env resolution.  Entries built
+    before the change keep their placement; callers flush the cache
+    when re-binding."""
+    global _MESH
+    _MESH = mesh
 
 
 class ValsetCombCache:
@@ -111,7 +149,15 @@ class ValsetCombCache:
 
         from ..ops import comb
 
+        mesh = active_mesh()
         index = {pk: i for i, pk in enumerate(pubkeys)}
+        if mesh is not None:
+            # pad the lane count to the mesh width; pad lanes carry a
+            # repeated real key but are never scattered into (valid rows
+            # only come from `index`), so they do dead-but-defined work
+            d = mesh.devices.size
+            pad = (-len(pubkeys)) % d
+            pubkeys = list(pubkeys) + [pubkeys[0]] * pad
         reuse: list[tuple[int, int]] = []  # (new row, base row)
         fresh: list[int] = []
         if base is not None:
@@ -124,8 +170,7 @@ class ValsetCombCache:
         if base is None or not reuse:
             a = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(-1, 32)
             tables, valid = comb.build_a_tables_jit(jnp.asarray(a))
-            tables.block_until_ready()
-            return _CacheEntry(tables, valid, index)
+            return _finish_entry(tables, valid, index, mesh)
 
         # Incremental churn: gather unchanged rows from the previous set's
         # device tables, build only the new keys.  A single-validator swap
@@ -144,7 +189,7 @@ class ValsetCombCache:
             a = np.frombuffer(b"".join(padded), dtype=np.uint8).reshape(-1, 32)
             t_new, v_new = comb.build_a_tables_jit(jnp.asarray(a))
         else:
-            t_new = base.tables[:0]
+            t_new = base.tables[..., :0]
             v_new = base.valid[:0]
         tables, valid = _assemble_churn_jit(
             base.tables,
@@ -156,23 +201,39 @@ class ValsetCombCache:
             jnp.asarray(np.asarray(fresh, np.int32)),
             V,
         )
-        tables.block_until_ready()
-        return _CacheEntry(tables, valid, index)
+        return _finish_entry(tables, valid, index, mesh)
+
+
+def _finish_entry(tables, valid, index, mesh) -> _CacheEntry:
+    """Place the built tables: sharded over the mesh's lane axis when the
+    multi-chip path is active, resident on the default device otherwise."""
+    if mesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        tables = jax.device_put(
+            tables, NamedSharding(mesh, P(None, None, None, None, axis))
+        )
+        valid = jax.device_put(valid, NamedSharding(mesh, P(axis)))
+    tables.block_until_ready()
+    return _CacheEntry(tables, valid, index, mesh)
 
 
 def _assemble_churn(base_t, base_v, new_t, new_v, new_rows, base_rows, fresh_rows, V):
     """One fused gather/scatter: reused rows from the old tables + freshly
-    built rows into a V-row table.  new_t may carry bucket padding beyond
-    len(fresh_rows); the scatter only reads its first len(fresh_rows) rows."""
+    built rows into a V-lane table.  The validator axis is the tables'
+    LAST axis (ops/comb.py layout); new_t may carry bucket padding beyond
+    len(fresh_rows) lanes, which the scatter never reads."""
     import jax.numpy as jnp
 
-    tables = jnp.zeros((V,) + tuple(base_t.shape[1:]), base_t.dtype)
+    tables = jnp.zeros(tuple(base_t.shape[:-1]) + (V,), base_t.dtype)
     valid = jnp.zeros((V,), bool)
-    tables = tables.at[new_rows].set(base_t[base_rows])
+    tables = tables.at[..., new_rows].set(base_t[..., base_rows])
     valid = valid.at[new_rows].set(base_v[base_rows])
     nf = fresh_rows.shape[0]
     if nf:
-        tables = tables.at[fresh_rows].set(new_t[:nf])
+        tables = tables.at[..., fresh_rows].set(new_t[..., :nf])
         valid = valid.at[fresh_rows].set(new_v[:nf])
     return tables, valid
 
@@ -277,18 +338,20 @@ class CombBatchVerifier:
         self._row_set.add(row)
         self._rows.append(row)
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    def submit(self):
+        """Assemble the batch and dispatch the device call WITHOUT waiting
+        for the result: device calls are asynchronous, so a caller may
+        overlap the next batch's host assembly with this one's kernel
+        (the blocksync replay pipeline, blocksync/replay.py).  Returns an
+        opaque ticket for collect()."""
         if self._fallback is not None:
-            return self._fallback.verify()
+            return ("sync", self._fallback.verify())
         n = len(self._rows)
         if n == 0:
-            return False, []
-        import time
-
+            return ("sync", (False, []))
         import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        V = self._entry.size
+        V = self._entry.vpad
         sig_arr = np.frombuffer(
             b"".join(s for _, _, s in self._items), dtype=np.uint8
         ).reshape(n, 64)
@@ -311,30 +374,55 @@ class CombBatchVerifier:
         active[idx] = active_n
 
         fn = self._verify_fn()
-        t1 = time.perf_counter()
         bits, all_ok = fn(
             self._entry.tables,
             self._entry.valid,
             jnp.asarray(packed),
             jnp.asarray(active),
         )
+        return ("dev", (bits, all_ok, idx))
+
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        """Wait for a submit() ticket and unpack (all_ok, per-signature)."""
+        kind, payload = ticket
+        if kind == "sync":
+            return payload
+        bits, all_ok, idx = payload
         if hasattr(bits, "block_until_ready"):
             bits.block_until_ready()
-        t2 = time.perf_counter()
         picked = (
-            np.unpackbits(np.asarray(bits), count=V).astype(bool)[idx]
+            np.unpackbits(np.asarray(bits), count=self._entry.vpad)
+            .astype(bool)[idx]
         )
-        result = bool(all_ok), picked.tolist()
-        t3 = time.perf_counter()
+        return bool(all_ok), picked.tolist()
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        import time
+
+        t0 = time.perf_counter()
+        ticket = self.submit()
+        t1 = time.perf_counter()
+        result = self.collect(ticket)
+        t2 = time.perf_counter()
         self.last_timings = {
             "assembly_ms": (t1 - t0) * 1e3,
             "kernel_ms": (t2 - t1) * 1e3,
-            "readback_ms": (t3 - t2) * 1e3,
         }
         return result
 
     def _verify_fn(self):
         if self._entry.verify_fn is None:
+            if self._entry.mesh is not None:
+                # multi-chip: tables + rows sharded over the mesh's lane
+                # axis, psum/all_gather combine (parallel/verify.py)
+                import functools
+
+                from ..parallel.verify import sharded_verify_cached
+
+                self._entry.verify_fn = functools.partial(
+                    sharded_verify_cached, self._entry.mesh
+                )
+                return self._entry.verify_fn
             import jax
             import jax.numpy as jnp
 

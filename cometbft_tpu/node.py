@@ -317,12 +317,22 @@ class Node:
         self.listen_addr: str | None = None
         self.rpc_server = None  # attached by start() when configured
         self.companion_server = None
+        self.companion_privileged_server = None
 
         # ---- metrics (node.go:983 Prometheus server; metricsgen sets)
         from .utils.metrics import NodeMetrics, Registry
 
-        self.metrics_registry = Registry()
-        self.metrics = NodeMetrics(self.metrics_registry)
+        # the hub's registry carries the per-package call-site metrics
+        # (consensus rounds, mempool rejects, p2p stream bytes, store
+        # latencies — utils/metrics.Hub); node-level gauges join it so
+        # /metrics exposes one coherent set
+        from .utils.metrics import hub as _metrics_hub
+
+        _h = _metrics_hub()
+        self.metrics_registry = _h.registry
+        if getattr(_h, "node_metrics", None) is None:
+            _h.node_metrics = NodeMetrics(self.metrics_registry)
+        self.metrics = _h.node_metrics
         self._metrics_httpd = None
         self._pprof_httpd = None
 
@@ -395,8 +405,22 @@ class Node:
             from . import __version__
             from .rpc.services import CompanionServiceServer
 
+            # public data services only — the pruner is deliberately not
+            # handed to this listener (rpc/services.py privileged split)
             self.companion_server = CompanionServiceServer(
                 _strip_tcp(self.config.rpc.companion_laddr),
+                self.block_store,
+                self.state_store,
+                event_bus=self.event_bus,
+                node_version=__version__,
+            )
+            self.companion_server.start()
+        if self.config.rpc.companion_privileged_laddr:
+            from . import __version__
+            from .rpc.services import CompanionServiceServer
+
+            self.companion_privileged_server = CompanionServiceServer(
+                _strip_tcp(self.config.rpc.companion_privileged_laddr),
                 self.block_store,
                 self.state_store,
                 pruner=self.pruner,
@@ -404,8 +428,9 @@ class Node:
                 block_indexer=self.block_indexer,
                 event_bus=self.event_bus,
                 node_version=__version__,
+                privileged=True,
             )
-            self.companion_server.start()
+            self.companion_privileged_server.start()
         if self.pex_reactor is not None:
             self.addr_book.save()
         self._start_metrics()
@@ -558,6 +583,11 @@ class Node:
         if self.companion_server is not None:
             try:
                 self.companion_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.companion_privileged_server is not None:
+            try:
+                self.companion_privileged_server.stop()
             except Exception:  # noqa: BLE001
                 pass
         try:

@@ -1,23 +1,24 @@
 """Comb-based cached Ed25519 verification: the validator-set fast path.
 
 The Straus kernel (ops/ed25519.verify_prepared) spends most of its time in
-the 256 shared doublings (measured 44 ns/row-double on a v5e: 186 ms of a
-521 ms kernel at 16k signatures).  For commit verification the pubkeys are
-known long in advance — the validator set changes rarely — so this module
-trades HBM for those doublings entirely:
+the 256 shared doublings.  For commit verification the pubkeys are known
+long in advance — the validator set changes rarely — so this module trades
+HBM for those doublings entirely:
 
-  - per-validator comb tables  T[v][i][j] = j * 16^i * (-A_v),  i<64, j<16,
+  - per-validator comb tables  T[i][j][v] = j * 16^i * (-A_v),  i<64, j<16,
     in affine Niels form (y+x, y-x, 2dxy), built once per validator set and
     kept device-resident (~270 KB/validator; a 10k-validator set is 2.7 GB
     of the chip's 16 GB HBM).  This is the TPU analogue of the reference's
     expanded-pubkey LRU (crypto/ed25519/ed25519.go:43,68), scaled to the
-    whole validator set.
-  - a shared radix-4096 comb for the base point B:  B_TAB[i][j] = j*4096^i*B,
-    22 positions x 4096 entries, looked up with one-hot f32 matmuls on the
-    MXU.
+    whole validator set.  Layout (64, 16, 3, 22, V): the validator axis is
+    MINOR so every select/add runs with full lane utilization (see
+    ops/field.py module doc).
+  - a shared radix-4096 comb for the base point B:
+    B_TAB[i] = (66, 4096) f32 with column j holding j*4096^i*B, looked up
+    with one (66, 4096) x (4096, V) matmul per position on the MXU.
 
 verify_cached then needs NO doublings and NO per-signature table build:
-   acc = sum_i T[v][i][k_i]  +  sum_i B_TAB[i][s_i]  - R,   check [8]acc = 0
+   acc = sum_i T[i][k_i][v]  +  sum_i B_TAB[i][s_i]  - R,   check [8]acc = 0
 64 + 22 + 1 additions and one point decompression (R) per signature,
 versus 256 doublings + 128 additions + 2 decompressions + table build for
 the uncached kernel.
@@ -44,20 +45,7 @@ NENT_A = 16
 NPOS_B = 22  # radix-4096 comb positions for the s*B part
 NENT_B = 4096
 
-_D2_L = F.to_limbs(ref.D2)
-
-
-# ----------------------------------------------------------- digit splits
-
-
-def nibbles_lsb(limbs, n: int):
-    """(..., 22) base-2^12 limbs -> (..., n) 4-bit digits, LSB first
-    (digit i has weight 16^i, matching table position i)."""
-    n0 = limbs & 15
-    n1 = lax.shift_right_logical(limbs, 4) & 15
-    n2 = lax.shift_right_logical(limbs, 8) & 15
-    nib = jnp.stack([n0, n1, n2], axis=-1).reshape(limbs.shape[:-1] + (66,))
-    return nib[..., :n]
+_D2_C = F.to_limbs(ref.D2)[:, None]  # (22, 1) broadcastable constant
 
 
 # --------------------------------------------------- A-table construction
@@ -65,7 +53,7 @@ def nibbles_lsb(limbs, n: int):
 
 def build_a_tables(a_enc):
     """(V, 32) uint8 compressed pubkeys ->
-       (tables (V, 64, 16, 3, 22) int32 affine-Niels, valid (V,) bool).
+       (tables (64, 16, 3, 22, V) int32 affine-Niels, valid (V,) bool).
 
     Runs once per validator set.  Entries are normalized to affine with a
     two-level Montgomery batch inversion (3 muls/entry amortized instead of
@@ -77,7 +65,7 @@ def build_a_tables(a_enc):
     V = a_enc.shape[0]
 
     def position_entries(p):
-        """[0..15]*p as stacked extended coords (16, V, 22) per coord."""
+        """[0..15]*p as stacked extended coords (16, 22, V) per coord."""
         ident = E.identity((V,))
         entries = [ident, p]
         for _ in range(14):
@@ -95,13 +83,13 @@ def build_a_tables(a_enc):
         p16 = E.double(E.double(E.double(E.double(p))))
         return p16, tx, ty, tz, tt
 
-    shape = (NPOS_A, NENT_A, V, F.NLIMBS)
+    shape = (NPOS_A, NENT_A, F.NLIMBS, V)
     init = (p0,) + tuple(jnp.zeros(shape, dtype=jnp.int32) for _ in range(4))
     _, tx, ty, tz, tt = lax.fori_loop(0, NPOS_A, body, init)
 
     niels = _normalize_to_niels(tx, ty, tz)
-    # (3, NPOS_A, NENT_A, V, 22) -> (V, NPOS_A, NENT_A, 3, 22)
-    tables = jnp.transpose(niels, (3, 1, 2, 0, 4))
+    # (3, NPOS_A, NENT_A, 22, V) -> (NPOS_A, NENT_A, 3, 22, V)
+    tables = jnp.transpose(niels, (1, 2, 0, 3, 4))
     return tables, valid
 
 
@@ -118,8 +106,8 @@ def build_a_tables_jit(a_enc):
 
 
 def _normalize_to_niels(tx, ty, tz):
-    """Extended (pos, ent, V, 22) coords -> stacked affine Niels
-    (3, pos, ent, V, 22): (y+x, y-x, 2dxy).
+    """Extended (pos, ent, 22, V) coords -> stacked affine Niels
+    (3, pos, ent, 22, V): (y+x, y-x, 2dxy).
 
     Batch inversion: Montgomery's trick over the entry axis, then over the
     position axis, so only (V,) values go through the full inversion chain.
@@ -130,13 +118,13 @@ def _normalize_to_niels(tx, ty, tz):
     prefix1 = [tz[:, 0]]
     for j in range(1, NENT_A):
         prefix1.append(F.mul(prefix1[-1], tz[:, j]))
-    tot1 = prefix1[-1]  # (pos, V, 22)
+    tot1 = prefix1[-1]  # (pos, 22, V)
 
     # level 2: prefix products over the 64-position axis
     prefix2 = [tot1[0]]
     for i in range(1, NPOS_A):
         prefix2.append(F.mul(prefix2[-1], tot1[i]))
-    tot2 = prefix2[-1]  # (V, 22)
+    tot2 = prefix2[-1]  # (22, V)
 
     inv_tot2 = F.invert(tot2)
 
@@ -149,7 +137,7 @@ def _normalize_to_niels(tx, ty, tz):
     inv_tot1[0] = running
 
     # unwind level 1: entry-axis inverses, batched over all positions
-    run = jnp.stack(inv_tot1)  # (pos, V, 22)
+    run = jnp.stack(inv_tot1)  # (pos, 22, V)
     inv_z = jnp.zeros_like(tz)
     for j in range(NENT_A - 1, 0, -1):
         inv_z = inv_z.at[:, j].set(F.mul(run, prefix1[j - 1]))
@@ -160,17 +148,18 @@ def _normalize_to_niels(tx, ty, tz):
     y = F.mul(ty, inv_z)
     xy = F.mul(x, y)
     return jnp.stack(
-        [F.add(y, x), F.sub(y, x), F.mul(xy, jnp.asarray(_D2_L))]
+        [F.add(y, x), F.sub(y, x), F.mul(xy, jnp.asarray(_D2_C))]
     )
 
 
 # --------------------------------------------------- B-table construction
 
-_B_TABLES = None  # device (NPOS_B, NENT_B, 66) f32, built lazily
+_B_TABLES = None  # device (NPOS_B, 66, NENT_B) f32, built lazily
 
 
 def build_b_tables() -> np.ndarray:
-    """(22, 4096, 66) f32: j * 4096^i * B in flattened affine Niels.
+    """(22, 66, 4096) f32: column j of slab i holds j * 4096^i * B in
+    flattened affine Niels.
 
     Built on HOST with exact integer arithmetic: the table is a pure
     constant (~24 MB), and building it as an XLA program constant-folds
@@ -211,7 +200,13 @@ def build_b_tables() -> np.ndarray:
             out[i, j, 0] = F.to_limbs((y + x) % P)
             out[i, j, 1] = F.to_limbs((y - x) % P)
             out[i, j, 2] = F.to_limbs(x * y % P * ref.D2 % P)
-    return out.reshape(NPOS_B, NENT_B, 3 * F.NLIMBS).astype(np.float32)
+    # (pos, ent, 3, 22) -> (pos, 66, ent): coords flattened, entry minor
+    return (
+        out.reshape(NPOS_B, NENT_B, 3 * F.NLIMBS)
+        .transpose(0, 2, 1)
+        .astype(np.float32)
+        .copy()
+    )
 
 
 def get_b_tables():
@@ -232,7 +227,7 @@ def _b_tables_cached() -> np.ndarray:
         try:
             tab = np.load(cache)
             # reject stale caches from an older table layout
-            if tab.shape == (NPOS_B, NENT_B, 3 * F.NLIMBS) and tab.dtype == np.float32:
+            if tab.shape == (NPOS_B, 3 * F.NLIMBS, NENT_B) and tab.dtype == np.float32:
                 return tab
         except (OSError, ValueError):
             pass
@@ -252,57 +247,51 @@ def _b_tables_cached() -> np.ndarray:
 def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
     """Batched cofactored verification against cached comb tables.
 
-    tables   : (V, 64, 16, 3, 22) int32 — build_a_tables output
+    tables   : (64, 16, 3, 22, V) int32 — build_a_tables output
     a_valid  : (V,) bool — per-row pubkey decompression success
     r_enc    : (V, 32) uint8 — signature R halves
     s_bytes  : (V, 32) uint8 — signature s halves
-    k_digest : (V, 64) uint8 — SHA-512(R || A || M), host-computed
-    b_tables : (22, 4096, 66) f32 — get_b_tables()
+    k_digest : (V, 64) uint8 — SHA-512(R || A || M)
+    b_tables : (22, 66, 4096) f32 — get_b_tables()
 
     Returns (V,) bool.  Rows whose validator did not sign carry dummy
     inputs; callers mask the result.
     """
     k_limbs = scalar.reduce_mod_l(scalar.bytes_to_limbs(k_digest, scalar.NL_X))
-    k_dig = nibbles_lsb(k_limbs, NPOS_A)  # (V, 64) 4-bit digits
+    k_dig = scalar.nibbles_lsb(k_limbs, NPOS_A)  # (64, V) 4-bit digits
     s_ok = scalar.s_lt_l(s_bytes)
     # s as 22 x 12-bit digits, LSB first: exactly its base-2^12 limbs
-    s_dig = scalar.bytes_to_limbs(s_bytes, NPOS_B)  # (V, 22)
+    s_dig = scalar.bytes_to_limbs(s_bytes, NPOS_B)  # (22, V)
 
     r_pt, r_valid = E.decompress(r_enc)
+    V = r_enc.shape[0]
 
-    # ---- A part: acc += T[v][i][k_i], 64 adds, one-hot multiply-reduce
+    # ---- A part: acc += T[i][k_i][v], 64 adds, one-hot multiply-reduce
+    ents_a = jnp.arange(NENT_A, dtype=jnp.int32)[:, None]
+
     def a_body(i, acc):
-        slab = lax.dynamic_index_in_dim(tables, i, axis=1, keepdims=False)
-        dig = lax.dynamic_index_in_dim(k_dig, i, axis=-1, keepdims=False)
-        onehot = (dig[:, None] == jnp.arange(NENT_A, dtype=jnp.int32)).astype(
-            jnp.int32
-        )  # (V, 16)
-        sel = jnp.einsum(
-            "vj,vjck->vck", onehot, slab, precision=lax.Precision.HIGHEST
-        )  # (V, 3, 22) — int32 path; precision pinned in case XLA
-        # ever routes an integer dot through reduced-precision MXU passes
-        return E.add_niels(
-            acc, E.Niels(sel[:, 0], sel[:, 1], sel[:, 2])
-        )
+        slab = lax.dynamic_index_in_dim(tables, i, axis=0, keepdims=False)
+        dig = lax.dynamic_index_in_dim(k_dig, i, axis=0, keepdims=False)
+        onehot = (ents_a == dig[None, :]).astype(jnp.int32)  # (16, V)
+        sel = jnp.sum(slab * onehot[:, None, None, :], axis=0)  # (3, 22, V)
+        return E.add_niels(acc, E.Niels(sel[0], sel[1], sel[2]))
 
-    acc = lax.fori_loop(0, NPOS_A, a_body, E.identity((r_enc.shape[0],)))
+    acc = lax.fori_loop(0, NPOS_A, a_body, E.identity((V,)))
 
-    # ---- B part: acc += B_TAB[i][s_i], 22 adds, MXU one-hot matmul
+    # ---- B part: acc += B_TAB[i][:, s_i], 22 adds, MXU one-hot matmul
+    ents_b = jnp.arange(NENT_B, dtype=jnp.int32)[:, None]
+
     def b_body(i, acc):
         slab = lax.dynamic_index_in_dim(b_tables, i, axis=0, keepdims=False)
-        dig = lax.dynamic_index_in_dim(s_dig, i, axis=-1, keepdims=False)
-        onehot = (dig[:, None] == jnp.arange(NENT_B, dtype=jnp.int32)).astype(
-            jnp.float32
-        )  # (V, 4096)
+        dig = lax.dynamic_index_in_dim(s_dig, i, axis=0, keepdims=False)
+        onehot = (ents_b == dig[None, :]).astype(jnp.float32)  # (4096, V)
         # HIGHEST: the TPU MXU default is bf16 passes (8 mantissa bits);
         # the Niels limbs are 12-bit values and must come through exact.
-        sel = (
-            jnp.matmul(onehot, slab, precision=lax.Precision.HIGHEST)
-            .astype(jnp.int32)
-            .reshape(-1, 3, F.NLIMBS)
-        )
+        sel = jnp.matmul(
+            slab, onehot, precision=lax.Precision.HIGHEST
+        ).astype(jnp.int32)  # (66, V)
         return E.add_niels(
-            acc, E.Niels(sel[:, 0], sel[:, 1], sel[:, 2])
+            acc, E.Niels(sel[0:22], sel[22:44], sel[44:66])
         )
 
     acc = lax.fori_loop(0, NPOS_B, b_body, acc)

@@ -1,10 +1,13 @@
 """Vectorized Edwards25519 group operations for TPU.
 
 Points are batches in extended twisted-Edwards coordinates (X:Y:Z:T),
-a = -1, held as four GF(2^255-19) limb arrays (see ops/field.py).  The
-a=-1 addition law is complete on this curve, so every operation below is
-branch-free — no exceptional cases, no data-dependent control flow —
-exactly what XLA needs to tile the 10k-signature batch onto the VPU.
+a = -1, held as four GF(2^255-19) limb arrays in the limbs-first layout of
+ops/field.py: each coordinate is (..., 22, L) with the lane/batch axis
+minor (full 128-lane utilization on the VPU) and the 22 limbs on
+sublanes.  The a=-1 addition law is complete on this curve, so every
+operation below is branch-free — no exceptional cases, no data-dependent
+control flow — exactly what XLA needs to tile the 10k-signature batch
+onto the vector unit.
 
 Scalar multiplication uses Straus/Shamir interleaving with 4-bit windows:
 one shared doubling chain evaluates [s]B + [k]A' per signature with 256
@@ -34,7 +37,7 @@ from ..crypto import _ref25519 as ref
 
 
 class Point(NamedTuple):
-    """Batched extended coordinates; each field is (..., 22) int32 limbs."""
+    """Batched extended coordinates; each field is (..., 22, L) int32 limbs."""
 
     x: jnp.ndarray
     y: jnp.ndarray
@@ -47,6 +50,11 @@ class Point(NamedTuple):
 _D_L = F.to_limbs(ref.D)
 _D2_L = F.to_limbs(ref.D2)
 _SQRT_M1_L = F.to_limbs(ref.SQRT_M1)
+
+
+def _c(limbs: np.ndarray):
+    """(22,) host constant -> (22, 1) broadcastable device constant."""
+    return jnp.asarray(limbs[:, None])
 
 
 def identity(batch_shape=()) -> Point:
@@ -76,7 +84,7 @@ def add(p: Point, q: Point) -> Point:
     """Unified complete addition (9 field muls)."""
     a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
     b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
-    c = F.mul(F.mul(p.t, q.t), jnp.asarray(_D2_L))
+    c = F.mul(F.mul(p.t, q.t), _c(_D2_L))
     d = F.mul(p.z, q.z)
     d = F.add(d, d)
     e = F.sub(b, a)
@@ -121,7 +129,7 @@ def add_niels(p: Point, n: Niels) -> Point:
 
 def niels_identity_like(n: Niels) -> Niels:
     """The identity in Niels form: (1, 1, 0)."""
-    shape = n.yplusx.shape[:-1]
+    shape = n.yplusx.shape[:-2] + n.yplusx.shape[-1:]
     return Niels(F.one(shape), F.one(shape), F.zero(shape))
 
 
@@ -131,39 +139,41 @@ def niels_identity_like(n: Niels) -> Niels:
 def decompress(enc):
     """(..., 32) uint8 -> (Point, ok).  ZIP-215 semantics (see module doc).
 
-    Invalid encodings yield ok=False and an arbitrary (but well-formed)
-    point so downstream arithmetic stays branch-free.
+    The Point's lane axis is enc's last batch axis; ok keeps enc's batch
+    shape.  Invalid encodings yield ok=False and an arbitrary (but
+    well-formed) point so downstream arithmetic stays branch-free.
     """
     sign = (lax.shift_right_logical(enc[..., 31].astype(jnp.int32), 7) & 1).astype(
         jnp.int32
     )
     masked = enc.at[..., 31].set(enc[..., 31] & jnp.uint8(0x7F))
     y = F.from_bytes(masked)
+    batch = y.shape[:-2] + y.shape[-1:]
     yy = F.square(y)
-    u = F.sub(yy, F.one(yy.shape[:-1]))
-    v = F.add(F.mul(yy, jnp.asarray(_D_L)), F.one(yy.shape[:-1]))
+    u = F.sub(yy, F.one(batch))
+    v = F.add(F.mul(yy, _c(_D_L)), F.one(batch))
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
     x = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
     vxx = F.mul(v, F.square(x))
     ok_direct = F.eq(vxx, u)
     ok_flipped = F.eq(vxx, F.neg(u))
-    x = F.select(ok_flipped, F.mul(x, jnp.asarray(_SQRT_M1_L)), x)
+    x = F.select(ok_flipped, F.mul(x, _c(_SQRT_M1_L)), x)
     ok = ok_direct | ok_flipped
     # Match the requested sign bit (x = 0, sign = 1 stays x = 0: accepted).
     flip = F.is_negative(x) != (sign == 1)
     x = F.select(flip, F.neg(x), x)
-    pt = Point(x, y, F.one(y.shape[:-1]), F.mul(x, y))
+    pt = Point(x, y, F.one(batch), F.mul(x, y))
     return pt, ok
 
 
 def compress(p: Point):
-    """Point -> canonical (..., 32) uint8 encoding."""
+    """Point -> canonical (..., L, 32) uint8 encoding (batch-first bytes)."""
     zi = F.invert(p.z)
     x = F.mul(p.x, zi)
     y = F.mul(p.y, zi)
     b = F.to_bytes(y)
-    signbit = (F.freeze(x)[..., 0] & 1).astype(jnp.uint8)
+    signbit = (F.freeze(x)[..., 0, :] & 1).astype(jnp.uint8)
     return b.at[..., 31].set(b[..., 31] | (signbit << 7))
 
 
@@ -208,15 +218,19 @@ def _build_base_window_table() -> np.ndarray:
 
 
 _B_WINDOW = _build_base_window_table()
+# (66, 16): flattened (3*22)-coord rows by entry, for the one-hot matmul
+_B_WINDOW_FLAT = _B_WINDOW.reshape(16, 66).T.copy()
 
 
-def lookup_niels(table, idx) -> Niels:
-    """One-hot select from a host table (16, 3, 22) by (...,) int32 idx."""
-    onehot = (idx[..., None] == jnp.arange(16, dtype=jnp.int32)).astype(jnp.int32)
-    flat = jnp.asarray(table.reshape(16, -1))  # (16, 66)
-    sel = onehot @ flat  # (..., 66) — MXU-friendly matmul
-    sel = sel.reshape(idx.shape + (3, F.NLIMBS))
-    return Niels(sel[..., 0, :], sel[..., 1, :], sel[..., 2, :])
+def lookup_niels(table_flat, idx) -> Niels:
+    """One-hot select from a host table (66, 16) by (..., L) int32 idx.
+
+    Returns Niels coords (..., 22, L): (66,16) @ onehot(..., 16, L)."""
+    onehot = (
+        idx[..., None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]
+    ).astype(jnp.int32)  # (..., 16, L)
+    sel = jnp.matmul(jnp.asarray(table_flat), onehot)  # (..., 66, L)
+    return Niels(sel[..., 0:22, :], sel[..., 22:44, :], sel[..., 44:66, :])
 
 
 def build_var_table(a: Point) -> Point:
@@ -224,7 +238,8 @@ def build_var_table(a: Point) -> Point:
 
     1 double + 13 unified adds; entry j holds j*A.
     """
-    entries = [identity(a.x.shape[:-1]), a, double(a)]
+    batch = a.x.shape[:-2] + a.x.shape[-1:]
+    entries = [identity(batch), a, double(a)]
     for j in range(3, 16):
         entries.append(add(entries[j - 1], a))
     return Point(
@@ -236,10 +251,11 @@ def build_var_table(a: Point) -> Point:
 
 
 def lookup_point(table: Point, idx) -> Point:
-    """One-hot select from a stacked (16, batch..., 22) point table."""
-    onehot = (idx == jnp.arange(16, dtype=jnp.int32)[(...,) + (None,) * idx.ndim]).astype(
-        jnp.int32
-    )[..., None]
+    """One-hot select from a stacked (16, ..., 22, L) point table by
+    (..., L) idx."""
+    onehot = (
+        idx == jnp.arange(16, dtype=jnp.int32)[(...,) + (None,) * idx.ndim]
+    ).astype(jnp.int32)[..., None, :]  # (16, ..., 1, L)
 
     def pick(coord):
         return jnp.sum(coord * onehot, axis=0)
@@ -253,13 +269,13 @@ def lookup_point(table: Point, idx) -> Point:
 def verify_prepared(a_enc, r_enc, s_windows, k_windows, s_ok):
     """Core batched verifier.
 
-    Inputs (batch shape (...,)):
-      a_enc, r_enc : (..., 32) uint8 — compressed pubkey / R point
-      s_windows    : (..., 64) int32 — 4-bit windows of s, MSB first
-      k_windows    : (..., 64) int32 — 4-bit windows of k = H(R,A,M) mod L
-      s_ok         : (...,) bool — s < L precondition (ops/scalar.s_lt_l)
+    Inputs (batch shape (..., L); byte arrays batch-first):
+      a_enc, r_enc : (..., L, 32) uint8 — compressed pubkey / R point
+      s_windows    : (..., 64, L) int32 — 4-bit windows of s, MSB first
+      k_windows    : (..., 64, L) int32 — 4-bit windows of k = H(R,A,M) mod L
+      s_ok         : (..., L) bool — s < L precondition (ops/scalar.s_lt_l)
 
-    Returns (...,) bool: [8]([s]B - [k]A - R) == identity, with decompress
+    Returns (..., L) bool: [8]([s]B - [k]A - R) == identity, with decompress
     failures and s >= L forced to False.
 
     Straus interleave: acc := 16*acc + s_i*B + k_i*(-A) per window step,
@@ -275,16 +291,17 @@ def verify_prepared(a_enc, r_enc, s_windows, k_windows, s_ok):
     def step(i, acc):
         acc = double(double(double(double(acc))))
         acc = add(acc, lookup_point(table, k_at(i)))  # k_i * (-A)
-        return add_niels(acc, lookup_niels(_B_WINDOW, s_at(i)))  # s_i * B
+        return add_niels(acc, lookup_niels(_B_WINDOW_FLAT, s_at(i)))  # s_i * B
 
-    # fori_loop with dynamic window indexing along the last axis.
+    # fori_loop with dynamic window indexing along the window axis (-2).
     def k_at(i):
-        return lax.dynamic_index_in_dim(k_windows, i, axis=-1, keepdims=False)
+        return lax.dynamic_index_in_dim(k_windows, i, axis=-2, keepdims=False)
 
     def s_at(i):
-        return lax.dynamic_index_in_dim(s_windows, i, axis=-1, keepdims=False)
+        return lax.dynamic_index_in_dim(s_windows, i, axis=-2, keepdims=False)
 
-    acc = lax.fori_loop(0, 64, step, identity(a_enc.shape[:-1]))
+    batch = a_enc.shape[:-1]
+    acc = lax.fori_loop(0, 64, step, identity(batch))
     acc = add(acc, neg(r_pt))
     acc = double(double(double(acc)))
     return is_identity(acc) & a_valid & r_valid & s_ok
@@ -311,7 +328,7 @@ def verify_batch(a_enc, r_enc, s_bytes, msg_blocks, msg_active):
     # RFC 8032 interprets the 64-byte digest as a little-endian integer.
     k_digest = sha2.sha512_blocks(msg_blocks, msg_active)  # (N, 64)
     k_limbs = scalar.reduce_mod_l(scalar.bytes_to_limbs(k_digest, scalar.NL_X))
-    k_windows = scalar.limbs_to_windows(k_limbs)
-    s_windows = scalar.bytes_to_windows(s_bytes)
-    s_ok = scalar.s_lt_l(s_bytes)
+    k_windows = scalar.limbs_to_windows(k_limbs)  # (64, N)
+    s_windows = scalar.bytes_to_windows(s_bytes)  # (64, N)
+    s_ok = scalar.s_lt_l(s_bytes)  # (N,)
     return verify_prepared(a_enc, r_enc, s_windows, k_windows, s_ok)

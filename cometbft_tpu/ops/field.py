@@ -1,12 +1,19 @@
 """Vectorized GF(2^255-19) arithmetic for TPU.
 
 Field elements are little-endian arrays of 22 signed 12-bit limbs held in
-int32 (shape (..., 22)).  The representation is chosen for the TPU VPU: all
-intermediate products and accumulations fit in int32 (no int64 on device),
-and every operation is element-wise/branch-free over an arbitrary batch
-shape, so a 10k-signature commit verification maps onto the vector unit as
-one fused program (reference workload: crypto/ed25519/ed25519.go:188-222
-BatchVerifier — curve25519-voi's CPU-SIMD equivalent, re-designed for TPU).
+int32, shaped (..., NLIMBS, L): the limb axis is SECOND-MINOR and the
+batch ("lane") axis L is minor.  TPU vector registers tile the two minor
+dims as (8 sublanes x 128 lanes); with limbs on the minor axis (the
+previous layout) every element-wise op used 22 of 128 lanes (83% waste).
+Limbs-on-sublanes puts the big batch axis on lanes (full utilization) and
+the 22 limbs on sublanes (22 of 24, 8% pad) — measured ~7x faster per
+field mul on the CPU backend and the same argument applies to the VPU.
+All intermediate products and accumulations fit in int32 (no int64 on
+device), and every operation is element-wise/branch-free over arbitrary
+leading batch axes, so a 10k-signature commit verification maps onto the
+vector unit as one fused program (reference workload:
+crypto/ed25519/ed25519.go:188-222 BatchVerifier — curve25519-voi's
+CPU-SIMD equivalent, re-designed for TPU).
 
 Bound contract (|limb| bounds; exercised adversarially in tests/test_field.py):
 
@@ -25,6 +32,8 @@ exceeds int32 range even for large q.
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 import jax.numpy as jnp
@@ -57,30 +66,46 @@ for _i in range(NLIMBS):
 
 
 def to_limbs(x: int, batch_shape=()) -> np.ndarray:
-    """Host-side: Python int -> limb array (numpy int32)."""
+    """Host-side: Python int -> (22,) limb vector (numpy int32); with a
+    batch_shape, broadcast to batch_shape[:-1] + (22, batch_shape[-1])."""
     x %= P
     out = np.zeros(NLIMBS, dtype=np.int32)
     for i in range(NLIMBS):
         out[i] = x & MASK
         x >>= BITS
     if batch_shape:
-        out = np.broadcast_to(out, batch_shape + (NLIMBS,)).copy()
+        out = np.broadcast_to(
+            out[:, None], batch_shape[:-1] + (NLIMBS, batch_shape[-1])
+        ).copy()
     return out
 
 
+@functools.lru_cache(maxsize=64)
+def cl(x: int):
+    """Device constant: (22, 1) limbs of x, broadcastable against any
+    (..., 22, L) element."""
+    return jnp.asarray(to_limbs(x)[:, None])
+
+
 def from_limbs(limbs) -> int:
-    """Host-side: limb array (1-D) -> Python int (not reduced mod p)."""
+    """Host-side: (22,) limb vector -> Python int (not reduced mod p)."""
     limbs = np.asarray(limbs)
-    return sum(int(limbs[i]) << (BITS * i) for i in range(limbs.shape[-1]))
+    return sum(int(limbs[i]) << (BITS * i) for i in range(limbs.shape[0]))
+
+
+def _el_shape(batch_shape):
+    if not batch_shape:
+        return (NLIMBS, 1)
+    return tuple(batch_shape[:-1]) + (NLIMBS, batch_shape[-1])
 
 
 def zero(batch_shape=()):
-    return jnp.zeros(batch_shape + (NLIMBS,), dtype=jnp.int32)
+    return jnp.zeros(_el_shape(batch_shape), dtype=jnp.int32)
 
 
 def one(batch_shape=()):
-    z = np.zeros(batch_shape + (NLIMBS,), dtype=np.int32)
-    z[..., 0] = 1
+    z = np.zeros(_el_shape(batch_shape), dtype=np.int32)
+    z[..., 0, :] = 1
     return jnp.asarray(z)
 
 
@@ -98,16 +123,21 @@ def neg(a):
     return -a
 
 
+def _pad_limb_axis(x, lo: int, hi: int):
+    pad = [(0, 0)] * (x.ndim - 2) + [(lo, hi), (0, 0)]
+    return jnp.pad(x, pad)
+
+
 def _carry_round(c):
-    """One parallel signed carry round over the last axis.
+    """One parallel signed carry round over the limb axis (-2).
 
     q = round(c / 2^12); limbs land in [-2048, 2047] before carry-ins.
     Returns (c', top_carry) where top_carry has weight 2^(12*nlimbs).
     """
     q = lax.shift_right_arithmetic(c + (RADIX >> 1), BITS)
     c = c - lax.shift_left(q, BITS)
-    carry_in = jnp.pad(q[..., :-1], [(0, 0)] * (q.ndim - 1) + [(1, 0)])
-    return c + carry_in, q[..., -1]
+    carry_in = _pad_limb_axis(q[..., :-1, :], 1, 0)
+    return c + carry_in, q[..., -1, :]
 
 
 def _fold_top(c, q):
@@ -119,8 +149,8 @@ def _fold_top(c, q):
     v = q * 19
     lo = (v & 7) * (1 << 9)
     hi = lax.shift_right_arithmetic(v, 3)
-    c = c.at[..., 0].add(lo)
-    c = c.at[..., 1].add(hi)
+    c = c.at[..., 0, :].add(lo)
+    c = c.at[..., 1, :].add(hi)
     return c
 
 
@@ -136,31 +166,34 @@ def carry(a, rounds: int = 3):
 def _conv(a, b, n: int, m: int):
     """Schoolbook product of n-limb a and m-limb b -> (n+m-1)-limb conv.
 
-    Unrolled static loop: m shifted multiply-adds, each a width-n vector op.
+    Unrolled static loop: m shifted multiply-adds, each a width-n vector op
+    over the lane axis.
     """
     out_len = n + m - 1
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (out_len,)
+    shape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2]) + (
+        out_len,
+        jnp.broadcast_shapes(a.shape[-1:], b.shape[-1:])[0],
+    )
     c = jnp.zeros(shape, dtype=jnp.int32)
     for i in range(m):
-        c = c.at[..., i : i + n].add(a * b[..., i : i + 1])
+        c = c.at[..., i : i + n, :].add(a * b[..., i : i + 1, :])
     return c
 
 
 def _reduce_conv(c):
     """Reduce a 43-limb signed conv (|limb| <= 1.72e9) to TIGHT limbs."""
-    lo = c[..., :NLIMBS]
-    hi = c[..., NLIMBS:]  # 21 limbs, weight offset 2^264
+    lo = c[..., :NLIMBS, :]
+    hi = c[..., NLIMBS:, :]  # 21 limbs, weight offset 2^264
     # Carry hi independently (pad so round-carries stay inside; top carry of
     # the padded array is provably zero with 3 pad limbs / 3 rounds).
-    pad = [(0, 0)] * (hi.ndim - 1) + [(0, 3)]
-    hi = jnp.pad(hi, pad)
+    hi = _pad_limb_axis(hi, 0, 3)
     for _ in range(3):
         hi, _ = _carry_round(hi)
     # Fold: limbs 0..21 of hi (abs positions 22..43) scale by 2^264 ≡ 9728;
     # pad limbs 22/23 (abs 44/45) scale by 2^528 ≡ 23104·2^12 → limbs 1/2.
-    lo = lo + hi[..., :NLIMBS] * FOLD
-    lo = lo.at[..., 1].add(hi[..., NLIMBS] * FOLD2_SHIFTED)
-    lo = lo.at[..., 2].add(hi[..., NLIMBS + 1] * FOLD2_SHIFTED)
+    lo = lo + hi[..., :NLIMBS, :] * FOLD
+    lo = lo.at[..., 1, :].add(hi[..., NLIMBS, :] * FOLD2_SHIFTED)
+    lo = lo.at[..., 2, :].add(hi[..., NLIMBS + 1, :] * FOLD2_SHIFTED)
     return carry(lo, rounds=3)
 
 
@@ -237,23 +270,23 @@ def freeze(a):
     """Fully reduce to canonical limbs in [0, 2^12), value in [0, p)."""
     c = carry(a, rounds=3)
     # Make non-negative: add 2^9 * p (limb-wise bias keeps limbs >= 0).
-    c = c + jnp.asarray(_BIAS)
+    c = c + jnp.asarray(_BIAS)[:, None]
     c = _unsigned_carry(c)
     # Two rounds of top-bit folding: value < 2^264 -> < 2^255 + eps -> < 2^255.
     for _ in range(2):
-        hi = lax.shift_right_logical(c[..., -1], 3)  # bits >= 255
-        c = c.at[..., -1].set(c[..., -1] & 7)
-        c = c.at[..., 0].add(hi * 19)
+        hi = lax.shift_right_logical(c[..., -1, :], 3)  # bits >= 255
+        c = c.at[..., -1, :].set(c[..., -1, :] & 7)
+        c = c.at[..., 0, :].add(hi * 19)
         c = _unsigned_carry(c)
     # Conditional subtract p (value in [0, 2^255) -> canonical [0, p)).
-    borrow = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    borrow = jnp.zeros(c.shape[:-2] + c.shape[-1:], dtype=jnp.int32)
     w = jnp.zeros_like(c)
     for i in range(NLIMBS):
-        d = c[..., i] - jnp.int32(int(_P_LIMBS[i])) - borrow
+        d = c[..., i, :] - jnp.int32(int(_P_LIMBS[i])) - borrow
         borrow = lax.shift_right_logical(d, 31) & 1  # 1 if negative
-        w = w.at[..., i].set(d + lax.shift_left(borrow, BITS))
+        w = w.at[..., i, :].set(d + lax.shift_left(borrow, BITS))
     ge_p = borrow == 0
-    return jnp.where(ge_p[..., None], w, c)
+    return jnp.where(ge_p[..., None, :], w, c)
 
 
 def _unsigned_carry(c):
@@ -262,41 +295,43 @@ def _unsigned_carry(c):
     Top carry here is < 2^4 (values < 2^268), so q*FOLD fits trivially.
     """
     out = jnp.zeros_like(c)
-    k = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    k = jnp.zeros(c.shape[:-2] + c.shape[-1:], dtype=jnp.int32)
     for i in range(NLIMBS):
-        t = c[..., i] + k
-        out = out.at[..., i].set(t & MASK)
+        t = c[..., i, :] + k
+        out = out.at[..., i, :].set(t & MASK)
         k = lax.shift_right_logical(t, BITS)
-    out = out.at[..., 0].add(k * FOLD)
+    out = out.at[..., 0, :].add(k * FOLD)
     # Local ripple in case limb 0/1 overflowed (addend < 2^18).
     for i in range(2):
-        ki = lax.shift_right_logical(out[..., i], BITS)
-        out = out.at[..., i].set(out[..., i] & MASK)
-        out = out.at[..., i + 1].add(ki)
+        ki = lax.shift_right_logical(out[..., i, :], BITS)
+        out = out.at[..., i, :].set(out[..., i, :] & MASK)
+        out = out.at[..., i + 1, :].add(ki)
     return out
 
 
 def eq(a, b):
     """Field equality (branch-free): freeze both, compare limbs."""
-    return jnp.all(freeze(a) == freeze(b), axis=-1)
+    return jnp.all(freeze(a) == freeze(b), axis=-2)
 
 
 def is_zero(a):
-    return jnp.all(freeze(a) == 0, axis=-1)
+    return jnp.all(freeze(a) == 0, axis=-2)
 
 
 def is_negative(a):
     """RFC 8032 sign: lowest bit of the canonical encoding."""
-    return (freeze(a)[..., 0] & 1).astype(jnp.bool_)
+    return (freeze(a)[..., 0, :] & 1).astype(jnp.bool_)
 
 
 def select(cond, a, b):
-    """Branch-free select: cond ? a : b.  cond shape = batch shape."""
-    return jnp.where(cond[..., None], a, b)
+    """Branch-free select: cond ? a : b.  cond shape = batch shape
+    (leading axes + lane axis)."""
+    return jnp.where(cond[..., None, :], a, b)
 
 
 def from_bytes(b):
-    """(..., 32) uint8 LE -> limbs.
+    """(..., 32) uint8 LE -> (..., 22, L) limbs, where L is the last
+    batch axis of b (a lone (32,) input yields (22, 1)).
 
     All 256 bits are taken; callers that need the sign bit (point
     decompression) mask it off first.  Value may exceed p — ZIP-215
@@ -311,15 +346,18 @@ def from_bytes(b):
     pad = [(0, 0)] * (bits.ndim - 1) + [(0, NLIMBS * BITS - 256)]
     bits = jnp.pad(bits, pad)
     bits = bits.reshape(bits.shape[:-1] + (NLIMBS, BITS))
-    return jnp.sum(bits * jnp.asarray(_POW2), axis=-1).astype(jnp.int32)
+    limbs = jnp.sum(bits * jnp.asarray(_POW2), axis=-1).astype(jnp.int32)
+    if limbs.ndim == 1:
+        return limbs[:, None]
+    return jnp.swapaxes(limbs, -1, -2)
 
 
 def to_bytes(a):
-    """limbs -> canonical (..., 32) uint8 LE encoding."""
-    c = freeze(a)
+    """(..., 22, L) limbs -> canonical (..., L, 32) uint8 LE encoding."""
+    c = jnp.swapaxes(freeze(a), -1, -2)  # (..., L, 22)
     bits = jnp.stack(
         [lax.shift_right_logical(c, k) & 1 for k in range(BITS)], axis=-1
-    )  # (..., 22, 12)
+    )  # (..., L, 22, 12)
     bits = bits.reshape(bits.shape[:-2] + (NLIMBS * BITS,))[..., :256]
     bits = bits.reshape(bits.shape[:-1] + (32, 8))
     return jnp.sum(

@@ -9,6 +9,11 @@ more than the whole TPU kernel).  Reduction is Barrett with base-2^12 limbs:
 then two conditional subtractions.  All intermediates fit int32 (unsigned
 12-bit limbs, products accumulate to < 2^29).
 
+Limb layout matches ops/field.py: (..., nlimbs, L) with the limb axis
+second-minor and the lane/batch axis minor (see field.py module doc for
+the TPU tiling rationale).  Byte arrays stay batch-first (..., nbytes);
+the conversion helpers transpose.
+
 Also provides s-range checking (s < L, ZIP-215 requirement) and 4-bit
 window extraction for the Straus scalar-multiplication loop.
 """
@@ -44,7 +49,8 @@ _MU_LIMBS = _const_limbs(_MU, 23)
 
 
 def bytes_to_limbs(b, nlimbs: int):
-    """(..., nbytes) uint8 LE -> (..., nlimbs) int32 base-2^12 limbs."""
+    """(..., nbytes) uint8 LE -> (..., nlimbs, L) int32 base-2^12 limbs
+    (L = the last batch axis of b; a lone 1-D input yields lane size 1)."""
     b = b.astype(jnp.int32)
     nbits = b.shape[-1] * 8
     bits = jnp.stack(
@@ -54,45 +60,49 @@ def bytes_to_limbs(b, nlimbs: int):
     if pad:
         bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
     bits = bits.reshape(bits.shape[:-1] + (nlimbs, BITS))
-    return jnp.sum(
+    limbs = jnp.sum(
         bits * jnp.asarray([1 << k for k in range(BITS)], dtype=jnp.int32), axis=-1
     ).astype(jnp.int32)
+    if limbs.ndim == 1:
+        return limbs[:, None]
+    return jnp.swapaxes(limbs, -1, -2)
 
 
 def _seq_carry(c, nlimbs: int):
     """Sequential signed carry; value must be known non-negative < 2^(12n)."""
     out = jnp.zeros_like(c)
-    k = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    k = jnp.zeros(c.shape[:-2] + c.shape[-1:], dtype=jnp.int32)
     for i in range(nlimbs):
-        t = c[..., i] + k
-        out = out.at[..., i].set(t & MASK)
+        t = c[..., i, :] + k
+        out = out.at[..., i, :].set(t & MASK)
         k = lax.shift_right_arithmetic(t, BITS)
     return out
 
 
 def _cond_sub(c, mod_limbs: np.ndarray):
     """One conditional subtract of mod_limbs via borrow chain (branch-free)."""
-    n = c.shape[-1]
-    borrow = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    n = c.shape[-2]
+    borrow = jnp.zeros(c.shape[:-2] + c.shape[-1:], dtype=jnp.int32)
     w = jnp.zeros_like(c)
     for i in range(n):
         m = int(mod_limbs[i]) if i < len(mod_limbs) else 0
-        d = c[..., i] - jnp.int32(m) - borrow
+        d = c[..., i, :] - jnp.int32(m) - borrow
         borrow = lax.shift_right_logical(d, 31) & 1
-        w = w.at[..., i].set(d + lax.shift_left(borrow, BITS))
-    return jnp.where((borrow == 0)[..., None], w, c)
+        w = w.at[..., i, :].set(d + lax.shift_left(borrow, BITS))
+    return jnp.where((borrow == 0)[..., None, :], w, c)
 
 
 def reduce_mod_l(x_limbs):
-    """(..., 43) limbs of a value < 2^512 -> (..., 22) limbs in [0, L)."""
+    """(..., 43, L) limbs of a value < 2^512 -> (..., 22, L) limbs in [0, L)."""
     # q1 = x * MU (43x23 conv, unsigned, partial sums < 23*2^24 < 2^29)
-    prod = F._conv(x_limbs, jnp.asarray(_MU_LIMBS), NL_X, 23)  # 65 limbs
+    mu = jnp.asarray(_MU_LIMBS)[:, None]
+    prod = F._conv(x_limbs, mu, NL_X, 23)  # 65 limbs
     # Normalize so the >>516 (drop 43 limbs) is exact.
-    prod = _seq_carry(prod, prod.shape[-1])
-    q = prod[..., NL_X:]  # (..., 22) limbs, q < 2^261... fits 22 limbs
+    prod = _seq_carry(prod, prod.shape[-2])
+    q = prod[..., NL_X:, :]  # (..., 22, L) limbs, q < 2^261... fits 22 limbs
     # r = x - q*L; r < 3L < 2^254 -> only low 22 limbs relevant.
-    ql = F._conv(q, jnp.asarray(_L_LIMBS), 22, 22)  # 43 limbs
-    r = x_limbs[..., :NL_S] - ql[..., :NL_S]
+    ql = F._conv(q, jnp.asarray(_L_LIMBS)[:, None], 22, 22)  # 43 limbs
+    r = x_limbs[..., :NL_S, :] - ql[..., :NL_S, :]
     # Low 22 limbs of (x - q*L) represent r exactly mod 2^264; r >= 0 < 2^264.
     r = _seq_carry(r, NL_S)
     r = _cond_sub(r, _L_LIMBS)
@@ -101,33 +111,47 @@ def reduce_mod_l(x_limbs):
 
 
 def s_lt_l(s_bytes):
-    """(..., 32) uint8 LE -> bool: s < L (ZIP-215 mandatory check)."""
-    s = bytes_to_limbs(s_bytes, NL_S)
-    borrow = jnp.zeros(s.shape[:-1], dtype=jnp.int32)
+    """(..., 32) uint8 LE -> (...,) bool: s < L (ZIP-215 mandatory check)."""
+    s = bytes_to_limbs(s_bytes, NL_S)  # (..., 22, L)
+    borrow = jnp.zeros(s.shape[:-2] + s.shape[-1:], dtype=jnp.int32)
     for i in range(NL_S):
         m = int(_L_LIMBS[i])
-        d = s[..., i] - jnp.int32(m) - borrow
+        d = s[..., i, :] - jnp.int32(m) - borrow
         borrow = lax.shift_right_logical(d, 31) & 1
-    return borrow == 1
+    out = borrow == 1
+    if s_bytes.ndim == 1:
+        return out[..., 0]
+    return out
+
+
+def nibbles_lsb(limbs, n: int):
+    """(..., 22, L) base-2^12 limbs -> (..., n, L) 4-bit digits, LSB first
+    (digit i has weight 16^i)."""
+    n0 = limbs & 15
+    n1 = lax.shift_right_logical(limbs, 4) & 15
+    n2 = lax.shift_right_logical(limbs, 8) & 15
+    nib = jnp.stack([n0, n1, n2], axis=-2)  # (..., 22, 3, L)
+    nib = nib.reshape(nib.shape[:-3] + (3 * limbs.shape[-2],) + nib.shape[-1:])
+    return nib[..., :n, :]
 
 
 def limbs_to_windows(limbs):
-    """(..., 22) base-2^12 limbs -> (..., 64) 4-bit windows, MSB first.
+    """(..., 22, L) base-2^12 limbs -> (..., 64, L) 4-bit windows, MSB first.
 
     Each 12-bit limb is three nibbles; 66 nibbles cover 264 bits, of which
     the top two are zero for scalars < 2^256.
     """
-    n0 = limbs & 15
-    n1 = lax.shift_right_logical(limbs, 4) & 15
-    n2 = lax.shift_right_logical(limbs, 8) & 15
-    nibbles = jnp.stack([n0, n1, n2], axis=-1).reshape(limbs.shape[:-1] + (66,))
-    return nibbles[..., :64][..., ::-1]
+    return nibbles_lsb(limbs, 64)[..., ::-1, :]
 
 
 def bytes_to_windows(b):
-    """(..., 32) uint8 LE scalar -> (..., 64) 4-bit windows, MSB first."""
+    """(..., 32) uint8 LE scalar -> (..., 64, L) 4-bit windows, MSB first
+    (L = last batch axis of b)."""
     b = b.astype(jnp.int32)
     lo = b & 15
     hi = lax.shift_right_logical(b, 4) & 15
     nibbles = jnp.stack([lo, hi], axis=-1).reshape(b.shape[:-1] + (64,))
-    return nibbles[..., ::-1]
+    nibbles = nibbles[..., ::-1]
+    if nibbles.ndim == 1:
+        return nibbles[:, None]
+    return jnp.swapaxes(nibbles, -1, -2)

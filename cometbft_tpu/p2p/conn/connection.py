@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ...utils.log import get_logger
+from ...utils.metrics import hub as _metrics_hub
 from ...utils.service import Service
 from ...wire import p2p_pb
 from ...wire.proto import decode_varint, encode_varint
@@ -201,7 +202,11 @@ class MConnection(Service):
                     pkt = st.next_packet()
                     if pkt is None:
                         break
-                    out += self._frame(p2p_pb.Packet(msg=pkt))
+                    frame = self._frame(p2p_pb.Packet(msg=pkt))
+                    _metrics_hub().p2p_send_bytes.inc(
+                        len(frame), ch_id=str(pkt.channel_id)
+                    )
+                    out += frame
                 if out:
                     self.send_monitor.throttle(len(out))
                     self.conn.write(bytes(out))
@@ -229,6 +234,9 @@ class MConnection(Service):
                 elif which == "pong":
                     self._last_pong = time.monotonic()
                 elif which == "msg":
+                    _metrics_hub().p2p_recv_bytes.inc(
+                        len(pkt.msg.data or b""), ch_id=str(pkt.msg.channel_id)
+                    )
                     self._recv_msg(pkt.msg)
                 else:
                     raise ValueError("empty packet")

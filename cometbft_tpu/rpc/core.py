@@ -116,10 +116,90 @@ class Environment:
             ],
         }
 
+    _GENESIS_CHUNK_SIZE = 2 * 1024 * 1024  # rpc/core/env.go:37
+
+    def _genesis_chunks(self) -> list[bytes]:
+        """Genesis JSON split into 2 MB chunks, computed once
+        (rpc/core/env.go genesis-chunks rules)."""
+        cached = getattr(self, "_gen_chunks", None)
+        if cached is None:
+            raw = self.node.genesis.to_json().encode()
+            n = self._GENESIS_CHUNK_SIZE
+            cached = [raw[i : i + n] for i in range(0, len(raw), n)] or [b""]
+            self._gen_chunks = cached
+        return cached
+
     def genesis(self) -> dict:
         import json as _json
 
+        if len(self._genesis_chunks()) > 1:
+            # rpc/core/net.go:113 ErrGenesisRespSize: oversized genesis
+            # must be fetched via /genesis_chunked
+            raise RPCError(
+                -32603,
+                "genesis response is large, please use the genesis_chunked API instead",
+            )
         return {"genesis": _json.loads(self.node.genesis.to_json())}
+
+    def genesis_chunked(self, chunk=0) -> dict:
+        """(rpc/core/net.go:131 GenesisChunked)"""
+        chunks = self._genesis_chunks()
+        cid = int(chunk or 0)
+        if cid < 0 or cid >= len(chunks):
+            raise RPCError(
+                -32603,
+                f"chunk id {cid} out of range: genesis has {len(chunks)} chunks",
+            )
+        return {
+            "chunk": str(cid),
+            "total": str(len(chunks)),
+            "data": b64(chunks[cid]),
+        }
+
+    # ------------------------------------------------- unsafe p2p controls
+
+    def _require_unsafe(self) -> None:
+        """Unsafe routes are registered only when rpc.unsafe is on
+        (rpc/core/routes.go:51-57 AddUnsafeRoutes); double-check at call
+        time so a misrouted dispatch can never dial on a safe node."""
+        if not getattr(self.node.config.rpc, "unsafe", False):
+            raise RPCError(
+                -32601, "unsafe RPC commands are disabled: set rpc.unsafe"
+            )
+
+    @staticmethod
+    def _addr_list(value) -> list[str]:
+        """Address-list param: JSON array (POST), JSON-encoded string or
+        comma-separated string (URI query) — never character iteration."""
+        if isinstance(value, str):
+            import json as _json
+
+            try:
+                parsed = _json.loads(value)
+                value = parsed if isinstance(parsed, list) else [str(parsed)]
+            except ValueError:
+                value = [s for s in value.split(",") if s]
+        return [str(v) for v in value]
+
+    def dial_seeds(self, seeds=None) -> dict:
+        """(rpc/core/net.go:55 UnsafeDialSeeds)"""
+        self._require_unsafe()
+        seeds = self._addr_list(seeds or [])
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        self.node.switch.dial_peers_async(seeds)
+        return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+    def dial_peers(self, peers=None, persistent=False, **_ignored) -> dict:
+        """(rpc/core/net.go:70 UnsafeDialPeers)"""
+        self._require_unsafe()
+        peers = self._addr_list(peers or [])
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+        if isinstance(persistent, str):
+            persistent = persistent.lower() in ("1", "true", "t")
+        self.node.switch.dial_peers_async(peers, persistent=bool(persistent))
+        return {"log": "Dialing peers in progress. See /net_info for details"}
 
     # ----------------------------------------------------------- blocks
 
@@ -410,17 +490,28 @@ class Environment:
     def abci_query(self, path="", data="", height=0, prove=False) -> dict:
         if isinstance(data, str):
             data = bytes.fromhex(data) if data else b""
+        if isinstance(prove, str):
+            prove = prove.lower() in ("1", "true", "t")
         resp = self.node.app_conns.query.query(
             abci.QueryRequest(
                 path=path, data=data, height=int(height or 0), prove=bool(prove)
             )
         )
+        proof_ops = None
+        if getattr(resp, "proof_ops", None) and resp.proof_ops.ops:
+            proof_ops = {
+                "ops": [
+                    {"type": op.type, "key": b64(op.key), "data": b64(op.data)}
+                    for op in resp.proof_ops.ops
+                ]
+            }
         return {
             "response": {
                 "code": resp.code,
                 "log": resp.log,
                 "key": b64(resp.key),
                 "value": b64(resp.value),
+                "proof_ops": proof_ops,
                 "height": str(resp.height),
             }
         }
@@ -578,6 +669,11 @@ ROUTES = {
     "status": ("", Environment.status),
     "net_info": ("", Environment.net_info),
     "genesis": ("", Environment.genesis),
+    "genesis_chunked": ("chunk", Environment.genesis_chunked),
+    # unsafe routes (reference gates behind config unsafe,
+    # rpc/core/routes.go:51-57); the handlers re-check rpc.unsafe
+    "dial_seeds": ("seeds", Environment.dial_seeds),
+    "dial_peers": ("peers,persistent", Environment.dial_peers),
     "block": ("height", Environment.block),
     "block_by_hash": ("hash", Environment.block_by_hash),
     "block_results": ("height", Environment.block_results),

@@ -72,8 +72,16 @@ class CompanionServiceServer(Service):
         abci_version: str = "2.1.0",
         p2p_version: int = 9,
         block_version: int = 11,
+        privileged: bool = False,
     ):
+        """privileged=False serves the public block/block-results/version
+        services and REJECTS pruning.* methods; privileged=True serves
+        ONLY pruning.*.  Mirrors the reference's grpc_laddr /
+        grpc_privileged_laddr split (node/node.go grpc server setup) so
+        operators can firewall the retain-height API separately from the
+        read-only data services."""
         super().__init__("CompanionServices")
+        self.privileged = privileged
         host, port = addr.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self.block_store = block_store
@@ -126,7 +134,7 @@ class CompanionServiceServer(Service):
                 if frame is None:
                     return
                 req = pb.ServiceRequest.decode(frame)
-                if req.method == "block.GetLatestHeight":
+                if req.method == "block.GetLatestHeight" and not self.privileged:
                     threading.Thread(
                         target=self._stream_latest_height,
                         args=(conn, send_mtx, req.id),
@@ -150,6 +158,15 @@ class CompanionServiceServer(Service):
 
     def _dispatch(self, req: pb.ServiceRequest) -> pb.ServiceResponse:
         try:
+            is_pruning = req.method.startswith("pruning.")
+            if is_pruning != self.privileged:
+                return pb.ServiceResponse(
+                    id=req.id,
+                    error=(
+                        f"method {req.method!r} not served on this listener "
+                        f"({'privileged' if self.privileged else 'public'})"
+                    ),
+                )
             handler = _HANDLERS.get(req.method)
             if handler is None:
                 return pb.ServiceResponse(

@@ -25,6 +25,9 @@ def _hkey(prefix: bytes, height: int) -> bytes:
     return prefix + struct.pack(">q", height)
 
 
+from ..store.block_store import _timed
+
+
 class StateStore:
     def __init__(self, db: DB):
         self._db = db
@@ -38,6 +41,7 @@ class StateStore:
             return None
         return State.from_proto(state_pb.StateProto.decode(raw))
 
+    @_timed
     def save(self, state: State) -> None:
         """Persist state + validator/params info for its next height
         (store.go:377)."""
@@ -88,6 +92,7 @@ class StateStore:
             info.validator_set = val_set.to_proto()
         self._db.set(_hkey(_VALIDATORS_PREFIX, height), info.encode())
 
+    @_timed
     def load_validators(self, height: int) -> ValidatorSet | None:
         raw = self._db.get(_hkey(_VALIDATORS_PREFIX, height))
         if raw is None:
@@ -144,6 +149,7 @@ class StateStore:
         info = state_pb.ABCIResponsesInfo(height=height, finalize_block=resp)
         self._db.set(_hkey(_ABCI_RESPONSES_PREFIX, height), info.encode())
 
+    @_timed
     def load_finalize_block_response(self, height: int) -> FinalizeBlockResponse | None:
         raw = self._db.get(_hkey(_ABCI_RESPONSES_PREFIX, height))
         if raw is None:

@@ -12,6 +12,26 @@ from ..types.block import Block, BlockID, Commit, ExtendedCommit
 from ..types.part_set import Part, PartSet
 from ..wire import types_pb as pb
 from .db import DB
+from ..utils.metrics import hub as _metrics_hub
+
+
+def _timed(fn):
+    """Store-op latency observer (reference: store metricsgen
+    BlockStoreAccessDurationSeconds, labeled by method)."""
+    import functools
+    import time as _t
+
+    @functools.wraps(fn)
+    def wrap(*a, **kw):
+        t0 = _t.perf_counter()
+        try:
+            return fn(*a, **kw)
+        finally:
+            _metrics_hub().store_access_seconds.observe(
+                _t.perf_counter() - t0, method=fn.__name__
+            )
+
+    return wrap
 
 _STATE_KEY = b"blockStore"
 
@@ -41,10 +61,12 @@ class BlockStore:
 
     # ------------------------------------------------------------- save
 
+    @_timed
     def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
         """(store.go:587)."""
         self._save(block, part_set, seen_commit, None)
 
+    @_timed
     def save_block_with_extended_commit(
         self, block: Block, part_set: PartSet, seen_extended_commit: ExtendedCommit
     ) -> None:
@@ -112,6 +134,7 @@ class BlockStore:
         raw = self._db.get(_h(b"H:", height))
         return pb.BlockMeta.decode(raw) if raw else None
 
+    @_timed
     def load_block(self, height: int) -> Block | None:
         """Reassemble a block from its parts (store.go:222)."""
         meta = self.load_block_meta(height)

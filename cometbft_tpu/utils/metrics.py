@@ -174,6 +174,101 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+class Hub:
+    """Process-global per-package metric sets, mirroring the reference's
+    metricsgen output per package (internal/consensus/metrics.go:33,
+    mempool/metrics.go, p2p/metrics.go, store metrics).  Subsystems call
+    sites hit these directly — no constructor plumbing — and the node
+    exposes the hub's registry on /metrics.  In multi-node test
+    processes the nodes share one hub (the multi-process e2e harness
+    gives each node its own process, hence its own hub).
+    """
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        # ---- consensus (internal/consensus/metrics.go:33)
+        self.cs_round_duration = r.histogram(
+            "consensus_round_duration_seconds",
+            "Time spent in a consensus round",
+            buckets=(0.1, 0.5, 1, 2, 4, 8, 16, 32, 64),
+        )
+        self.cs_validators_power = r.gauge(
+            "consensus_validators_power", "Total voting power of the validator set"
+        )
+        self.cs_missing_validators = r.gauge(
+            "consensus_missing_validators",
+            "Validators absent from the last commit",
+        )
+        self.cs_missing_validators_power = r.gauge(
+            "consensus_missing_validators_power",
+            "Voting power absent from the last commit",
+        )
+        self.cs_proposal_create_count = r.counter(
+            "consensus_proposal_create_count", "Proposals created by this node"
+        )
+        self.cs_proposal_receive_count = r.counter(
+            "consensus_proposal_receive_count",
+            "Proposals received (label status=accepted|rejected)",
+        )
+        self.cs_block_size_bytes = r.gauge(
+            "consensus_block_size_bytes", "Size of the latest block"
+        )
+        self.cs_late_votes = r.counter(
+            "consensus_late_votes", "Votes for earlier heights (label vote_type)"
+        )
+        self.cs_duplicate_vote = r.counter(
+            "consensus_duplicate_vote", "Exact-duplicate votes received"
+        )
+        self.cs_duplicate_block_part = r.counter(
+            "consensus_duplicate_block_part", "Duplicate block parts received"
+        )
+        # ---- mempool (mempool/metrics.go)
+        self.mp_tx_size_bytes = r.histogram(
+            "mempool_tx_size_bytes",
+            "Accepted tx sizes",
+            buckets=(32, 128, 512, 1024, 4096, 16384, 65536, 262144, 1048576),
+        )
+        self.mp_failed_txs = r.counter(
+            "mempool_failed_txs", "Txs rejected by CheckTx"
+        )
+        self.mp_evicted_txs = r.counter(
+            "mempool_evicted_txs", "Txs evicted (full mempool / TTL)"
+        )
+        self.mp_recheck_times = r.counter(
+            "mempool_recheck_times", "Txs re-checked after a block"
+        )
+        self.mp_already_received_txs = r.counter(
+            "mempool_already_received_txs", "Duplicate txs offered"
+        )
+        # ---- p2p (p2p/metrics.go)
+        self.p2p_send_bytes = r.counter(
+            "p2p_message_send_bytes_total", "Bytes sent (label ch_id)"
+        )
+        self.p2p_recv_bytes = r.counter(
+            "p2p_message_receive_bytes_total", "Bytes received (label ch_id)"
+        )
+        # ---- stores (store/metrics.go BlockStore access durations)
+        self.store_access_seconds = r.histogram(
+            "store_block_store_access_duration_seconds",
+            "Block/state store op latency (label method)",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0),
+        )
+
+
+_HUB: Hub | None = None
+_HUB_MTX = threading.Lock()
+
+
+def hub() -> Hub:
+    global _HUB
+    if _HUB is None:
+        with _HUB_MTX:
+            if _HUB is None:
+                _HUB = Hub()
+    return _HUB
+
+
 class NodeMetrics:
     """The node's metric set (the named subset of the reference's
     per-package metricsgen output that the QA dashboards read)."""

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from .canonical import Timestamp
 from .proto import Message, Field
-from .types_pb import ConsensusParamsProto, Duration
+from .types_pb import ConsensusParamsProto, Duration, ProofOps
 
 # CheckTxType (types.proto:82-91)
 CHECK_TX_TYPE_UNKNOWN = 0
@@ -364,6 +364,7 @@ class QueryResponse(Message):
         Field(5, "index", "varint"),
         Field(6, "key", "bytes"),
         Field(7, "value", "bytes"),
+        Field(8, "proof_ops", "message", ProofOps),
         Field(9, "height", "varint"),
         Field(10, "codespace", "string"),
     ]

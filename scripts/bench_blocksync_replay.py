@@ -1,0 +1,83 @@
+"""BASELINE config 5: blocksync streamed replay — commits of a V-validator
+set streamed to the device through the double-buffered pipeline
+(cometbft_tpu/blocksync/replay.py).  Prints one JSON line with blocks/s
+and sigs/s.  Reference hot path: internal/blocksync/reactor.go:547
+(VerifyCommitLight per replayed block, serial on CPU: ~V * 27.5 us).
+
+  BENCH_V       validators per commit   (default 5000)
+  BENCH_BLOCKS  commits streamed        (default 64)
+  BENCH_DISTINCT distinct commits to synthesize (cycled; default 8)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+V = int(os.environ.get("BENCH_V", "5000"))
+BLOCKS = int(os.environ.get("BENCH_BLOCKS", "64"))
+DISTINCT = int(os.environ.get("BENCH_DISTINCT", "8"))
+
+
+def main() -> None:
+    from cometbft_tpu.blocksync.replay import CommitStreamVerifier
+    from cometbft_tpu.crypto import ed25519 as host
+    from cometbft_tpu.models import comb_verifier as cv
+
+    rng = np.random.default_rng(11)
+    keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(V)]
+    pubs = [k.pub_key().data for k in keys]
+
+    t0 = time.perf_counter()
+    entry = cv.global_cache().ensure(pubs)
+    build_s = time.perf_counter() - t0
+
+    # a handful of distinct synthetic commits (distinct heights -> distinct
+    # sign bytes), cycled through the stream; the device does full work per
+    # block either way
+    commits = []
+    for h in range(DISTINCT):
+        items = []
+        for i, sk in enumerate(keys):
+            msg = (
+                b"\x08\x02\x11" + h.to_bytes(8, "little")
+                + i.to_bytes(8, "big") + b"|replay-bench"
+            )
+            items.append((pubs[i], msg, sk.sign(msg)))
+        commits.append(items)
+
+    stream = (commits[b % DISTINCT] for b in range(BLOCKS))
+    sv = CommitStreamVerifier(entry, depth=2)
+
+    # warmup: one commit end-to-end (compile)
+    for out in CommitStreamVerifier(entry, depth=1).run(iter([commits[0]])):
+        assert out[0]
+
+    t0 = time.perf_counter()
+    n_ok = 0
+    for all_ok, per in sv.run(stream):
+        assert all_ok and len(per) == V
+        n_ok += 1
+    dt = time.perf_counter() - t0
+    assert n_ok == BLOCKS
+    print(
+        json.dumps(
+            {
+                "metric": "blocksync_replay_blocks_per_s",
+                "value": round(BLOCKS / dt, 2),
+                "unit": "blocks/s",
+                "v_validators": V,
+                "blocks": BLOCKS,
+                "sigs_per_s": round(BLOCKS * V / dt, 1),
+                "table_build_s": round(build_s, 1),
+                "go_cpu_baseline_blocks_per_s": round(1e6 / (V * 27.5), 2),
+                "vs_baseline": round((BLOCKS / dt) * (V * 27.5) / 1e6, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,24 @@
 """Phase profiler for the comb-cached VerifyCommit kernel: table build,
 scalar reduce, R decompression, A/B comb loops, single field ops — run on
-the real chip to direct optimization (numbers recorded in BASELINE.md)."""
+the real chip to direct optimization (numbers recorded in BASELINE.md).
+
+Layout note: field elements are limbs-first (..., 22, V) since round 4
+(see ops/field.py); the comb tables are (64, 16, 3, 22, V)."""
 import sys, os, time, hashlib
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax, jax.numpy as jnp
 from jax import lax
-from cometbft_tpu.ops import comb, ed25519 as E, field as F, scalar
+from cometbft_tpu.ops import comb, ed25519 as E, field as F, scalar, sha2
 from cometbft_tpu.crypto import ed25519 as host
 
-V = 10_000
+V = int(os.environ.get("COMBPROF_V", "10000"))
 TDIR = "/tmp/combprof"
 rng = np.random.default_rng(7)
 keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(V)]
 pubs = [k.pub_key().data for k in keys]
 
-tp, vp = os.path.join(TDIR,"tables.npy"), os.path.join(TDIR,"valid.npy")
+tp, vp = os.path.join(TDIR, f"tablesT{V}.npy"), os.path.join(TDIR, f"validT{V}.npy")
 if os.path.exists(tp) and os.path.exists(vp):
     t0=time.time()
     tables = jnp.asarray(np.load(tp, mmap_mode="r"))
@@ -25,7 +28,7 @@ if os.path.exists(tp) and os.path.exists(vp):
 else:
     t0=time.time()
     a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(-1,32)
-    tables, valid = jax.jit(comb.build_a_tables)(jnp.asarray(a))
+    tables, valid = comb.build_a_tables_jit(jnp.asarray(a))
     tables.block_until_ready()
     print("tables built", round(time.time()-t0,1), "s", flush=True)
     if os.environ.get("COMBPROF_SAVE") == "1":
@@ -53,34 +56,42 @@ def timeit(name, f, *args):
 
 timeit("full verify_cached", jax.jit(comb.verify_cached), tables, valid, ra, sa, da, bt)
 
-timeit("scalar+nibbles", jax.jit(lambda d: comb.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(d, scalar.NL_X)), comb.NPOS_A)), da)
+# device SHA-512 digest phase (the engine path hashes on device now)
+msgs = [b"m%d" % i for i in range(V)]
+blocks, active = sha2.pad_messages_sha512([s_all[i].tobytes() for i in range(V)])
+timeit("sha512 digests", jax.jit(sha2.sha512_blocks), jnp.asarray(blocks), jnp.asarray(active))
+
+timeit("scalar+nibbles", jax.jit(lambda d: scalar.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(d, scalar.NL_X)), comb.NPOS_A)), da)
 timeit("decompress R", jax.jit(lambda r: E.decompress(r)[0].x), ra)
 
 @jax.jit
 def a_loop(tables, dig):
-    k_dig = comb.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(dig, scalar.NL_X)), comb.NPOS_A)
+    k_dig = scalar.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(dig, scalar.NL_X)), comb.NPOS_A)
+    ents = jnp.arange(comb.NENT_A, dtype=jnp.int32)[:, None]
     def a_body(i, acc):
-        slab = lax.dynamic_index_in_dim(tables, i, axis=1, keepdims=False)
-        d = lax.dynamic_index_in_dim(k_dig, i, axis=-1, keepdims=False)
-        onehot=(d[:,None]==jnp.arange(comb.NENT_A,dtype=jnp.int32)).astype(jnp.int32)
-        sel=jnp.einsum("vj,vjck->vck",onehot,slab,precision=lax.Precision.HIGHEST)
-        return E.add_niels(acc, E.Niels(sel[:,0],sel[:,1],sel[:,2]))
+        slab = lax.dynamic_index_in_dim(tables, i, axis=0, keepdims=False)
+        d = lax.dynamic_index_in_dim(k_dig, i, axis=0, keepdims=False)
+        onehot=(ents == d[None,:]).astype(jnp.int32)
+        sel=jnp.sum(slab*onehot[:,None,None,:],axis=0)
+        return E.add_niels(acc, E.Niels(sel[0],sel[1],sel[2]))
     return lax.fori_loop(0, comb.NPOS_A, a_body, E.identity((dig.shape[0],))).x
 timeit("A loop", a_loop, tables, da)
 
 @jax.jit
 def b_loop(bt, s):
     s_dig = scalar.bytes_to_limbs(s, comb.NPOS_B)
+    ents = jnp.arange(comb.NENT_B, dtype=jnp.int32)[:, None]
     def b_body(i, acc):
         slab = lax.dynamic_index_in_dim(bt, i, axis=0, keepdims=False)
-        d = lax.dynamic_index_in_dim(s_dig, i, axis=-1, keepdims=False)
-        onehot=(d[:,None]==jnp.arange(comb.NENT_B,dtype=jnp.int32)).astype(jnp.float32)
-        sel=(jnp.matmul(onehot,slab,precision=lax.Precision.HIGHEST).astype(jnp.int32).reshape(-1,3,F.NLIMBS))
-        return E.add_niels(acc, E.Niels(sel[:,0],sel[:,1],sel[:,2]))
+        d = lax.dynamic_index_in_dim(s_dig, i, axis=0, keepdims=False)
+        onehot=(ents == d[None,:]).astype(jnp.float32)
+        sel=jnp.matmul(slab,onehot,precision=lax.Precision.HIGHEST).astype(jnp.int32)
+        return E.add_niels(acc, E.Niels(sel[0:22],sel[22:44],sel[44:66]))
     return lax.fori_loop(0, comb.NPOS_B, b_body, E.identity((s.shape[0],))).x
 timeit("B loop", b_loop, bt, sa)
 
-x = jnp.ones((V, F.NLIMBS), jnp.int32)
+x = jnp.ones((F.NLIMBS, V), jnp.int32)
 timeit("1 field mul", jax.jit(F.mul), x, x)
+timeit("100 field muls", jax.jit(lambda a,b: lax.fori_loop(0,100,lambda _,v: F.mul(v,b), a)), x, x)
 nl = E.Niels(x, x, x)
 timeit("1 add_niels", jax.jit(lambda p, a,b,c: E.add_niels(p, E.Niels(a,b,c)).x), E.identity((V,)), x,x,x)

@@ -1,0 +1,52 @@
+"""Streamed commit-replay pipeline (blocksync/replay.py): ordering,
+blame, and fallback through the double-buffered device stream."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # comb kernel compile on the CPU backend
+
+from cometbft_tpu.blocksync.replay import CommitStreamVerifier
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.models import comb_verifier as cv
+
+
+def test_commit_stream_pipeline_order_and_blame():
+    n = 8
+    keys = [host.PrivKey.from_seed(bytes([i + 30]) * 32) for i in range(n)]
+    pubs = [k.pub_key().data for k in keys]
+    entry = cv.ValsetCombCache().ensure(pubs)
+
+    def commit(h, tamper=None):
+        items = []
+        for i, sk in enumerate(keys):
+            msg = b"replay-%d-%d" % (h, i)
+            sig = sk.sign(msg)
+            if i == tamper:
+                msg += b"!"
+            items.append((pubs[i], msg, sig))
+        return items
+
+    commits = [commit(h, tamper=3 if h == 2 else None) for h in range(5)]
+    outs = list(CommitStreamVerifier(entry, depth=2).run(iter(commits)))
+    assert len(outs) == 5
+    for h, (all_ok, per) in enumerate(outs):
+        if h == 2:
+            assert not all_ok and per == [i != 3 for i in range(n)]
+        else:
+            assert all_ok and per == [True] * n, f"block {h}"
+
+    # subset commit (absent validators) rides the same pipeline
+    outs = list(
+        CommitStreamVerifier(entry, depth=2).run(iter([commits[0][:5]]))
+    )
+    assert outs[0][0] and outs[0][1] == [True] * 5
+
+    # a foreign key demotes that block to the uncached path, in order
+    alien = host.PrivKey.from_seed(bytes([99]) * 32)
+    bad = commits[1][:4] + [
+        (alien.pub_key().data, b"alien", alien.sign(b"alien"))
+    ]
+    outs = list(CommitStreamVerifier(entry, depth=2).run(iter([commits[0], bad])))
+    assert outs[0][0]
+    assert outs[1][0] and len(outs[1][1]) == 5

@@ -137,3 +137,76 @@ def test_proof_of_possession():
     assert not bls.pop_verify(other, proof)
     # an ordinary signature over pk bytes is NOT a PoP (different DST)
     assert not bls.pop_verify(pk, sk.sign(pk.data))
+
+
+def test_svdw_exceptional_inputs_map_to_curve():
+    """RFC 9380 inv0 convention: u with (1 ± g(Z)·u²) = 0 (tv3 == 0) must
+    map onto the curve instead of crashing (the old x=Z special case
+    raised TypeError when g(Z) was non-square)."""
+    from cometbft_tpu.crypto import bls12381 as B
+
+    hit = 0
+    for sign in (1, -1):
+        tgt = B.f2_inv(B._SVDW_GZ)
+        if sign == -1:
+            tgt = B.f2_neg(tgt)
+        u = B.f2_sqrt(tgt)
+        if u is None:
+            continue
+        hit += 1
+        x, y = B._map_to_curve_svdw(u)
+        g = B.f2_add(B.f2_mul(B.f2_sqr(x), x), B._FP2.b)
+        assert B.f2_sqr(y) == g, "mapped point must satisfy y^2 = g(x)"
+    assert hit, "at least one exceptional u exists in Fp2"
+
+
+def test_native_pairing_core_matches_python():
+    """native/bls381.cc must agree with the pure-Python pairing: full
+    pairing values coefficient-by-coefficient, and the product check on
+    both a valid signature relation and a broken one."""
+    import ctypes
+    import random
+
+    from cometbft_tpu.crypto import bls12381 as B
+
+    lib = B._native_pairing_lib()
+    if lib is None:
+        import pytest
+
+        pytest.skip("native pairing core unavailable")
+    lib.bls381_pairing.restype = None
+
+    rnd = random.Random(7)
+    for _ in range(2):
+        k1 = rnd.randrange(1, B.R)
+        k2 = rnd.randrange(1, B.R)
+        p = B._to_affine(B._FP, B._jac_mul(B._FP, B._from_affine(B._FP, B.G1_GEN), k1))
+        q = B._to_affine(
+            B._FP2, B._jac_mul(B._FP2, B._from_affine(B._FP2, B.G2_GEN), k2)
+        )
+        want = B._final_exp(B._miller(q, p))
+        a1 = (ctypes.c_uint64 * 12)(*(B._limbs6(p[0]) + B._limbs6(p[1])))
+        a2 = (ctypes.c_uint64 * 24)(
+            *(B._limbs6(q[0][0]) + B._limbs6(q[0][1])
+              + B._limbs6(q[1][0]) + B._limbs6(q[1][1]))
+        )
+        out = (ctypes.c_uint64 * 72)()
+        lib.bls381_pairing(a1, a2, out)
+        got = tuple(
+            (
+                sum(out[i * 12 + j] << (64 * j) for j in range(6)),
+                sum(out[i * 12 + 6 + j] << (64 * j) for j in range(6)),
+            )
+            for i in range(6)
+        )
+        assert got == want
+
+    # bilinearity through the product check: e(-kP, Q) * e(P, kQ) == 1
+    k = rnd.randrange(2, B.R)
+    kp = B._to_affine(B._FP, B._jac_mul(B._FP, B._from_affine(B._FP, B.G1_GEN), k))
+    nkp = (kp[0], (-kp[1]) % B.P)
+    kq = B._to_affine(B._FP2, B._jac_mul(B._FP2, B._from_affine(B._FP2, B.G2_GEN), k))
+    g1 = B._to_affine(B._FP, B._from_affine(B._FP, B.G1_GEN))
+    g2 = B._to_affine(B._FP2, B._from_affine(B._FP2, B.G2_GEN))
+    assert B._pairings_product_is_one([(nkp, g2), (g1, kq)])
+    assert not B._pairings_product_is_one([(kp, g2), (g1, kq)])

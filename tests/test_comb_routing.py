@@ -20,6 +20,8 @@ def _fake_entry(pubs, good_rows=None):
     e.valid = None
     e.index = {pk: i for i, pk in enumerate(pubs)}
     e.size = len(pubs)
+    e.vpad = len(pubs)
+    e.mesh = None
 
     def fake_verify(tables, valid, packed, active):
         packed = np.asarray(packed)
@@ -124,9 +126,12 @@ def test_incremental_churn_reuses_rows(monkeypatch):
     def fake_build(a):
         a = np.asarray(a)
         built_batches.append(a.shape[0])
-        # marker table: every row filled with the pubkey's first byte
+        # marker table (lanes minor, like the real layout): every lane
+        # filled with its pubkey's first byte
         t = jnp.asarray(
-            np.broadcast_to(a[:, :1, None], (a.shape[0], 4, 2)).astype(np.int32)
+            np.broadcast_to(a[None, None, :, 0], (4, 2, a.shape[0])).astype(
+                np.int32
+            )
         )
         return t, jnp.ones((a.shape[0],), bool)
 
@@ -136,20 +141,20 @@ def test_incremental_churn_reuses_rows(monkeypatch):
     pk = lambda x: bytes([x]) * 32
     e1 = c.ensure([pk(1), pk(2), pk(3)])
     assert built_batches == [3]
-    assert np.asarray(e1.tables)[:, 0, 0].tolist() == [1, 2, 3]
+    assert np.asarray(e1.tables)[0, 0, :].tolist() == [1, 2, 3]
 
     # churn: drop 3, add 9, reorder — only the fresh key is built (padded
     # to a power-of-two bucket of 1), other rows gathered from e1
     e2 = c.ensure([pk(2), pk(9), pk(1)])
     assert built_batches == [3, 1]
-    assert np.asarray(e2.tables)[:, 0, 0].tolist() == [2, 9, 1]
+    assert np.asarray(e2.tables)[0, 0, :].tolist() == [2, 9, 1]
     assert np.asarray(e2.valid).tolist() == [True, True, True]
     assert e2.index == {pk(2): 0, pk(9): 1, pk(1): 2}
 
     # three fresh keys pad to a 4-bucket; reused row still gathered
     e3 = c.ensure([pk(1), pk(5), pk(6), pk(7)])
     assert built_batches == [3, 1, 4]
-    assert np.asarray(e3.tables)[:, 0, 0].tolist() == [1, 5, 6, 7]
+    assert np.asarray(e3.tables)[0, 0, :].tolist() == [1, 5, 6, 7]
 
 
 def test_validator_set_pubkeys_cache_invalidation():

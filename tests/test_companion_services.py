@@ -22,31 +22,45 @@ def net():
     for i in range(6):
         h.step(1 + i, GENESIS_NS + (1 + i) * 2 * NS)
     pruner = Pruner(MemDB(), h.state_store, h.block_store)
+    # public and privileged listeners are split (rpc/services.py): the
+    # pruning retain-height API lives on its own firewallable port
     srv = CompanionServiceServer(
+        "127.0.0.1:0",
+        h.block_store,
+        h.state_store,
+        event_bus=h.event_bus,
+        node_version="0.1.0-test",
+    )
+    srv.start()
+    priv = CompanionServiceServer(
         "127.0.0.1:0",
         h.block_store,
         h.state_store,
         pruner=pruner,
         event_bus=h.event_bus,
         node_version="0.1.0-test",
+        privileged=True,
     )
-    srv.start()
+    priv.start()
     cli = CompanionServiceClient(srv.laddr)
-    yield h, srv, cli, pruner
+    pcli = CompanionServiceClient(priv.laddr)
+    yield h, srv, cli, pruner, pcli
     cli.close()
+    pcli.close()
     srv.stop()
+    priv.stop()
     h.stop()
 
 
 def test_version_service(net):
-    _, _, cli, _ = net
+    _, _, cli, _, _ = net
     v = cli.get_version()
     assert v.node == "0.1.0-test"
     assert v.abci and v.block > 0 and v.p2p > 0
 
 
 def test_block_service_get_by_height(net):
-    h, _, cli, _ = net
+    h, _, cli, _, _ = net
     resp = cli.get_by_height(3)
     assert resp.block.header.height == 3
     assert resp.block_id.hash == h.block_store.load_block_meta(3).block_id.hash
@@ -57,7 +71,7 @@ def test_block_service_get_by_height(net):
 
 
 def test_block_results_service(net):
-    h, _, cli, _ = net
+    h, _, cli, _, _ = net
     r = cli.get_block_results(4)
     assert r.height == 4
     assert r.app_hash == h.state_store.load_finalize_block_response(4).app_hash
@@ -66,7 +80,7 @@ def test_block_results_service(net):
 
 
 def test_latest_height_stream_follows_new_blocks(net):
-    h, _, cli, _ = net
+    h, _, cli, _, _ = net
     heights = []
     done = threading.Event()
 
@@ -91,7 +105,7 @@ def test_latest_height_stream_follows_new_blocks(net):
 
 
 def test_pruning_service_retain_heights(net):
-    h, _, cli, pruner = net
+    h, _, _, pruner, cli = net  # pruning rides the privileged listener
     cli.set_block_retain_height(4)
     got = cli.get_block_retain_height()
     assert got.pruning_service_retain_height == 4
@@ -115,8 +129,19 @@ def test_pruning_service_retain_heights(net):
     assert cli.get_block_indexer_retain_height() == 2
 
 
+def test_pruning_rejected_on_public_listener(net):
+    """The public listener must refuse pruning.* (privileged split —
+    reference: grpc_laddr vs grpc_privileged_laddr), and the privileged
+    listener must refuse the public services."""
+    _, _, cli, _, pcli = net
+    with pytest.raises(RuntimeError, match="not served on this listener"):
+        cli.set_block_retain_height(4)
+    with pytest.raises(RuntimeError, match="not served on this listener"):
+        pcli.get_version()
+
+
 def test_unknown_method_errors(net):
-    _, srv, cli, _ = net
+    _, srv, cli, _, _ = net
     from cometbft_tpu.wire import services_pb as spb
 
     with pytest.raises(RuntimeError, match="unknown method"):

@@ -24,7 +24,8 @@ def host_points(n, include_identity=False):
 
 def to_device(pts) -> E.Point:
     def limb(vals):
-        return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+        # limbs-first layout: (22, n)
+        return jnp.asarray(np.stack([F.to_limbs(v) for v in vals], axis=-1))
 
     return E.Point(
         limb([p[0] for p in pts]),
@@ -124,7 +125,7 @@ def test_niels_fixed_base_window():
     """j*B from the host-precomputed niels window table."""
     f = jax.jit(
         lambda idx: E.compress(
-            E.add_niels(E.identity(idx.shape), E.lookup_niels(E._B_WINDOW, idx))
+            E.add_niels(E.identity(idx.shape), E.lookup_niels(E._B_WINDOW_FLAT, idx))
         )
     )
     idx = jnp.asarray(np.array([0, 1, 5, 15], dtype=np.int32))

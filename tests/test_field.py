@@ -31,13 +31,14 @@ def rand_ints(n):
 
 
 def limbs_of(vals):
-    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+    """Values -> (22, n) limbs-first batch (lane axis minor)."""
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals], axis=-1))
 
 
 def ints_of(limbs):
-    """Freeze a batch on device, convert each row to a Python int."""
+    """Freeze a batch on device, convert each lane to a Python int."""
     fz = np.asarray(freeze_j(limbs))
-    return [F.from_limbs(fz[i]) for i in range(fz.shape[0])]
+    return [F.from_limbs(fz[:, i]) for i in range(fz.shape[-1])]
 
 
 def test_roundtrip():
@@ -69,12 +70,12 @@ def test_mul_of_uncarried_sums():
 
 def test_worst_case_bounds_no_overflow():
     """Adversarial limbs at the documented magnitude bounds."""
-    a = np.full((1, F.NLIMBS), 8204, dtype=np.int32)
+    a = np.full((F.NLIMBS, 1), 8204, dtype=np.int32)
     a[0, 0] = 14336
     b = -a.copy()
     for x, y in [(a, a), (a, b), (b, b)]:
         m = mul_j(jnp.asarray(x), jnp.asarray(y))
-        want = (F.from_limbs(x[0]) * F.from_limbs(y[0])) % F.P
+        want = (F.from_limbs(x[:, 0]) * F.from_limbs(y[:, 0])) % F.P
         assert ints_of(m) == [want]
 
 
@@ -112,8 +113,8 @@ def test_predicates():
     assert list(np.asarray(jax.jit(F.is_zero)(a))) == [True, False, False, False]
     assert list(np.asarray(jax.jit(F.is_negative)(a))) == [False, True, False, False]
     eq_j = jax.jit(F.eq)
-    assert bool(np.asarray(eq_j(a[:1], a[:1]))[0])
-    assert not bool(np.asarray(eq_j(a[0:1], a[1:2]))[0])
+    assert bool(np.asarray(eq_j(a[..., :1], a[..., :1]))[0])
+    assert not bool(np.asarray(eq_j(a[..., 0:1], a[..., 1:2]))[0])
 
 
 def test_mul_small():

@@ -54,6 +54,97 @@ def _light_client(rpc):
     )
 
 
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def test_abci_query_fail_closed_and_verified_proof():
+    """light/rpc/client.go:110-160 semantics: prove is forced, a valid
+    ValueOp chain against the NEXT header's app_hash passes, tampered
+    values and proofless responses are rejected (fail closed)."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.wire import abci_pb as apb
+
+    app = KVStoreApplication(merkle_state=True)
+    # through the real block flow: the app hash returned by FinalizeBlock
+    # must already commit to that block's writes (header h+1 carries it)
+    fin = app.finalize_block(
+        apb.FinalizeBlockRequest(
+            height=5, txs=[b"k1=v1", b"k2=v2", b"zz=v3"]
+        )
+    )
+    approot = fin.app_hash
+    app.commit(apb.CommitRequest())
+    assert app.app_hash() == approot  # stable across commit
+
+    class FakeRPC:
+        def __init__(self, app):
+            self.app = app
+
+        def abci_query(self, path, data, height=0, prove=False):
+            assert prove is True, "VerifyingClient must force prove=True"
+            r = self.app.query(
+                apb.QueryRequest(path=path, data=data, prove=prove)
+            )
+            ops = None
+            if getattr(r, "proof_ops", None) and r.proof_ops.ops:
+                ops = {
+                    "ops": [
+                        {"type": o.type, "key": _b64(o.key), "data": _b64(o.data)}
+                        for o in r.proof_ops.ops
+                    ]
+                }
+            return {
+                "response": {
+                    "code": r.code,
+                    "key": _b64(r.key),
+                    "value": _b64(r.value),
+                    "proof_ops": ops,
+                    "height": str(r.height),
+                }
+            }
+
+    class FakeLC:
+        def __init__(self, root):
+            self.root = root
+            self.asked = []
+
+        def verify_light_block_at_height(self, h):
+            self.asked.append(h)
+            hdr = type("H", (), {"app_hash": self.root})()
+            sh = type("SH", (), {"header": hdr})()
+            return type("LB", (), {"signed_header": sh})()
+
+    lc = FakeLC(approot)
+    vc = VerifyingClient(FakeRPC(app), lc)
+    out = vc.abci_query("/key", b"k1")
+    assert base64.b64decode(out["response"]["value"]) == b"v1"
+    assert lc.asked == [6]  # app hash of height-5 state lands in header 6
+
+    # tampered value must not verify
+    class TamperRPC(FakeRPC):
+        def abci_query(self, *a, **kw):
+            r = super().abci_query(*a, **kw)
+            r["response"]["value"] = _b64(b"evil")
+            return r
+
+    with pytest.raises(VerificationFailed, match="proof invalid"):
+        VerifyingClient(TamperRPC(app), FakeLC(approot)).abci_query("/key", b"k1")
+
+    # wrong root (lying header chain vs lying app) must not verify
+    with pytest.raises(VerificationFailed, match="proof invalid"):
+        VerifyingClient(FakeRPC(app), FakeLC(b"\x00" * 32)).abci_query(
+            "/key", b"k1"
+        )
+
+    # parity-mode kvstore ships no proofs: fail closed, never trust
+    plain = KVStoreApplication()
+    plain.db.set(b"kvPairKey:k1", b"v1")
+    plain.height = 5
+    with pytest.raises(VerificationFailed, match="no proof"):
+        VerifyingClient(FakeRPC(plain), FakeLC(approot)).abci_query("/key", b"k1")
+
+
 @pytest.mark.slow
 def test_json_parsers_roundtrip(live_node):
     _, rpc = live_node

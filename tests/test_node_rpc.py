@@ -158,6 +158,17 @@ def test_node_runs_and_serves_rpc(tmp_path):
         assert any(t["hash"] == tx_h for t in found["txs"])
         br = rpc.call("block_results", height=committed_h)
         assert br["txs_results"][0]["code"] == 0
+
+        # per-package call-site metrics moved during the run
+        # (internal/consensus/metrics.go:33 checklist analogues)
+        from cometbft_tpu.utils.metrics import hub as mhub
+
+        text = mhub().registry.expose_text()
+        assert "cometbft_consensus_round_duration_seconds_count" in text
+        assert mhub().cs_validators_power.value() > 0
+        assert mhub().cs_proposal_create_count.value() > 0
+        assert mhub().mp_tx_size_bytes._totals != {}
+        assert mhub().store_access_seconds._totals != {}
     finally:
         node.stop()
 
@@ -296,5 +307,64 @@ def test_extended_rpc_routes(tmp_path):
         raw = b64mod.b64encode(evidence_to_proto(ev).encode()).decode()
         out = rpc.call("broadcast_evidence", evidence=raw)
         assert out["hash"] == ev.hash().hex().upper()
+
+        # genesis_chunked (rpc/core/net.go:131): small genesis = 1 chunk
+        # that round-trips to the same doc
+        gc = rpc.call("genesis_chunked", chunk=0)
+        assert gc["total"] == "1" and gc["chunk"] == "0"
+        doc = json.loads(b64mod.b64decode(gc["data"]))
+        assert doc["chain_id"] == "ext-chain"
+        with pytest.raises(Exception, match="out of range"):
+            rpc.call("genesis_chunked", chunk=5)
+
+        # unsafe dial routes are disabled unless rpc.unsafe
+        # (rpc/core/routes.go:51-57)
+        with pytest.raises(Exception, match="unsafe"):
+            rpc.call("dial_seeds", seeds=["aa@127.0.0.1:1"])
+        node.config.rpc.unsafe = True
+        out = rpc.call("dial_peers", peers=["00" * 20 + "@127.0.0.1:1"])
+        assert "Dialing" in out["log"]
+        node.config.rpc.unsafe = False
     finally:
         node.stop()
+
+
+@pytest.mark.slow
+def test_cli_reindex_event(tmp_path):
+    """commands/reindex_event.go: offline re-index from the stores; the
+    rebuilt index serves the same tx and block-event lookups."""
+    home = _mk_home(tmp_path, "ri", chain_id="ri-chain")
+    cfg = _test_cfg(home)
+    cfg.base.db_backend = "sqlite"  # reindex is offline: needs a disk DB
+    save_config(cfg)
+    node = Node(cfg)
+    node.start()
+    try:
+        rpc = HTTPClient(node.rpc_server.listen_addr)
+        res = rpc.broadcast_tx_commit(b"reindex=me")
+        txhash = res["hash"]
+        assert _wait(
+            lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 3
+        )
+    finally:
+        node.stop()
+
+    assert cli_main(["--home", home, "reindex-event"]) == 0
+    assert (
+        cli_main(["--home", home, "reindex-event", "--start-height", "999"]) == 1
+    )
+
+    # the rebuilt kv index resolves the committed tx
+    from cometbft_tpu.indexer import TxIndexer
+    from cometbft_tpu.node import default_db_provider
+    from cometbft_tpu.store.db import PrefixDB
+
+    db = default_db_provider(load_config(home))
+    try:
+        rec = TxIndexer(PrefixDB(db, b"txi/")).get(bytes.fromhex(txhash))
+        assert rec is not None
+        import base64 as b64mod
+
+        assert b64mod.b64decode(rec["tx"]) == b"reindex=me"
+    finally:
+        db.close()

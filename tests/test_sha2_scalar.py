@@ -40,11 +40,11 @@ def test_reduce_mod_l():
     b = np.stack(
         [np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8) for v in vals]
     )
-    limbs = scalar.bytes_to_limbs(jnp.asarray(b), scalar.NL_X)
-    got = np.asarray(reduce_j(limbs))
+    limbs = scalar.bytes_to_limbs(jnp.asarray(b), scalar.NL_X)  # (43, n)
+    got = np.asarray(reduce_j(limbs))  # (22, n)
     for i, v in enumerate(vals):
         want = v % L
-        have = sum(int(got[i, k]) << (12 * k) for k in range(scalar.NL_S))
+        have = sum(int(got[k, i]) << (12 * k) for k in range(scalar.NL_S))
         assert have == want, f"case {i}"
 
 
@@ -61,7 +61,7 @@ def test_s_lt_l():
 def test_windows():
     v = int.from_bytes(rng.bytes(32), "little") % scalar.L
     b = jnp.asarray(np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)[None])
-    w = np.asarray(jax.jit(scalar.bytes_to_windows)(b))[0]
+    w = np.asarray(jax.jit(scalar.bytes_to_windows)(b))[:, 0]  # (64,)
     # MSB-first 4-bit windows reconstruct the value
     acc = 0
     for x in w:
@@ -69,5 +69,5 @@ def test_windows():
     assert acc == v
     # limb path agrees
     limbs = scalar.bytes_to_limbs(b, scalar.NL_S)
-    w2 = np.asarray(jax.jit(scalar.limbs_to_windows)(limbs))[0]
+    w2 = np.asarray(jax.jit(scalar.limbs_to_windows)(limbs))[:, 0]
     assert list(w2) == list(w)
