@@ -39,6 +39,7 @@ class BlocksyncReactor(Reactor):
         switch_interval: float = SWITCH_TO_CONSENSUS_INTERVAL,
     ):
         super().__init__("BLOCKSYNC")
+        self._switched = False  # one-shot consensus handoff latch
         store_height = store.height
         if store_height and state.last_block_height != store_height:
             raise RuntimeError(
@@ -330,7 +331,13 @@ class BlocksyncReactor(Reactor):
     # ------------------------------------------------- switch to consensus
 
     def _check_switch_to_consensus(self, state) -> bool:
-        """reactor.go:516 isCaughtUp + the SwitchToConsensus handoff."""
+        """reactor.go:516 isCaughtUp + the SwitchToConsensus handoff.
+
+        Single-shot: the handoff must never run twice (the consensus
+        reactor also guards, but the pool stop + mempool enable below
+        aren't idempotent either)."""
+        if self._switched:
+            return True
         caught_up, height, _ = self.pool.is_caught_up()
         blocks_chain = False
         if self.local_addr and state.validators is not None:
@@ -339,6 +346,7 @@ class BlocksyncReactor(Reactor):
             )
         if not (caught_up or blocks_chain):
             return False
+        self._switched = True
         self.logger.info(f"caught up at height {height}; switching to consensus")
         self.pool.stop()
         if self.switch is not None:

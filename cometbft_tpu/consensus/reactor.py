@@ -138,6 +138,7 @@ class ConsensusReactor(Reactor):
         super().__init__("ConsensusReactor")
         self.cs = cs
         self.wait_sync = wait_sync  # blocksync still running
+        self._switch_mtx = threading.Lock()  # guards the one-shot handoff
         self.logger = get_logger("cs-reactor")
         # the state machine tells us what to flood
         cs.broadcast_hook = self._on_internal_msg
@@ -164,10 +165,23 @@ class ConsensusReactor(Reactor):
             self.cs.stop()
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
-        """Blocksync → consensus handoff (reactor.go:117)."""
-        self.cs.update_to_state(state)
-        self.wait_sync = False
-        self.cs.start()
+        """Blocksync → consensus handoff (reactor.go:117).
+
+        Idempotent and locked: a duplicate handoff (pool double-signal)
+        must NOT re-run update_to_state on a running state machine — the
+        rs swap staleness-drops every scheduled timeout while the failed
+        re-start() schedules nothing new, wedging the node at the handoff
+        height with an empty queue and no pending timer (the post-restart
+        stall chased across rounds 3-4)."""
+        with self._switch_mtx:
+            if not self.wait_sync:
+                self.logger.error(
+                    "switch_to_consensus called again; ignoring duplicate"
+                )
+                return
+            self.cs.update_to_state(state)
+            self.wait_sync = False
+            self.cs.start()
 
     # ------------------------------------------------------------- peers
 

@@ -114,7 +114,7 @@ class ConsensusState(Service):
         self.state = None  # set by update_to_state
 
         self._queue: queue.Queue[MsgInfo | TimeoutInfo] = queue.Queue(maxsize=1000)
-        self._ticker = TimeoutTicker(self._enqueue)
+        self._ticker = TimeoutTicker(self._enqueue_timeout)
         self._thread: threading.Thread | None = None
         self._mtx = threading.RLock()
         self._replay_mode = False
@@ -151,6 +151,9 @@ class ConsensusState(Service):
         )
         self._thread.start()
         self._schedule_round0(self.rs)
+        threading.Thread(
+            target=self._watchdog_routine, name="cs-watchdog", daemon=True
+        ).start()
 
     def on_stop(self) -> None:
         self._ticker.stop()
@@ -163,12 +166,25 @@ class ConsensusState(Service):
     # --------------------------------------------------------- public API
 
     def _enqueue(self, item) -> None:
-        """Never block the caller (reactor/ticker threads): shed peer load
-        when the machine is saturated rather than deadlocking."""
+        """Never block the caller (reactor threads): shed peer load when
+        the machine is saturated rather than deadlocking."""
         try:
             self._queue.put_nowait(item)
         except queue.Full:
             self.logger.error("consensus queue full; dropping input")
+
+    def _enqueue_timeout(self, item) -> None:
+        """Timeouts are control-plane and must NEVER be shed: a dropped
+        round timeout leaves no pending timer and nothing scheduled — the
+        machine wedges until peer input arrives (one of the evaporating-
+        timeout paths behind the post-restart stalls).  The ticker thread
+        may safely block until the receive loop drains the queue."""
+        while self.is_running():
+            try:
+                self._queue.put(item, timeout=1.0)
+                return
+            except queue.Full:
+                self.logger.error("consensus queue full; RETRYING timeout enqueue")
 
     def add_vote(self, vote: Vote, peer_id: str = "") -> None:
         self._enqueue(MsgInfo(VoteMessage(vote), peer_id, time.time_ns()))
@@ -370,6 +386,63 @@ class ConsensusState(Service):
             self._try_add_vote(msg.vote, mi.peer_id)
         else:
             self.logger.error(f"unknown msg type {type(msg)}")
+
+    _WATCHDOG_INTERVAL = 10.0
+
+    def _watchdog_routine(self) -> None:
+        """Liveness backstop: if the machine sits at the same (H, R, S)
+        across two intervals with an EMPTY queue and NO pending timeout,
+        every scheduled timeout has evaporated (the class of bug behind
+        the post-restart stalls: stale-rs swaps, dropped ticker fires).
+        Re-kick by scheduling the current step's timeout; steps that wait
+        on peer input instead re-announce our round step so peers resend.
+        Healthy nodes never trigger: progress, a pending timer, or queued
+        input all reset the check."""
+        kickable = (
+            STEP_NEW_HEIGHT,
+            STEP_NEW_ROUND,
+            STEP_PROPOSE,
+            STEP_PREVOTE_WAIT,
+            STEP_PRECOMMIT_WAIT,
+        )
+        last = None
+        stalled_checks = 0
+        while self.is_running():
+            time.sleep(self._WATCHDOG_INTERVAL)
+            rs = self.rs
+            cur = (rs.height, rs.round, rs.step)
+            idle = (
+                cur == last
+                and self._ticker._pending is None
+                and self._queue.empty()
+            )
+            # deliberate idle: waiting for txs before proposing
+            # (create_empty_blocks=false) is not a stall
+            waiting_for_txs = (
+                rs.step == STEP_NEW_ROUND
+                and not self.config.create_empty_blocks
+                and self.tx_notifier is not None
+                and self.tx_notifier.size() == 0
+            )
+            if idle and not waiting_for_txs and not self._replay_mode:
+                stalled_checks += 1
+                if stalled_checks >= 2:
+                    self.logger.error(
+                        f"watchdog: no progress at h={cur[0]} r={cur[1]} "
+                        f"step={cur[2]}, no pending timeout — re-kicking"
+                    )
+                    if rs.step in kickable:
+                        self._ticker.schedule(
+                            TimeoutInfo(0.05, rs.height, rs.round, rs.step)
+                        )
+                    else:
+                        # waiting on votes/parts: re-announce so peers
+                        # re-route what we're missing
+                        self.on_new_round_step(rs)
+                    stalled_checks = 0
+            else:
+                stalled_checks = 0
+            last = cur
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs

@@ -26,6 +26,10 @@ class NodeSpec:
     name: str
     start_at: int = 0  # height to join at (0 = genesis)
     perturbations: list[str] = field(default_factory=list)  # kill|pause|restart
+    # per-link shaping (runner/latency_emulation.go analogue): outbound
+    # delay +- jitter applied at this node's sockets (utils/netutil)
+    latency_ms: float = 0.0
+    latency_jitter_ms: float = 0.0
 
 
 @dataclass
@@ -37,15 +41,22 @@ class Manifest:
 
 
 class E2ENode:
-    def __init__(self, name: str, home: str, rpc_port: int):
+    def __init__(self, name: str, home: str, rpc_port: int,
+                 latency_ms: float = 0.0, latency_jitter_ms: float = 0.0):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
+        self.latency_ms = latency_ms
+        self.latency_jitter_ms = latency_jitter_ms
         self.proc: subprocess.Popen | None = None
 
     def start(self) -> None:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        if self.latency_ms or self.latency_jitter_ms:
+            env["COMETBFT_TPU_TEST_LATENCY_MS"] = (
+                f"{self.latency_ms}:{self.latency_jitter_ms}"
+            )
         self.proc = subprocess.Popen(
             [
                 sys.executable, "-m", "cometbft_tpu",
@@ -138,7 +149,13 @@ class Runner:
             )
             save_config(cfg)
             self.nodes.append(
-                E2ENode(spec.name, home, self.base_port + 1000 + i)
+                E2ENode(
+                    spec.name,
+                    home,
+                    self.base_port + 1000 + i,
+                    latency_ms=spec.latency_ms,
+                    latency_jitter_ms=spec.latency_jitter_ms,
+                )
             )
 
     def start(self) -> None:

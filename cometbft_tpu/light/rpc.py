@@ -189,9 +189,12 @@ class VerifyingClient:
     """RPC client that refuses to return state it cannot verify
     (reference: light/rpc/client.go)."""
 
-    def __init__(self, rpc_client, light_client):
+    def __init__(self, rpc_client, light_client, next_header_timeout: float = 15.0):
         self.rpc = rpc_client
         self.lc = light_client
+        # how long abci_query waits for the header anchoring a fresh
+        # query result (one block interval on a live chain)
+        self.next_header_timeout = next_header_timeout
 
     # -- helpers
 
@@ -313,8 +316,34 @@ class VerifyingClient:
             raise
         except Exception as e:  # noqa: BLE001 — fail closed on any garbage
             raise VerificationFailed(f"abci_query: malformed response: {e}") from e
-        # the proven root is the app hash of the NEXT header
-        hdr = self._verified_header(rh + 1)
+        # The proven root is the app hash of the NEXT header, which only
+        # exists once block rh+1 commits — on a live chain that's one
+        # block interval away.  Wait for it briefly instead of failing:
+        # the captured value+proof stay anchored to state rh regardless
+        # of later writes (client.go waits for the next header the same
+        # way via WaitForHeight).
+        import time as _time
+
+        hdr = None
+        deadline = _time.monotonic() + self.next_header_timeout
+        while True:
+            try:
+                hdr = self._verified_header(rh + 1)
+                break
+            except (ErrHeightTooHigh, ErrLightBlockNotFound) as e:
+                # genuinely not produced yet: wait one block interval
+                if _time.monotonic() >= deadline:
+                    raise VerificationFailed(
+                        f"abci_query: header {rh + 1} unavailable: {e}"
+                    ) from e
+                _time.sleep(0.25)
+            except Exception as e:  # noqa: BLE001
+                # anything else (bad header, failed commit verification,
+                # divergence) is a real verification failure: fail fast,
+                # don't spin re-verifying a forged header for the timeout
+                raise VerificationFailed(
+                    f"abci_query: header {rh + 1} failed verification: {e}"
+                ) from e
         keypath = merkle.key_path_to_string([key])
         try:
             merkle.ProofOperators(ops).verify_value(hdr.app_hash, keypath, value)

@@ -34,7 +34,7 @@ class _CacheEntry:
     __slots__ = ("tables", "valid", "index", "size", "vpad", "mesh", "verify_fn")
 
     def __init__(self, tables, valid, index: dict[bytes, int], mesh=None):
-        self.tables = tables  # device (64, 16, 3, 22, Vpad) int32 — V minor
+        self.tables = tables  # device (64, 9, 3, 22, Vpad) int32 — V minor
         self.valid = valid  # device (Vpad,) bool
         self.index = index  # pubkey bytes -> row
         self.size = len(index)
@@ -82,7 +82,7 @@ def set_active_mesh(mesh) -> None:
 class ValsetCombCache:
     """LRU of device-resident comb tables, keyed by the pubkey list.
 
-    A 10k-validator entry is ~2.7 GB of HBM (270 KB/validator), so the
+    A 10k-validator entry is ~1.5 GB of HBM (152 KB/validator), so the
     LRU is small; consensus only ever needs the current set and, briefly,
     the previous one across a validator-set change.
     """
@@ -180,7 +180,7 @@ class ValsetCombCache:
         # compiled build shapes rather than one compile per distinct count,
         # and the gather/scatter assembly runs as one jitted program so XLA
         # fuses it instead of materializing intermediate full-size copies
-        # (an entry is ~2.7 GB at V=10k; transient copies would OOM HBM).
+        # (an entry is ~1.5 GB at V=10k; transient copies would OOM HBM).
         V = len(pubkeys)
         if fresh:
             bucket = 1 << (len(fresh) - 1).bit_length()

@@ -66,6 +66,15 @@ def make_app(cfg: Config):
         return local_client_creator(
             KVStoreApplication(lanes=default_lanes(), snapshot_interval=100)
         )
+    if pa == "kvstore-merkle":
+        # Merkle-committed state: app_hash is a root over the kv pairs and
+        # Query(prove=True) serves ValueOp proofs the light client can
+        # verify end-to-end (light/rpc.py abci_query)
+        return local_client_creator(
+            KVStoreApplication(
+                lanes=default_lanes(), snapshot_interval=100, merkle_state=True
+            )
+        )
     if pa == "noop":
         from .abci.types import BaseApplication
 

@@ -5,12 +5,14 @@ the 256 shared doublings.  For commit verification the pubkeys are known
 long in advance — the validator set changes rarely — so this module trades
 HBM for those doublings entirely:
 
-  - per-validator comb tables  T[i][j][v] = j * 16^i * (-A_v),  i<64, j<16,
-    in affine Niels form (y+x, y-x, 2dxy), built once per validator set and
-    kept device-resident (~270 KB/validator; a 10k-validator set is 2.7 GB
+  - per-validator comb tables  T[i][j][v] = j * 16^i * (-A_v),  i<64,
+    j<=8 (SIGNED digits: negative digits negate the entry at lookup, so
+    only half the entries are stored), in affine Niels form
+    (y+x, y-x, 2dxy), built once per validator set and kept
+    device-resident (~152 KB/validator; a 10k-validator set is 1.5 GB
     of the chip's 16 GB HBM).  This is the TPU analogue of the reference's
     expanded-pubkey LRU (crypto/ed25519/ed25519.go:43,68), scaled to the
-    whole validator set.  Layout (64, 16, 3, 22, V): the validator axis is
+    whole validator set.  Layout (64, 9, 3, 22, V): the validator axis is
     MINOR so every select/add runs with full lane utilization (see
     ops/field.py module doc).
   - a shared radix-4096 comb for the base point B:
@@ -41,7 +43,7 @@ from . import scalar
 from ..crypto import _ref25519 as ref
 
 NPOS_A = 64  # radix-16 comb positions for the k*(-A) part
-NENT_A = 16
+NENT_A = 9  # SIGNED digits: entries 0..8, sign applied at lookup
 NPOS_B = 22  # radix-4096 comb positions for the s*B part
 NENT_B = 4096
 
@@ -53,11 +55,16 @@ _D2_C = F.to_limbs(ref.D2)[:, None]  # (22, 1) broadcastable constant
 
 def build_a_tables(a_enc):
     """(V, 32) uint8 compressed pubkeys ->
-       (tables (64, 16, 3, 22, V) int32 affine-Niels, valid (V,) bool).
+       (tables (64, 9, 3, 22, V) int32 affine-Niels, valid (V,) bool).
 
-    Runs once per validator set.  Entries are normalized to affine with a
-    two-level Montgomery batch inversion (3 muls/entry amortized instead of
-    a ~265-mul chain each), so the per-verify additions are the cheap
+    Runs once per validator set.  Signed-digit comb: only entries
+    j = 0..8 are stored (the lookup negates for digits < 0), halving
+    both the HBM footprint and the per-position build work vs a 0..15
+    table.  Entries come from a double/add chain (4 doubles + 3 adds
+    per position; 16*P for the next position is one more double of the
+    8*P entry).  Entries are normalized to affine with a two-level
+    Montgomery batch inversion (3 muls/entry amortized instead of a
+    ~265-mul chain each), so the per-verify additions are the cheap
     7-multiply add_niels.
     """
     pt, valid = E.decompress(a_enc)
@@ -65,22 +72,27 @@ def build_a_tables(a_enc):
     V = a_enc.shape[0]
 
     def position_entries(p):
-        """[0..15]*p as stacked extended coords (16, 22, V) per coord."""
-        ident = E.identity((V,))
-        entries = [ident, p]
-        for _ in range(14):
-            entries.append(E.add(entries[-1], p))
+        """[0..8]*p as stacked extended coords (9, 22, V) per coord,
+        plus 16*p for the next position."""
+        e2 = E.double(p)
+        e3 = E.add(e2, p)
+        e4 = E.double(e2)
+        e5 = E.add(e4, p)
+        e6 = E.double(e3)
+        e7 = E.add(e6, p)
+        e8 = E.double(e4)
+        entries = [E.identity((V,)), p, e2, e3, e4, e5, e6, e7, e8]
+        p16 = E.double(e8)
         stack = lambda c: jnp.stack([getattr(e, c) for e in entries])
-        return stack("x"), stack("y"), stack("z"), stack("t")
+        return stack("x"), stack("y"), stack("z"), stack("t"), p16
 
     def body(i, carry):
         p, tx, ty, tz, tt = carry
-        ex, ey, ez, et = position_entries(p)
+        ex, ey, ez, et, p16 = position_entries(p)
         tx = lax.dynamic_update_index_in_dim(tx, ex, i, axis=0)
         ty = lax.dynamic_update_index_in_dim(ty, ey, i, axis=0)
         tz = lax.dynamic_update_index_in_dim(tz, ez, i, axis=0)
         tt = lax.dynamic_update_index_in_dim(tt, et, i, axis=0)
-        p16 = E.double(E.double(E.double(E.double(p))))
         return p16, tx, ty, tz, tt
 
     shape = (NPOS_A, NENT_A, F.NLIMBS, V)
@@ -247,7 +259,7 @@ def _b_tables_cached() -> np.ndarray:
 def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
     """Batched cofactored verification against cached comb tables.
 
-    tables   : (64, 16, 3, 22, V) int32 — build_a_tables output
+    tables   : (64, 9, 3, 22, V) int32 — build_a_tables output
     a_valid  : (V,) bool — per-row pubkey decompression success
     r_enc    : (V, 32) uint8 — signature R halves
     s_bytes  : (V, 32) uint8 — signature s halves
@@ -258,7 +270,9 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
     inputs; callers mask the result.
     """
     k_limbs = scalar.reduce_mod_l(scalar.bytes_to_limbs(k_digest, scalar.NL_X))
-    k_dig = scalar.nibbles_lsb(k_limbs, NPOS_A)  # (64, V) 4-bit digits
+    # signed radix-16 digits in [-8, 7]: |d| selects the entry, the sign
+    # flips the Niels point ((y+x, y-x, 2dxy) -> (y-x, y+x, -2dxy))
+    k_dig = scalar.signed_digits_radix16(k_limbs, NPOS_A)  # (64, V)
     s_ok = scalar.s_lt_l(s_bytes)
     # s as 22 x 12-bit digits, LSB first: exactly its base-2^12 limbs
     s_dig = scalar.bytes_to_limbs(s_bytes, NPOS_B)  # (22, V)
@@ -266,15 +280,20 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables):
     r_pt, r_valid = E.decompress(r_enc)
     V = r_enc.shape[0]
 
-    # ---- A part: acc += T[i][k_i][v], 64 adds, one-hot multiply-reduce
+    # ---- A part: acc += T[i][|k_i|][v] (sign-adjusted), 64 adds
     ents_a = jnp.arange(NENT_A, dtype=jnp.int32)[:, None]
 
     def a_body(i, acc):
         slab = lax.dynamic_index_in_dim(tables, i, axis=0, keepdims=False)
         dig = lax.dynamic_index_in_dim(k_dig, i, axis=0, keepdims=False)
-        onehot = (ents_a == dig[None, :]).astype(jnp.int32)  # (16, V)
+        neg = dig < 0
+        absd = jnp.abs(dig)
+        onehot = (ents_a == absd[None, :]).astype(jnp.int32)  # (9, V)
         sel = jnp.sum(slab * onehot[:, None, None, :], axis=0)  # (3, 22, V)
-        return E.add_niels(acc, E.Niels(sel[0], sel[1], sel[2]))
+        yplusx = F.select(neg, sel[1], sel[0])
+        yminusx = F.select(neg, sel[0], sel[1])
+        t2d = F.select(neg, -sel[2], sel[2])
+        return E.add_niels(acc, E.Niels(yplusx, yminusx, t2d))
 
     acc = lax.fori_loop(0, NPOS_A, a_body, E.identity((V,)))
 

@@ -135,6 +135,27 @@ def nibbles_lsb(limbs, n: int):
     return nib[..., :n, :]
 
 
+def signed_digits_radix16(limbs, n: int):
+    """(..., 22, L) limbs -> (n, ..., L) signed radix-16 digits, LSB
+    first: value == sum d_i 16^i with d_i in [-8, 7].
+
+    The signed recode halves the comb table (entries 1..8 plus sign
+    instead of 0..15): d = nibble + carry; d >= 8 borrows 16 from the
+    next digit.  For scalars < 2^253 (k mod L) the top nibble is <= 2,
+    so the final carry never overflows into a 65th digit.
+    """
+    nib = jnp.moveaxis(nibbles_lsb(limbs, n), -2, 0)  # (n, ..., L)
+
+    def step(c, nv):
+        d = nv + c
+        ge = (d >= 8).astype(nv.dtype)
+        return ge, d - 16 * ge
+
+    carry0 = jnp.zeros(nib.shape[1:], nib.dtype)
+    _, ds = lax.scan(step, carry0, nib)
+    return ds
+
+
 def limbs_to_windows(limbs):
     """(..., 22, L) base-2^12 limbs -> (..., 64, L) 4-bit windows, MSB first.
 

@@ -46,8 +46,10 @@ class Peer(Service):
             extra["send_rate"] = send_rate
         if recv_rate is not None:
             extra["recv_rate"] = recv_rate
+        from ..utils.netutil import maybe_shape_latency
+
         self.mconn = MConnection(
-            conn,
+            maybe_shape_latency(conn),
             stream_descs,
             on_receive=lambda sid, msg: on_receive(sid, self, msg),
             on_error=lambda e: on_error(self, e),

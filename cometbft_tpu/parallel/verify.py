@@ -85,11 +85,11 @@ def _comb_verify_fn(mesh: Mesh):
     path (models/comb_verifier.py) over a device mesh.
 
     Shardings: the comb tables' VALIDATOR axis (their minor lane axis,
-    ops/comb.py layout (64, 16, 3, 22, V)) and every per-call row array
+    ops/comb.py layout (64, 9, 3, 22, V)) and every per-call row array
     shard over "sig"; the 24 MB base-point table is replicated.  A psum
     over bad counts yields the global all-ok bit; the per-validator
     bitmap is all_gathered and packed on every device (replicated).
-    A 10k-validator set's 2.7 GB of tables become ~340 MB per chip on an
+    A 10k-validator set's 1.5 GB of tables become ~190 MB per chip on an
     8-chip mesh — the component that most needs sharding.
     """
     axis = mesh.axis_names[0]

@@ -20,3 +20,90 @@ def close_socket(sock: socket.socket | None) -> None:
         sock.close()
     except OSError:
         pass
+
+
+class LatencyConn:
+    """Test-only link shaping: delays every outbound write by
+    delay_ms ± jitter_ms before it reaches the wrapped connection,
+    preserving pipelining (writes are queued with delivery deadlines and
+    drained by a pump thread, so latency does not serialize bandwidth).
+    The e2e runner's analogue of the reference's tc-netem emulation
+    (test/e2e/runner/latency_emulation.go), applied at the socket layer
+    because the multi-process localnet shares one network namespace.
+    Sender-side-only delay: a link's RTT is the sum of both ends'
+    configured delays.
+    """
+
+    def __init__(self, inner, delay_ms: float, jitter_ms: float = 0.0):
+        import queue
+        import random
+        import threading
+        import time
+
+        self._inner = inner
+        self._delay = max(0.0, delay_ms) / 1e3
+        self._jitter = max(0.0, jitter_ms) / 1e3
+        self._rand = random.Random()
+        self._q: "queue.Queue" = queue.Queue()
+        self._time = time
+        self._closed = False
+        self._dead = False  # pump hit a write error: surface it to senders
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True, name="latency-conn"
+        )
+        self._pump_thread.start()
+
+    def _pump(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            deliver_at, data = item
+            wait = deliver_at - self._time.monotonic()
+            if wait > 0:
+                self._time.sleep(wait)
+            try:
+                self._inner.write(data)
+            except Exception:  # noqa: BLE001 — conn died; senders must see it
+                self._dead = True
+                return
+
+    def write(self, data: bytes) -> int:
+        if self._closed or self._dead:
+            raise OSError("connection closed")
+        deliver_at = self._time.monotonic() + self._delay + (
+            self._rand.random() * self._jitter
+        )
+        self._q.put((deliver_at, bytes(data)))
+        return len(data)
+
+    def read(self, n: int) -> bytes:
+        return self._inner.read(n)
+
+    def close(self) -> None:
+        # flush: writes already acknowledged to the caller must reach the
+        # wire before the inner conn closes (bounded by the max shaping
+        # delay; a dead pump skips the wait)
+        self._closed = True
+        self._q.put(None)
+        if not self._dead:
+            self._pump_thread.join(timeout=self._delay + self._jitter + 1.0)
+        self._inner.close()
+
+
+def maybe_shape_latency(conn):
+    """Wrap conn in LatencyConn when COMETBFT_TPU_TEST_LATENCY_MS is set
+    (value 'delay' or 'delay:jitter', milliseconds).  Production nodes
+    never set it; the e2e runner sets it per node process."""
+    import os
+
+    spec = os.environ.get("COMETBFT_TPU_TEST_LATENCY_MS", "")
+    if not spec:
+        return conn
+    try:
+        if ":" in spec:
+            d, j = spec.split(":", 1)
+            return LatencyConn(conn, float(d), float(j))
+        return LatencyConn(conn, float(spec))
+    except ValueError:
+        return conn

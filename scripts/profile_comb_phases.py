@@ -3,7 +3,7 @@ scalar reduce, R decompression, A/B comb loops, single field ops — run on
 the real chip to direct optimization (numbers recorded in BASELINE.md).
 
 Layout note: field elements are limbs-first (..., 22, V) since round 4
-(see ops/field.py); the comb tables are (64, 16, 3, 22, V)."""
+(see ops/field.py); the comb tables are (64, 9, 3, 22, V)."""
 import sys, os, time, hashlib
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
@@ -66,14 +66,15 @@ timeit("decompress R", jax.jit(lambda r: E.decompress(r)[0].x), ra)
 
 @jax.jit
 def a_loop(tables, dig):
-    k_dig = scalar.nibbles_lsb(scalar.reduce_mod_l(scalar.bytes_to_limbs(dig, scalar.NL_X)), comb.NPOS_A)
+    k_dig = scalar.signed_digits_radix16(scalar.reduce_mod_l(scalar.bytes_to_limbs(dig, scalar.NL_X)), comb.NPOS_A)
     ents = jnp.arange(comb.NENT_A, dtype=jnp.int32)[:, None]
     def a_body(i, acc):
         slab = lax.dynamic_index_in_dim(tables, i, axis=0, keepdims=False)
         d = lax.dynamic_index_in_dim(k_dig, i, axis=0, keepdims=False)
-        onehot=(ents == d[None,:]).astype(jnp.int32)
+        neg = d < 0
+        onehot=(ents == jnp.abs(d)[None,:]).astype(jnp.int32)
         sel=jnp.sum(slab*onehot[:,None,None,:],axis=0)
-        return E.add_niels(acc, E.Niels(sel[0],sel[1],sel[2]))
+        return E.add_niels(acc, E.Niels(F.select(neg, sel[1], sel[0]), F.select(neg, sel[0], sel[1]), F.select(neg, -sel[2], sel[2])))
     return lax.fori_loop(0, comb.NPOS_A, a_body, E.identity((dig.shape[0],))).x
 timeit("A loop", a_loop, tables, da)
 

@@ -1,0 +1,35 @@
+"""Fast-tier smoke of the flagship verify path: one comb-cached
+round-trip through the crypto/batch seam (round-4 verdict item — kernel
+regressions must surface every fast-tier run, not once per slow-tier
+run).  Shapes match tests/test_comb.py's (V=8, single SHA-512 block) so
+a warm persistent compile cache makes this seconds; a cold cache pays
+one small-V compile, far below the 10k-lane programs the slow tier
+builds."""
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.models.comb_verifier import CombBatchVerifier
+
+
+def test_comb_verify_smoke(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "8")
+    n = 8
+    keys = [host.PrivKey.from_seed(bytes([40 + i]) * 32) for i in range(n)]
+    pubs = [k.pub_key().data for k in keys]
+    items = [
+        (pubs[i], b"route-%d" % i, keys[i].sign(b"route-%d" % i))
+        for i in range(n)
+    ]
+
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    assert isinstance(bv, CombBatchVerifier)
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert ok and per == [True] * n
+
+    bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    for i, (p, m, s) in enumerate(items):
+        bv.add(p, m + (b"x" if i == 2 else b""), s)
+    ok, per = bv.verify()
+    assert not ok and per == [i != 2 for i in range(n)]

@@ -146,6 +146,65 @@ def test_abci_query_fail_closed_and_verified_proof():
 
 
 @pytest.mark.slow
+def test_verified_abci_query_live(tmp_path):
+    """Full loop on a live chain: kvstore-merkle commits a Merkle state
+    root as app_hash, and the light client verifies an abci_query value
+    against the NEXT verified header (light/rpc/client.go semantics)."""
+    from test_node_rpc import _mk_home, _test_cfg, _wait  # noqa: F811
+
+    home = _mk_home(tmp_path, "vq", chain_id="vq-chain")
+    cfg = _test_cfg(home)
+    cfg.base.proxy_app = "kvstore-merkle"
+    node = Node(cfg)
+    node.start()
+    try:
+        rpc = HTTPClient(node.rpc_server.listen_addr)
+        assert _wait(
+            lambda: int(rpc.status()["sync_info"]["latest_block_height"]) >= 2
+        )
+        res = rpc.broadcast_tx_commit(b"vk=vv")
+        assert int(res["tx_result"].get("code", 0) or 0) == 0
+        vc = VerifyingClient(
+            rpc, _light_client_for(rpc, "vq-chain"), next_header_timeout=60.0
+        )
+        out = vc.abci_query("/key", b"vk")
+        assert base64.b64decode(out["response"]["value"]) == b"vv"
+
+        # a value the chain never committed must not verify
+        class Tamper:
+            def __getattr__(self, name):
+                return getattr(rpc, name)
+
+            def abci_query(self, path, data, height=0, prove=False):
+                r = rpc.abci_query(path, data, height=height, prove=prove)
+                r["response"]["value"] = _b64(b"forged")
+                return r
+
+        with pytest.raises(VerificationFailed):
+            VerifyingClient(Tamper(), _light_client_for(rpc, "vq-chain")).abci_query(
+                "/key", b"vk"
+            )
+    finally:
+        node.stop()
+
+
+def _light_client_for(rpc, chain_id):
+    provider = HTTPProvider(chain_id, rpc)
+    lb1 = provider.light_block(1)
+    return Client(
+        chain_id,
+        TrustOptions(
+            period_ns=3600 * 10**9,
+            height=1,
+            hash=lb1.signed_header.header.hash(),
+        ),
+        primary=provider,
+        witnesses=[],
+        store=LightStore(MemDB()),
+    )
+
+
+@pytest.mark.slow
 def test_json_parsers_roundtrip(live_node):
     _, rpc = live_node
     c = rpc.commit(2)
