@@ -41,11 +41,21 @@ def generate(seed: int) -> Manifest:
         # never perturb the late node and at most half the net
         if p and i != late_slot and sum(bool(s.perturbations) for s in nodes) < n // 2:
             perturbations = [p]
+        # WAN-link emulation on ~1/4 of nodes (the reference generator
+        # assigns per-zone latencies for tc-netem the same way,
+        # generator/generate.go latency handling)
+        latency = 0.0
+        jitter = 0.0
+        if rng.random() < 0.25:
+            latency = float(rng.choice([20, 50, 100]))
+            jitter = latency / 3
         nodes.append(
             NodeSpec(
                 name=f"node{i:02d}",
                 start_at=rng.randint(3, 6) if i == late_slot else 0,
                 perturbations=perturbations,
+                latency_ms=latency,
+                latency_jitter_ms=jitter,
             )
         )
     return Manifest(

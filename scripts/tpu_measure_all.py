@@ -1,0 +1,189 @@
+"""One-shot TPU measurement session: run every round-4 benchmark in a
+single process (the device tunnel serializes one client at a time and
+wedges if a client is killed, so everything rides one clean process that
+writes partial results as it goes and exits normally).
+
+Writes JSON lines to /tmp/tpu_measurements.jsonl as each stage lands:
+  layout      — limbs-first vs limbs-minor field-mul chain
+  bench_small — verify_commit p50 at BENCH_SMALL_N (fast signal)
+  bench_10k   — the flagship 10k-validator VerifyCommit p50 + phases
+  blocksync   — streamed replay blocks/s (BASELINE config 5)
+
+Run:  python scripts/tpu_measure_all.py     (full env — axon registered)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+OUT = os.environ.get("TPU_MEASURE_OUT", "/tmp/tpu_measurements.jsonl")
+
+
+def emit(stage: str, **data) -> None:
+    rec = {"stage": stage, "ts": time.time(), **data}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    import numpy as np
+
+    t0 = time.time()
+    import jax
+
+    devs = jax.devices()
+    emit("backend", platform=devs[0].platform, init_s=round(time.time() - t0, 1))
+
+    # persistent compile cache
+    from __graft_entry__ import _enable_compile_cache
+
+    _enable_compile_cache()
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from cometbft_tpu.ops import field as F
+
+    # ---- stage 1: layout micro-proof (chain of muls per layout)
+    try:
+        V = int(os.environ.get("LAYOUT_V", "10000"))
+        CHAIN = int(os.environ.get("LAYOUT_CHAIN", "100"))
+        rng = np.random.default_rng(0)
+        a_np = rng.integers(0, 2048, size=(F.NLIMBS, V), dtype=np.int32)
+        b_np = rng.integers(0, 2048, size=(F.NLIMBS, V), dtype=np.int32)
+        a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+
+        @jax.jit
+        def chain(x, y):
+            return lax.fori_loop(0, CHAIN, lambda _, v: F.mul(v, y), x)
+
+        jax.block_until_ready(chain(a, b))
+        ts = []
+        for _ in range(5):
+            s = time.perf_counter()
+            jax.block_until_ready(chain(a, b))
+            ts.append(time.perf_counter() - s)
+        emit(
+            "layout",
+            chain=CHAIN,
+            chain_ms=round(1e3 * min(ts), 3),
+            us_per_mul=round(1e6 * min(ts) / CHAIN, 2),
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("layout", error=str(e))
+
+    # ---- stage 2: small bench (fast end-to-end signal before the big build)
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import ed25519 as host
+
+    def run_bench(n: int, iters: int):
+        rng = np.random.default_rng(7)
+        keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(n)]
+        pubs = [k.pub_key().data for k in keys]
+        items = []
+        for i, sk in enumerate(keys):
+            msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|mb"
+            items.append((pubs[i], msg, sk.sign(msg)))
+        t0 = time.perf_counter()
+        crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+        build_s = time.perf_counter() - t0
+
+        def once():
+            v = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+            t0 = time.perf_counter()
+            for it in items:
+                v.add(*it)
+            ok, per = v.verify()
+            assert ok and len(per) == n
+            return (time.perf_counter() - t0) * 1e3, getattr(v, "last_timings", {})
+
+        once()
+        once()
+        runs = sorted((once() for _ in range(iters)), key=lambda r: r[0])
+        p50, timings = runs[len(runs) // 2]
+        return build_s, p50, timings
+
+    try:
+        small_n = int(os.environ.get("BENCH_SMALL_N", "1024"))
+        build_s, p50, timings = run_bench(small_n, 5)
+        emit(
+            "bench_small",
+            n=small_n,
+            p50_ms=round(p50, 2),
+            table_build_s=round(build_s, 1),
+            **{k: round(v, 2) for k, v in timings.items()},
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("bench_small", error=str(e))
+
+    # ---- stage 3: the flagship 10k (TPU_MEASURE_SKIP_10K=1 to skip —
+    # a 10k table build on the CPU backend is hours)
+    if os.environ.get("TPU_MEASURE_SKIP_10K") == "1":
+        emit("bench_10k", skipped=True)
+    else:
+      try:
+        build_s, p50, timings = run_bench(10_000, 10)
+        emit(
+            "bench_10k",
+            n=10_000,
+            p50_ms=round(p50, 2),
+            vs_go_cpu=round(275.0 / p50, 2),
+            table_build_s=round(build_s, 1),
+            **{k: round(v, 2) for k, v in timings.items()},
+        )
+      except Exception as e:  # noqa: BLE001
+        emit("bench_10k", error=str(e))
+
+    # ---- stage 4: blocksync streamed replay (5k validators)
+    try:
+        from cometbft_tpu.blocksync.replay import CommitStreamVerifier
+        from cometbft_tpu.models import comb_verifier as cv
+
+        Vv = int(os.environ.get("BENCH_V", "5000"))
+        blocks = int(os.environ.get("BENCH_BLOCKS", "64"))
+        rng = np.random.default_rng(11)
+        keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(Vv)]
+        pubs = [k.pub_key().data for k in keys]
+        t0 = time.perf_counter()
+        entry = cv.global_cache().ensure(pubs)
+        build_s = time.perf_counter() - t0
+        commits = []
+        for h in range(4):
+            items = []
+            for i, sk in enumerate(keys):
+                msg = (
+                    b"\x08\x02\x11" + h.to_bytes(8, "little")
+                    + i.to_bytes(8, "big") + b"|replay"
+                )
+                items.append((pubs[i], msg, sk.sign(msg)))
+            commits.append(items)
+        for out in CommitStreamVerifier(entry, depth=1).run(iter([commits[0]])):
+            assert out[0]
+        t0 = time.perf_counter()
+        nok = 0
+        for all_ok, per in CommitStreamVerifier(entry, depth=2).run(
+            commits[b % 4] for b in range(blocks)
+        ):
+            assert all_ok
+            nok += 1
+        dt = time.perf_counter() - t0
+        assert nok == blocks, f"pipeline yielded {nok}/{blocks}"
+        emit(
+            "blocksync",
+            v=Vv,
+            blocks=blocks,
+            blocks_per_s=round(blocks / dt, 2),
+            sigs_per_s=round(blocks * Vv / dt, 1),
+            table_build_s=round(build_s, 1),
+            vs_go_cpu=round((blocks / dt) * (Vv * 27.5) / 1e6, 2),
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("blocksync", error=str(e))
+
+    emit("done")
+
+
+if __name__ == "__main__":
+    main()
