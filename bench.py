@@ -60,22 +60,19 @@ def emit_and_exit(code: int = 0) -> None:
     raise SystemExit(code)
 
 
-def probe_backend() -> None:
-    """Fail fast if the accelerator backend can't initialize.
+def backend_available() -> tuple[bool, str]:
+    """Probe the accelerator backend in a throwaway subprocess.
 
     Runs `jax.devices()` in a subprocess with a timeout: a wedged tunnel
     blocks forever in backend init (no exception), which is unkillable
     in-process.  The subprocess exits before this process attaches, so
-    the device is never held by two processes at once.
+    the device is never held by two processes at once.  Popen + poll
+    deadline rather than subprocess.run(timeout=...): run() reaps the
+    killed child with an unbounded communicate(), and a child wedged in
+    uninterruptible device I/O would hang the reap — the exact failure
+    this probe exists to detect.  Returns (ok, platform-or-error).
     """
-    if os.environ.get("BENCH_SKIP_PROBE") == "1":
-        return
     code = "import jax; print(jax.devices()[0].platform)"
-    # Popen + poll deadline rather than subprocess.run(timeout=...): run()
-    # reaps the killed child with an unbounded communicate(), and a child
-    # wedged in uninterruptible device I/O would hang the reap — the exact
-    # failure this probe exists to detect.  Here the child is abandoned
-    # (daemonless double-kill) and the JSON line always emits.
     with open(os.devnull, "wb") as devnull:
         proc = subprocess.Popen(
             [sys.executable, "-c", code],
@@ -89,18 +86,24 @@ def probe_backend() -> None:
             time.sleep(0.5)
         if proc.poll() is None:
             proc.kill()
-            REPORT["error"] = (
-                f"backend-unavailable: jax.devices() hung >{timeout_s}s "
-                "(wedged device tunnel)"
+            return False, (
+                f"jax.devices() hung >{timeout_s}s (wedged device tunnel)"
             )
-            emit_and_exit()
         out = proc.stdout.read() if proc.stdout else ""
         if proc.returncode != 0:
-            REPORT["error"] = "backend-unavailable: probe exited " + str(
-                proc.returncode
-            )
-            emit_and_exit()
-    REPORT["backend"] = out.strip().splitlines()[-1] if out.strip() else "?"
+            return False, f"probe exited {proc.returncode}"
+    return True, out.strip().splitlines()[-1] if out.strip() else "?"
+
+
+def probe_backend() -> None:
+    """Fail fast (with the structured JSON line) on a dead backend."""
+    if os.environ.get("BENCH_SKIP_PROBE") == "1":
+        return
+    ok, detail = backend_available()
+    if not ok:
+        REPORT["error"] = "backend-unavailable: " + detail
+        emit_and_exit()
+    REPORT["backend"] = detail
 
 
 def _enable_compile_cache() -> None:

@@ -334,3 +334,57 @@ def test_node_key_persistence(tmp_path):
     nk2 = NodeKey.load_or_gen(path)
     assert nk.id() == nk2.id()
     assert len(nk.id()) == 40  # 20-byte address, hex
+
+
+def test_latency_conn_shapes_flushes_and_surfaces_errors():
+    """utils/netutil.LatencyConn: delayed ordered delivery, flush on
+    close (acknowledged writes must reach the wire), and a dead inner
+    conn surfaces to subsequent writers."""
+    import time
+
+    from cometbft_tpu.utils.netutil import LatencyConn
+
+    class Inner:
+        def __init__(self):
+            self.wrote = []
+            self.closed = False
+            self.fail = False
+
+        def write(self, b):
+            if self.fail:
+                raise OSError("broken pipe")
+            self.wrote.append((time.monotonic(), bytes(b)))
+            return len(b)
+
+        def read(self, n):
+            return b""
+
+        def close(self):
+            self.closed = True
+
+    inner = Inner()
+    c = LatencyConn(inner, delay_ms=40, jitter_ms=10)
+    t0 = time.monotonic()
+    c.write(b"a")
+    c.write(b"b")
+    c.close()  # must flush both before closing inner
+    assert inner.closed
+    assert [d for _, d in inner.wrote] == [b"a", b"b"]
+    for ts, _ in inner.wrote:
+        assert ts - t0 >= 0.035  # the link delay actually applied
+
+    # pump death surfaces to the next writer instead of silently queueing
+    inner2 = Inner()
+    inner2.fail = True
+    c2 = LatencyConn(inner2, delay_ms=1)
+    c2.write(b"x")  # accepted; pump will die trying to deliver
+    deadline = time.monotonic() + 2
+    died = False
+    while time.monotonic() < deadline:
+        try:
+            c2.write(b"y")
+            time.sleep(0.02)
+        except OSError:
+            died = True
+            break
+    assert died, "dead pump never surfaced to writers"
