@@ -260,6 +260,17 @@ class BlockPool(Service):
                 second = r2.block
             return first, second, ext
 
+    def peek_block(self, height: int):
+        """Block + extended commit buffered at an arbitrary height (the
+        reactor's verify-ahead pipeline looks past the head pair).  The
+        returned objects may be dropped from the pool at any time (peer
+        removal); callers must re-check identity at use time."""
+        with self._mtx:
+            r = self.requesters.get(height)
+            if r is None:
+                return None, None
+            return r.block, r.ext_commit
+
     def pop_request(self) -> None:
         """Advance past a verified block (pool.go:234)."""
         with self._mtx:

@@ -22,6 +22,23 @@ from ..wire import blocksync_pb as pb
 from .pool import BlockPool, BlockRequest, PeerError
 
 BLOCKSYNC_STREAM = 0x40  # reactor.go:21
+
+
+class _PendingBlock:
+    """One verify-ahead pipeline slot: the exact block/commit objects the
+    device verification was submitted for, so _process_block can detect
+    pool refetches (object identity) and validator-set changes (hash)
+    before trusting the result."""
+
+    __slots__ = ("first", "second", "parts", "block_id", "set_hash", "verification")
+
+    def __init__(self, first, second, parts, block_id, set_hash, verification):
+        self.first = first
+        self.second = second
+        self.parts = parts
+        self.block_id = block_id
+        self.set_hash = set_hash
+        self.verification = verification
 TRY_SYNC_INTERVAL = 0.01  # reactor.go:23
 STATUS_UPDATE_INTERVAL = 10.0  # reactor.go:30
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0  # reactor.go:32
@@ -67,6 +84,10 @@ class BlocksyncReactor(Reactor):
         self._synced_callbacks: list = []
         self.blocks_synced = 0
         self._state_synced = False
+        # validator-set hash that probed "no async verify path" (small
+        # set / cpu backend): skip re-probing — the probe itself costs a
+        # make_part_set + hash per block — until the set changes
+        self._no_async_for: bytes | None = None
 
     # -------------------------------------------------------------- wiring
 
@@ -252,11 +273,24 @@ class BlocksyncReactor(Reactor):
 
     # --------------------------------------------------------- pool routine
 
+    # how many commit verifications may be in flight on the device ahead
+    # of the apply cursor (2 = double buffer: the chip verifies height
+    # h+1's commit while the host saves/applies height h)
+    VERIFY_AHEAD_DEPTH = 2
+
     def _pool_routine(self) -> None:
         """Apply fetched blocks pairwise; switch to consensus when caught up
-        (reactor.go:315 poolRoutine)."""
+        (reactor.go:315 poolRoutine).
+
+        Catch-up replay is the BASELINE "blocksync replay" config: when
+        the validator set routes to the device-cached comb verifier, the
+        commit checks pipeline ahead of the apply cursor
+        (types/validation.submit_verify_commit_light) so the TPU verifies
+        height h+1 while the host stores height h — replacing the serial
+        verify-per-block CPU pattern of reactor.go:547."""
         state = self.initial_state
         last_switch_check = 0.0
+        pending: dict[int, _PendingBlock] = {}
         while self.is_running() and self.pool.is_running():
             now = time.monotonic()
             if now - last_switch_check >= self.switch_interval:
@@ -275,13 +309,21 @@ class BlocksyncReactor(Reactor):
                     f"peeked first block has unexpected height "
                     f"{first.header.height}, want {state.last_block_height + 1}"
                 )
+            h = first.header.height
+            for ph in [p for p in pending if p < h]:
+                del pending[ph]  # heights already applied (or refetched past)
+            self._top_up_verify_pipeline(pending, state, h)
+            pend = pending.pop(h, None)
             try:
-                state = self._process_block(first, second, state, ext)
+                state = self._process_block(first, second, state, ext, pend)
                 self.blocks_synced += 1
             except Exception as e:  # noqa: BLE001
                 self.logger.error(
                     f"invalid block at {first.header.height}: {e}"
                 )
+                # in-flight verifications may reference blocks the redo
+                # below is about to drop: discard the whole window
+                pending.clear()
                 # ban both senders and refetch (reactor.go:565-581)
                 for h in (first.header.height, second.header.height):
                     pid = self.pool.remove_peer_and_redo_all(h)
@@ -289,24 +331,82 @@ class BlocksyncReactor(Reactor):
                     if peer is not None:
                         self.switch.stop_peer(peer, f"bad block: {e}")
 
-    def _process_block(self, first: Block, second: Block, state, ext) -> object:
+    def _top_up_verify_pipeline(
+        self, pending: dict, state, head_height: int
+    ) -> None:
+        """Submit device commit verifications for up to VERIFY_AHEAD_DEPTH
+        buffered heights.  Only heights whose header claims the CURRENT
+        validator set are submitted (untrusted hint — cheap skip of
+        windows that straddle a set change); the trusted re-check happens
+        at use time in _process_block."""
+        from ..types.block import BlockID
+        from ..types.validation import submit_verify_commit_light
+
+        vals = state.validators
+        if vals is None:
+            return
+        set_hash = vals.hash()
+        if set_hash == self._no_async_for:
+            return  # this set probed "no async path"; don't pay the probe again
+        chain_id = self.initial_state.chain_id
+        for hh in range(head_height, head_height + self.VERIFY_AHEAD_DEPTH):
+            if hh in pending:
+                continue
+            blk, _ = self.pool.peek_block(hh)
+            nxt, _ = self.pool.peek_block(hh + 1)
+            if blk is None or nxt is None or nxt.last_commit is None:
+                continue
+            if blk.header.validators_hash != set_hash:
+                continue
+            try:
+                parts = blk.make_part_set()
+                bid = BlockID(hash=blk.hash(), part_set_header=parts.header)
+                p = submit_verify_commit_light(
+                    chain_id, vals, bid, hh, nxt.last_commit
+                )
+            except Exception:  # noqa: BLE001
+                # structurally bad / malformed peer data (bad commit, odd
+                # sig lengths, ...): leave it for the serial path, which
+                # owns the ban/refetch bookkeeping — never kill the sync
+                # thread over untrusted bytes
+                continue
+            if p is None:
+                self._no_async_for = set_hash
+                return  # set doesn't route to the async comb path
+            pending[hh] = _PendingBlock(blk, nxt, parts, bid, set_hash, p)
+
+    def _process_block(
+        self, first: Block, second: Block, state, ext, pend=None
+    ) -> object:
         """reactor.go:536 processBlock: verify w/ second.LastCommit, save,
         apply."""
         from ..types.block import BlockID
         from ..types.validation import verify_commit_light
 
         chain_id = self.initial_state.chain_id
-        first_parts = first.make_part_set()
-        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
+        if (
+            pend is not None
+            and pend.first is first
+            and pend.second is second
+            and pend.set_hash == state.validators.hash()
+        ):
+            # verify-ahead hit: the kernel has been running since the
+            # pipeline submitted it; collect raises like verify_commit_light
+            first_parts = pend.parts
+            first_id = pend.block_id
+            pend.verification.collect()
+        else:
+            first_parts = first.make_part_set()
+            first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
 
-        # the TPU-batched signature check (types/validation.go VerifyCommitLight)
-        verify_commit_light(
-            chain_id,
-            state.validators,
-            first_id,
-            first.header.height,
-            second.last_commit,
-        )
+            # the TPU-batched signature check (types/validation.go VerifyCommitLight)
+            verify_commit_light(
+                chain_id,
+                state.validators,
+                first_id,
+                first.header.height,
+                second.last_commit,
+            )
         self.block_exec.validate_block(state, first)
 
         extensions_enabled = state.consensus_params.feature.vote_extensions_enabled(

@@ -31,6 +31,12 @@ class TimeoutTicker:
         self._mtx = threading.Lock()
         self._stopped = False
 
+    def _arm_locked(self, ti: TimeoutInfo) -> None:
+        self._pending = ti
+        self._timer = threading.Timer(ti.duration, self._on_fire, args=(ti,))
+        self._timer.daemon = True
+        self._timer.start()
+
     def schedule(self, ti: TimeoutInfo) -> None:
         """Replace any pending timeout with this one (ticker.go
         ScheduleTimeout; newer round states always win)."""
@@ -39,10 +45,21 @@ class TimeoutTicker:
                 return
             if self._timer is not None:
                 self._timer.cancel()
-            self._pending = ti
-            self._timer = threading.Timer(ti.duration, self._on_fire, args=(ti,))
-            self._timer.daemon = True
-            self._timer.start()
+            self._arm_locked(ti)
+
+    def schedule_if_idle(self, ti: TimeoutInfo) -> bool:
+        """Schedule ONLY when no timeout is pending.  Used by the liveness
+        watchdog: an unconditional schedule() could replace a legitimate
+        newer timeout the machine armed between the watchdog's idle sample
+        and its re-kick, and the replacement (carrying the watchdog's stale
+        (H,R,S)) would then be dropped as stale — cancelling the real
+        timer.  The check and the arm happen under one lock so that window
+        does not exist."""
+        with self._mtx:
+            if self._stopped or self._pending is not None:
+                return False
+            self._arm_locked(ti)
+            return True
 
     def _on_fire(self, ti: TimeoutInfo) -> None:
         with self._mtx:

@@ -254,6 +254,31 @@ class Runner:
             problems.append(f"app hash divergence at height {heights}: {apps}")
         return problems
 
+    def check_watchdog_fires(self) -> list[str]:
+        """A consensus-watchdog re-kick in any node means a scheduled
+        timeout evaporated — a state-machine bug the watchdog papered
+        over.  The reference runs with no watchdog at all
+        (internal/consensus/state.go:795-884), so perturbed runs must
+        show zero fires to claim parity."""
+        from ..consensus.state import ConsensusState
+
+        token = ConsensusState.WATCHDOG_LOG_TOKEN.encode()
+        problems = []
+        for node in self.nodes:
+            log = os.path.join(node.home, "node.log")
+            try:
+                with open(log, "rb") as f:
+                    for line in f:
+                        if token in line:
+                            problems.append(
+                                f"{node.name}: {line.decode(errors='replace').strip()}"
+                            )
+            except OSError as e:
+                # a node that ran but left no log can't be checked — that
+                # is a finding, not a vacuous pass
+                problems.append(f"{node.name}: node.log unreadable: {e}")
+        return problems
+
     def dump_stalled(self, target_height: int) -> None:
         """Print /debug/threads of every node behind target — turns a
         CI stall into an actionable trace (debug kill's goroutine dump)."""

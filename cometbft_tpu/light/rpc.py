@@ -181,6 +181,18 @@ class HTTPProvider:
 # ----------------------------------------------------------- verifying client
 
 
+class AppQueryError(Exception):
+    """abci_query returned a non-zero code.  The error itself is
+    app-level and unverifiable, so nothing from the response may be
+    trusted; the reference errors the same way (light/rpc/client.go
+    ABCIQueryWithOptions: resp.IsErr() -> err)."""
+
+    def __init__(self, code: int, log: str) -> None:
+        super().__init__(f"abci_query failed: code={code} log={log!r}")
+        self.code = code
+        self.log = log
+
+
 class VerificationFailed(Exception):
     pass
 
@@ -282,8 +294,13 @@ class VerifyingClient:
         # malformed heights, base64, or proof bytes must surface as the
         # same fail-closed VerificationFailed as a wrong proof.
         try:
-            if int(r.get("code", 0) or 0) != 0:
-                return resp  # app-level error: nothing state-bearing to trust
+            code = int(r.get("code", 0) or 0)
+            if code != 0:
+                # Error responses carry no proof and cannot be verified;
+                # returning them would hand a byzantine node's value/log/
+                # height to callers that skip the code check.  Fail like
+                # the reference (resp.IsErr() -> error).
+                raise AppQueryError(code, str(r.get("log", "")))
             rh = int(r.get("height", 0) or 0)
             if rh <= 0:
                 raise VerificationFailed("abci_query: response carries no height")
@@ -312,7 +329,7 @@ class VerifyingClient:
                     aunts=list(vop.proof.aunts),
                 )
                 ops.append(merkle.ValueOp(base64.b64decode(op["key"]), proof))
-        except VerificationFailed:
+        except (VerificationFailed, AppQueryError):
             raise
         except Exception as e:  # noqa: BLE001 — fail closed on any garbage
             raise VerificationFailed(f"abci_query: malformed response: {e}") from e

@@ -185,7 +185,8 @@ def _verify_basic_vals_and_commit(vals, commit, height, block_id):
         )
 
 
-def _verify_commit_batch(
+def _assemble_commit_batch(
+    bv,
     chain_id: str,
     vals: ValidatorSet,
     commit: Commit,
@@ -195,12 +196,10 @@ def _verify_commit_batch(
     count_all_signatures: bool,
     lookup_by_index: bool,
     cache: SignatureCache | None,
-) -> None:
-    """(validation.go:265) — batch assembly, power tally, TPU verify, blame."""
-    proposer = vals.get_proposer()
-    bv = crypto_batch.create_batch_verifier(
-        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
-    )
+):
+    """(validation.go:265, assembly half) — fill the batch verifier and
+    tally power; raises on insufficient power / double votes.  Returns
+    (batch_sig_idxs, sign_bytes_at) for the judging half."""
     seen_vals: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0
@@ -242,21 +241,21 @@ def _verify_commit_batch(
 
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+    return batch_sig_idxs, sign_bytes_at
 
-    if not batch_sig_idxs:
-        return  # everything came from the cache
 
-    if VERIFY_LATENCY_OBSERVER is not None:
-        import time as _time
-
-        _t0 = _time.perf_counter()
-        ok, valid_sigs = bv.verify()
-        VERIFY_LATENCY_OBSERVER(_time.perf_counter() - _t0)
-    else:
-        ok, valid_sigs = bv.verify()
+def _judge_batch_result(
+    ok: bool,
+    valid_sigs: list[bool],
+    commit: Commit,
+    batch_sig_idxs: list[int],
+    sign_bytes_at,
+    cache: SignatureCache | None,
+) -> None:
+    """(validation.go:384-399, judging half) — blame order + cache fill."""
     if ok:
         if cache is not None:
-            for i, idx in enumerate(batch_sig_idxs):
+            for idx in batch_sig_idxs:
                 cs = commit.signatures[idx]
                 cache.add(
                     cs.signature,
@@ -285,6 +284,108 @@ def _verify_commit_batch(
             )
     raise CommitVerificationError(
         "BUG: batch verification failed with no invalid signatures"
+    )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig,
+    count_sig,
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+    cache: SignatureCache | None,
+) -> None:
+    """(validation.go:265) — batch assembly, power tally, TPU verify, blame."""
+    proposer = vals.get_proposer()
+    bv = crypto_batch.create_batch_verifier(
+        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
+    )
+    batch_sig_idxs, sign_bytes_at = _assemble_commit_batch(
+        bv, chain_id, vals, commit, voting_power_needed, ignore_sig,
+        count_sig, count_all_signatures, lookup_by_index, cache,
+    )
+    if not batch_sig_idxs:
+        return  # everything came from the cache
+
+    if VERIFY_LATENCY_OBSERVER is not None:
+        import time as _time
+
+        _t0 = _time.perf_counter()
+        ok, valid_sigs = bv.verify()
+        VERIFY_LATENCY_OBSERVER(_time.perf_counter() - _t0)
+    else:
+        ok, valid_sigs = bv.verify()
+    _judge_batch_result(ok, valid_sigs, commit, batch_sig_idxs, sign_bytes_at, cache)
+
+
+class PendingCommitVerification:
+    """An in-flight verify_commit_light: the device kernel was dispatched
+    by submit_verify_commit_light and is running while the caller does
+    other host work (the blocksync verify-ahead pipeline).  collect()
+    waits for the result and raises exactly what verify_commit_light
+    would have."""
+
+    __slots__ = ("_bv", "_ticket", "_commit", "_idxs", "_sign_bytes_at", "_cache")
+
+    def __init__(self, bv, ticket, commit, idxs, sign_bytes_at, cache):
+        self._bv = bv
+        self._ticket = ticket
+        self._commit = commit
+        self._idxs = idxs
+        self._sign_bytes_at = sign_bytes_at
+        self._cache = cache
+
+    def collect(self) -> None:
+        if self._bv is None:
+            return  # everything came from the signature cache
+        ok, valid_sigs = self._bv.collect(self._ticket)
+        _judge_batch_result(
+            ok, valid_sigs, self._commit, self._idxs, self._sign_bytes_at,
+            self._cache,
+        )
+
+
+def submit_verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    count_all_signatures: bool = False,
+    cache: SignatureCache | None = None,
+) -> PendingCommitVerification | None:
+    """Asynchronous verify_commit_light (reactor.go:547's hot path,
+    pipelined): run every host-side phase now — basic checks, batch
+    assembly, power tally, all of which raise immediately — and dispatch
+    the device kernel WITHOUT waiting for its verdict.  Returns None when
+    the commit doesn't take the device-cached batch path (small set,
+    heterogeneous keys, cpu backend): the caller must then run
+    verify_commit_light synchronously."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    if not should_batch_verify(vals, commit):
+        return None
+    proposer = vals.get_proposer()
+    bv = crypto_batch.create_batch_verifier(
+        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
+    )
+    if not hasattr(bv, "submit"):
+        return None  # no async seam outside the comb-cached verifier
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    batch_sig_idxs, sign_bytes_at = _assemble_commit_batch(
+        bv, chain_id, vals, commit, voting_power_needed,
+        ignore_sig=lambda cs: not cs.for_block(),
+        count_sig=lambda cs: True,
+        count_all_signatures=count_all_signatures,
+        lookup_by_index=True,
+        cache=cache,
+    )
+    if not batch_sig_idxs:
+        return PendingCommitVerification(None, None, commit, [], sign_bytes_at, cache)
+    return PendingCommitVerification(
+        bv, bv.submit(), commit, batch_sig_idxs, sign_bytes_at, cache
     )
 
 

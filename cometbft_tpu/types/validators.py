@@ -135,6 +135,7 @@ class ValidatorSet:
         new = ValidatorSet.__new__(ValidatorSet)
         new.validators = [v.copy() for v in self.validators]
         new._total_voting_power = self._total_voting_power
+        new._set_hash = getattr(self, "_set_hash", None)  # same membership
         new.proposer = None
         if self.proposer is not None:
             for v in new.validators:
@@ -217,8 +218,16 @@ class ValidatorSet:
     # ------------------------------------------------------------ hashing
 
     def hash(self) -> bytes:
-        """Merkle root over SimpleValidator encodings (validator_set.go:386)."""
-        return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+        """Merkle root over SimpleValidator encodings (validator_set.go:386).
+        Memoized: blocksync's verify-ahead pipeline compares it per block,
+        and the set only changes through update_with_change_set (which
+        drops the cache).  Proposer-priority churn doesn't affect it —
+        SimpleValidator excludes priorities."""
+        h = getattr(self, "_set_hash", None)
+        if h is None:
+            h = merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
+            self._set_hash = h
+        return h
 
     # ------------------------------------------- proposer priority cycle
 
@@ -328,6 +337,7 @@ class ValidatorSet:
         self.validators = sorted(merged.values(), key=_val_sort_key)
         self._total_voting_power = None
         self._pub_keys_bytes = None  # membership changed: drop pubkey cache
+        self._set_hash = None
         self._update_total_voting_power()
         if self.proposer is not None and self.proposer.address not in merged:
             self.proposer = None

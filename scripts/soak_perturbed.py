@@ -1,0 +1,101 @@
+"""Soak the perturbed localnet scenario N times and count consensus
+watchdog fires (round-5 verdict item 5: 'watchdog never fires across
+>=50 perturbed e2e runs').
+
+Each iteration is the slow-tier perturbed manifest (kill + pause + WAN
+late-joiner).  Appends one JSON line per run to SOAK_OUT so partial
+progress survives interruption.
+
+Run:  python scripts/soak_perturbed.py [N]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from cometbft_tpu.e2e import Manifest, NodeSpec, Runner  # noqa: E402
+
+OUT = os.environ.get("SOAK_OUT", "/tmp/soak_perturbed.jsonl")
+
+
+def one_run(i: int, base_port: int) -> dict:
+    out_dir = tempfile.mkdtemp(prefix=f"soak{i}-")
+    m = Manifest(
+        chain_id=f"soak-{i}",
+        nodes=[
+            NodeSpec("stable0"),
+            NodeSpec("killed", perturbations=["kill"]),
+            NodeSpec("paused", perturbations=["pause"]),
+            NodeSpec("late", start_at=4, latency_ms=60, latency_jitter_ms=20),
+        ],
+        target_height=6,
+        load_tx_per_round=3,
+    )
+    r = Runner(m, out_dir, base_port=base_port)
+    t0 = time.monotonic()
+    rec = {"run": i, "ok": False, "fires": [], "problems": []}
+    try:
+        r.setup()
+        r.start()
+        deadline = time.monotonic() + 420
+        perturbed = False
+        round_id = 0
+        while time.monotonic() < deadline:
+            r.start_late_nodes()
+            hs = r._heights(only_running=True)
+            if hs and max(hs) >= 4 and not perturbed:
+                r.perturb()
+                perturbed = True
+            r.load(round_id)
+            round_id += 1
+            if (
+                hs
+                and min(hs) >= m.target_height
+                and all(n.proc is not None for n in r.nodes)
+                and len(hs) == len(r.nodes)
+            ):
+                break
+            time.sleep(2.0)
+        heights = r._heights(only_running=True)
+        rec["heights"] = heights
+        rec["perturbed"] = perturbed
+        rec["problems"] = r.check_invariants(upto=m.target_height)
+        rec["fires"] = r.check_watchdog_fires()
+        rec["ok"] = (
+            perturbed
+            and len(heights) == 4
+            and min(heights) >= m.target_height
+            and not rec["problems"]
+            and not rec["fires"]
+        )
+    except Exception as e:  # noqa: BLE001
+        rec["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        r.stop_all()
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return rec
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    fails = 0
+    for i in range(n):
+        rec = one_run(i, base_port=30000 + (i % 40) * 50)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if not rec["ok"]:
+            fails += 1
+    print(f"SOAK DONE: {n - fails}/{n} clean", flush=True)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Probe whether the TPU backend is reachable WITHOUT risking a wedge.
+
+Killing a device-attached process wedges the tunnel for hours, so this
+probe never gets killed externally: a SIGALRM fires inside the process
+and os._exit(2)s before any external timeout would. Exit codes:
+  0 — TPU visible (prints platform + device)
+  2 — timed out (tunnel wedged / unreachable)
+  3 — backend error (prints it)
+"""
+import os
+import threading
+
+TIMEOUT_S = int(os.environ.get("TPU_PROBE_TIMEOUT", "60"))
+
+
+def _bail() -> None:
+    # os._exit is a raw syscall and works from a daemon thread even while
+    # the main thread is blocked inside PJRT C++ discovery (where Python
+    # signal handlers would be deferred indefinitely).
+    print(f"PROBE_TIMEOUT after {TIMEOUT_S}s", flush=True)
+    os._exit(2)
+
+
+def main() -> None:
+    t = threading.Timer(TIMEOUT_S, _bail)
+    t.daemon = True
+    t.start()
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception as e:  # noqa: BLE001
+        print(f"PROBE_ERROR {type(e).__name__}: {e}", flush=True)
+        os._exit(3)
+    t.cancel()
+    print(f"PROBE_OK platform={devs[0].platform} n={len(devs)} {devs[0]}", flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

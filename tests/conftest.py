@@ -36,6 +36,25 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _watchdog_must_not_fire():
+    """The consensus liveness watchdog is a production backstop for bug
+    classes fixed in r4; a healthy state machine never needs it (the
+    reference has no watchdog — internal/consensus/state.go:795-884).
+    Fail any in-process test during which it re-kicks so regressions in
+    timeout scheduling surface as the root cause, not as a silent 20 s
+    hiccup the watchdog papers over."""
+    from cometbft_tpu.consensus.state import ConsensusState
+
+    before = ConsensusState.watchdog_fire_count
+    yield
+    after = ConsensusState.watchdog_fire_count
+    assert after == before, (
+        f"consensus watchdog re-kicked {after - before}x during this test: "
+        "a scheduled timeout evaporated (see state.py _watchdog_routine)"
+    )
+
+
 @pytest.fixture
 def cpu_crypto_backend(monkeypatch):
     """Force the sequential host verifier (storage/domain-logic tests

@@ -315,3 +315,28 @@ def test_restart_continues_chain(tmp_path):
     finally:
         cs2.stop()
         conns.stop()
+
+
+def test_ticker_schedule_if_idle_never_replaces_pending():
+    """schedule_if_idle (the watchdog's re-kick path) must decline when a
+    legitimate timeout is already armed — an unconditional replace would
+    cancel the real timer with a stale (H,R,S) one that _handle_timeout
+    then drops (the evaporating-timeout class the watchdog exists to
+    catch, not cause)."""
+    from cometbft_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+
+    fired = []
+    t = TimeoutTicker(fired.append)
+    real = TimeoutInfo(0.05, height=5, round=1, step=4)
+    t.schedule(real)
+    # watchdog re-kick while the real timer is pending: declined
+    assert t.schedule_if_idle(TimeoutInfo(0.01, 5, 0, 1)) is False
+    time.sleep(0.3)
+    assert fired == [real]  # the real timeout survived and fired
+    # now idle: the re-kick arms
+    assert t.schedule_if_idle(TimeoutInfo(0.01, 5, 1, 4)) is True
+    time.sleep(0.2)
+    assert len(fired) == 2
+    # stopped ticker declines everything
+    t.stop()
+    assert t.schedule_if_idle(TimeoutInfo(0.0, 5, 1, 4)) is False

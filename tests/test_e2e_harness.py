@@ -111,6 +111,7 @@ def test_two_node_localnet_smoke(tmp_path):
             f"smoke net stalled: {heights}"
         )
         assert not r.check_invariants(upto=m.target_height)
+        assert not r.check_watchdog_fires()
     finally:
         r.stop_all()
 
@@ -167,6 +168,8 @@ def test_perturbed_localnet_keeps_invariants(tmp_path):
         assert min(heights) >= m.target_height, f"stalled: {heights}"
         problems = r.check_invariants(upto=m.target_height)
         assert not problems, problems
+        fires = r.check_watchdog_fires()
+        assert not fires, f"consensus watchdog re-kicked (timeout evaporated): {fires}"
     finally:
         r.stop_all()
 

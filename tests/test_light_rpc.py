@@ -144,6 +144,22 @@ def test_abci_query_fail_closed_and_verified_proof():
     with pytest.raises(VerificationFailed, match="no proof"):
         VerifyingClient(FakeRPC(plain), FakeLC(approot)).abci_query("/key", b"k1")
 
+    # non-zero code is an app error the proof chain can't cover: raise a
+    # distinct error, never hand the unverified body to the caller
+    # (light/rpc/client.go: resp.IsErr() -> error)
+    from cometbft_tpu.light.rpc import AppQueryError
+
+    class ErrRPC(FakeRPC):
+        def abci_query(self, *a, **kw):
+            r = super().abci_query(*a, **kw)
+            r["response"]["code"] = 7
+            r["response"]["log"] = "boom"
+            r["response"]["value"] = _b64(b"forged-state")
+            return r
+
+    with pytest.raises(AppQueryError, match="code=7"):
+        VerifyingClient(ErrRPC(app), FakeLC(approot)).abci_query("/key", b"k1")
+
 
 @pytest.mark.slow
 def test_verified_abci_query_live(tmp_path):
