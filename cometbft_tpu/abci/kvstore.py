@@ -152,6 +152,15 @@ class KVStoreApplication(Application):
         self.snapshot_interval = snapshot_interval
         self.snapshot_keep = snapshot_keep
         self._snapshots: dict[int, bytes] = {}  # height -> payload
+        # merkle-state snapshot caches, keyed by (height, #staged).
+        # Within a height the staged-tx list only grows, and committed kv
+        # pairs only change at Commit (which bumps height), so the pair
+        # is a sound snapshot key.  Root and proofs cache separately:
+        # app_hash runs every block and needs only the root; the full
+        # proof trails + key index are built lazily on the first proven
+        # query against that snapshot.
+        self._root_cache: tuple | None = None  # (key, root)
+        self._proof_cache: tuple | None = None  # (key, (index, proofs))
         self._load_state()
 
     # ------------------------------------------------------------- state
@@ -195,10 +204,33 @@ class KVStoreApplication(Application):
             k + hashlib.sha256(v).digest() for k, v in sorted(pairs.items())
         ]
 
+    def _snap_key(self):
+        return (self.height, len(self.staged_txs))
+
     def _state_root(self) -> bytes:
         from ..crypto import merkle
 
-        return merkle.hash_from_byte_slices(self._state_leaves(), device=False)
+        key = self._snap_key()
+        if self._root_cache is not None and self._root_cache[0] == key:
+            return self._root_cache[1]
+        root = merkle.hash_from_byte_slices(self._state_leaves(), device=False)
+        self._root_cache = (key, root)
+        return root
+
+    def _merkle_proofs(self):
+        """Cached (key->index, proofs) for the current snapshot — built
+        on the first proven query, not on the per-block app_hash path."""
+        from ..crypto import merkle
+
+        key = self._snap_key()
+        if self._proof_cache is not None and self._proof_cache[0] == key:
+            return self._proof_cache[1]
+        leaves = self._state_leaves()
+        index = {leaf[:-32]: i for i, leaf in enumerate(leaves)}
+        _root, proofs = merkle.proofs_from_byte_slices(leaves)
+        snap = (index, proofs)
+        self._proof_cache = (key, snap)
+        return snap
 
     def _query_proof(self, key: bytes):
         """ValueOp proof that key=value is in the state root.
@@ -206,18 +238,12 @@ class KVStoreApplication(Application):
         The ProofOps chain is one simple:v op (crypto/merkle.py ValueOp);
         the light client verifies it against the NEXT header's app_hash
         (light/rpc.py abci_query)."""
-        from ..crypto import merkle
         from ..wire import types_pb as tpb
 
-        leaves = self._state_leaves()
-        target = None
-        for i, leaf in enumerate(leaves):
-            if leaf[:-32] == key:
-                target = i
-                break
+        index, proofs = self._merkle_proofs()
+        target = index.get(key)
         if target is None:
             return None
-        _, proofs = merkle.proofs_from_byte_slices(leaves)
         p = proofs[target]
         vop = tpb.ValueOpProto(
             key=key,

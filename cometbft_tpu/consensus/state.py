@@ -441,12 +441,18 @@ class ConsensusState(Service):
                 stalled_checks += 1
                 if stalled_checks >= 2:
                     # Re-read the round state at the last instant: the
-                    # machine may have progressed since the idle samples,
-                    # and a re-kick carrying the old (H,R,S) would be
-                    # dropped as stale after replacing a real timer.
+                    # machine may have progressed since the idle samples.
+                    # If it did, there is no stall — bail instead of
+                    # kicking the CURRENT step (a 0.05 s re-kick of a
+                    # just-entered propose step would time it out almost
+                    # immediately) or counting a false fire.
                     with self._mtx:
                         rs = self.rs
                         cur = (rs.height, rs.round, rs.step)
+                    if cur != last:
+                        stalled_checks = 0
+                        last = cur
+                        continue
                     fired = False
                     if rs.step in kickable:
                         # schedule_if_idle never replaces a pending

@@ -11,19 +11,19 @@ hash-to-curve are self-contained Python over bigints — no native
 dependency — and the type is always importable; ``ENABLED`` mirrors the
 reference's ``Enabled`` const.
 
-Deviation, documented: hash-to-curve uses the Shallue–van de Woestijne
-map (RFC 9380 §6.6.1) instead of the isogeny-based simplified-SWU
-mapping blst uses, and the DST names the SVDW suite accordingly.
-SvdW's constants are derivable from the curve equation alone (RFC 9380
-§H.1), so the map is fully self-contained and verifiably correct; the
-isogeny route needs the 3-isogeny coefficient tables, which are
-external data.  Signatures are internally consistent and secure, but
-not byte-compatible with blst-produced signatures until the SSWU
-isogeny constants are wired in and the DST switched back.
+Hash-to-curve is the standard isogeny-based simplified-SWU suite
+``BLS12381G2_XMD:SHA-256_SSWU_RO_`` (RFC 9380 §8.8.2) with the
+reference's DST, so signatures are wire-compatible with blst-based
+networks: the map targets the 3-isogenous curve E' (A' = 240·I,
+B' = 1012·(1+I), Z = −(2+I)), applies the 3-isogeny with the RFC 9380
+Appendix E.3 coefficient tables, and clears the cofactor by the RFC's
+h_eff scalar.  Conformance is pinned by the RFC 9380 J.10.1 vectors in
+tests/test_bls12381.py.
 
-Verification cost on host Python is ~1 s/pairing — this key type is for
-protocol completeness (the reference gates it off by default too); the
-hot path remains Ed25519 on the TPU plane.
+Verification cost on host Python is ~1 s/pairing (≈50 ms through the
+native pairing core, native/bls381.cc) — this key type is for protocol
+completeness (the reference gates it off by default too); the hot path
+remains Ed25519 on the TPU plane.
 """
 
 from __future__ import annotations
@@ -41,18 +41,15 @@ from .hash import sum_truncated
 X_PARAM = -0xD201000000010000
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
-H1 = (X_PARAM - 1) ** 2 // 3  # G1 cofactor
 _x = X_PARAM
 H2 = (
     _x**8 - 4 * _x**7 + 5 * _x**6 - 4 * _x**4 + 6 * _x**3 - 4 * _x**2 - 4 * _x + 13
-) // 9  # G2 cofactor
+) // 9  # G2 cofactor; kept to pin H_EFF_G2 = H2 * (3x^2 - 3) below
 
-# The reference's suite is ..._SSWU_RO_NUL_ (key_bls12381.go:30); this
-# implementation runs the SVDW sibling suite (RFC 9380 §8.8.2 naming) and
-# says so in its DST — a mapping/DST mismatch would be silently
-# non-conformant, a different suite ID is honest.
-DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_NUL_"
-POP_DST = b"BLS_POP_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
+# The reference's exact ciphersuite (key_bls12381.go:30-41): basic
+# (NUL) scheme over the standard SSWU G2 suite.
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"
+POP_DST = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
 PUBKEY_SIZE = 48
 SIG_SIZE = 96
@@ -124,16 +121,6 @@ XI = (1, 1)  # u + 1, the sextic non-residue
 # Is there a square root?  p^2 ≡ 9 mod 16; use the generic Tonelli–Shanks
 # over Fp2 via the norm trick: sqrt(a) for a = (x,y) — we use the
 # "complex method": sqrt of a+bu via sqrt over Fp of the norm.
-
-
-def f2_legendre(x) -> int:
-    """1 if x is a nonzero square in Fp2, -1 if non-square, 0 if zero."""
-    if x == F2_ZERO:
-        return 0
-    # norm map N(a+bu) = a^2 + b^2 is onto Fp*; x is a square in Fp2 iff
-    # N(x) is a square in Fp.
-    n = (x[0] * x[0] + x[1] * x[1]) % P
-    return 1 if pow(n, (P - 1) // 2, P) == 1 else -1
 
 
 def _fp_sqrt(n: int) -> int | None:
@@ -616,7 +603,17 @@ def _native_pairing_lib():
             lib = ctypes.CDLL(so)
             lib.bls381_pairing_product_is_one.restype = ctypes.c_int
             _NATIVE = lib
-        except Exception:  # noqa: BLE001 — pure-Python path still works
+        except Exception as e:  # noqa: BLE001 — pure-Python path still works
+            # Loud, once: the fallback is ~20x slower per pairing (a
+            # BLS-heavy validator set becomes minutes per commit), so an
+            # operator must be able to see WHY the fast path is off.
+            from ..utils.log import get_logger
+
+            get_logger("bls12381").error(
+                f"native pairing core unavailable ({e}); falling back to "
+                "pure-Python pairings (~1 s each). Prebuild with "
+                "`make -C native` to avoid in-process compilation."
+            )
             _NATIVE = False
     return _NATIVE or None
 
@@ -686,40 +683,6 @@ def _hash_to_field_fp2(msg: bytes, count: int, dst: bytes):
     return out
 
 
-def _svdw_z_fp2():
-    """RFC 9380 §H.1: pick Z for the SvdW map over g(x) = x^3 + 4(u+1):
-    g(Z) != 0; -(3Z^2 + 4A)/4 nonzero and square (A = 0 here); and at
-    least one of g(Z), g(-Z/2) is square."""
-
-    def g(x):
-        return f2_add(f2_mul(f2_sqr(x), x), _FP2.b)
-
-    def ok(z):
-        gz = g(z)
-        if gz == F2_ZERO:
-            return False
-        qu = f2_mul(f2_neg(f2_muls(f2_sqr(z), 3)), f2_inv((4, 0)))
-        if qu == F2_ZERO or f2_legendre(qu) != 1:
-            return False
-        g_nh = g(f2_mul(z, f2_neg(f2_inv((2, 0)))))
-        return f2_legendre(gz) == 1 or f2_legendre(g_nh) == 1
-
-    for c in range(1, 9):
-        for z in ((c, 0), (P - c, 0), (0, c), (0, P - c), (c, c), (P - c, P - c)):
-            if ok(z):
-                return z
-    raise RuntimeError("no SvdW Z found")
-
-
-_SVDW_Z = _svdw_z_fp2()
-# Precomputed SvdW constants (RFC 9380 §6.6.1):
-#   c1 = g(Z); c2 = -Z/2; c3 = sqrt(-g(Z)*(3Z^2+4A)) with sgn0(c3)==0;
-#   c4 = -4*g(Z)/(3Z^2+4A)
-_SVDW_GZ = f2_add(f2_mul(f2_sqr(_SVDW_Z), _SVDW_Z), _FP2.b)
-_SVDW_C2 = f2_mul(_SVDW_Z, f2_neg(f2_inv((2, 0))))
-_SVDW_3Z2 = f2_muls(f2_sqr(_SVDW_Z), 3)
-
-
 def _sgn0_fp2(x) -> int:
     a, b = x
     sign_0 = a & 1
@@ -728,54 +691,152 @@ def _sgn0_fp2(x) -> int:
     return sign_0 | (zero_0 & sign_1)
 
 
-_SVDW_C3 = f2_sqrt(f2_mul(f2_neg(_SVDW_GZ), _SVDW_3Z2))
-if _SVDW_C3 is None:
-    raise RuntimeError("SvdW c3 not a square")
-if _sgn0_fp2(_SVDW_C3) != 0:
-    _SVDW_C3 = f2_neg(_SVDW_C3)
-_SVDW_C4 = f2_mul(f2_muls(_SVDW_GZ, 4), f2_inv(f2_neg(_SVDW_3Z2)))
+# Simplified-SWU target curve E': y^2 = x^3 + A'x + B' over Fp2, the
+# curve 3-isogenous to G2's (RFC 9380 §8.8.2).  A' = 240·I,
+# B' = 1012·(1+I), Z = −(2+I).
+_SSWU_A = (0, 240)
+_SSWU_B = (1012, 1012)
+_SSWU_Z = (P - 2, P - 1)
 
 
-def _map_to_curve_svdw(u):
-    """RFC 9380 §6.6.1 straight-line SvdW map into E'(Fp2)."""
+def _map_to_curve_sswu_g2(u):
+    """Simplified SWU for AB ≠ 0 (RFC 9380 §6.6.2), into E'(Fp2)."""
 
-    def g(x):
-        return f2_add(f2_mul(f2_sqr(x), x), _FP2.b)
+    def gp(x):
+        return f2_add(f2_add(f2_mul(f2_sqr(x), x), f2_mul(_SSWU_A, x)), _SSWU_B)
 
-    tv1 = f2_mul(f2_sqr(u), _SVDW_GZ)
-    tv2 = f2_add(F2_ONE, tv1)
-    tv1 = f2_sub(F2_ONE, tv1)
-    tv3 = f2_mul(tv1, tv2)
-    # RFC 9380 straight-line convention: inv0 (1/0 = 0).  In the
-    # exceptional case tv3 == 0 the candidates degenerate to x1 = x2 =
-    # -Z/2 and x3 = Z, of which at least one is square by the SvdW Z
-    # selection criteria — no special-case branch (the old x = Z fallback
-    # crashed when g(Z) happened to be non-square).
-    tv3 = f2_inv(tv3) if tv3 != F2_ZERO else F2_ZERO
-    tv4 = f2_mul(f2_mul(f2_mul(u, tv1), tv3), _SVDW_C3)
-    x1 = f2_sub(_SVDW_C2, tv4)
-    x2 = f2_add(_SVDW_C2, tv4)
-    x3 = f2_add(
-        _SVDW_Z,
-        f2_mul(_SVDW_C4, f2_sqr(f2_mul(f2_mul(tv2, tv2), tv3))),
+    zu2 = f2_mul(_SSWU_Z, f2_sqr(u))
+    tv1 = f2_add(f2_sqr(zu2), zu2)  # Z^2 u^4 + Z u^2
+    if tv1 == F2_ZERO:
+        x1 = f2_mul(_SSWU_B, f2_inv(f2_mul(_SSWU_Z, _SSWU_A)))
+    else:
+        x1 = f2_mul(
+            f2_mul(f2_neg(_SSWU_B), f2_inv(_SSWU_A)),
+            f2_add(F2_ONE, f2_inv(tv1)),
+        )
+    gx1 = gp(x1)
+    y1 = f2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x = f2_mul(zu2, x1)
+        y = f2_sqrt(gp(x))
+        if y is None:  # impossible by SSWU's exceptional-case analysis
+            raise RuntimeError("SSWU: neither candidate is on E'")
+    if _sgn0_fp2(u) != _sgn0_fp2(y):
+        y = f2_neg(y)
+    return (x, y)
+
+
+def _fp2c(c0: int, c1: int):
+    return (c0, c1)
+
+
+# 3-isogeny E' → E coefficient tables (RFC 9380 Appendix E.3 — public
+# protocol constants, ascending powers of x').
+_ISO3_XNUM = (
+    _fp2c(
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    _fp2c(
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    _fp2c(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    _fp2c(
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+)
+_ISO3_XDEN = (
+    _fp2c(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    _fp2c(
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    F2_ONE,
+)
+_ISO3_YNUM = (
+    _fp2c(
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    _fp2c(
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    _fp2c(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    _fp2c(
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+)
+_ISO3_YDEN = (
+    _fp2c(
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    _fp2c(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    _fp2c(
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    F2_ONE,
+)
+
+# Cofactor-clearing scalar h_eff for the G2 suite (RFC 9380 §8.8.2).
+# Divisible by the G2 cofactor h2, so the result lands in the r-order
+# subgroup; the exact multiple matters for conformance (blst clears via
+# the equivalent endomorphism method).
+H_EFF_G2 = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+assert H_EFF_G2 == H2 * (3 * X_PARAM**2 - 3), "h_eff must be h2*(3x^2-3)"
+
+
+def _iso3_map(pt):
+    """Evaluate the 3-isogeny E' → E at an affine point (Appendix E.3)."""
+    x, y = pt
+
+    def horner(coeffs):
+        acc = coeffs[-1]
+        for c in reversed(coeffs[:-1]):
+            acc = f2_add(f2_mul(acc, x), c)
+        return acc
+
+    xden = horner(_ISO3_XDEN)
+    yden = horner(_ISO3_YDEN)
+    if xden == F2_ZERO or yden == F2_ZERO:
+        return None  # kernel point: maps to the identity
+    return (
+        f2_mul(horner(_ISO3_XNUM), f2_inv(xden)),
+        f2_mul(y, f2_mul(horner(_ISO3_YNUM), f2_inv(yden))),
     )
-    for x in (x1, x2, x3):
-        y = f2_sqrt(g(x))
-        if y is not None:
-            if _sgn0_fp2(u) != _sgn0_fp2(y):
-                y = f2_neg(y)
-            return (x, y)
-    raise RuntimeError("SvdW: no candidate on curve")  # unreachable
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST):
-    """hash_to_curve for G2: two field elements, two maps, add, clear
-    cofactor.  Returns an affine point in the r-order subgroup."""
+    """hash_to_curve for G2 (RFC 9380 §3): two field elements, two
+    SSWU+isogeny maps, add, clear cofactor by h_eff.  Returns an affine
+    point in the r-order subgroup."""
     u0, u1 = _hash_to_field_fp2(msg, 2, dst)
-    q0 = _map_to_curve_svdw(u0)
-    q1 = _map_to_curve_svdw(u1)
-    s = _jac_add(_FP2, _from_affine(_FP2, q0), _from_affine(_FP2, q1))
-    cleared = _jac_mul(_FP2, s, H2)
+    q0 = _iso3_map(_map_to_curve_sswu_g2(u0))
+    q1 = _iso3_map(_map_to_curve_sswu_g2(u1))
+    s = _from_affine(_FP2, None)  # jacobian identity
+    for q in (q0, q1):
+        if q is not None:
+            s = _jac_add(_FP2, s, _from_affine(_FP2, q))
+    cleared = _jac_mul(_FP2, s, H_EFF_G2)
     aff = _to_affine(_FP2, cleared)
     if aff is None:  # astronomically unlikely; retry domain-separated
         return hash_to_g2(msg + b"\x00", dst)

@@ -91,7 +91,14 @@ class Node:
         genesis: GenesisDoc | None = None,
         client_creator=None,
         db: DB | None = None,
+        metrics_hub=None,
     ):
+        # metrics_hub: optional per-node utils/metrics.Hub so multiple
+        # in-process Nodes (tests/tools) keep separate registries; None =
+        # the process-global hub (one node per process, the e2e layout —
+        # the reference scopes metrics per node via its provider fn too,
+        # node.go DefaultMetricsProvider)
+        self._metrics_hub = metrics_hub
         self.config = config
         self.logger = get_logger("node")
         genesis = genesis or GenesisDoc.load(config.genesis_file())
@@ -337,7 +344,7 @@ class Node:
         # /metrics exposes one coherent set
         from .utils.metrics import hub as _metrics_hub
 
-        _h = _metrics_hub()
+        _h = self._metrics_hub if self._metrics_hub is not None else _metrics_hub()
         self.metrics_registry = _h.registry
         if getattr(_h, "node_metrics", None) is None:
             _h.node_metrics = NodeMetrics(self.metrics_registry)
