@@ -84,11 +84,55 @@ def cmd_start(args) -> int:
 
     signal.signal(signal.SIGINT, _sig)
     signal.signal(signal.SIGTERM, _sig)
+
+    # e2e network-partition hook (runner/perturb.go disconnect): SIGUSR1
+    # toggles severing this node's p2p sockets without touching the
+    # process, so the runner can partition and heal a live node the way
+    # the reference detaches a container from the docker network
+    def _partition_toggle(_s, _f):
+        sw = getattr(node, "switch", None)
+        if sw is None:
+            return
+        on = not sw._partitioned
+        node.logger.error(f"e2e: network partition {'ON' if on else 'OFF'}")
+        sw.set_partitioned(on)
+
+    signal.signal(signal.SIGUSR1, _partition_toggle)
     try:
         while not stop:
             time.sleep(0.2)
     finally:
         node.stop()
+    return 0
+
+
+def cmd_kvstore(args) -> int:
+    """Serve the kvstore app over the ABCI socket transport (the
+    reference's `abci-cli kvstore`, abci/cmd/abci-cli/abci-cli.go) — the
+    external-app half of the e2e generator's `abci=socket` axis."""
+    from .abci import KVStoreApplication
+    from .abci.kvstore import default_lanes
+    from .abci.server import SocketServer
+
+    app = KVStoreApplication(
+        lanes=default_lanes(),
+        snapshot_interval=args.snapshot_interval,
+        merkle_state=args.merkle,
+    )
+    addr = args.addr
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    srv = SocketServer(addr, app)
+    srv.start()
+    print(f"ABCI kvstore serving on {srv.laddr}", flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        srv.stop()
     return 0
 
 
@@ -464,11 +508,27 @@ def cmd_config(args) -> int:
     """commands/config + internal/confix: get/set/migrate TOML config."""
     cfg_path = os.path.join(args.home, "config", "config.toml")
     if args.action == "migrate":
-        # load whatever keys the old file has, re-emit the full current
-        # template with those values preserved (confix migrations)
+        # confix migration (internal/confix/migrations.go): report what
+        # changes, back up the original, re-emit the current template
+        # with the old file's recognized values preserved
+        from .config import migrate_report
+
+        report = migrate_report(args.home)
         cfg = load_config(args.home)
+        if os.path.exists(cfg_path):
+            import shutil
+
+            shutil.copy(cfg_path, cfg_path + ".bak")
         save_config(cfg)
-        print(f"migrated {cfg_path} to the current format")
+        for k in report["added"]:
+            print(f"  + {k} (new key, default value)")
+        for k in report["dropped"]:
+            print(f"  - {k} (obsolete, removed; value preserved in .bak)")
+        print(
+            f"migrated {cfg_path}: {len(report['kept'])} kept, "
+            f"{len(report['added'])} added, {len(report['dropped'])} dropped "
+            f"(backup: {cfg_path}.bak)"
+        )
         return 0
     cfg = load_config(args.home)
     obj, key = _config_resolve(cfg, args.key)
@@ -531,6 +591,12 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--rpc-laddr", default=None, dest="rpc_laddr")
     sp.add_argument("--persistent-peers", default=None)
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("kvstore", help="serve the kvstore app over the ABCI socket")
+    sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    sp.add_argument("--merkle", action="store_true")
+    sp.add_argument("--snapshot-interval", type=int, default=100)
+    sp.set_defaults(fn=cmd_kvstore)
 
     sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)

@@ -33,6 +33,10 @@ class BaseConfig:
     priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     log_level: str = "info"
+    # snapshot cadence for the built-in kvstore apps (the reference e2e
+    # app's snapshot_interval); statesync peers can only serve snapshots
+    # taken at these heights
+    app_snapshot_interval: int = 100
     tx_index: str = "kv"  # "kv" | "null" | "psql" (config.go TxIndexConfig)
     # for tx_index = "psql": a DB conn string — postgres when psycopg2 is
     # installed, or "sqlite:///path" (indexer/sink.py SQLEventSink)
@@ -70,6 +74,10 @@ class MempoolConfig:
 @dataclass
 class StatesyncConfig:
     enable: bool = False
+    # comma-separated full-node RPC endpoints the light-client state
+    # provider verifies against (config.go StateSyncConfig.RPCServers;
+    # first = primary, rest = witnesses)
+    rpc_servers: str = ""
     trust_height: int = 0
     trust_hash: str = ""
     trust_period: float = 168 * 3600.0  # seconds
@@ -189,6 +197,47 @@ def _apply(obj, data: dict) -> None:
     for f in fields(obj):
         if f.name in data:
             setattr(obj, f.name, data[f.name])
+
+
+def migrate_report(home: str) -> dict:
+    """confix-style migration summary (internal/confix): compare the
+    on-disk TOML against the current schema and report what a rewrite
+    would add (new keys at defaults), drop (obsolete keys), and keep.
+    Pure analysis — the caller decides whether to rewrite."""
+    cfg = Config(home=home)
+    path = cfg.config_file()
+    raw: dict = {}
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+
+    known: dict[str, set[str]] = {
+        "": {f.name for f in fields(cfg.base)},
+    }
+    for name, _cls in _SECTIONS.items():
+        known[name] = {f.name for f in fields(getattr(cfg, name))}
+
+    kept: list[str] = []
+    dropped: list[str] = []
+    present: dict[str, set[str]] = {"": set()}
+    for key, val in raw.items():
+        if isinstance(val, dict):
+            present[key] = set(val)
+            if key not in known:
+                dropped.extend(f"{key}.{k}" for k in val)
+                continue
+            for k in val:
+                (kept if k in known[key] else dropped).append(f"{key}.{k}")
+        else:
+            present[""].add(key)
+            (kept if key in known[""] else dropped).append(key)
+
+    added = []
+    for section, names in known.items():
+        have = present.get(section, set())
+        for k in sorted(names - have):
+            added.append(f"{section}.{k}" if section else k)
+    return {"added": added, "dropped": sorted(dropped), "kept": sorted(kept)}
 
 
 def save_config(cfg: Config) -> None:

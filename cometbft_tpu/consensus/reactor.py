@@ -182,6 +182,12 @@ class ConsensusReactor(Reactor):
             self.cs.update_to_state(state)
             self.wait_sync = False
             self.cs.start()
+        # Tell every peer where we are NOW that we can accept their
+        # catchup traffic (announcements were suppressed while syncing;
+        # reference SwitchToConsensus reaches peers via the NewRoundStep
+        # the restarted state machine emits — ours may have been replayed
+        # past that emission, so announce explicitly)
+        self._on_new_round_step(self.cs.get_round_state())
 
     # ------------------------------------------------------------- peers
 
@@ -198,8 +204,17 @@ class ConsensusReactor(Reactor):
             return
         if not peer.has_channel(STATE_STREAM):
             return  # peer runs no consensus reactor: skip the gossip threads
-        # announce our current round state so the peer can route to us
-        self._send_round_step(peer)
+        # Announce our round state so the peer can route to us — but NEVER
+        # while block/state sync is running (reactor.go:193 AddPeer gates
+        # on !conR.WaitSync()).  While syncing, receive() drops vote/data
+        # traffic; announcing a consensus height in that window makes
+        # peers serve catchup votes into the void and mark them sent in
+        # their per-peer votes_seen, which is only pruned when OUR height
+        # advances — so after the handoff nobody ever resends them and
+        # the node wedges at its handoff height (the perturbed-soak
+        # post-kill stall, root-caused round 5).
+        if not self.wait_sync:
+            self._send_round_step(peer)
         threading.Thread(
             target=self._gossip_data_routine, args=(peer, ps), daemon=True
         ).start()
@@ -369,7 +384,9 @@ class ConsensusReactor(Reactor):
         self.switch.broadcast(STATE_STREAM, wire)
 
     def _on_new_round_step(self, rs) -> None:
-        if self.switch is None:
+        if self.switch is None or self.wait_sync:
+            # syncing: we drop the vote/data traffic an announcement
+            # would draw (see add_peer) — stay silent until the handoff
             return
         wire = self._round_step_msg(rs)
         self.switch.broadcast(STATE_STREAM, wire)
@@ -527,7 +544,7 @@ class ConsensusReactor(Reactor):
                 # resend whenever the peer may not know us, and every few
                 # ticks regardless.
                 ticks += 1
-                if ps.height == 0 or ticks % 5 == 0:
+                if not self.wait_sync and (ps.height == 0 or ticks % 5 == 0):
                     self._send_round_step(peer)
                 if rs.votes is not None and ps.height == rs.height:
                     # query for the PEER's round (reactor.go:720 uses

@@ -45,6 +45,19 @@ def comb_min() -> int:
         return 512
 
 
+def comb_async_min() -> int:
+    """Set size above which a missing comb table builds in the
+    BACKGROUND while verification proceeds through the uncached kernel —
+    a large build must never stall consensus (the reference's
+    expanded-key LRU likewise fills lazily, ed25519.go:43,68).  Smaller
+    sets build synchronously: their build is fast and callers (and
+    tests) get the comb verifier deterministically on first use."""
+    try:
+        return int(os.environ.get("COMETBFT_TPU_COMB_ASYNC_MIN", "2048"))
+    except ValueError:
+        return 2048
+
+
 def create_batch_verifier(
     key_type: str, pubkeys: list[bytes] | None = None
 ) -> BatchVerifier:
@@ -65,5 +78,10 @@ def create_batch_verifier(
     if pubkeys is not None and len(pubkeys) >= comb_min():
         from ..models.comb_verifier import CombBatchVerifier, global_cache
 
+        if len(pubkeys) >= comb_async_min():
+            entry = global_cache().ensure_async(list(pubkeys))
+            if entry is None:
+                return TpuEd25519BatchVerifier()  # tables still warming
+            return CombBatchVerifier(entry)
         return CombBatchVerifier(global_cache().ensure(list(pubkeys)))
     return TpuEd25519BatchVerifier()

@@ -15,7 +15,11 @@ from .runner import Manifest, NodeSpec
 
 # weighted choices mirroring generate.go's testnetCombinations shape
 _TOPOLOGIES = [(2, 0.2), (3, 0.3), (4, 0.4), (5, 0.1)]
-_PERTURBATIONS = ["kill", "pause", "restart", None, None, None]
+_PERTURBATIONS = ["kill", "pause", "restart", "disconnect", None, None, None]
+# config-space axes (generate.go sweeps ABCI transports and DB backends
+# the same way; key types stay ed25519 — the consensus hot path)
+_ABCI = [("local", 0.7), ("socket", 0.3)]
+_DB = [("", 0.55), ("native", 0.15), ("sqlite", 0.15), ("memdb", 0.15)]
 
 
 def _weighted(rng: random.Random, pairs):
@@ -56,6 +60,8 @@ def generate(seed: int) -> Manifest:
                 perturbations=perturbations,
                 latency_ms=latency,
                 latency_jitter_ms=jitter,
+                abci=_weighted(rng, _ABCI),
+                db_backend=_weighted(rng, _DB),
             )
         )
     return Manifest(

@@ -25,11 +25,21 @@ class NodeSpec:
 
     name: str
     start_at: int = 0  # height to join at (0 = genesis)
-    perturbations: list[str] = field(default_factory=list)  # kill|pause|restart
+    # kill|pause|restart|disconnect (disconnect = network partition via
+    # SIGUSR1 toggle, the runner/perturb.go docker-disconnect analogue)
+    perturbations: list[str] = field(default_factory=list)
     # per-link shaping (runner/latency_emulation.go analogue): outbound
     # delay +- jitter applied at this node's sockets (utils/netutil)
     latency_ms: float = 0.0
     latency_jitter_ms: float = 0.0
+    # generator axes (generator/generate.go): ABCI transport and DB
+    # backend; "" = the config default
+    abci: str = "local"  # "local" | "socket" (external app process)
+    db_backend: str = ""  # "" | "native" | "sqlite" | "memdb"
+    # join mid-run via statesync (requires start_at > 0): the runner
+    # fetches trust height/hash from a running node right before launch
+    # (manifest.go StateSync)
+    state_sync: bool = False
 
 
 @dataclass
@@ -42,13 +52,16 @@ class Manifest:
 
 class E2ENode:
     def __init__(self, name: str, home: str, rpc_port: int,
-                 latency_ms: float = 0.0, latency_jitter_ms: float = 0.0):
+                 latency_ms: float = 0.0, latency_jitter_ms: float = 0.0,
+                 abci_port: int = 0):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
         self.latency_ms = latency_ms
         self.latency_jitter_ms = latency_jitter_ms
+        self.abci_port = abci_port  # non-zero: external socket app
         self.proc: subprocess.Popen | None = None
+        self.app_proc: subprocess.Popen | None = None
 
     def start(self) -> None:
         env = dict(os.environ)
@@ -56,6 +69,20 @@ class E2ENode:
         if self.latency_ms or self.latency_jitter_ms:
             env["COMETBFT_TPU_TEST_LATENCY_MS"] = (
                 f"{self.latency_ms}:{self.latency_jitter_ms}"
+            )
+        if self.abci_port and self.app_proc is None:
+            # external app rides the ABCI socket transport (the
+            # generator's abci=socket axis); it outlives node restarts
+            # the way the reference's app container does
+            self.app_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "cometbft_tpu", "kvstore",
+                    "--addr", f"tcp://127.0.0.1:{self.abci_port}",
+                    "--snapshot-interval", "2",
+                ],
+                env=env,
+                stdout=open(os.path.join(self.home, "app.log"), "ab"),
+                stderr=subprocess.STDOUT,
             )
         self.proc = subprocess.Popen(
             [
@@ -101,6 +128,12 @@ class E2ENode:
         if self.proc:
             self.proc.send_signal(signal.SIGCONT)
 
+    def partition_toggle(self) -> None:
+        """SIGUSR1: toggle severing the node's p2p sockets (cli.py
+        cmd_start's hook)."""
+        if self.proc:
+            self.proc.send_signal(signal.SIGUSR1)
+
     def terminate(self) -> None:
         if self.proc:
             try:
@@ -109,6 +142,13 @@ class E2ENode:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
             self.proc = None
+        if self.app_proc:
+            try:
+                self.app_proc.terminate()
+                self.app_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.app_proc.kill()
+            self.app_proc = None
 
 
 class Runner:
@@ -147,6 +187,16 @@ class Runner:
             cfg.instrumentation.pprof_laddr = (
                 f"127.0.0.1:{self.base_port + 2000 + i}"
             )
+            # frequent snapshots so a statesync joiner always finds one
+            # (the reference e2e app config sets snapshot_interval=3 the
+            # same way)
+            cfg.base.app_snapshot_interval = 2
+            abci_port = 0
+            if spec.abci == "socket":
+                abci_port = self.base_port + 3000 + i
+                cfg.base.proxy_app = f"tcp://127.0.0.1:{abci_port}"
+            if spec.db_backend:
+                cfg.base.db_backend = spec.db_backend
             save_config(cfg)
             self.nodes.append(
                 E2ENode(
@@ -155,6 +205,7 @@ class Runner:
                     self.base_port + 1000 + i,
                     latency_ms=spec.latency_ms,
                     latency_jitter_ms=spec.latency_jitter_ms,
+                    abci_port=abci_port,
                 )
             )
 
@@ -168,7 +219,32 @@ class Runner:
         tip = max(started_heights) if started_heights else 0
         for node, spec in zip(self.nodes, self.m.nodes):
             if spec.start_at > 0 and node.proc is None and tip >= spec.start_at:
+                if spec.state_sync:
+                    try:
+                        self._configure_statesync(node, spec)
+                    except Exception:  # noqa: BLE001
+                        continue  # trust root not available yet; retry
                 node.start()
+
+    def _configure_statesync(self, node: E2ENode, spec: NodeSpec) -> None:
+        """Write the joiner's trust root + rpc_servers right before
+        launch (runner/setup.go does this from the seed node's /commit —
+        the trust hash can only exist once the chain is running)."""
+        running = [n for n in self.nodes if n.proc is not None and n is not node]
+        if len(running) < 1:
+            raise RuntimeError("no running nodes to trust")
+        trust_h = max(1, spec.start_at - 2)
+        cm = running[0].rpc("commit", height=trust_h)
+        trust_hash = cm["signed_header"]["commit"]["block_id"]["hash"]
+        cfg = load_config(node.home)
+        cfg.statesync.enable = True
+        cfg.statesync.trust_height = trust_h
+        cfg.statesync.trust_hash = trust_hash
+        cfg.statesync.discovery_time = 2.0  # localnet: peers are right there
+        cfg.statesync.rpc_servers = ",".join(
+            f"127.0.0.1:{n.rpc_port}" for n in running[:2]
+        )
+        save_config(cfg)
 
     def load(self, round_id: int) -> None:
         """Submit txs through a random running node (runner/load.go)."""
@@ -203,6 +279,12 @@ class Runner:
                     node.terminate()
                     time.sleep(0.5)
                     node.start()
+                elif p == "disconnect":
+                    # network partition: sever sockets, not processes
+                    # (runner/perturb.go:47-60); heal after a few seconds
+                    node.partition_toggle()
+                    time.sleep(4.0)
+                    node.partition_toggle()
 
     def wait_for_height(self, h: int, timeout: float = 240.0) -> bool:
         deadline = time.monotonic() + timeout

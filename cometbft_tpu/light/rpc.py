@@ -46,7 +46,8 @@ _AMINO_TO_KEY_TYPE = {
 
 
 def _ts_from_rfc3339(s: str) -> Timestamp:
-    if not s or s.startswith("0001-01-01"):
+    # "1-01-01" tolerates pre-fix serializers whose %Y didn't zero-pad
+    if not s or s.startswith("0001-01-01") or s.startswith("1-01-01"):
         return Timestamp()
     frac_ns = 0
     if "." in s:
@@ -176,6 +177,48 @@ class HTTPProvider:
             self.rpc.call("broadcast_evidence", evidence=ev)
         except Exception:  # noqa: BLE001
             pass
+
+    def consensus_params(self, height: int):
+        """params_source seam for the statesync state provider
+        (statesync/stateprovider.go fetches params over RPC the same
+        way); the caller verifies the result against the light-verified
+        header's consensus_hash, so this source is untrusted."""
+        from ..types.params import (
+            BlockParams,
+            ConsensusParams,
+            EvidenceParams,
+            FeatureParams,
+            SynchronyParams,
+            ValidatorParams,
+            VersionParams,
+        )
+
+        j = self.rpc.call("consensus_params", height=height)["consensus_params"]
+        return ConsensusParams(
+            block=BlockParams(
+                max_bytes=int(j["block"]["max_bytes"]),
+                max_gas=int(j["block"]["max_gas"]),
+            ),
+            evidence=EvidenceParams(
+                max_age_num_blocks=int(j["evidence"]["max_age_num_blocks"]),
+                max_age_duration_ns=int(j["evidence"]["max_age_duration"]),
+                max_bytes=int(j["evidence"]["max_bytes"]),
+            ),
+            validator=ValidatorParams(
+                pub_key_types=list(j["validator"]["pub_key_types"])
+            ),
+            version=VersionParams(app=int(j.get("version", {}).get("app", 0))),
+            synchrony=SynchronyParams(
+                precision_ns=int(j["synchrony"]["precision"]),
+                message_delay_ns=int(j["synchrony"]["message_delay"]),
+            ),
+            feature=FeatureParams(
+                vote_extensions_enable_height=int(
+                    j["feature"]["vote_extensions_enable_height"]
+                ),
+                pbts_enable_height=int(j["feature"]["pbts_enable_height"]),
+            ),
+        )
 
 
 # ----------------------------------------------------------- verifying client

@@ -92,6 +92,7 @@ class ValsetCombCache:
         self._max = max_entries
         self._mtx = threading.Lock()
         self._building: dict[bytes, threading.Lock] = {}
+        self._async_inflight: set[bytes] = set()
 
     @staticmethod
     def fingerprint(pubkeys: list[bytes]) -> bytes:
@@ -133,6 +134,39 @@ class ValsetCombCache:
                     self._entries.popitem(last=False)
                 self._building.pop(fp, None)
             return entry
+
+    def ensure_async(self, pubkeys: list[bytes]) -> _CacheEntry | None:
+        """Non-blocking ensure: the entry if it's ready, else None with a
+        background build kicked off (once per fingerprint).  The caller
+        verifies through the uncached Straus kernel until the tables are
+        warm — the analog of the reference's lazily-filling expanded-key
+        LRU (ed25519.go:43,68), where the first verification under a new
+        key also pays an expansion the cache then amortizes.  A validator
+        -set change therefore never stalls consensus behind a table
+        build: the new set's tables (an incremental churn build when the
+        previous set's entry exists) land a few blocks later."""
+        fp = self.fingerprint(pubkeys)
+        e = self.get(fp)
+        if e is not None:
+            return e
+        with self._mtx:
+            if fp in self._async_inflight:
+                return None  # background build already running
+            self._async_inflight.add(fp)
+        pubkeys = list(pubkeys)
+
+        def build():
+            try:
+                # ensure() owns the per-fingerprint build lock, so a
+                # concurrent synchronous caller can never duplicate the
+                # build — whoever wins, the loser finds the entry
+                self.ensure(pubkeys)
+            finally:
+                with self._mtx:
+                    self._async_inflight.discard(fp)
+
+        threading.Thread(target=build, name="comb-build", daemon=True).start()
+        return None
 
     def _newest(self) -> _CacheEntry | None:
         with self._mtx:

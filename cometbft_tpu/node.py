@@ -62,9 +62,10 @@ def make_app(cfg: Config):
     """The in-process demo apps, or a socket client creator for an
     external app (proxy/client.go DefaultClientCreator)."""
     pa = cfg.base.proxy_app
+    snap = cfg.base.app_snapshot_interval
     if pa == "kvstore":
         return local_client_creator(
-            KVStoreApplication(lanes=default_lanes(), snapshot_interval=100)
+            KVStoreApplication(lanes=default_lanes(), snapshot_interval=snap)
         )
     if pa == "kvstore-merkle":
         # Merkle-committed state: app_hash is a root over the kv pairs and
@@ -72,7 +73,7 @@ def make_app(cfg: Config):
         # verify end-to-end (light/rpc.py abci_query)
         return local_client_creator(
             KVStoreApplication(
-                lanes=default_lanes(), snapshot_interval=100, merkle_state=True
+                lanes=default_lanes(), snapshot_interval=snap, merkle_state=True
             )
         )
     if pa == "noop":
@@ -368,14 +369,26 @@ class Node:
 
     def _make_state_provider(self):
         sscfg = self.config.statesync
-        # the local stores are empty; providers must be remote.  The
-        # in-process BlockStoreProvider covers tests; RPC-backed providers
-        # plug in here once configured.
-        providers = getattr(self, "state_providers", None) or [
-            BlockStoreProvider(
-                self.genesis.chain_id, self.block_store, self.state_store
-            )
-        ]
+        # the local stores are empty; providers must be remote: the
+        # configured rpc_servers become light HTTP providers
+        # (statesync/stateprovider.go:58 rpcClient per server); tests may
+        # inject in-process providers via `state_providers`
+        providers = getattr(self, "state_providers", None)
+        if not providers and sscfg.rpc_servers:
+            from .light.rpc import HTTPProvider
+            from .rpc.client import HTTPClient
+
+            providers = [
+                HTTPProvider(self.genesis.chain_id, HTTPClient(addr.strip()))
+                for addr in sscfg.rpc_servers.split(",")
+                if addr.strip()
+            ]
+        if not providers:
+            providers = [
+                BlockStoreProvider(
+                    self.genesis.chain_id, self.block_store, self.state_store
+                )
+            ]
         return LightClientStateProvider(
             self.genesis.chain_id,
             self.genesis.initial_height,

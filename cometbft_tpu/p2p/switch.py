@@ -47,6 +47,7 @@ class Switch(Service):
         self.max_peers = max_peers
         self.persistent_addrs: set[str] = set()
         self._dialing: set[str] = set()
+        self._partitioned = False
         self._mtx = threading.Lock()
         self.logger = get_logger("switch")
         self._accept_thread: threading.Thread | None = None
@@ -111,6 +112,9 @@ class Switch(Service):
                     self.logger.error(f"accept error: {e}")
                     continue
                 return
+            if self._partitioned:
+                conn.close()  # network-partition perturbation active
+                continue
             if info.node_id == self.transport.node_info.node_id:
                 self.logger.info("rejecting inbound connection claiming our id")
                 conn.close()
@@ -142,6 +146,8 @@ class Switch(Service):
         attempts = 0
         try:
             while self.is_running():
+                if self._partitioned:
+                    return  # healing redials persistent addrs
                 try:
                     conn, info = self.transport.dial(addr)
                 except Exception as e:  # noqa: BLE001
@@ -181,6 +187,12 @@ class Switch(Service):
     def _add_peer_conn(
         self, conn, info, outbound: bool, persistent: bool = False, addr: str = ""
     ) -> None:
+        if self._partitioned:
+            # a dial/accept already past the earlier checks can land here
+            # after set_partitioned(True) severed everything — the
+            # partition must hold until healed
+            conn.close()
+            return
         peer = Peer(
             conn,
             info,
@@ -227,6 +239,19 @@ class Switch(Service):
         addr = peer.get("dial_addr")
         if peer.persistent and addr and self.is_running():
             self.dial_peer_async(addr, persistent=True)
+
+    def set_partitioned(self, on: bool) -> None:
+        """Network-partition perturbation (reference: e2e runner
+        `disconnect`, test/e2e/runner/perturb.go:47-60, which severs the
+        docker network).  Severs every peer socket and refuses new
+        connections while on; healing redials the persistent peers and
+        lets PEX/reconnect rebuild the rest."""
+        self._partitioned = on
+        if on:
+            for peer in self.peers.list():
+                self.stop_peer(peer, "network partition (e2e perturbation)")
+        else:
+            self.dial_peers_async(list(self.persistent_addrs), persistent=True)
 
     def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
         """Disconnect a misbehaving peer (switch.go StopPeerForError);

@@ -392,39 +392,83 @@ class Environment:
 
     # ----------------------------------------------------------- indexer
 
-    def tx(self, hash="") -> dict:
-        """rpc/core/tx.go Tx: lookup by hash in the tx indexer."""
+    def tx(self, hash="", prove=False) -> dict:
+        """rpc/core/tx.go Tx: lookup by hash in the tx indexer; with
+        prove, attach the Merkle proof of inclusion in the block's
+        data_hash (types/tx.go Txs.Proof)."""
         h = bytes.fromhex(hash) if isinstance(hash, str) else hash
         rec = self.node.tx_indexer.get(h)
         if rec is None:
             raise RPCError(-32603, f"tx {h.hex()} not found")
-        return self._tx_record_json(h, rec)
+        out = self._tx_record_json(h, rec)
+        if _as_bool(prove):
+            out["proof"] = self._tx_inclusion_proof(rec)
+        return out
 
-    def tx_search(self, query="", page=1, per_page=30) -> dict:
+    def _tx_inclusion_proof(self, rec: dict, _cache: dict | None = None) -> dict:
+        from ..types.tx import tx_proof
+
+        height = int(rec["height"])
+        blk = _cache.get(height) if _cache is not None else None
+        if blk is None:
+            blk = self.block_store.load_block(height)
+            if blk is None:
+                raise RPCError(-32603, f"block {rec['height']} not found")
+            if _cache is not None:
+                _cache[height] = blk
+        index = int(rec["index"])
+        root, proof = tx_proof(blk.data.txs, index)
+        return {
+            "root_hash": hex_up(root),
+            "data": rec["tx"],
+            "proof": {
+                "total": str(proof.total),
+                "index": str(proof.index),
+                "leaf_hash": b64(proof.leaf_hash),
+                "aunts": [b64(a) for a in proof.aunts],
+            },
+        }
+
+    @staticmethod
+    def _order(recs: list, order_by: str, keyfn) -> list:
+        """order_by semantics (rpc/core/tx.go): "asc" | "desc" | "" (asc)."""
+        if order_by in ("", None, "asc"):
+            return sorted(recs, key=keyfn)
+        if order_by == "desc":
+            return sorted(recs, key=keyfn, reverse=True)
+        raise RPCError(-32602, "order_by must be 'asc' or 'desc'")
+
+    def tx_search(self, query="", prove=False, page=1, per_page=30, order_by="") -> dict:
         """rpc/core/tx.go TxSearch over the kv indexer."""
         try:
             recs = self.node.tx_indexer.search(query, limit=10_000)
         except ValueError as e:
             raise RPCError(-32602, f"invalid query: {e}") from e
+        recs = self._order(
+            recs, order_by, lambda r: (int(r["height"]), int(r["index"]))
+        )
         page = max(1, int(page or 1))
         per_page = min(100, max(1, int(per_page or 30)))
         start = (page - 1) * per_page
         sel = recs[start : start + per_page]
         import base64 as _b64
 
-        return {
-            "txs": [
-                self._tx_record_json(tx_hash(_b64.b64decode(r["tx"])), r)
-                for r in sel
-            ],
-            "total_count": str(len(recs)),
-        }
+        prove = _as_bool(prove)
+        blk_cache: dict = {}  # page-of-results often shares blocks
+        out = []
+        for r in sel:
+            j = self._tx_record_json(tx_hash(_b64.b64decode(r["tx"])), r)
+            if prove:
+                j["proof"] = self._tx_inclusion_proof(r, blk_cache)
+            out.append(j)
+        return {"txs": out, "total_count": str(len(recs))}
 
-    def block_search(self, query="", page=1, per_page=30) -> dict:
+    def block_search(self, query="", page=1, per_page=30, order_by="") -> dict:
         try:
             heights = self.node.block_indexer.search(query, limit=10_000)
         except ValueError as e:
             raise RPCError(-32602, f"invalid query: {e}") from e
+        heights = self._order(heights, order_by, lambda h: h)
         page = max(1, int(page or 1))
         per_page = min(100, max(1, int(per_page or 30)))
         sel = heights[(page - 1) * per_page : (page - 1) * per_page + per_page]
@@ -490,8 +534,7 @@ class Environment:
     def abci_query(self, path="", data="", height=0, prove=False) -> dict:
         if isinstance(data, str):
             data = bytes.fromhex(data) if data else b""
-        if isinstance(prove, str):
-            prove = prove.lower() in ("1", "true", "t")
+        prove = _as_bool(prove)
         resp = self.node.app_conns.query.query(
             abci.QueryRequest(
                 path=path, data=data, height=int(height or 0), prove=bool(prove)
@@ -594,6 +637,21 @@ class Environment:
             "txs": [b64(t) for t in txs],
         }
 
+    def unconfirmed_tx(self, hash="") -> dict:
+        """rpc/core/mempool.go UnconfirmedTx: fetch one pending tx by key."""
+        h = bytes.fromhex(hash) if isinstance(hash, str) else hash
+        entry = self.node.mempool.get_entry(h)
+        if entry is None:
+            raise RPCError(-32603, f"tx {h.hex()} not found in mempool")
+        return {"tx": b64(entry.tx)}
+
+    def unsafe_flush_mempool(self) -> dict:
+        """rpc/core/mempool.go UnsafeFlushMempool (unsafe-gated,
+        routes.go:63)."""
+        self._require_unsafe()
+        self.node.mempool.flush()
+        return {}
+
     def num_unconfirmed_txs(self) -> dict:
         mp = self.node.mempool
         return {
@@ -637,8 +695,26 @@ class Environment:
                 "validator": {
                     "pub_key_types": list(params.validator.pub_key_types)
                 },
+                "version": {"app": str(params.version.app)},
+                "synchrony": {
+                    "precision": str(params.synchrony.precision_ns),
+                    "message_delay": str(params.synchrony.message_delay_ns),
+                },
+                "feature": {
+                    "vote_extensions_enable_height": str(
+                        params.feature.vote_extensions_enable_height
+                    ),
+                    "pbts_enable_height": str(params.feature.pbts_enable_height),
+                },
             },
         }
+
+
+def _as_bool(v) -> bool:
+    """URI-route params arrive as strings; 'false' must not be truthy."""
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "t")
+    return bool(v)
 
 
 def _parse_hash(h: str) -> bytes:
@@ -681,9 +757,11 @@ ROUTES = {
     "header": ("height", Environment.header),
     "header_by_hash": ("hash", Environment.header_by_hash),
     "commit": ("height", Environment.commit),
-    "tx": ("hash", Environment.tx),
-    "tx_search": ("query,page,per_page", Environment.tx_search),
-    "block_search": ("query,page,per_page", Environment.block_search),
+    "tx": ("hash,prove", Environment.tx),
+    "tx_search": ("query,prove,page,per_page,order_by", Environment.tx_search),
+    "block_search": ("query,page,per_page,order_by", Environment.block_search),
+    "unconfirmed_tx": ("hash", Environment.unconfirmed_tx),
+    "unsafe_flush_mempool": ("", Environment.unsafe_flush_mempool),
     "validators": ("height,page,per_page", Environment.validators),
     "abci_info": ("", Environment.abci_info),
     "abci_query": ("path,data,height,prove", Environment.abci_query),

@@ -28,7 +28,13 @@ def _rfc3339(t) -> str:
     ns = t.unix_ns()
     dt = datetime.datetime.fromtimestamp(ns // 10**9, datetime.timezone.utc)
     frac = ns % 10**9
-    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    # strftime %Y does NOT zero-pad years < 1000 on glibc: the zero time
+    # (year 1, absent commit signatures) must still round-trip as
+    # RFC 3339 "0001-01-01T00:00:00Z"
+    base = (
+        f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+        f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}"
+    )
     return f"{base}.{frac:09d}Z" if frac else base + "Z"
 
 

@@ -1,0 +1,141 @@
+"""Benchmark the native storage engine at blocksync-replay scale
+(round-5 verdict item 8; reference: store/bench_test.go + pebbledb.go).
+
+Simulates the block-store write pattern of a 50k-block catch-up: per
+height one batch of meta + parts + commit (BLOCK_KB of payload split
+into part-sized values), interleaved periodic reads, then pruning half
+the range and compacting.  Reports write/read/prune throughput, max
+single-batch stall, compaction pause, and the engine's resident index
+cost (RSS growth per key).
+
+Run:  python scripts/bench_native_store.py [n_blocks] [block_kb]
+Appends one JSON line per stage to NATIVE_BENCH_OUT
+(default /tmp/native_store_bench.jsonl).
+"""
+
+import json
+import os
+import resource
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.store.native_db import NativeDB  # noqa: E402
+
+OUT = os.environ.get("NATIVE_BENCH_OUT", "/tmp/native_store_bench.jsonl")
+
+
+def emit(stage: str, **data) -> None:
+    rec = {"stage": stage, **data}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> None:
+    n_blocks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    block_kb = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    part_size = 4096
+    payload = os.urandom(block_kb * 1024)
+    parts = [
+        payload[i : i + part_size] for i in range(0, len(payload), part_size)
+    ]
+    home = tempfile.mkdtemp(prefix="native-bench-")
+    db = NativeDB(os.path.join(home, "blockstore.db"))
+    try:
+        rss0 = rss_mb()
+        t0 = time.perf_counter()
+        worst_batch = 0.0
+        for h in range(1, n_blocks + 1):
+            hb = h.to_bytes(8, "big")
+            sets = [(b"H:" + hb, b"meta" * 8), (b"C:" + hb, payload[:512])]
+            for i, part in enumerate(parts):
+                sets.append((b"P:" + hb + i.to_bytes(2, "big"), part))
+            tb = time.perf_counter()
+            db.write_batch(sets)
+            worst_batch = max(worst_batch, time.perf_counter() - tb)
+            if h % 997 == 0:  # interleaved reads, like gossip serving
+                for rh in (1, h // 2, h):
+                    db.get(b"H:" + rh.to_bytes(8, "big"))
+        dt = time.perf_counter() - t0
+        keys = n_blocks * (2 + len(parts))
+        emit(
+            "write",
+            blocks=n_blocks,
+            block_kb=block_kb,
+            blocks_per_s=round(n_blocks / dt, 1),
+            mb_per_s=round(n_blocks * block_kb / 1024 / dt, 1),
+            worst_batch_ms=round(worst_batch * 1e3, 1),
+            keys=keys,
+            index_rss_mb=round(rss_mb() - rss0, 1),
+            rss_bytes_per_key=round((rss_mb() - rss0) * 1048576 / keys, 1),
+        )
+
+        t0 = time.perf_counter()
+        nreads = 5_000
+        for i in range(nreads):
+            h = 1 + (i * 9973) % n_blocks
+            hb = h.to_bytes(8, "big")
+            assert db.get(b"H:" + hb) is not None
+            db.get(b"P:" + hb + (0).to_bytes(2, "big"))
+        dt = time.perf_counter() - t0
+        emit("read", reads=2 * nreads, reads_per_s=round(2 * nreads / dt, 1))
+
+        # iterate a 1000-block range (RPC blockchain_info pattern)
+        t0 = time.perf_counter()
+        n = sum(
+            1
+            for _ in db.iterator(
+                b"H:" + (1).to_bytes(8, "big"),
+                b"H:" + (1001).to_bytes(8, "big"),
+            )
+        )
+        emit("scan", rows=n, seconds=round(time.perf_counter() - t0, 3))
+
+        # prune the first half (retain-height advance), then compact
+        t0 = time.perf_counter()
+        for h in range(1, n_blocks // 2 + 1):
+            hb = h.to_bytes(8, "big")
+            dels = [b"H:" + hb, b"C:" + hb] + [
+                b"P:" + hb + i.to_bytes(2, "big") for i in range(len(parts))
+            ]
+            db.write_batch([], dels)
+        prune_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        db.compact()
+        compact_s = time.perf_counter() - t0
+        emit(
+            "prune",
+            pruned_blocks=n_blocks // 2,
+            prune_s=round(prune_s, 1),
+            compact_pause_s=round(compact_s, 2),
+            disk_mb=round(
+                sum(
+                    os.path.getsize(os.path.join(home, f))
+                    for f in os.listdir(home)
+                    if os.path.isfile(os.path.join(home, f))
+                )
+                / 1048576,
+                1,
+            ),
+        )
+
+        # survivors still readable after compaction
+        hb = (n_blocks).to_bytes(8, "big")
+        assert db.get(b"H:" + hb) is not None
+        assert db.get(b"H:" + (1).to_bytes(8, "big")) is None
+        emit("done", ok=True)
+    finally:
+        db.close()
+        shutil.rmtree(home, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

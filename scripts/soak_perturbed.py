@@ -26,14 +26,26 @@ OUT = os.environ.get("SOAK_OUT", "/tmp/soak_perturbed.jsonl")
 
 def one_run(i: int, base_port: int) -> dict:
     out_dir = tempfile.mkdtemp(prefix=f"soak{i}-")
+    variant = os.environ.get("SOAK_VARIANT", "full")
+    if variant == "kill":
+        # kill-focused: maximize post-restart catchup interleavings (the
+        # run-41 stall class: killed node wedges at its handoff height)
+        nodes = [
+            NodeSpec("stable0"),
+            NodeSpec("killed1", perturbations=["kill"]),
+            NodeSpec("killed2", perturbations=["kill"]),
+            NodeSpec("stable1"),
+        ]
+    else:
+        nodes = [
+            NodeSpec("stable0", perturbations=["disconnect"]),
+            NodeSpec("killed", perturbations=["kill"]),
+            NodeSpec("paused", perturbations=["pause"], abci="socket"),
+            NodeSpec("late", start_at=4, latency_ms=60, latency_jitter_ms=20),
+        ]
     m = Manifest(
         chain_id=f"soak-{i}",
-        nodes=[
-            NodeSpec("stable0"),
-            NodeSpec("killed", perturbations=["kill"]),
-            NodeSpec("paused", perturbations=["pause"]),
-            NodeSpec("late", start_at=4, latency_ms=60, latency_jitter_ms=20),
-        ],
+        nodes=nodes,
         target_height=6,
         load_tx_per_round=3,
     )
@@ -77,9 +89,33 @@ def one_run(i: int, base_port: int) -> dict:
     except Exception as e:  # noqa: BLE001
         rec["error"] = f"{type(e).__name__}: {e}"
     finally:
+        if not rec["ok"]:
+            # capture the stalled nodes' thread dumps + p2p state BEFORE
+            # teardown — a failing interleaving is rare and the logs are
+            # the only evidence
+            diag = {}
+            for node in r.nodes:
+                if node.proc is None:
+                    diag[node.name] = "not running"
+                    continue
+                try:
+                    diag[node.name] = {
+                        "height": node.height(),
+                        "net_info": node.rpc("net_info"),
+                    }
+                except Exception as de:  # noqa: BLE001
+                    diag[node.name] = f"rpc dead: {de}"
+            rec["diag"] = diag
+            r.dump_stalled(10**9)
         r.stop_all()
         rec["wall_s"] = round(time.monotonic() - t0, 1)
-        shutil.rmtree(out_dir, ignore_errors=True)
+        if rec["ok"]:
+            shutil.rmtree(out_dir, ignore_errors=True)
+        else:
+            keep = f"/tmp/soak-fail-{i}-{int(time.time())}"
+            shutil.move(out_dir, keep)
+            rec["kept_dir"] = keep
+            print(f"KEPT failing run dir: {keep}", flush=True)
     return rec
 
 

@@ -18,6 +18,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 OUT = os.environ.get("TPU_MEASURE_OUT", "/tmp/tpu_measurements.jsonl")
+# measure the warm comb path: never route timed calls through the
+# async-build Straus fallback
+os.environ.setdefault("COMETBFT_TPU_COMB_ASYNC_MIN", str(1 << 30))
 
 
 def emit(stage: str, **data) -> None:
@@ -135,6 +138,35 @@ def main() -> None:
         )
       except Exception as e:  # noqa: BLE001
         emit("bench_10k", error=str(e))
+
+    # ---- stage 3b: incremental churn on the 10k set (round-5 verdict
+    # item 2: table ready fast after 1% churn; the full build is the
+    # r3-measured ~300 s pain point)
+    if os.environ.get("TPU_MEASURE_SKIP_10K") != "1":
+      try:
+        from cometbft_tpu.models import comb_verifier as cv
+
+        rng = np.random.default_rng(7)
+        keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(10_000)]
+        pubs = [k.pub_key().data for k in keys]  # same set as bench_10k
+        cache = cv.global_cache()
+        cache.ensure(pubs)  # warm (already built by stage 3)
+        for frac, nch in (("1pct", 100), ("10pct", 1000)):
+            fresh = [
+                host.PrivKey.from_seed(b"churn" + i.to_bytes(4, "big")).pub_key().data
+                for i in range(nch)
+            ]
+            churned = pubs[nch:] + fresh
+            t0 = time.perf_counter()
+            cache.ensure(churned)
+            emit(
+                "churn",
+                frac=frac,
+                changed=nch,
+                build_s=round(time.perf_counter() - t0, 2),
+            )
+      except Exception as e:  # noqa: BLE001
+        emit("churn", error=str(e))
 
     # ---- stage 4: blocksync streamed replay (5k validators)
     try:

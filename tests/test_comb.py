@@ -117,3 +117,57 @@ def test_create_batch_verifier_routes_to_comb(monkeypatch):
         bv.add(p, m, s)
     ok, per = bv.verify()
     assert ok and per == [True] * 3
+
+
+def test_incremental_churn_builds_only_changed_rows(monkeypatch):
+    """Validator churn must cost O(changed), not O(set): swapping k keys
+    of a cached set routes exactly one pow2-bucket build of ~k rows
+    through the table kernel, with every unchanged row gathered from the
+    previous entry's device tables (models/comb_verifier._build).
+    Round-5 verdict item 2 (the reference's always-warm expanded-key
+    LRU, ed25519.go:43,68)."""
+    from cometbft_tpu.models import comb_verifier as cv
+
+    built_rows = []
+    real_build = comb.build_a_tables_jit
+
+    def spy(a):
+        built_rows.append(int(a.shape[0]))
+        return real_build(a)
+
+    monkeypatch.setattr(comb, "build_a_tables_jit", spy)
+
+    V = 64
+    keys = [host.PrivKey.from_seed(bytes([i]) * 32) for i in range(V + V)]
+    pubs = [k.pub_key().data for k in keys]
+
+    cache = cv.ValsetCombCache()
+    cache.ensure(pubs[:V])
+    assert built_rows == [V]  # cold build: all rows
+
+    # 1-validator churn: one bucket of 1
+    set_1pct = pubs[1:V] + [pubs[V]]
+    e = cache.ensure(set_1pct)
+    assert built_rows[1:] == [1], f"1-key churn built {built_rows[1:]}"
+    assert e.size == V
+
+    # ~10% churn (6 keys): one bucket of 8
+    set_10pct = set_1pct[6:] + pubs[V + 1 : V + 7]
+    cache.ensure(set_10pct)
+    assert built_rows[2:] == [8], f"6-key churn built {built_rows[2:]}"
+
+    # 100% churn: no reuse, full build
+    cache.ensure(pubs[V:])
+    assert built_rows[3:] == [V]
+
+    # correctness after churn: verify a commit-shaped batch against the
+    # churned set, including a tampered row
+    entry = cache.ensure(set_10pct)
+    bv = cv.CombBatchVerifier(entry)
+    by_pub = {k.pub_key().data: k for k in keys}
+    msgs = [b"churn-%d" % i for i in range(len(set_10pct))]
+    for i, pk in enumerate(set_10pct):
+        sig = by_pub[pk].sign(msgs[i])
+        bv.add(pk, msgs[i] + (b"!" if i == 3 else b""), sig)
+    ok, per = bv.verify()
+    assert not ok and per == [i != 3 for i in range(len(set_10pct))]

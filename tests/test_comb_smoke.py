@@ -33,3 +33,66 @@ def test_comb_verify_smoke(monkeypatch):
         bv.add(p, m + (b"x" if i == 2 else b""), s)
     ok, per = bv.verify()
     assert not ok and per == [i != 2 for i in range(n)]
+
+
+def test_uncached_kernel_smoke(monkeypatch):
+    """Fast-tier smoke of the UNCACHED device kernel (ops/ed25519.
+    verify_batch through TpuEd25519BatchVerifier) — the path taken for
+    foreign-key batches and sets below the comb threshold.  Lowers the
+    device-batch floor so an 8-signature bucket dispatches to the
+    device; shapes match the slow tier's smallest bucket so a warm
+    persistent cache keeps this in seconds."""
+    from cometbft_tpu.models.verifier import TpuEd25519BatchVerifier
+
+    monkeypatch.setenv("COMETBFT_TPU_DEVICE_BATCH_MIN", "8")
+    n = 8
+    keys = [host.PrivKey.from_seed(bytes([70 + i]) * 32) for i in range(n)]
+    items = [
+        (keys[i].pub_key().data, b"straus-%d" % i, keys[i].sign(b"straus-%d" % i))
+        for i in range(n)
+    ]
+    bv = TpuEd25519BatchVerifier()
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, per = bv.verify()
+    assert ok and per == [True] * n
+
+    bv = TpuEd25519BatchVerifier()
+    for i, (p, m, s) in enumerate(items):
+        bv.add(p, m + (b"!" if i == 5 else b""), s)
+    ok, per = bv.verify()
+    assert not ok and per == [i != 5 for i in range(n)]
+
+
+def test_async_build_falls_back_then_warms(monkeypatch):
+    """Above COMETBFT_TPU_COMB_ASYNC_MIN a missing table must not stall
+    the caller: create_batch_verifier returns the uncached verifier
+    while a background thread builds, then routes to the comb verifier
+    once warm (round-5 verdict item 2: set churn must never stall
+    consensus behind a 10k-row build)."""
+    import time
+
+    from cometbft_tpu.models import comb_verifier as cv
+    from cometbft_tpu.models.verifier import TpuEd25519BatchVerifier
+
+    monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "8")
+    monkeypatch.setenv("COMETBFT_TPU_COMB_ASYNC_MIN", "8")
+    n = 8
+    keys = [host.PrivKey.from_seed(bytes([90 + i]) * 32) for i in range(n)]
+    pubs = [k.pub_key().data for k in keys]
+    # fresh cache so the entry is genuinely cold
+    monkeypatch.setattr(cv, "_GLOBAL_CACHE", cv.ValsetCombCache())
+
+    first = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    assert isinstance(first, TpuEd25519BatchVerifier), "must not block on build"
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+        if isinstance(bv, CombBatchVerifier):
+            break
+        time.sleep(0.2)
+    assert isinstance(bv, CombBatchVerifier), "background build never landed"
+    for i, pk in enumerate(pubs):
+        bv.add(pk, b"warm-%d" % i, keys[i].sign(b"warm-%d" % i))
+    ok, per = bv.verify()
+    assert ok and per == [True] * n

@@ -185,3 +185,83 @@ def test_late_joiner_catches_up_via_gossip():
     finally:
         for n in nodes:
             n.stop()
+
+
+@pytest.mark.slow
+def test_deep_catchup_from_far_ahead_peer():
+    """Deep catchup (reactor.go gossipVotesForHeight's stored-commit
+    branch): a node parked in consensus at height H must converge when
+    its only peer is dozens of heights ahead — the peer serves stored
+    commit precommits + catchup block parts from its block store.
+
+    This is the run-shape behind the perturbed-soak stall class: a
+    killed node rejoins, blocksync hands off at H, and the rest of the
+    net is far past H by the time consensus starts."""
+    from cometbft_tpu.blocksync import pool as pool_mod
+    from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+    from cometbft_tpu.types.block import BlockID
+
+    from tests.test_blocksync_replay import _build_chain
+
+    n_chain = 31
+    keys = [ed25519.PrivKey.from_seed(bytes([80 + i]) * 32) for i in range(4)]
+    genesis, blocks, consumer_b = _build_chain(
+        n_chain, keys, chain_id="deep-catchup"
+    )
+
+    def make_cs_node(consumer, upto, idx):
+        """Apply the chain through `upto` and park a consensus node at
+        upto+1 (no privval — it can't vote, like a freshly handed-off
+        non-validator)."""
+        state, ex, store, conns = consumer
+        for h in range(1, upto + 1):
+            block = blocks[h - 1][0]
+            parts = block.make_part_set()
+            bid = BlockID(hash=block.hash(), part_set_header=parts.header)
+            commit_h = blocks[h][0].last_commit  # commit FOR h (in block h+1)
+            store.save_block(block, parts, commit_h)
+            state = ex.apply_verified_block(state, bid, block)
+        cfg = test_consensus_config()
+        cfg.wal_path = ""
+        mem = CListMempool(
+            MempoolConfig(), conns.mempool,
+            lane_priorities=default_lanes(), default_lane="default",
+        )
+        cs = ConsensusState(cfg, state, ex, store, mem)
+        reactor = ConsensusReactor(cs)
+        nk = NodeKey.generate(bytes([140 + idx]) * 32)
+        info = NodeInfo(node_id=nk.id(), network="deep-catchup", moniker=f"d{idx}")
+        switch = Switch(TCPTransport(nk, info))
+        switch.add_reactor("consensus", reactor)
+        addr = switch.transport.listen("127.0.0.1:0")
+        return cs, switch, addr, conns
+
+    # B: far ahead (applied 30 of 31 blocks, consensus parked at 31)
+    cs_b, sw_b, addr_b, conns_b = make_cs_node(consumer_b, n_chain - 1, 0)
+    # A: way behind — a fresh consumer over the same genesis, fed the
+    # shared chain up to height 4, consensus parked at 5
+    _g, _no_blocks, consumer_a = _build_chain(0, keys, chain_id="deep-catchup")
+    cs_a, sw_a, addr_a, conns_a = make_cs_node(consumer_a, 4, 1)
+
+    sw_b.start()
+    sw_a.start()
+    sw_a.dial_peer_async(addr_b, persistent=True)
+    try:
+        assert cs_b.rs.height == n_chain
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if cs_a.state.last_block_height >= 8:
+                break
+            time.sleep(0.25)
+        assert cs_a.state.last_block_height >= 8, (
+            f"deep catchup stalled at {cs_a.state.last_block_height} "
+            f"(rs: h={cs_a.rs.height} r={cs_a.rs.round} step={cs_a.rs.step})"
+        )
+    finally:
+        try:
+            sw_a.stop()
+            sw_b.stop()
+        except Exception:
+            pass
+        conns_a.stop()
+        conns_b.stop()

@@ -156,6 +156,23 @@ def test_node_runs_and_serves_rpc(tmp_path):
         found = rpc.call("tx_search", query=f"tx.height={committed_h}")
         assert int(found["total_count"]) >= 1
         assert any(t["hash"] == tx_h for t in found["txs"])
+
+        # tx?prove=true ships a Merkle proof rooted in the block's
+        # data_hash (rpc/core/tx.go Tx with prove)
+        proved = rpc.call("tx", hash=tx_h, prove=True)
+        root = proved["proof"]["root_hash"]
+        hdr = rpc.block(committed_h)["block"]["header"]
+        assert root == hdr["data_hash"]
+        assert int(proved["proof"]["proof"]["total"]) >= 1
+        # order_by runs both directions (tx.go TxSearch order_by)
+        desc = rpc.call(
+            "tx_search", query=f"tx.height={committed_h}", order_by="desc"
+        )
+        assert int(desc["total_count"]) == int(found["total_count"])
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="order_by"):
+            rpc.call("tx_search", query="tx.height=1", order_by="sideways")
         br = rpc.call("block_results", height=committed_h)
         assert br["txs_results"][0]["code"] == 0
 
@@ -368,3 +385,88 @@ def test_cli_reindex_event(tmp_path):
         assert b64mod.b64decode(rec["tx"]) == b"reindex=me"
     finally:
         db.close()
+
+
+def test_mempool_routes_unconfirmed_tx_and_flush():
+    """unconfirmed_tx + unsafe_flush_mempool (rpc/core/mempool.go,
+    routes.go:63) against a real mempool, no live chain — deterministic."""
+    from cometbft_tpu.abci import KVStoreApplication
+    from cometbft_tpu.abci.kvstore import default_lanes
+    from cometbft_tpu.mempool import CListMempool, MempoolConfig
+    from cometbft_tpu.mempool.mempool import key_of
+    from cometbft_tpu.proxy import local_client_creator, new_app_conns
+    from cometbft_tpu.rpc.core import Environment, RPCError
+
+    conns = new_app_conns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    try:
+        mp = CListMempool(
+            MempoolConfig(), conns.mempool,
+            lane_priorities=default_lanes(), default_lane="default",
+        )
+        mp.check_tx(b"pending=1")
+
+        class _Cfg:
+            class rpc:
+                unsafe = False
+
+        class _Node:
+            mempool = mp
+            config = _Cfg()
+
+        env = Environment.__new__(Environment)
+        env.node = _Node()
+
+        key = key_of(b"pending=1")
+        out = env.unconfirmed_tx(hash=key.hex())
+        import base64
+
+        assert base64.b64decode(out["tx"]) == b"pending=1"
+        with pytest.raises(RPCError, match="not found"):
+            env.unconfirmed_tx(hash="ab" * 32)
+
+        # flush is unsafe-gated (AddUnsafeRoutes)
+        with pytest.raises(RPCError, match="unsafe"):
+            env.unsafe_flush_mempool()
+        _Cfg.rpc.unsafe = True
+        assert mp.size() == 1
+        env.unsafe_flush_mempool()
+        assert mp.size() == 0
+    finally:
+        conns.stop()
+
+
+def test_config_migrate_reports_and_rewrites(tmp_path):
+    """confix-style migration (internal/confix): an old config with a
+    missing new key and an obsolete key migrates to the current schema —
+    recognized values kept, obsolete keys dropped (with a .bak), new
+    keys added at defaults."""
+    home = _mk_home(tmp_path, "mig", chain_id="mig-chain")
+    cfg_path = os.path.join(home, "config", "config.toml")
+    # simulate an older version: drop one current key, add an obsolete
+    # one, and keep a customized value
+    text = open(cfg_path).read()
+    lines = [
+        l for l in text.splitlines() if not l.startswith("db_backend")
+    ]
+    lines.insert(1, 'fast_sync_removed_in_v1 = true')
+    lines = [
+        'moniker = "migrated-node"' if l.startswith("moniker") else l
+        for l in lines
+    ]
+    open(cfg_path, "w").write("\n".join(lines) + "\n")
+
+    from cometbft_tpu.config import migrate_report
+
+    rep = migrate_report(home)
+    assert "db_backend" in rep["added"]
+    assert "fast_sync_removed_in_v1" in rep["dropped"]
+    assert "moniker" in rep["kept"]
+
+    assert cli_main(["--home", home, "config", "migrate"]) == 0
+    assert os.path.exists(cfg_path + ".bak")
+    cfg = load_config(home)
+    assert cfg.base.moniker == "migrated-node"  # custom value survived
+    out = open(cfg_path).read()
+    assert "db_backend" in out  # new key materialized
+    assert "fast_sync_removed_in_v1" not in out  # obsolete key dropped
