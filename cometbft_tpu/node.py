@@ -575,15 +575,23 @@ class Node:
 
             paddr = self.config.instrumentation.pprof_laddr
             phost, _, pport = paddr.rpartition(":")
-            self._pprof_httpd = ThreadingHTTPServer(
-                (phost or "127.0.0.1", int(pport)), P
-            )
-            threading.Thread(
-                target=self._pprof_httpd.serve_forever,
-                daemon=True,
-                name="pprof",
-            ).start()
-            self.logger.info(f"debug/profiling endpoints on {paddr}")
+            try:
+                self._pprof_httpd = ThreadingHTTPServer(
+                    (phost or "127.0.0.1", int(pport)), P
+                )
+            except OSError as e:
+                # an observability endpoint must never take down the
+                # node: a restarted node's configured pprof port can be
+                # transiently held by an ephemeral outbound socket
+                self.logger.error(f"pprof endpoint unavailable ({paddr}): {e}")
+                self._pprof_httpd = None
+            else:
+                threading.Thread(
+                    target=self._pprof_httpd.serve_forever,
+                    daemon=True,
+                    name="pprof",
+                ).start()
+                self.logger.info(f"debug/profiling endpoints on {paddr}")
 
     def stop(self) -> None:
         from .types import validation as _validation

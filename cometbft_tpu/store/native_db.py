@@ -164,7 +164,10 @@ class NativeDB(DB):
         return self._iter(start, end, True)
 
     def compact(self) -> None:
-        if not self._lib.kv_compact(self._h):
+        # blocks the CALLER until a full pass reclaims space (waiting
+        # out any in-flight background run); concurrent writers only
+        # stall for the final tail-copy + rename
+        if self._lib.kv_compact(self._h) == 0:
             raise NativeDBError("compaction failed")
 
     def size(self) -> int:

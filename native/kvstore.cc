@@ -15,12 +15,15 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <chrono>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -58,10 +61,12 @@ class KVStore {
  public:
   explicit KVStore(const std::string& path) : path_(path) {
     Load();
+    CollapseFrozen();
     log_ = std::fopen(path_.c_str(), "ab");
   }
 
   ~KVStore() {
+    if (compactor_.joinable()) compactor_.join();
     if (log_) std::fclose(log_);
   }
 
@@ -93,7 +98,12 @@ class KVStore {
       dead_ += (r.type == 2) ? 1 : 0;
     }
     writes_since_compact_ += recs.size();
-    if (writes_since_compact_ > 200000 && dead_ * 4 > index_.size()) Compact();
+    if (writes_since_compact_ > 200000 && dead_ * 4 > index_.size() &&
+        !compacting_.exchange(true)) {
+      FreezeLocked();
+      if (compactor_.joinable()) compactor_.join();  // reap previous run
+      compactor_ = std::thread([this] { CompactFrozen(); });
+    }
     return true;
   }
 
@@ -113,37 +123,117 @@ class KVStore {
     return index_.size();
   }
 
-  bool Compact() {
-    // rewrite only the live set; callers hold mu_
-    std::string tmp = path_ + ".compact";
-    FILE* f = std::fopen(tmp.c_str(), "wb");
-    if (!f) return false;
-    std::string buf;
-    for (const auto& kv : index_) {
-      buf.clear();
-      EncodeRecord(Record{1, kv.first, kv.second}, buf);
-      if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
-        std::fclose(f);
-        std::remove(tmp.c_str());
-        return false;
-      }
-    }
-    std::fflush(f);
-    std::fclose(f);
+  // Compaction, writer-stall-bounded.  Phase 1 (FreezeLocked, O(1) under
+  // mu_): close the active log, rename it to <path>.frozen, reopen a
+  // fresh active log.  Phase 2 (CompactFrozen, NO lock held): replay the
+  // frozen log, write its live set to <path>.compact, append a copy of
+  // whatever the active log accumulated meanwhile (chasing it unlocked),
+  // then take mu_ only for the final chase of the last few bytes + the
+  // rename swap.  Writers stall only for that tail (the reference's
+  // pebble compacts in the background the same way).  Crash-safe at
+  // every step: Load() replays <path>.frozen before <path>, and a
+  // leftover .compact is discarded.
+  void FreezeLocked() {
+    std::fflush(log_);
     std::fclose(log_);
-    if (std::rename(tmp.c_str(), path_.c_str()) != 0) {
-      log_ = std::fopen(path_.c_str(), "ab");
-      return false;
+    log_ = nullptr;
+    // a leftover frozen log (previous compaction FAILED) still holds
+    // the only on-disk copy of the pre-freeze records: fold it back
+    // into one log first — never delete it
+    FILE* probe = std::fopen(FrozenPath().c_str(), "rb");
+    if (probe) {
+      std::fclose(probe);
+      CollapseFrozen();
     }
+    std::rename(path_.c_str(), FrozenPath().c_str());
     log_ = std::fopen(path_.c_str(), "ab");
-    dead_ = 0;
-    writes_since_compact_ = 0;
-    return true;
   }
 
-  bool CompactNow() {
-    std::lock_guard<std::mutex> g(mu_);
-    return Compact();
+  bool CompactFrozen() {
+    std::string tmp = path_ + ".compact";
+    bool ok = false;
+    {
+      std::map<std::string, std::string> frozen;
+      ReplayFile(FrozenPath(), &frozen, nullptr);
+      FILE* f = std::fopen(tmp.c_str(), "wb");
+      if (!f) {
+        compacting_ = false;
+        return false;
+      }
+      std::string buf;
+      ok = true;
+      for (const auto& kv : frozen) {
+        buf.clear();
+        EncodeRecord(Record{1, kv.first, kv.second}, buf);
+        if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+          ok = false;
+          break;
+        }
+      }
+      if (ok) {
+        // chase the active log without stalling writers: anything
+        // appended after the copy loop is picked up next pass
+        long copied = 0;
+        for (int pass = 0; ok && pass < 8; pass++) {
+          long end = ActiveEndFlushed();
+          if (end <= copied) break;
+          ok = AppendRange(f, copied, end);
+          copied = end;
+          if (static_cast<long>(ActiveEndFlushed()) - copied < (1 << 20)) break;
+        }
+        if (ok) {
+          // final tail + swap under the writer lock: bounded by what
+          // arrived during the last unlocked pass
+          std::lock_guard<std::mutex> g(mu_);
+          std::fflush(log_);
+          long end = FileEnd(path_);
+          ok = AppendRange(f, copied, end);
+          std::fflush(f);
+          std::fclose(f);
+          f = nullptr;
+          if (ok) {
+            std::fclose(log_);
+            if (std::rename(tmp.c_str(), path_.c_str()) == 0) {
+              std::remove(FrozenPath().c_str());
+              dead_ = 0;
+              writes_since_compact_ = 0;
+            } else {
+              ok = false;
+            }
+            log_ = std::fopen(path_.c_str(), "ab");
+          }
+        }
+        if (f) std::fclose(f);
+      } else {
+        std::fclose(f);
+      }
+      if (!ok) std::remove(tmp.c_str());
+    }
+    if (!ok) {
+      // back off: without this a failing compaction (e.g. disk full)
+      // would re-trigger a full fold+rewrite on the next batch.  The
+      // frozen log stays on disk and FreezeLocked folds it back in
+      // before the retry, so no data is at risk.
+      std::lock_guard<std::mutex> g(mu_);
+      writes_since_compact_ = 0;
+    }
+    compacting_ = false;
+    return ok;
+  }
+
+  // 1 = compacted, 0 = failed.  An explicit compaction is a promise of
+  // reclaimed space: wait out any in-flight background run, then do a
+  // full pass.  Writers still only stall for the tail copy + rename.
+  int CompactNow() {
+    while (compacting_.exchange(true)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      FreezeLocked();
+    }
+    if (compactor_.joinable()) compactor_.join();
+    return CompactFrozen() ? 1 : 0;
   }
 
  private:
@@ -160,8 +250,57 @@ class KVStore {
     out += payload;
   }
 
-  void Load() {
-    FILE* f = std::fopen(path_.c_str(), "rb");
+  std::string FrozenPath() const { return path_ + ".frozen"; }
+
+  static long FileEnd(const std::string& p) {
+    FILE* f = std::fopen(p.c_str(), "rb");
+    if (!f) return 0;
+    std::fseek(f, 0, SEEK_END);
+    long end = std::ftell(f);
+    std::fclose(f);
+    return end;
+  }
+
+  long ActiveEndFlushed() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::fflush(log_);
+    return FileEnd(path_);
+  }
+
+  // copy bytes [from, to) of the active log into f (append-only source,
+  // so an unlocked copy of an already-flushed range is stable)
+  bool AppendRange(FILE* f, long from, long to) {
+    if (to <= from) return true;
+    FILE* src = std::fopen(path_.c_str(), "rb");
+    if (!src) return false;
+    std::fseek(src, from, SEEK_SET);
+    std::vector<char> buf(1 << 20);
+    long left = to - from;
+    bool ok = true;
+    while (left > 0) {
+      size_t want = static_cast<size_t>(
+          std::min(left, static_cast<long>(buf.size())));
+      size_t n = std::fread(buf.data(), 1, want, src);
+      if (n == 0) {
+        ok = false;
+        break;
+      }
+      if (std::fwrite(buf.data(), 1, n, f) != n) {
+        ok = false;
+        break;
+      }
+      left -= static_cast<long>(n);
+    }
+    std::fclose(src);
+    return ok;
+  }
+
+  // replay a log file into `into`; reports the end of the last good
+  // record via good_end when non-null (torn-tail truncation point)
+  static void ReplayFile(const std::string& p,
+                         std::map<std::string, std::string>* into,
+                         long* good_end_out) {
+    FILE* f = std::fopen(p.c_str(), "rb");
     if (!f) return;
     std::vector<uint8_t> hdr(8);
     std::vector<uint8_t> payload;
@@ -179,25 +318,67 @@ class KVStore {
       if (5 + klen > len) break;
       std::string key(reinterpret_cast<char*>(payload.data() + 5), klen);
       if (type == 1) {
-        index_[key] = std::string(
+        (*into)[key] = std::string(
             reinterpret_cast<char*>(payload.data() + 5 + klen), len - 5 - klen);
       } else {
-        index_.erase(key);
+        into->erase(key);
       }
       good_end = std::ftell(f);
     }
     std::fclose(f);
+    if (good_end_out) *good_end_out = good_end;
+  }
+
+  // A leftover frozen log (crash mid-compaction, or a failed run) must
+  // be folded back into ONE on-disk log before any new freeze could
+  // clobber it: truncate the frozen file at its last GOOD record (a
+  // crash during a previous fold can leave a torn tail mid-file —
+  // appending after it would make Load()'s torn-tail truncation eat
+  // valid data later), append the active log, and make the result the
+  // active log.  Replay order is preserved exactly.  Callers must have
+  // log_ closed (constructor: not yet opened; FreezeLocked: just closed).
+  void CollapseFrozen() {
+    FILE* probe = std::fopen(FrozenPath().c_str(), "rb");
+    if (!probe) return;
+    std::fclose(probe);
+    {
+      std::map<std::string, std::string> scratch;
+      long good = 0;
+      ReplayFile(FrozenPath(), &scratch, &good);
+      FILE* t = std::fopen(FrozenPath().c_str(), "rb+");
+      if (t) {
+        std::fseek(t, 0, SEEK_END);
+        if (std::ftell(t) != good) (void)!ftruncate(fileno(t), good);
+        std::fclose(t);
+      }
+    }
+    FILE* f = std::fopen(FrozenPath().c_str(), "ab");
+    if (!f) return;
+    long end = FileEnd(path_);
+    bool ok = AppendRange(f, 0, end);
+    std::fflush(f);
+    std::fclose(f);
+    if (ok) {
+      std::rename(FrozenPath().c_str(), path_.c_str());
+    }
+  }
+
+  void Load() {
+    // a crash mid-compaction leaves <path>.frozen (+ possibly .compact):
+    // the frozen log holds everything before the freeze and replays
+    // FIRST; a partial .compact is garbage
+    std::remove((path_ + ".compact").c_str());
+    ReplayFile(FrozenPath(), &index_, nullptr);
+    long good_end = 0;
+    ReplayFile(path_, &index_, &good_end);
     // truncate any torn tail so the append log stays well-formed
     if (good_end >= 0) {
       FILE* t = std::fopen(path_.c_str(), "rb+");
       if (t) {
-#ifdef _WIN32
-#else
+        std::fseek(t, 0, SEEK_END);
         if (std::ftell(t) != good_end) {
-          // use ftruncate via fileno
           (void)!ftruncate(fileno(t), good_end);
         }
-#endif
         std::fclose(t);
       }
     }
@@ -209,6 +390,8 @@ class KVStore {
   std::mutex mu_;
   size_t dead_ = 0;
   size_t writes_since_compact_ = 0;
+  std::atomic<bool> compacting_{false};
+  std::thread compactor_;
 };
 
 struct Iter {
@@ -301,6 +484,6 @@ void kv_iter_close(void* ih) { delete static_cast<Iter*>(ih); }
 
 uint64_t kv_size(void* h) { return static_cast<KVStore*>(h)->Size(); }
 
-int kv_compact(void* h) { return static_cast<KVStore*>(h)->CompactNow() ? 1 : 0; }
+int kv_compact(void* h) { return static_cast<KVStore*>(h)->CompactNow(); }
 
 }  // extern "C"

@@ -1,0 +1,109 @@
+"""4-node localnet throughput/latency benchmark (BASELINE config 3;
+reference: test/e2e/runner/benchmark.go:109 — mean/σ block interval over
+a live testnet, plus the loadtime latency report).
+
+Runs a real 4-process localnet, drives timestamped load through the
+loadtime generator, and reports:
+  block_interval_mean_s / stddev   (benchmark.go's headline stats)
+  tx_per_s committed               (loadtime report)
+  latency avg/max                  (block time - payload time)
+
+Run:  python scripts/bench_localnet.py [duration_s] [rate_tx_s]
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from cometbft_tpu.e2e import Manifest, NodeSpec, Runner  # noqa: E402
+from cometbft_tpu.e2e.loadtime import LoadGenerator, report  # noqa: E402
+from cometbft_tpu.rpc.client import HTTPClient  # noqa: E402
+
+OUT = os.environ.get("LOCALNET_BENCH_OUT", "/tmp/localnet_bench.json")
+
+
+def main() -> None:
+    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
+    rate = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    out_dir = tempfile.mkdtemp(prefix="lbench-")
+    m = Manifest(
+        chain_id="localnet-bench",
+        nodes=[NodeSpec(f"v{i}") for i in range(4)],
+        target_height=3,
+    )
+    r = Runner(m, out_dir, base_port=21800)
+    rec: dict = {}
+    try:
+        r.setup()
+        r.start()
+        assert r.wait_for_height(3, timeout=120), "net never started"
+        addr = f"127.0.0.1:{r.nodes[0].rpc_port}"
+        gen = LoadGenerator(
+            lambda: HTTPClient(addr), connections=2, rate=rate // 2, size=256
+        )
+        rpc = HTTPClient(addr)
+        h_start = int(rpc.status()["sync_info"]["latest_block_height"])
+        t0 = time.monotonic()
+        load = gen.run(duration)
+        wall = time.monotonic() - t0
+        h_end = int(rpc.status()["sync_info"]["latest_block_height"])
+        time.sleep(3)  # let the tail commit
+
+        rep = report(rpc)
+        # block intervals over the LOADED window only (benchmark.go
+        # measures the testnet under load, not startup/settle idling)
+        last = int(rpc.status()["sync_info"]["latest_block_height"])
+        times = []
+        for h in range(max(1, h_start), min(h_end, last) + 1):
+            bt = rpc.block(h)["block"]["header"]["time"]
+            import datetime
+
+            base_s, _, frac = bt.rstrip("Z").partition(".")
+            dt = datetime.datetime.strptime(
+                base_s, "%Y-%m-%dT%H:%M:%S"
+            ).replace(tzinfo=datetime.timezone.utc)
+            times.append(
+                int(dt.timestamp()) * 10**9
+                + int((frac or "0").ljust(9, "0")[:9])
+            )
+        ivals = [
+            (b - a) / 1e9 for a, b in zip(times, times[1:]) if b > a
+        ]
+        rec = {
+            "nodes": 4,
+            "duration_s": round(wall, 1),
+            "rate_target_tx_s": rate,
+            "sent": load.sent,
+            "accepted": load.accepted,
+            "committed": rep["payload_txs"],
+            "tx_per_s": rep["throughput_txs_per_s"],
+            "blocks": last,
+            "block_interval_mean_s": round(statistics.fmean(ivals), 3)
+            if ivals
+            else None,
+            "block_interval_stddev_s": round(statistics.pstdev(ivals), 3)
+            if len(ivals) > 1
+            else None,
+            "latency": {
+                k: {"avg_s": v["avg_s"], "max_s": v["max_s"]}
+                for k, v in rep["experiments"].items()
+            },
+            "errors": load.errors[:3],
+        }
+        print(json.dumps(rec), flush=True)
+        with open(OUT, "w") as f:
+            json.dump(rec, f)
+    finally:
+        r.stop_all()
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

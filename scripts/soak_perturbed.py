@@ -123,7 +123,11 @@ def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 50
     fails = 0
     for i in range(n):
-        rec = one_run(i, base_port=30000 + (i % 40) * 50)
+        # keep every derived port family (p2p=base, rpc=+1000,
+        # pprof=+2000, abci=+3000) BELOW the Linux ephemeral range
+        # (32768+): an outbound socket that randomly lands on a node's
+        # listen port would otherwise break that node's restart
+        rec = one_run(i, base_port=20000 + (i % 40) * 100)
         with open(OUT, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)

@@ -121,6 +121,61 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         emit("bench_small", error=str(e))
 
+    # ---- stage 2b: VerifyCommitLight @ 150 validators through
+    # types/validation.py (BASELINE config 2: the light-client shape) —
+    # the REAL path: sign-bytes assembly, power tally, comb verify
+    try:
+        from cometbft_tpu.types import validation as val
+        from cometbft_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
+        from cometbft_tpu.types.validators import Validator, ValidatorSet
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.wire.canonical import PRECOMMIT_TYPE, Timestamp
+
+        nv = 150
+        rng = np.random.default_rng(3)
+        vkeys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(nv)]
+        vals150 = ValidatorSet(
+            [Validator(k.pub_key(), 10) for k in vkeys]
+        )
+        bid = BlockID(
+            hash=b"\x42" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x24" * 32),
+        )
+        ts = Timestamp(seconds=1_700_000_000)
+        by_addr = {k.pub_key().address(): k for k in vkeys}
+        sigs = []
+        for i, v in enumerate(vals150.validators):  # set order is sorted
+            vote = Vote(
+                type=PRECOMMIT_TYPE, height=9, round=0, block_id=bid,
+                timestamp=ts, validator_address=v.address, validator_index=i,
+            )
+            sig = by_addr[v.address].sign(vote.sign_bytes("bench-light"))
+            sigs.append(
+                CommitSig(
+                    block_id_flag=2, validator_address=v.address,
+                    timestamp=ts, signature=sig,
+                )
+            )
+        commit150 = Commit(height=9, round=0, block_id=bid, signatures=sigs)
+        os.environ["COMETBFT_TPU_COMB_MIN"] = "64"  # route 150 to the comb
+        val.verify_commit_light("bench-light", vals150, bid, 9, commit150)
+        runs = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            val.verify_commit_light(
+                "bench-light", vals150, bid, 9, commit150,
+                count_all_signatures=True,
+            )
+            runs.append((time.perf_counter() - t0) * 1e3)
+        runs.sort()
+        emit(
+            "light_150",
+            p50_ms=round(runs[len(runs) // 2], 2),
+            vs_go_cpu=round(150 * 27.5e-3 / runs[len(runs) // 2], 2),
+        )
+    except Exception as e:  # noqa: BLE001
+        emit("light_150", error=str(e))
+
     # ---- stage 3: the flagship 10k (TPU_MEASURE_SKIP_10K=1 to skip —
     # a 10k table build on the CPU backend is hours)
     if os.environ.get("TPU_MEASURE_SKIP_10K") == "1":

@@ -254,3 +254,42 @@ def test_native_db_compaction(tmp_path):
     assert db.size() == 50
     assert db.get(b"key199") == b"v" * 100
     db.close()
+
+
+def test_native_db_crash_mid_compaction_replays_frozen_log(tmp_path):
+    """Freeze-and-chase compaction leaves <path>.frozen while rewriting;
+    a crash in that window must lose nothing: Load() replays the frozen
+    log before the fresh active log (kvstore.cc Load)."""
+    import os
+
+    from cometbft_tpu.store.native_db import NativeDB
+
+    path = str(tmp_path / "c.kvlog")
+    db = NativeDB(path)
+    db.set(b"a", b"1")
+    db.set(b"b", b"2")
+    db.delete(b"a")
+    db.close()
+
+    # simulate a crash right after FreezeLocked: active log became the
+    # frozen file and a new empty active log took its place
+    os.rename(path, path + ".frozen")
+    open(path + ".compact", "wb").write(b"partial-garbage")
+
+    db2 = NativeDB(path)  # replays frozen, discards .compact
+    assert db2.get(b"b") == b"2"
+    assert db2.get(b"a") is None
+    db2.set(b"c", b"3")  # lands in the fresh active log
+    db2.close()
+    assert not os.path.exists(path + ".compact")
+
+    db3 = NativeDB(path)  # frozen + active replay together
+    assert db3.get(b"b") == b"2" and db3.get(b"c") == b"3"
+    db3.compact()  # full compaction collapses both into one log
+    db3.close()
+    assert not os.path.exists(path + ".frozen")
+
+    db4 = NativeDB(path)
+    assert db4.get(b"b") == b"2" and db4.get(b"c") == b"3"
+    assert db4.get(b"a") is None
+    db4.close()
