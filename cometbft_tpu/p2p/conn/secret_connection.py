@@ -14,8 +14,17 @@ Station-to-Station protocol with the reference's construction:
 The reference hashes the transcript with Merlin/STROBE; this
 implementation uses HKDF-SHA256 over the sorted ephemeral keys — same
 security shape (the two sides derive identical keys and a shared
-challenge bound to the DH result), not byte-compatible with Go peers,
-which is fine: both ends of every link run this stack.
+challenge bound to the DH result), not byte-compatible with Go peers.
+
+DECISION (round 5, explicit): keep the HKDF transcript permanently.
+Merlin requires a STROBE/Keccak-duplex implementation whose only value
+here would be byte-level interop with Go peers for mixed-fleet
+differential testing — which this environment cannot run anyway (no Go
+toolchain), and which the framework does not need: both ends of every
+link run this stack, and the protocol-level wire format (frames,
+nonces, proofs) matches the reference. The deviation is confined to
+this file's key-schedule; swapping in a STROBE transcript later would
+not change any other layer.
 """
 
 from __future__ import annotations
