@@ -101,14 +101,34 @@ def backend_available() -> tuple[bool, str]:
 
 
 def probe_backend() -> None:
-    """Fail fast (with the structured JSON line) on a dead backend."""
+    """Fail fast (with the structured JSON line) on a dead backend.
+
+    A wedged tunnel often recovers when a stranded client's lease
+    expires, so a failed probe retries a few times (BENCH_PROBE_RETRIES,
+    default 3, 120 s apart) before giving up — cheap insurance against
+    reporting value=null for a transient wedge."""
     if os.environ.get("BENCH_SKIP_PROBE") == "1":
         return
-    ok, detail = backend_available()
-    if not ok:
-        REPORT["error"] = "backend-unavailable: " + detail
-        emit_and_exit()
-    REPORT["backend"] = detail
+
+    def _int_env(name: str, default: int) -> int:
+        try:
+            return int(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+
+    attempts = max(1, _int_env("BENCH_PROBE_RETRIES", 3))
+    delay_s = max(0, _int_env("BENCH_PROBE_RETRY_DELAY", 120))
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(delay_s)
+        ok, detail = backend_available()
+        if ok:
+            REPORT["backend"] = detail
+            REPORT["probe_attempts"] = attempt + 1
+            return
+    REPORT["error"] = "backend-unavailable: " + detail
+    REPORT["probe_attempts"] = attempts
+    emit_and_exit()
 
 
 def _enable_compile_cache() -> None:

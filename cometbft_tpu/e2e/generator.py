@@ -39,6 +39,9 @@ def generate(seed: int) -> Manifest:
     n = _weighted(rng, _TOPOLOGIES)
     nodes = []
     late_slot = rng.randrange(n) if n >= 3 and rng.random() < 0.5 else -1
+    # half of late joiners bootstrap via statesync instead of blocksync
+    # (generate.go's stateSync node axis)
+    late_statesync = late_slot >= 0 and rng.random() < 0.5
     for i in range(n):
         perturbations = []
         p = rng.choice(_PERTURBATIONS)
@@ -57,6 +60,7 @@ def generate(seed: int) -> Manifest:
             NodeSpec(
                 name=f"node{i:02d}",
                 start_at=rng.randint(3, 6) if i == late_slot else 0,
+                state_sync=(i == late_slot and late_statesync),
                 perturbations=perturbations,
                 latency_ms=latency,
                 latency_jitter_ms=jitter,

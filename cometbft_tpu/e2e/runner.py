@@ -66,6 +66,14 @@ class E2ENode:
     def start(self) -> None:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        # Keep e2e nodes OFF the real device tunnel: the axon sitecustomize
+        # (keyed on PALLAS_AXON_POOL_IPS) contacts the device relay at
+        # interpreter start and OVERRIDES JAX_PLATFORMS; kill/restart
+        # perturbations then SIGKILL mid-session clients, which wedges the
+        # one-client-at-a-time tunnel for every later process (the round-3/4
+        # driver benches died exactly this way).  CPU is forced above, so
+        # the plugin has nothing to offer these nodes anyway.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         if self.latency_ms or self.latency_jitter_ms:
             env["COMETBFT_TPU_TEST_LATENCY_MS"] = (
                 f"{self.latency_ms}:{self.latency_jitter_ms}"

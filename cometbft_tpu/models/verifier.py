@@ -60,10 +60,19 @@ def _next_bucket(n: int) -> int:
 def _device_batch_min() -> int:
     import os
 
-    try:
-        return int(os.environ.get("COMETBFT_TPU_DEVICE_BATCH_MIN", "32"))
-    except ValueError:
-        return 32
+    v = os.environ.get("COMETBFT_TPU_DEVICE_BATCH_MIN", "")
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    # Default is link-aware: through a remote device tunnel (axon) every
+    # call pays ~85 ms host->device latency plus ~85 ms per result fetch
+    # (measured, scripts/profile_tunnel.py), so batches under ~2k
+    # signatures finish sooner on the host (~0.14 ms/sig sequential).  A
+    # locally attached chip has microsecond dispatch and wins from a few
+    # dozen signatures.
+    return 2048 if os.environ.get("PALLAS_AXON_POOL_IPS") else 32
 
 
 

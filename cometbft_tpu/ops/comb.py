@@ -224,7 +224,12 @@ def build_b_tables() -> np.ndarray:
 def get_b_tables():
     global _B_TABLES
     if _B_TABLES is None:
-        _B_TABLES = jnp.asarray(_b_tables_cached())
+        # the device constant is cached process-wide, so it must never be
+        # born inside somebody's jit trace (a stored tracer poisons every
+        # later program); force eager creation even when first called
+        # under tracing
+        with jax.ensure_compile_time_eval():
+            _B_TABLES = jnp.asarray(_b_tables_cached())
     return _B_TABLES
 
 

@@ -299,6 +299,80 @@ def sha512_blocks(blocks, active_blocks=None):
     return b.reshape(state.shape[:-2] + (64,))
 
 
+# ------------------------------------------- device-side R||A||M assembly
+
+
+def ram_blocks_from_parts(r, a, m, mlen, nblocks: int):
+    """Assemble SHA-512-padded R || A || M blocks ON DEVICE.
+
+    r, a    : (V, 32) uint8 — signature R half / compressed pubkey
+    m       : (V, maxm) uint8 — messages, zero-padded to the static width
+    mlen    : (V,) int32 — per-row live message length (<= maxm)
+    nblocks : static block count; maxm + 81 <= nblocks*128 must hold
+
+    Returns (blocks (V, nblocks, 128) uint8, active (V,) int32).  The host
+    used to ship fully padded 128-byte blocks per row (64 bytes of R+A
+    repeated, zero padding, trailers); over a ~10 MB/s device link the
+    padding itself dominated the verify call, so only the tight payload
+    crosses the wire and the minimal per-row SHA padding (0x80 trailer +
+    128-bit big-endian bit length in the row's own final block) is
+    reconstructed here with static-offset writes + iota masks.
+    """
+    V, maxm = m.shape
+    width = nblocks * 128
+    assert maxm + 64 + 17 <= width, (maxm, nblocks)
+    total = mlen + 64  # live bytes before padding
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]  # (1, width)
+    buf = jnp.zeros((V, width), dtype=jnp.uint8)
+    buf = buf.at[:, :32].set(r)
+    buf = buf.at[:, 32:64].set(a)
+    buf = buf.at[:, 64 : 64 + maxm].set(m)
+    # zero any stale bytes beyond each row's message, then the 0x80 marker
+    live = pos < total[:, None]
+    buf = jnp.where(live, buf, 0)
+    buf = buf | ((pos == total[:, None]) * jnp.uint8(0x80)).astype(jnp.uint8)
+    # 128-bit big-endian bit length in the last 16 bytes of the row's own
+    # final block; bitlen < 2^32 here so only the last 4 bytes are nonzero
+    nbr = (total + 17 + 127) // 128  # (V,) per-row block count
+    shift = (nbr[:, None] * 128 - 1 - pos) * 8  # BE byte shift at each col
+    bitlen = (total * 8)[:, None]
+    lb = jnp.where(
+        (shift >= 0) & (shift < 32),
+        lax.shift_right_logical(bitlen, jnp.minimum(jnp.maximum(shift, 0), 31))
+        & 0xFF,
+        0,
+    ).astype(jnp.uint8)
+    buf = buf | lb
+    return buf.reshape(V, nblocks, 128), nbr
+
+
+def parse_verify_payload(payload, pubs):
+    """Decode the tight verify payload and assemble its SHA-512 blocks.
+
+    payload : (V, 68 + maxm) uint8 — R(32) | s(32) | mlen(3B LE) |
+              live(1B) | msg (models/comb_verifier.assemble_payload)
+    pubs    : (V, 32) uint8 — device-resident compressed pubkeys
+
+    Returns (r, s, blocks, active, live): the single source of truth for
+    the payload row layout, shared by the single-device program
+    (models/comb_verifier._device_verify) and the mesh-sharded one
+    (parallel/verify).  active is 0 for non-live rows.
+    """
+    maxm = payload.shape[1] - 68
+    nblocks = (64 + maxm + 17 + 127) // 128
+    r = payload[:, :32]
+    s = payload[:, 32:64]
+    mlen = (
+        payload[:, 64].astype(jnp.int32)
+        | (payload[:, 65].astype(jnp.int32) << 8)
+        | (payload[:, 66].astype(jnp.int32) << 16)
+    )
+    live = payload[:, 67] == 1
+    blocks, nbr = ram_blocks_from_parts(r, pubs, payload[:, 68:], mlen, nblocks)
+    active = jnp.where(live, nbr, 0)
+    return r, s, blocks, active, live
+
+
 # ------------------------------------------------------- host-side padding
 
 

@@ -99,18 +99,17 @@ def _comb_verify_fn(mesh: Mesh):
 
     bt = comb.get_b_tables()
 
-    def local(tables, valid, packed, active):
-        nb = (packed.shape[1] - 64) // 128
-        r = packed[:, :32]
-        s = packed[:, 32:64]
-        blocks = packed[:, 64:].reshape(-1, nb, 128)
+    def local(tables, valid, pubs, payload):
+        r, s, blocks, active, live = sha2.parse_verify_payload(payload, pubs)
         dig = sha2.sha512_blocks(blocks, active)
         ok = comb.verify_cached(tables, valid, r, s, dig, bt)
-        mask = active > 0
-        bad = jnp.sum((~(ok | ~mask)).astype(jnp.int32))
+        bad = jnp.sum((~(ok | ~live)).astype(jnp.int32))
         total_bad = jax.lax.psum(bad, axis)
-        ok_all = jax.lax.all_gather(ok & mask, axis, tiled=True)
-        return jnp.packbits(ok_all), total_bad == 0
+        ok_all = jax.lax.all_gather(ok & live, axis, tiled=True)
+        # one replicated [bitmap | all_ok] array — a single host fetch
+        return jnp.concatenate(
+            [jnp.packbits(ok_all), (total_bad == 0).astype(jnp.uint8)[None]]
+        )
 
     return jax.jit(
         shard_map(
@@ -119,24 +118,25 @@ def _comb_verify_fn(mesh: Mesh):
             in_specs=(
                 P(None, None, None, None, axis),  # tables: validator lanes
                 P(axis),
-                P(axis),
-                P(axis),
+                P(axis, None),  # pubs
+                P(axis, None),  # payload rows
             ),
-            out_specs=(P(), P()),
+            out_specs=P(),
         )
     )
 
 
-def sharded_verify_cached(mesh: Mesh, tables, valid, packed, active):
+def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
     """Comb-cached VerifyCommit with validators sharded over the mesh.
 
-    packed: (V, 64 + nb*128) uint8 rows (R | s | padded R||A||M blocks),
-    active: (V,) int32 live-block counts (0 = validator didn't sign).
-    V must be divisible by the mesh size (the comb cache pads entries to
-    lane buckets).  Returns (packed validity bitmap, all_ok scalar) —
-    the same contract as the single-chip jit in models/comb_verifier.
+    payload: (V, 68 + maxm) uint8 tight rows (R | s | mlen 3B LE | live |
+    msg) — SHA blocks are assembled on device (ops/sha2) so only
+    irreducible bytes cross the host->device link.  V must be divisible
+    by the mesh size (the comb cache pads entries to lane buckets).
+    Returns one uint8 array [packbits(ok & live) | all_ok byte] — the
+    same single-fetch contract as models/comb_verifier._device_verify.
     """
-    return _comb_verify_fn(mesh)(tables, valid, packed, active)
+    return _comb_verify_fn(mesh)(tables, valid, pubs, payload)
 
 
 @functools.lru_cache(maxsize=8)

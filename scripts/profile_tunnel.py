@@ -1,0 +1,178 @@
+"""Disambiguate the comb kernel's ~270 ms V-independent cost: tunnel RPC
+latency vs H2D/D2H bandwidth vs deferred device compute.
+
+The r5 phase profile (profile_comb_phases.py on the v5e) showed the FULL
+verify_cached at V=1024 taking ~0.0 ms steady-state on device-resident
+inputs with block_until_ready, while the end-to-end bench measures
+353 ms at the same V.  Either the per-call cost is entirely in the
+host<->device path (the axon tunnel), or block_until_ready does not
+actually wait under axon and compute happens at fetch time.  This script
+separates the terms:
+
+  ping        - trivial jit (x+1 on 8 floats) + 1-element fetch
+  h2d_*       - jnp.asarray of N bytes + block
+  d2h_*       - np.asarray fetch of a device array of N bytes
+  block_vs_fetch - heavy kernel (100k field muls): time block_until_ready
+                   separately from the subsequent 4-byte fetch.  If block
+                   is ~0 and fetch carries the cost, block lies.
+
+Emits one JSON line per measurement (p50 of 10 runs after 2 warmups).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def p50(f, n=10, warmup=2):
+    for _ in range(warmup):
+        f()
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return 1e3 * ts[len(ts) // 2]
+
+
+def main():
+    dev = jax.devices()[0]
+    emit(stage="backend", platform=dev.platform)
+
+    # --- ping: minimal jit + minimal fetch
+    tiny = jnp.ones((8,), jnp.float32)
+    inc = jax.jit(lambda x: x + 1)
+    inc(tiny).block_until_ready()
+    emit(stage="ping_block", ms=round(p50(lambda: inc(tiny).block_until_ready()), 2))
+    emit(stage="ping_fetch", ms=round(p50(lambda: np.asarray(inc(tiny))), 2))
+
+    # --- H2D bandwidth
+    for nbytes in (32 << 10, 2 << 20, 16 << 20):
+        host = np.zeros(nbytes, np.uint8)
+        ms = p50(lambda: jnp.asarray(host).block_until_ready())
+        emit(stage="h2d", nbytes=nbytes, ms=round(ms, 2),
+             mb_s=round(nbytes / 1e6 / (ms / 1e3), 1))
+
+    # --- D2H bandwidth
+    for nbytes in (1 << 10, 1 << 20, 16 << 20):
+        devarr = jnp.zeros(nbytes, jnp.uint8)
+        devarr.block_until_ready()
+        ms = p50(lambda: np.asarray(devarr))
+        emit(stage="d2h", nbytes=nbytes, ms=round(ms, 2),
+             mb_s=round(nbytes / 1e6 / (ms / 1e3), 1))
+
+    # --- does block_until_ready actually wait?
+    from cometbft_tpu.ops import field as F
+
+    x = jnp.ones((F.NLIMBS, 8192), jnp.int32)
+
+    @jax.jit
+    def heavy(a):
+        return lax.fori_loop(0, 100_000, lambda _, v: F.mul(v, a), a)[0, 0]
+
+    heavy(x).block_until_ready()
+    t0 = time.perf_counter()
+    out = heavy(x)
+    dispatch_ms = 1e3 * (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    out.block_until_ready()
+    block_ms = 1e3 * (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    np.asarray(out)
+    fetch_ms = 1e3 * (time.perf_counter() - t0)
+    emit(stage="block_vs_fetch", dispatch_ms=round(dispatch_ms, 2),
+         block_ms=round(block_ms, 2), fetch_after_block_ms=round(fetch_ms, 2))
+
+    # --- dtype: does per-element overhead exist? (same 2 MB, 4x fewer els)
+    for dt, n in ((np.uint8, 2 << 20), (np.int32, (2 << 20) // 4)):
+        host = np.zeros(n, dt)
+        ms = p50(lambda: jnp.asarray(host).block_until_ready())
+        emit(stage="h2d_dtype", dtype=np.dtype(dt).name, nbytes=int(host.nbytes),
+             ms=round(ms, 2))
+
+    # --- device_put vs asarray
+    host = np.zeros(2 << 20, np.uint8)
+    ms = p50(lambda: jax.device_put(host).block_until_ready())
+    emit(stage="h2d_device_put", nbytes=2 << 20, ms=round(ms, 2))
+
+    # --- do concurrent H2D transfers overlap?
+    import threading
+
+    def pair():
+        h1 = np.zeros(1 << 20, np.uint8)
+        h2 = np.ones(1 << 20, np.uint8)
+        out = [None, None]
+
+        def send(i, h):
+            out[i] = jnp.asarray(h)
+
+        t1 = threading.Thread(target=send, args=(0, h1))
+        t2 = threading.Thread(target=send, args=(1, h2))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        out[0].block_until_ready()
+        out[1].block_until_ready()
+
+    emit(stage="h2d_2x1mb_concurrent", ms=round(p50(pair), 2))
+    host2 = np.zeros(2 << 20, np.uint8)
+    emit(stage="h2d_1x2mb_serial", ms=round(
+        p50(lambda: jnp.asarray(host2).block_until_ready()), 2))
+
+    # --- one fetch vs two fetches of small results
+    small1 = jax.jit(lambda x: (x[:1250], x[1250] > 0))(jnp.zeros(4096, jnp.uint8))
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), small1)
+
+    @jax.jit
+    def two_out(x):
+        return x[:1250], x[1250] > 0
+
+    @jax.jit
+    def one_out(x):
+        return x[:1251]
+
+    zin = jnp.zeros(4096, jnp.uint8)
+    zin.block_until_ready()
+
+    def fetch_two():
+        a, b = two_out(zin)
+        np.asarray(a); np.asarray(b)
+
+    def fetch_one():
+        np.asarray(one_out(zin))
+
+    emit(stage="fetch_two_results", ms=round(p50(fetch_two), 2))
+    emit(stage="fetch_one_result", ms=round(p50(fetch_one), 2))
+
+    # --- end-to-end shape of one bench call, decomposed (V=10000 rows)
+    V = 10_000
+    packed = np.zeros((V, 192), np.uint8)
+
+    @jax.jit
+    def touch(p):
+        return jnp.packbits(p[:, 0] > 0), jnp.all(p[:, 0] >= 0)
+
+    b, a = touch(jnp.asarray(packed))
+    b.block_until_ready()
+
+    def call():
+        b, a = touch(jnp.asarray(packed))
+        b.block_until_ready()
+        np.asarray(b)
+        np.asarray(a)
+
+    emit(stage="call_trivial_10k", ms=round(p50(call), 2))
+    emit(stage="done")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,11 @@ OUT = os.environ.get("TPU_MEASURE_OUT", "/tmp/tpu_measurements.jsonl")
 # measure the warm comb path: never route timed calls through the
 # async-build Straus fallback
 os.environ.setdefault("COMETBFT_TPU_COMB_ASYNC_MIN", str(1 << 30))
+# ...and never through the link-aware small-batch host routing: this
+# suite measures the DEVICE kernels (production would route sub-2048
+# batches to the host through the tunnel; that trade is recorded in
+# BASELINE.md, not re-measured here)
+os.environ.setdefault("COMETBFT_TPU_DEVICE_BATCH_MIN", "1")
 
 
 def emit(stage: str, **data) -> None:
@@ -208,7 +213,9 @@ def main() -> None:
         cache.ensure(pubs)  # warm (already built by stage 3)
         for frac, nch in (("1pct", 100), ("10pct", 1000)):
             fresh = [
-                host.PrivKey.from_seed(b"churn" + i.to_bytes(4, "big")).pub_key().data
+                host.PrivKey.from_seed(
+                    (b"churn" + i.to_bytes(4, "big")).rjust(32, b"\x00")
+                ).pub_key().data
                 for i in range(nch)
             ]
             churned = pubs[nch:] + fresh

@@ -15,6 +15,21 @@ so the host-device-count flag can be injected here.
 
 import os
 
+# Scrub the axon device-plugin trigger so every subprocess the tests spawn
+# (e2e nodes, failpoint crash-children, remote signers) starts WITHOUT
+# contacting the real TPU tunnel: the sitecustomize keyed on this var dials
+# the relay at interpreter start, and tests that kill their children
+# (crash-recovery, perturbations) would strand half-open device sessions —
+# wedging the one-client tunnel for the benchmark that runs after the
+# suite.  The pytest process itself already ran sitecustomize; dropping
+# the var here only affects children, which all force JAX_PLATFORMS=cpu.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# Kernel tests use tiny batches (V=8..64); production link-aware routing
+# would send those to the host verifier and silently skip the device
+# paths under test, so force the device threshold down for the suite.
+os.environ.setdefault("COMETBFT_TPU_DEVICE_BATCH_MIN", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -18,6 +18,12 @@ BENCH = os.path.join(REPO, "bench.py")
 def _run(env_extra: dict) -> dict:
     env = os.environ.copy()
     env.pop("JAX_PLATFORMS", None)
+    # The axon sitecustomize (keyed on PALLAS_AXON_POOL_IPS) registers the
+    # real TPU plugin at interpreter start and overrides JAX_PLATFORMS, so
+    # "no_such_platform" would still find a live device and bench.py would
+    # run the real 10k benchmark.  Drop it so the env knobs are honored and
+    # the test stays hermetic (and cannot touch — or block on — the tunnel).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(env_extra)
     r = subprocess.run(
         [sys.executable, BENCH],
@@ -34,7 +40,17 @@ def _run(env_extra: dict) -> dict:
 
 
 def test_unavailable_backend_yields_structured_error():
-    out = _run({"JAX_PLATFORMS": "no_such_platform", "BENCH_PROBE_TIMEOUT": "60"})
+    out = _run(
+        {
+            "JAX_PLATFORMS": "no_such_platform",
+            "BENCH_PROBE_TIMEOUT": "60",
+            # one attempt, no retry sleep: the retry ladder (default 3 x
+            # 120 s, for wedged-tunnel recovery) would outlive the 120 s
+            # subprocess timeout and break the emit-one-line contract
+            "BENCH_PROBE_RETRIES": "1",
+            "BENCH_PROBE_RETRY_DELAY": "0",
+        }
+    )
     assert out["metric"] == "verify_commit_p50_10k_ms"
     assert out["value"] is None
     assert "error" in out and "backend-unavailable" in out["error"]

@@ -18,29 +18,41 @@ def _fake_entry(pubs, good_rows=None):
     e = cv._CacheEntry.__new__(cv._CacheEntry)
     e.tables = None
     e.valid = None
+    e.pubs = None
     e.index = {pk: i for i, pk in enumerate(pubs)}
     e.size = len(pubs)
     e.vpad = len(pubs)
     e.mesh = None
 
-    def fake_verify(tables, valid, packed, active):
-        packed = np.asarray(packed)
-        active = np.asarray(active)
+    def fake_verify(tables, valid, entry_pubs, payload):
+        payload = np.asarray(payload)
         V = len(pubs)
-        nb = (packed.shape[1] - 64) // 128
-        assert packed.shape == (V, 64 + nb * 128) and nb >= 1
-        assert active.shape == (V,)
-        r, blocks = packed[:, :32], packed[:, 64:]
+        maxm = payload.shape[1] - 68
+        assert maxm >= 32 and maxm % 32 == 0  # bucketed width
+        assert payload.shape[0] == V
+        r = payload[:, :32]
+        mlen = (
+            payload[:, 64].astype(np.int64)
+            | (payload[:, 65].astype(np.int64) << 8)
+            | (payload[:, 66].astype(np.int64) << 16)
+        )
+        live = payload[:, 67] == 1
         populated = r.any(axis=1)
-        # scattered rows carry padded R||A||M blocks; the 0x80 pad marker
-        # guarantees a populated block region even for empty messages
-        assert (blocks.any(axis=1) == (active > 0)).all()
+        # scattered rows carry their message bytes at the static offset
+        msgs = payload[:, 68:]
+        assert (mlen <= maxm).all()
+        for i in range(V):
+            if live[i] and mlen[i]:
+                assert msgs[i, : mlen[i]].any()
+            if not live[i]:
+                assert not payload[i].any()
         ok = populated.copy()
         if good_rows is not None:
             for i in range(V):
                 ok[i] = ok[i] and (i in good_rows)
-        mask = active > 0
-        return np.packbits(ok & mask), bool((ok | ~mask).all())
+        bits = np.packbits(ok & live)
+        all_ok = np.uint8((ok | ~live).all())
+        return np.concatenate([bits, all_ok[None]])
 
     e.verify_fn = fake_verify
     return e
