@@ -116,8 +116,11 @@ def probe_backend() -> None:
         except ValueError:
             return default
 
-    attempts = max(1, _int_env("BENCH_PROBE_RETRIES", 3))
-    delay_s = max(0, _int_env("BENCH_PROBE_RETRY_DELAY", 120))
+    # defaults keep the worst case (attempts x probe timeout + sleeps)
+    # under ~10 min — the driver tolerated >4 min probe hangs in past
+    # rounds, but a structured line must still land within its patience
+    attempts = max(1, _int_env("BENCH_PROBE_RETRIES", 2))
+    delay_s = max(0, _int_env("BENCH_PROBE_RETRY_DELAY", 90))
     for attempt in range(attempts):
         if attempt:
             time.sleep(delay_s)

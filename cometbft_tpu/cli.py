@@ -120,6 +120,22 @@ def cmd_kvstore(args) -> int:
         merkle_state=args.merkle,
     )
     addr = args.addr
+    if addr.startswith("grpc://"):
+        # gRPC transport (abci-cli's --abci grpc flag)
+        from .abci.grpc_transport import GrpcServer
+
+        gsrv = GrpcServer(app, addr)
+        gsrv.start()
+        print(f"ABCI kvstore serving on grpc port {gsrv.port}", flush=True)
+        stop = []
+        signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+        signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
+        try:
+            while not stop:
+                time.sleep(0.2)
+        finally:
+            gsrv.stop()
+        return 0
     if addr.startswith("tcp://"):
         addr = addr[len("tcp://"):]
     srv = SocketServer(addr, app)
@@ -520,6 +536,8 @@ def cmd_config(args) -> int:
 
             shutil.copy(cfg_path, cfg_path + ".bak")
         save_config(cfg)
+        for k in report.get("renamed", []):
+            print(f"  ~ {k} (renamed, value carried over)")
         for k in report["added"]:
             print(f"  + {k} (new key, default value)")
         for k in report["dropped"]:

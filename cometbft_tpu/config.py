@@ -185,6 +185,7 @@ def load_config(home: str) -> Config:
         return cfg
     with open(path, "rb") as f:
         data = tomllib.load(f)
+    data, _ = _apply_renames(data)  # old configs load with values intact
     _apply(cfg.base, data)  # top-level keys are the base section
     for name, cls in _SECTIONS.items():
         if name in data:
@@ -199,17 +200,81 @@ def _apply(obj, data: dict) -> None:
             setattr(obj, f.name, data[f.name])
 
 
+# Cross-version key renames (internal/confix/migrations.go's per-version
+# plans): "old key" -> "new key", applied before the known/obsolete split
+# so an old config carries its VALUES across a rename instead of dropping
+# them.  Keys are dotted ("" section = top level); a None target deletes.
+# The entries mirror the reference's own history (fast_sync -> block_sync
+# and the [fastsync] section in v0.37, config.go).
+_RENAMES: dict[str, str | None] = {
+    "fast_sync": "block_sync",
+    "fastsync.version": None,  # folded into the engine; no knob survives
+    "blocksync.version": None,
+    # order matters: psql-conn must leave the [tx_index] section BEFORE
+    # the indexer key collapses the section into a top-level scalar
+    "tx_index.psql-conn": "psql_conn",
+    "tx_index.indexer": "tx_index",
+}
+
+
+def _apply_renames(raw: dict) -> tuple[dict, list[str]]:
+    """Flatten-rename pass: returns (rewritten raw, renamed-key report)."""
+    renamed: list[str] = []
+    out: dict = {k: (dict(v) if isinstance(v, dict) else v) for k, v in raw.items()}
+
+    def pop_dotted(key: str):
+        if "." in key:
+            sec, k = key.split(".", 1)
+            if isinstance(out.get(sec), dict) and k in out[sec]:
+                v = out[sec].pop(k)
+                if not out[sec]:
+                    del out[sec]
+                return True, v
+            return False, None
+        if key in out and not isinstance(out[key], dict):
+            return True, out.pop(key)
+        return False, None
+
+    def set_dotted(key: str, v) -> None:
+        if "." in key:
+            sec, k = key.split(".", 1)
+            out.setdefault(sec, {})[k] = v
+            return
+        prev = out.get(key)
+        if isinstance(prev, dict):
+            # a section collapsing into a scalar (old [tx_index] table ->
+            # top-level key): surface any leftover keys rather than
+            # silently burying them under the new scalar
+            renamed.extend(f"{key}.{k} (retired)" for k in prev)
+        out[key] = v
+
+    for old, new in _RENAMES.items():
+        if old == new:
+            continue
+        found, v = pop_dotted(old)
+        if not found:
+            continue
+        if new is None:
+            renamed.append(f"{old} (retired)")
+        else:
+            set_dotted(new, v)
+            renamed.append(f"{old} -> {new}")
+    return out, renamed
+
+
 def migrate_report(home: str) -> dict:
     """confix-style migration summary (internal/confix): compare the
     on-disk TOML against the current schema and report what a rewrite
-    would add (new keys at defaults), drop (obsolete keys), and keep.
-    Pure analysis — the caller decides whether to rewrite."""
+    would rename (old keys whose values carry over), add (new keys at
+    defaults), drop (obsolete keys), and keep.  Pure analysis — the
+    caller decides whether to rewrite."""
     cfg = Config(home=home)
     path = cfg.config_file()
     raw: dict = {}
     if os.path.exists(path):
         with open(path, "rb") as f:
             raw = tomllib.load(f)
+    raw, renamed = _apply_renames(raw)
 
     known: dict[str, set[str]] = {
         "": {f.name for f in fields(cfg.base)},
@@ -237,7 +302,12 @@ def migrate_report(home: str) -> dict:
         have = present.get(section, set())
         for k in sorted(names - have):
             added.append(f"{section}.{k}" if section else k)
-    return {"added": added, "dropped": sorted(dropped), "kept": sorted(kept)}
+    return {
+        "added": added,
+        "dropped": sorted(dropped),
+        "kept": sorted(kept),
+        "renamed": renamed,
+    }
 
 
 def save_config(cfg: Config) -> None:

@@ -18,7 +18,7 @@ _TOPOLOGIES = [(2, 0.2), (3, 0.3), (4, 0.4), (5, 0.1)]
 _PERTURBATIONS = ["kill", "pause", "restart", "disconnect", None, None, None]
 # config-space axes (generate.go sweeps ABCI transports and DB backends
 # the same way; key types stay ed25519 — the consensus hot path)
-_ABCI = [("local", 0.7), ("socket", 0.3)]
+_ABCI = [("local", 0.6), ("socket", 0.25), ("grpc", 0.15)]
 _DB = [("", 0.55), ("native", 0.15), ("sqlite", 0.15), ("memdb", 0.15)]
 
 

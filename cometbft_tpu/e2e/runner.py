@@ -34,7 +34,7 @@ class NodeSpec:
     latency_jitter_ms: float = 0.0
     # generator axes (generator/generate.go): ABCI transport and DB
     # backend; "" = the config default
-    abci: str = "local"  # "local" | "socket" (external app process)
+    abci: str = "local"  # "local" | "socket" | "grpc" (external app)
     db_backend: str = ""  # "" | "native" | "sqlite" | "memdb"
     # join mid-run via statesync (requires start_at > 0): the runner
     # fetches trust height/hash from a running node right before launch
@@ -53,13 +53,14 @@ class Manifest:
 class E2ENode:
     def __init__(self, name: str, home: str, rpc_port: int,
                  latency_ms: float = 0.0, latency_jitter_ms: float = 0.0,
-                 abci_port: int = 0):
+                 abci_port: int = 0, abci_scheme: str = "tcp"):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
         self.latency_ms = latency_ms
         self.latency_jitter_ms = latency_jitter_ms
-        self.abci_port = abci_port  # non-zero: external socket app
+        self.abci_port = abci_port  # non-zero: external app process
+        self.abci_scheme = abci_scheme  # "tcp" (socket) | "grpc"
         self.proc: subprocess.Popen | None = None
         self.app_proc: subprocess.Popen | None = None
 
@@ -74,18 +75,23 @@ class E2ENode:
         # driver benches died exactly this way).  CPU is forced above, so
         # the plugin has nothing to offer these nodes anyway.
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        # the test conftest forces the device threshold to 1 so kernel
+        # tests exercise the device paths; a NODE inheriting that would
+        # compile an XLA program to verify a 2-signature commit — scrub
+        # back to the production default (host path at localnet scale)
+        env.pop("COMETBFT_TPU_DEVICE_BATCH_MIN", None)
         if self.latency_ms or self.latency_jitter_ms:
             env["COMETBFT_TPU_TEST_LATENCY_MS"] = (
                 f"{self.latency_ms}:{self.latency_jitter_ms}"
             )
         if self.abci_port and self.app_proc is None:
-            # external app rides the ABCI socket transport (the
-            # generator's abci=socket axis); it outlives node restarts
-            # the way the reference's app container does
+            # external app rides the ABCI socket or gRPC transport (the
+            # generator's abci axis); it outlives node restarts the way
+            # the reference's app container does
             self.app_proc = subprocess.Popen(
                 [
                     sys.executable, "-m", "cometbft_tpu", "kvstore",
-                    "--addr", f"tcp://127.0.0.1:{self.abci_port}",
+                    "--addr", f"{self.abci_scheme}://127.0.0.1:{self.abci_port}",
                     "--snapshot-interval", "2",
                 ],
                 env=env,
@@ -203,6 +209,9 @@ class Runner:
             if spec.abci == "socket":
                 abci_port = self.base_port + 3000 + i
                 cfg.base.proxy_app = f"tcp://127.0.0.1:{abci_port}"
+            elif spec.abci == "grpc":
+                abci_port = self.base_port + 3000 + i
+                cfg.base.proxy_app = f"grpc://127.0.0.1:{abci_port}"
             if spec.db_backend:
                 cfg.base.db_backend = spec.db_backend
             save_config(cfg)
@@ -214,6 +223,7 @@ class Runner:
                     latency_ms=spec.latency_ms,
                     latency_jitter_ms=spec.latency_jitter_ms,
                     abci_port=abci_port,
+                    abci_scheme="grpc" if spec.abci == "grpc" else "tcp",
                 )
             )
 

@@ -58,6 +58,18 @@ def default_db_provider(cfg: Config) -> DB:
     return SQLiteDB(os.path.join(cfg.db_dir(), "cometbft.db"))
 
 
+def _companion_server(laddr: str, **components):
+    """Companion-service server for a listen address: grpc:// picks the
+    real gRPC transport, anything else the varint-framed socket one."""
+    if laddr.startswith("grpc://"):
+        from .rpc.grpc_services import GrpcCompanionServer
+
+        return GrpcCompanionServer(laddr[len("grpc://"):], **components)
+    from .rpc.services import CompanionServiceServer
+
+    return CompanionServiceServer(_strip_tcp(laddr), **components)
+
+
 def make_app(cfg: Config):
     """The in-process demo apps, or a socket client creator for an
     external app (proxy/client.go DefaultClientCreator)."""
@@ -80,6 +92,10 @@ def make_app(cfg: Config):
         from .abci.types import BaseApplication
 
         return local_client_creator(BaseApplication())
+    if pa.startswith("grpc://"):
+        from .abci.grpc_transport import grpc_client_creator
+
+        return grpc_client_creator(pa)
     return remote_client_creator(_strip_tcp(pa))
 
 
@@ -432,26 +448,26 @@ class Node:
                 pass
         if self.config.rpc.companion_laddr:
             from . import __version__
-            from .rpc.services import CompanionServiceServer
 
             # public data services only — the pruner is deliberately not
-            # handed to this listener (rpc/services.py privileged split)
-            self.companion_server = CompanionServiceServer(
-                _strip_tcp(self.config.rpc.companion_laddr),
-                self.block_store,
-                self.state_store,
+            # handed to this listener (rpc/services.py privileged split).
+            # grpc:// serves the reference's real gRPC services
+            # (rpc/grpc_services.py); tcp:// keeps the socket framing.
+            self.companion_server = _companion_server(
+                self.config.rpc.companion_laddr,
+                block_store=self.block_store,
+                state_store=self.state_store,
                 event_bus=self.event_bus,
                 node_version=__version__,
             )
             self.companion_server.start()
         if self.config.rpc.companion_privileged_laddr:
             from . import __version__
-            from .rpc.services import CompanionServiceServer
 
-            self.companion_privileged_server = CompanionServiceServer(
-                _strip_tcp(self.config.rpc.companion_privileged_laddr),
-                self.block_store,
-                self.state_store,
+            self.companion_privileged_server = _companion_server(
+                self.config.rpc.companion_privileged_laddr,
+                block_store=self.block_store,
+                state_store=self.state_store,
                 pruner=self.pruner,
                 tx_indexer=self.tx_indexer,
                 block_indexer=self.block_indexer,

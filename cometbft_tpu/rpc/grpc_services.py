@@ -1,0 +1,211 @@
+"""True gRPC data-companion services (reference: rpc/grpc/server —
+blockservice, blockresultservice, versionservice, pruningservice).
+
+Serves the reference's exact service paths over grpcio:
+
+  cometbft.services.block.v1.BlockService/GetByHeight
+  cometbft.services.block.v1.BlockService/GetLatestHeight   (streaming)
+  cometbft.services.block_results.v1.BlockResultsService/GetBlockResults
+  cometbft.services.version.v1.VersionService/GetVersion
+  cometbft.services.pruning.v1.PruningService/{Set,Get}*RetainHeight
+
+The message bodies ride the framework's deterministic codec
+(wire/services_pb.py, field numbers matching the reference protos), and
+the business logic is the SAME handler methods the socket-framed
+companion server uses (rpc/services.py) — this module only swaps the
+transport.  The public/privileged listener split mirrors grpc_laddr /
+grpc_privileged_laddr (node/node.go): privileged=True serves ONLY the
+pruning service so operators can firewall the retain-height API.
+"""
+
+from __future__ import annotations
+
+from ..utils.log import get_logger
+from ..utils.service import Service
+from ..wire import services_pb as pb
+from .services import _HANDLERS, CompanionServiceServer
+
+_BLOCK = "cometbft.services.block.v1.BlockService"
+_RESULTS = "cometbft.services.block_results.v1.BlockResultsService"
+_VERSION = "cometbft.services.version.v1.VersionService"
+_PRUNING = "cometbft.services.pruning.v1.PruningService"
+
+# full gRPC path -> the socket server's envelope method name
+GRPC_PATHS: dict[str, str] = {
+    f"/{_BLOCK}/GetByHeight": "block.GetByHeight",
+    f"/{_RESULTS}/GetBlockResults": "block_results.GetBlockResults",
+    f"/{_VERSION}/GetVersion": "version.GetVersion",
+    **{
+        f"/{_PRUNING}/{m.split('.', 1)[1]}": m
+        for m in _HANDLERS
+        if m.startswith("pruning.")
+    },
+}
+_STREAM_PATH = f"/{_BLOCK}/GetLatestHeight"
+
+
+class GrpcCompanionServer(Service):
+    """gRPC front end over the companion-service handlers.
+
+    Takes the same components as CompanionServiceServer; an internal
+    (never-started) instance carries them so both transports execute
+    identical logic."""
+
+    def __init__(self, addr: str, privileged: bool = False, **components):
+        super().__init__("GrpcCompanionServices")
+        self.addr = addr
+        self.privileged = privileged
+        # host the handlers without opening the socket listener
+        self._inner = CompanionServiceServer(
+            addr="127.0.0.1:0", privileged=privileged, **components
+        )
+        self._server = None
+        self.port = 0
+        self.logger = get_logger("grpc-services")
+
+    def on_start(self) -> None:
+        import grpc
+        from concurrent import futures
+
+        outer = self
+        inner = self._inner
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                path = details.method
+                if path == _STREAM_PATH:
+                    if outer.privileged:
+                        return None  # public service; not on this listener
+                    return grpc.unary_stream_rpc_method_handler(
+                        outer._latest_height_stream,
+                        request_deserializer=bytes,
+                        response_serializer=lambda m: m.encode(),
+                    )
+                method = GRPC_PATHS.get(path)
+                if method is None:
+                    return None
+                if method.startswith("pruning.") != outer.privileged:
+                    return None  # wrong listener for this service
+                handler = _HANDLERS[method]
+
+                def unary(payload: bytes, _ctx):
+                    return handler(inner, payload)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=bytes,
+                    response_serializer=lambda m: m.encode(),
+                )
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4), handlers=(Handler(),)
+        )
+        self.port = self._server.add_insecure_port(self.addr)
+        if self.port == 0:
+            raise OSError(f"grpc companion server failed to bind {self.addr!r}")
+        self._server.start()
+        kind = "privileged" if self.privileged else "public"
+        self.logger.info(f"{kind} gRPC companion services on port {self.port}")
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0).wait()
+            self._server = None
+
+    def _latest_height_stream(self, _payload: bytes, ctx):
+        """One response now, then one per NewBlock event
+        (blockservice/service.go:79); ends when the client cancels."""
+        import queue as _q
+        import uuid
+
+        inner = self._inner
+        sub = None
+        subscriber = f"grpc-latest-{uuid.uuid4().hex[:12]}"
+        try:
+            if inner.event_bus is not None:
+                from ..types.event_bus import EventQueryNewBlock
+
+                sub = inner.event_bus.subscribe(subscriber, EventQueryNewBlock)
+            yield pb.GetLatestHeightResponse(height=inner.block_store.height)
+            if sub is None:
+                return
+            while self.is_running() and ctx.is_active():
+                try:
+                    msg, _events = sub.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                yield pb.GetLatestHeightResponse(
+                    height=msg.data["block"].header.height
+                )
+        finally:
+            if sub is not None:
+                try:
+                    inner.event_bus.unsubscribe(subscriber, EventQueryNewBlock)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+class GrpcCompanionClient:
+    """Thin unary client for the gRPC companion services (the reference
+    ships generated clients; this one plugs the framework codec into
+    grpcio directly)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(addr)
+        self.timeout = timeout
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _unary(self, path: str, req_msg, resp_cls):
+        call = self._channel.unary_unary(
+            path,
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=resp_cls.decode,
+        )
+        return call(req_msg, timeout=self.timeout)
+
+    def get_by_height(self, height: int = 0) -> pb.GetByHeightResponse:
+        return self._unary(
+            f"/{_BLOCK}/GetByHeight",
+            pb.GetByHeightRequest(height=height),
+            pb.GetByHeightResponse,
+        )
+
+    def latest_height_stream(self):
+        call = self._channel.unary_stream(
+            _STREAM_PATH,
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=pb.GetLatestHeightResponse.decode,
+        )
+        return call(pb.GetLatestHeightRequest())
+
+    def get_block_results(self, height: int = 0) -> pb.GetBlockResultsResponse:
+        return self._unary(
+            f"/{_RESULTS}/GetBlockResults",
+            pb.GetBlockResultsRequest(height=height),
+            pb.GetBlockResultsResponse,
+        )
+
+    def get_version(self) -> pb.GetVersionResponse:
+        return self._unary(
+            f"/{_VERSION}/GetVersion",
+            pb.GetVersionRequest(),
+            pb.GetVersionResponse,
+        )
+
+    def set_block_retain_height(self, height: int) -> None:
+        self._unary(
+            f"/{_PRUNING}/SetBlockRetainHeight",
+            pb.SetBlockRetainHeightRequest(height=height),
+            pb.Empty,
+        )
+
+    def get_block_retain_height(self) -> pb.GetBlockRetainHeightResponse:
+        return self._unary(
+            f"/{_PRUNING}/GetBlockRetainHeight",
+            pb.Empty(),
+            pb.GetBlockRetainHeightResponse,
+        )

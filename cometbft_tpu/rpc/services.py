@@ -2,14 +2,15 @@
 privileged pruning service (reference: rpc/grpc/server/services/
 {blockservice,blockresultservice,versionservice,pruningservice}).
 
-The reference serves these over gRPC; grpcio is not available in this
-image, so they ride the same varint-delimited proto socket framing the
-ABCI and privval sidecars use (abci/client/socket_client.go pattern),
-with a method-routed envelope (wire/services_pb.ServiceRequest) and
+The reference serves these over gRPC; this module is the lightweight
+socket transport — the same varint-delimited proto framing the ABCI and
+privval sidecars use (abci/client/socket_client.go pattern), with a
+method-routed envelope (wire/services_pb.ServiceRequest) and
 server-streaming support for GetLatestHeight
 (blockservice/service.go:79 streams a height per committed block).
-Functionally equivalent for a data companion; the transport is the
-documented substitution.
+The REAL gRPC transport over the reference's exact service paths lives
+in rpc/grpc_services.py and reuses this module's handlers; a
+companion_laddr of grpc://host:port selects it (node.py).
 """
 
 from __future__ import annotations

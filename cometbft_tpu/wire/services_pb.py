@@ -1,9 +1,9 @@
 """Data-companion service wire messages (field layouts mirror
 proto/cometbft/services/{block,block_results,version,pruning}/v1 of the
-reference).  Served over the varint-framed socket transport
-(rpc/services.py) instead of gRPC/HTTP2 — grpcio is not available in
-this image; the framing is the same one the ABCI and privval sidecar
-protocols use.
+reference).  Served over BOTH companion transports: the real gRPC
+services (rpc/grpc_services.py, the reference's exact service paths)
+and the varint-framed socket substitute (rpc/services.py — the framing
+the ABCI and privval sidecar protocols use).
 """
 
 from __future__ import annotations

@@ -126,8 +126,9 @@ def test_perturbed_localnet_keeps_invariants(tmp_path):
         chain_id="e2e-perturb",
         nodes=[
             # partitioned at the network layer (sockets severed, process
-            # alive) then healed — perturb.go's docker disconnect
-            NodeSpec("stable0", perturbations=["disconnect"]),
+            # alive) then healed — perturb.go's docker disconnect; rides
+            # the external-app ABCI gRPC transport throughout
+            NodeSpec("stable0", perturbations=["disconnect"], abci="grpc"),
             NodeSpec("killed", perturbations=["kill"]),
             # rides the external-app ABCI socket transport while paused
             NodeSpec("paused", perturbations=["pause"], abci="socket"),
@@ -207,14 +208,14 @@ def test_generator_deterministic_and_valid():
                 seen_late = True
                 assert 3 <= spec.start_at <= 6
             seen_perts.update(spec.perturbations)
-            assert spec.abci in ("local", "socket")
+            assert spec.abci in ("local", "socket", "grpc")
             assert spec.db_backend in ("", "native", "sqlite", "memdb")
             seen_abci.add(spec.abci)
             seen_db.add(spec.db_backend)
         assert perturbed <= len(m.nodes) // 2
     assert len(seen_sizes) >= 3  # the space actually gets explored
     assert seen_perts and seen_late
-    assert seen_abci == {"local", "socket"}  # transport axis explored
+    assert seen_abci == {"local", "socket", "grpc"}  # transport axis explored
     assert len(seen_db) >= 3  # db-backend axis explored
 
 

@@ -56,6 +56,7 @@ def test_crash_at_every_commit_step_recovers(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("COMETBFT_TPU_DEVICE_BATCH_MIN", None)  # conftest forces 1
     env["JAX_PLATFORMS"] = "cpu"
 
     def cli(*a, **kw):

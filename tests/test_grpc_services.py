@@ -1,0 +1,94 @@
+"""True-gRPC data-companion services (rpc/grpc_services.py) against the
+reference's service paths (rpc/grpc/server/services/*): block,
+block-results, version, streaming latest-height, and the privileged
+pruning split — same business handlers as the socket transport
+(tests/test_companion_services.py), different wire."""
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from cometbft_tpu.rpc.grpc_services import GrpcCompanionClient, GrpcCompanionServer
+from cometbft_tpu.state.pruner import Pruner
+from cometbft_tpu.store.db import MemDB
+
+from test_execution import GENESIS_NS, Harness
+
+NS = 1_000_000_000
+
+
+@pytest.fixture
+def net():
+    h = Harness()
+    for i in range(6):
+        h.step(1 + i, GENESIS_NS + (1 + i) * 2 * NS)
+    pruner = Pruner(MemDB(), h.state_store, h.block_store)
+    srv = GrpcCompanionServer(
+        "127.0.0.1:0",
+        block_store=h.block_store,
+        state_store=h.state_store,
+        event_bus=h.event_bus,
+        node_version="0.1.0-test",
+    )
+    srv.start()
+    priv = GrpcCompanionServer(
+        "127.0.0.1:0",
+        privileged=True,
+        block_store=h.block_store,
+        state_store=h.state_store,
+        pruner=pruner,
+        event_bus=h.event_bus,
+        node_version="0.1.0-test",
+    )
+    priv.start()
+    cli = GrpcCompanionClient(f"127.0.0.1:{srv.port}")
+    pcli = GrpcCompanionClient(f"127.0.0.1:{priv.port}")
+    yield h, srv, cli, pruner, pcli
+    cli.close()
+    pcli.close()
+    srv.stop()
+    priv.stop()
+    h.stop()
+
+
+def test_grpc_version_and_block_services(net):
+    h, _, cli, _, _ = net
+    v = cli.get_version()
+    assert v.node == "0.1.0-test"
+    assert v.abci and v.block > 0 and v.p2p > 0
+
+    latest = cli.get_by_height(0)
+    assert latest.block_id.hash and latest.block.header.height == 6
+    b3 = cli.get_by_height(3)
+    assert b3.block.header.height == 3
+
+    res = cli.get_block_results(3)
+    assert res.height == 3
+
+
+def test_grpc_latest_height_stream(net):
+    h, _, cli, _, _ = net
+    stream = cli.latest_height_stream()
+    first = next(iter(stream))
+    assert first.height == 6
+    # a new committed block pushes a second response
+    h.step(7, GENESIS_NS + 7 * 2 * NS)
+    second = next(iter(stream))
+    assert second.height == 7
+    stream.cancel()
+
+
+def test_grpc_privileged_split(net):
+    import grpc as _grpc
+
+    _, srv, cli, pruner, pcli = net
+    # pruning on the PUBLIC listener: unimplemented
+    with pytest.raises(_grpc.RpcError):
+        cli.set_block_retain_height(3)
+    # ...and works on the privileged one
+    pcli.set_block_retain_height(3)
+    got = pcli.get_block_retain_height()
+    assert got.pruning_service_retain_height == 3
+    # public data services are NOT on the privileged listener
+    with pytest.raises(_grpc.RpcError):
+        pcli.get_version()

@@ -470,3 +470,38 @@ def test_config_migrate_reports_and_rewrites(tmp_path):
     out = open(cfg_path).read()
     assert "db_backend" in out  # new key materialized
     assert "fast_sync_removed_in_v1" not in out  # obsolete key dropped
+
+
+def test_config_migrate_renames_carry_values(tmp_path):
+    """Cross-version renames (internal/confix/migrations.go per-version
+    plans): an old config using pre-rename keys carries its VALUES to the
+    new names instead of dropping them — both when migrating and when a
+    node simply loads the old file."""
+    home = _mk_home(tmp_path, "ren", chain_id="ren-chain")
+    cfg_path = os.path.join(home, "config", "config.toml")
+    text = open(cfg_path).read()
+    lines = [
+        l for l in text.splitlines() if not l.startswith("block_sync")
+    ]
+    # v0.34/v0.36-style spellings: top-level fast_sync + [fastsync] version
+    lines.insert(1, "fast_sync = false")
+    lines.append("")
+    lines.append("[fastsync]")
+    lines.append('version = "v0"')
+    open(cfg_path, "w").write("\n".join(lines) + "\n")
+
+    from cometbft_tpu.config import migrate_report
+
+    rep = migrate_report(home)
+    assert "fast_sync -> block_sync" in rep["renamed"]
+    assert "fastsync.version (retired)" in rep["renamed"]
+    assert "block_sync" in rep["kept"]
+
+    # a plain load honors the old spelling (value carried, not default)
+    assert load_config(home).base.block_sync is False
+
+    assert cli_main(["--home", home, "config", "migrate"]) == 0
+    out = open(cfg_path).read()
+    assert "block_sync = false" in out
+    assert "fast_sync" not in out.replace("block_sync", "")
+    assert "[fastsync]" not in out
