@@ -430,7 +430,11 @@ class KVStoreApplication(Application):
             return pb.CommitResponse()
 
     def _update_validator(self, v: pb.ValidatorUpdate) -> None:
-        pub = ed25519.PubKey(v.pub_key_bytes)
+        from ..crypto import encoding as keyenc
+
+        pub = keyenc.pubkey_from_type_and_bytes(
+            v.pub_key_type or "ed25519", v.pub_key_bytes
+        )
         addr = pub.address()
         key = VALIDATOR_PREFIX.encode() + addr
         if v.power == 0:

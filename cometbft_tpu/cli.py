@@ -32,14 +32,19 @@ from .wire.canonical import Timestamp
 VERSION = "0.3.0"
 
 
-def _ensure_init(cfg: Config, chain_id: str | None = None) -> None:
-    """init: config + genesis + node key + privval (commands/init.go)."""
+def _ensure_init(
+    cfg: Config, chain_id: str | None = None, key_type: str = "ed25519"
+) -> None:
+    """init: config + genesis + node key + privval (commands/init.go;
+    --key-type per commands/init.go's key-type flag)."""
     os.makedirs(os.path.join(cfg.home, "config"), exist_ok=True)
     os.makedirs(os.path.join(cfg.home, "data"), exist_ok=True)
     if not os.path.exists(cfg.config_file()):
         save_config(cfg)
     pv = FilePV.load_or_generate(
-        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+        cfg.priv_validator_key_file(),
+        cfg.priv_validator_state_file(),
+        key_type=key_type,
     )
     NodeKey.load_or_gen(cfg.node_key_file())
     if not os.path.exists(cfg.genesis_file()):
@@ -48,18 +53,23 @@ def _ensure_init(cfg: Config, chain_id: str | None = None) -> None:
             genesis_time=Timestamp.from_unix_ns(time.time_ns()),
             validators=[
                 GenesisValidator(
-                    pub_key_type="ed25519",
-                    pub_key_bytes=pv.key.priv_key.pub_key().data,
+                    pub_key_type=pv.key.pub_key.type,
+                    pub_key_bytes=pv.key.pub_key.bytes(),
                     power=10,
                 )
             ],
         )
+        doc.consensus_params.validator.pub_key_types = [pv.key.pub_key.type]
         doc.save_as(cfg.genesis_file())
     print(f"initialized node in {cfg.home}")
 
 
 def cmd_init(args) -> int:
-    _ensure_init(load_config(args.home), args.chain_id)
+    _ensure_init(
+        load_config(args.home),
+        args.chain_id,
+        key_type=getattr(args, "key_type", "ed25519"),
+    )
     return 0
 
 
@@ -124,23 +134,15 @@ def cmd_kvstore(args) -> int:
         # gRPC transport (abci-cli's --abci grpc flag)
         from .abci.grpc_transport import GrpcServer
 
-        gsrv = GrpcServer(app, addr)
-        gsrv.start()
-        print(f"ABCI kvstore serving on grpc port {gsrv.port}", flush=True)
-        stop = []
-        signal.signal(signal.SIGINT, lambda *_: stop.append(True))
-        signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
-        try:
-            while not stop:
-                time.sleep(0.2)
-        finally:
-            gsrv.stop()
-        return 0
-    if addr.startswith("tcp://"):
-        addr = addr[len("tcp://"):]
-    srv = SocketServer(addr, app)
-    srv.start()
-    print(f"ABCI kvstore serving on {srv.laddr}", flush=True)
+        srv = GrpcServer(app, addr)
+        srv.start()
+        print(f"ABCI kvstore serving on grpc port {srv.port}", flush=True)
+    else:
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        srv = SocketServer(addr, app)
+        srv.start()
+        print(f"ABCI kvstore serving on {srv.laddr}", flush=True)
     stop = []
     signal.signal(signal.SIGINT, lambda *_: stop.append(True))
     signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
@@ -159,21 +161,16 @@ def cmd_show_node_id(args) -> int:
 
 
 def cmd_show_validator(args) -> int:
-    import base64
-
     cfg = load_config(args.home)
     pv = FilePV.load_or_generate(
         cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
     )
+    from .utils import amino_json
+
     pub = pv.key.priv_key.pub_key()
-    print(
-        json.dumps(
-            {
-                "type": "tendermint/PubKeyEd25519",
-                "value": base64.b64encode(pub.data).decode(),
-            }
-        )
-    )
+    # amino-typed JSON so the registered name matches the key's real type
+    # (show_validator.go marshals the same way)
+    print(amino_json.marshal(pub))
     return 0
 
 
@@ -188,7 +185,9 @@ def cmd_gen_node_key(args) -> int:
 def cmd_gen_validator(args) -> int:
     cfg = load_config(args.home)
     pv = FilePV.load_or_generate(
-        cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+        cfg.priv_validator_key_file(),
+        cfg.priv_validator_state_file(),
+        key_type=getattr(args, "key_type", "ed25519"),
     )
     print(f"validator key written to {cfg.priv_validator_key_file()}")
     return 0
@@ -225,7 +224,9 @@ def cmd_testnet(args) -> int:
         os.makedirs(os.path.join(home, "data"), exist_ok=True)
         pvs.append(
             FilePV.load_or_generate(
-                cfg.priv_validator_key_file(), cfg.priv_validator_state_file()
+                cfg.priv_validator_key_file(),
+                cfg.priv_validator_state_file(),
+                key_type=getattr(args, "key_type", "ed25519"),
             )
         )
         node_keys.append(NodeKey.load_or_gen(cfg.node_key_file()))
@@ -235,12 +236,15 @@ def cmd_testnet(args) -> int:
         genesis_time=Timestamp.from_unix_ns(time.time_ns()),
         validators=[
             GenesisValidator(
-                pub_key_type="ed25519",
-                pub_key_bytes=pv.key.priv_key.pub_key().data,
+                pub_key_type=pv.key.pub_key.type,
+                pub_key_bytes=pv.key.pub_key.bytes(),
                 power=10,
             )
             for pv in pvs
         ],
+    )
+    genesis.consensus_params.validator.pub_key_types = sorted(
+        {pv.key.pub_key.type for pv in pvs}
     )
     base_p2p, base_rpc = args.starting_port, args.starting_port + 1000
     for i, cfg in enumerate(cfgs):
@@ -601,6 +605,8 @@ def main(argv: list[str] | None = None) -> int:
 
     sp = sub.add_parser("init", help="initialize config/genesis/keys")
     sp.add_argument("--chain-id", default=None)
+    sp.add_argument("--key-type", default="ed25519",
+                    choices=["ed25519", "secp256k1", "secp256k1eth", "bls12_381"])
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
@@ -619,7 +625,10 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
     sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)
     sub.add_parser("gen-node-key").set_defaults(fn=cmd_gen_node_key)
-    sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
+    sp = sub.add_parser("gen-validator")
+    sp.add_argument("--key-type", default="ed25519",
+                    choices=["ed25519", "secp256k1", "secp256k1eth", "bls12_381"])
+    sp.set_defaults(fn=cmd_gen_validator)
     sub.add_parser("unsafe-reset-all").set_defaults(fn=cmd_unsafe_reset_all)
 
     sp = sub.add_parser("rollback", help="roll engine state back one height")
@@ -643,6 +652,8 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--o", default="./mytestnet")
     sp.add_argument("--chain-id", default=None)
     sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--key-type", default="ed25519",
+                    choices=["ed25519", "secp256k1", "secp256k1eth", "bls12_381"])
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("light", help="light-client verifying RPC proxy")

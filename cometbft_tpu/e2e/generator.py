@@ -16,10 +16,14 @@ from .runner import Manifest, NodeSpec
 # weighted choices mirroring generate.go's testnetCombinations shape
 _TOPOLOGIES = [(2, 0.2), (3, 0.3), (4, 0.4), (5, 0.1)]
 _PERTURBATIONS = ["kill", "pause", "restart", "disconnect", None, None, None]
-# config-space axes (generate.go sweeps ABCI transports and DB backends
-# the same way; key types stay ed25519 — the consensus hot path)
+# config-space axes (generate.go sweeps ABCI transports, DB backends,
+# and validator key types the same way)
 _ABCI = [("local", 0.6), ("socket", 0.25), ("grpc", 0.15)]
 _DB = [("", 0.55), ("native", 0.15), ("sqlite", 0.15), ("memdb", 0.15)]
+# per-net validator key type (generate.go keyType): secp256k1 nets run
+# the sequential verify fallback end to end; bls is excluded here (pure-
+# Python signing is too slow for a multi-process localnet on 1 core)
+_KEY_TYPES = [("ed25519", 0.8), ("secp256k1", 0.2)]
 
 
 def _weighted(rng: random.Random, pairs):
@@ -73,6 +77,7 @@ def generate(seed: int) -> Manifest:
         nodes=nodes,
         load_tx_per_round=rng.choice([0, 2, 5, 10]),
         target_height=rng.randint(8, 14),
+        key_type=_weighted(rng, _KEY_TYPES),
     )
 
 

@@ -48,6 +48,9 @@ class Manifest:
     nodes: list[NodeSpec] = field(default_factory=list)
     load_tx_per_round: int = 5
     target_height: int = 12
+    # validator key type for the whole net (generate.go's keyType axis);
+    # non-ed25519 nets exercise the sequential verify fallback
+    key_type: str = "ed25519"
 
 
 class E2ENode:
@@ -184,6 +187,7 @@ class Runner:
                 "testnet", "--v", str(n), "--o", self.out,
                 "--chain-id", self.m.chain_id,
                 "--starting-port", str(self.base_port),
+                "--key-type", self.m.key_type,
             ]
         ) == 0
         for i, spec in enumerate(self.m.nodes):

@@ -51,6 +51,40 @@ def _atomic_write(path: str, data: str) -> None:
         raise
 
 
+def _generate_priv_key(key_type: str, seed: bytes | None = None):
+    """Validator key of any supported type (privval/file.go
+    GenFilePVWithKeyType; key types per crypto/encoding).  Consensus
+    signs/verifies through the PrivKey/PubKey interface, so everything
+    downstream is type-agnostic — but only ed25519 rides the TPU batch
+    path (crypto/batch.supports_batch_verifier); the rest verify through
+    the sequential fallback in types/validation.py."""
+    if key_type == "ed25519":
+        return ed25519.PrivKey.from_seed(seed) if seed else ed25519.PrivKey.generate()
+    if key_type == "secp256k1":
+        from ..crypto import secp256k1
+
+        return (
+            secp256k1.PrivKey.from_seed(seed) if seed else secp256k1.PrivKey.generate()
+        )
+    if key_type == "secp256k1eth":
+        from ..crypto import secp256k1eth
+
+        return (
+            secp256k1eth.PrivKey.from_seed(seed)
+            if seed
+            else secp256k1eth.PrivKey.generate()
+        )
+    if key_type == "bls12_381":
+        from ..crypto import bls12381
+
+        return (
+            bls12381.PrivKey.from_secret(seed)
+            if seed
+            else bls12381.PrivKey.generate()
+        )
+    raise ValueError(f"unsupported validator key type {key_type!r}")
+
+
 class FilePVKey:
     """privval_key.json: address + pubkey + privkey (file.go FilePVKey)."""
 
@@ -177,8 +211,14 @@ class FilePV:
     # ---------------------------------------------------- construction
 
     @classmethod
-    def generate(cls, key_file: str = "", state_file: str = "", seed: bytes | None = None) -> "FilePV":
-        priv = ed25519.PrivKey.from_seed(seed) if seed else ed25519.PrivKey.generate()
+    def generate(
+        cls,
+        key_file: str = "",
+        state_file: str = "",
+        seed: bytes | None = None,
+        key_type: str = "ed25519",
+    ) -> "FilePV":
+        priv = _generate_priv_key(key_type, seed)
         pv = cls(FilePVKey(priv, key_file), FilePVLastSignState(state_file))
         return pv
 
@@ -187,10 +227,12 @@ class FilePV:
         return cls(FilePVKey.load(key_file), FilePVLastSignState.load(state_file))
 
     @classmethod
-    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+    def load_or_generate(
+        cls, key_file: str, state_file: str, key_type: str = "ed25519"
+    ) -> "FilePV":
         if os.path.exists(key_file):
             return cls.load(key_file, state_file)
-        pv = cls.generate(key_file, state_file)
+        pv = cls.generate(key_file, state_file, key_type=key_type)
         pv.save()
         return pv
 

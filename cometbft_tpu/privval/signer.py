@@ -249,9 +249,11 @@ class SignerClient:
                 raise RemoteSignerError(f"unexpected response {resp.which()}")
             if r.error is not None:
                 raise RemoteSignerError(r.error.description)
-            from ..crypto import ed25519
+            from ..crypto import encoding as keyenc
 
-            self._pub_key = ed25519.PubKey(r.pub_key_bytes)
+            self._pub_key = keyenc.pubkey_from_type_and_bytes(
+                r.pub_key_type or "ed25519", r.pub_key_bytes
+            )
         return self._pub_key
 
     # `key` facade so ConsensusState's address lookups keep working
@@ -428,7 +430,7 @@ class SignerServer:
             pub = self.pv.key.priv_key.pub_key()
             return pb.PrivvalMessage(
                 pub_key_response=pb.PubKeyResponse(
-                    pub_key_bytes=pub.data, pub_key_type="ed25519"
+                    pub_key_bytes=pub.bytes(), pub_key_type=pub.type
                 )
             )
         if which == "sign_vote_request":

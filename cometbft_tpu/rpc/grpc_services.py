@@ -113,36 +113,13 @@ class GrpcCompanionServer(Service):
             self._server = None
 
     def _latest_height_stream(self, _payload: bytes, ctx):
-        """One response now, then one per NewBlock event
-        (blockservice/service.go:79); ends when the client cancels."""
-        import queue as _q
-        import uuid
-
-        inner = self._inner
-        sub = None
-        subscriber = f"grpc-latest-{uuid.uuid4().hex[:12]}"
-        try:
-            if inner.event_bus is not None:
-                from ..types.event_bus import EventQueryNewBlock
-
-                sub = inner.event_bus.subscribe(subscriber, EventQueryNewBlock)
-            yield pb.GetLatestHeightResponse(height=inner.block_store.height)
-            if sub is None:
-                return
-            while self.is_running() and ctx.is_active():
-                try:
-                    msg, _events = sub.get(timeout=1.0)
-                except _q.Empty:
-                    continue
-                yield pb.GetLatestHeightResponse(
-                    height=msg.data["block"].header.height
-                )
-        finally:
-            if sub is not None:
-                try:
-                    inner.event_bus.unsubscribe(subscriber, EventQueryNewBlock)
-                except Exception:  # noqa: BLE001
-                    pass
+        """gRPC framing over the shared subscription generator
+        (rpc/services.py latest_heights); ends when the client cancels
+        or this server stops."""
+        for height in self._inner.latest_heights(
+            live=lambda: self.is_running() and ctx.is_active()
+        ):
+            yield pb.GetLatestHeightResponse(height=height)
 
 
 class GrpcCompanionClient:

@@ -197,58 +197,60 @@ class CompanionServiceServer(Service):
             block_id=meta.block_id, block=block.to_proto()
         )
 
-    def _stream_latest_height(self, conn, send_mtx, req_id: int) -> None:
-        """One response now, then one per NewBlock event
-        (blockservice/service.go:79 GetLatestHeight stream).  The
-        subscriber name is globally unique (req ids are per-connection),
-        and the subscription is torn down when the conn dies — the write
-        failure surfaces as OSError on the next block."""
+    def latest_heights(self, live=None):
+        """Generator: the current height now, then one height per
+        NewBlock event (blockservice/service.go:79 GetLatestHeight) —
+        the ONE subscription lifecycle shared by both transports (the
+        socket framing below and rpc/grpc_services.py's stream handler).
+        live: optional () -> bool liveness predicate REPLACING this
+        service's own is_running() (the gRPC wrapper hosts an unstarted
+        instance and supplies its own).
+        Subscribes BEFORE yielding the initial height: a block that
+        commits between the two would otherwise be missed forever."""
         import queue as _q
         import uuid
 
         sub = None
         subscriber = f"svc-latest-{uuid.uuid4().hex[:12]}"
         try:
-            # subscribe BEFORE the initial frame: a block that commits
-            # between the two would otherwise be missed forever
             if self.event_bus is not None:
                 from ..types.event_bus import EventQueryNewBlock
 
                 sub = self.event_bus.subscribe(subscriber, EventQueryNewBlock)
-            with send_mtx:
-                _write_frame(
-                    conn,
-                    pb.ServiceResponse(
-                        id=req_id,
-                        payload=pb.GetLatestHeightResponse(
-                            height=self.block_store.height
-                        ).encode(),
-                    ).encode(),
-                )
+            yield self.block_store.height
             if sub is None:
                 return
-            while self.is_running():
+            while live() if live is not None else self.is_running():
                 try:
                     msg, _events = sub.get(timeout=1.0)
                 except _q.Empty:
                     continue
-                height = msg.data["block"].header.height
-                with send_mtx:
-                    _write_frame(
-                        conn,
-                        pb.ServiceResponse(
-                            id=req_id,
-                            payload=pb.GetLatestHeightResponse(height=height).encode(),
-                        ).encode(),
-                    )
-        except (OSError, ValueError):
-            return
+                yield msg.data["block"].header.height
         finally:
             if sub is not None:
                 try:
                     self.event_bus.unsubscribe(subscriber, EventQueryNewBlock)
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _stream_latest_height(self, conn, send_mtx, req_id: int) -> None:
+        """Socket framing over latest_heights(); the subscription is torn
+        down when the conn dies — the write failure surfaces as OSError
+        on the next block."""
+        try:
+            for height in self.latest_heights():
+                with send_mtx:
+                    _write_frame(
+                        conn,
+                        pb.ServiceResponse(
+                            id=req_id,
+                            payload=pb.GetLatestHeightResponse(
+                                height=height
+                            ).encode(),
+                        ).encode(),
+                    )
+        except (OSError, ValueError):
+            return
 
     # ---- block-results service (blockresultservice/service.go)
 

@@ -9,7 +9,21 @@ line per stage like tpu_measure_all.py.
 import json
 import os
 import sys
+import threading
 import time
+
+# Hard self-timeout: a wedged tunnel blocks PJRT calls in C++ where
+# Python signal handlers never run; a daemon timer + os._exit is the only
+# reliable bail (same pattern as tpu_probe.py).  Exiting is safe — a
+# wedged session is lost either way, and a zombie profiler would hold
+# its claim forever in front of the round-end bench.
+_DEADLINE_S = int(os.environ.get("KERNEL_PROF_TIMEOUT", "1800"))
+_watchdog = threading.Timer(
+    _DEADLINE_S,
+    lambda: (print(f"TIMEOUT after {_DEADLINE_S}s", flush=True), os._exit(3)),
+)
+_watchdog.daemon = True
+_watchdog.start()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np

@@ -1,0 +1,101 @@
+"""Non-ed25519 validator key types end to end (reference: the e2e
+generator's keyType axis, test/e2e/generator/generate.go; privval
+key-type flag, commands/init.go): FilePV generation/roundtrip, testnet
+genesis typing, and commit verification through the sequential fallback
+(types/validation.py — batch verification is ed25519-only)."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.cli import main as cli_main
+from cometbft_tpu.privval.file_pv import FilePV, _generate_priv_key
+from cometbft_tpu.types.genesis import GenesisDoc
+
+
+@pytest.mark.parametrize("kt", ["ed25519", "secp256k1", "secp256k1eth"])
+def test_filepv_generate_and_roundtrip(tmp_path, kt):
+    kf = str(tmp_path / f"{kt}_key.json")
+    sf = str(tmp_path / f"{kt}_state.json")
+    pv = FilePV.generate(kf, sf, seed=bytes([7]) * 32, key_type=kt)
+    pv.save()
+    assert pv.key.pub_key.type == kt
+    back = FilePV.load(kf, sf)
+    assert back.key.pub_key.type == kt
+    assert back.key.pub_key.bytes() == pv.key.pub_key.bytes()
+    # the loaded key signs and its pubkey verifies
+    sig = back.key.priv_key.sign(b"kt-roundtrip")
+    assert back.key.pub_key.verify_signature(b"kt-roundtrip", sig)
+
+
+def test_generate_priv_key_rejects_unknown():
+    with pytest.raises(ValueError):
+        _generate_priv_key("rsa4096")
+
+
+def test_testnet_key_type_flows_into_genesis(tmp_path):
+    out = str(tmp_path / "net")
+    assert cli_main(
+        [
+            "testnet", "--v", "2", "--o", out,
+            "--chain-id", "kt-chain", "--key-type", "secp256k1",
+            "--starting-port", "29990",
+        ]
+    ) == 0
+    doc = GenesisDoc.load(os.path.join(out, "node0", "config", "genesis.json"))
+    assert [v.pub_key_type for v in doc.validators] == ["secp256k1"] * 2
+    assert doc.consensus_params.validator.pub_key_types == ["secp256k1"]
+    # the typed pubkeys reconstruct and carry addresses
+    vs = doc.validator_set()
+    assert vs.size() == 2
+    for v in vs.validators:
+        assert v.pub_key.type == "secp256k1" and len(v.address) == 20
+
+
+def test_verify_commit_secp256k1_sequential_fallback():
+    """A full commit signed by secp256k1 validators verifies through
+    types/validation.verify_commit (the sequential path — batch is
+    ed25519-only per crypto/batch.supports_batch_verifier)."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
+    from cometbft_tpu.types.validation import verify_commit
+    from cometbft_tpu.types.validators import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.wire.canonical import PRECOMMIT_TYPE, Timestamp
+
+    keys = [
+        _generate_priv_key("secp256k1", bytes([40 + i]) * 32) for i in range(4)
+    ]
+    assert not crypto_batch.supports_batch_verifier(keys[0].pub_key().type)
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+    bid = BlockID(
+        hash=b"\x21" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x12" * 32),
+    )
+    ts = Timestamp(seconds=1_700_000_500)
+    by_addr = {k.pub_key().address(): k for k in keys}
+    sigs = []
+    for i, v in enumerate(vals.validators):
+        vote = Vote(
+            type=PRECOMMIT_TYPE, height=3, round=0, block_id=bid,
+            timestamp=ts, validator_address=v.address, validator_index=i,
+        )
+        sigs.append(
+            CommitSig(
+                block_id_flag=2, validator_address=v.address, timestamp=ts,
+                signature=by_addr[v.address].sign(vote.sign_bytes("kt-chain")),
+            )
+        )
+    commit = Commit(height=3, round=0, block_id=bid, signatures=sigs)
+    verify_commit("kt-chain", vals, bid, 3, commit)  # raises on failure
+
+    # a tampered signature still fails through the fallback
+    sigs[2] = CommitSig(
+        block_id_flag=2,
+        validator_address=sigs[2].validator_address,
+        timestamp=ts,
+        signature=bytes(64),
+    )
+    bad = Commit(height=3, round=0, block_id=bid, signatures=sigs)
+    with pytest.raises(Exception):
+        verify_commit("kt-chain", vals, bid, 3, bad)
