@@ -432,18 +432,21 @@ class KVStoreApplication(Application):
     def _update_validator(self, v: pb.ValidatorUpdate) -> None:
         from ..crypto import encoding as keyenc
 
-        pub = keyenc.pubkey_from_type_and_bytes(
-            v.pub_key_type or "ed25519", v.pub_key_bytes
-        )
+        # normalize ONCE: an empty type (proto default) means ed25519, and
+        # the same normalized name must flow into the address derivation,
+        # the stored record, and the in-memory map — a raw "" stored here
+        # would crash pubkey reconstruction on replay
+        key_type = v.pub_key_type or "ed25519"
+        pub = keyenc.pubkey_from_type_and_bytes(key_type, v.pub_key_bytes)
         addr = pub.address()
         key = VALIDATOR_PREFIX.encode() + addr
         if v.power == 0:
             self.db.delete(key)
             self.val_addr_to_pubkey.pop(addr, None)
         else:
-            record = f"{v.pub_key_type}!{base64.b64encode(v.pub_key_bytes).decode()}!{v.power}"
+            record = f"{key_type}!{base64.b64encode(v.pub_key_bytes).decode()}!{v.power}"
             self.db.set(key, record.encode())
-            self.val_addr_to_pubkey[addr] = (v.pub_key_type, v.pub_key_bytes)
+            self.val_addr_to_pubkey[addr] = (key_type, v.pub_key_bytes)
 
     def get_validators(self) -> list[pb.ValidatorUpdate]:
         out = []

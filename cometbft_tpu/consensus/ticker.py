@@ -21,6 +21,21 @@ class TimeoutInfo:
     step: int
 
 
+def _should_skip(new: TimeoutInfo, pending: TimeoutInfo) -> bool:
+    """(ticker.go:130 shouldSkipTick) — new is older than, or a
+    duplicate of, the pending timeout."""
+    if new.height < pending.height:
+        return True
+    return new.height == pending.height and (
+        new.round < pending.round
+        or (
+            new.round == pending.round
+            and pending.step > 0
+            and new.step <= pending.step
+        )
+    )
+
+
 class TimeoutTicker:
     """threading.Timer-backed ticker (ticker.go timeoutTicker)."""
 
@@ -38,10 +53,17 @@ class TimeoutTicker:
         self._timer.start()
 
     def schedule(self, ti: TimeoutInfo) -> None:
-        """Replace any pending timeout with this one (ticker.go
-        ScheduleTimeout; newer round states always win)."""
+        """Replace any pending timeout with a NEWER one (ticker.go
+        ScheduleTimeout + shouldSkipTick): an older or duplicate (H,R,S)
+        never clobbers the armed timer.  Without this rule a delayed
+        schedule for an earlier step cancels the live timer, the
+        replacement is then dropped as stale by the state machine, and
+        the round wedges with nothing pending — the evaporating-timeout
+        class behind the liveness-watchdog fires."""
         with self._mtx:
             if self._stopped:
+                return
+            if self._pending is not None and _should_skip(ti, self._pending):
                 return
             if self._timer is not None:
                 self._timer.cancel()

@@ -10,7 +10,7 @@ events.  validate_block's LastCommit check is the TPU hot path
 
 from __future__ import annotations
 
-from ..crypto import ed25519
+from ..crypto import encoding as keyenc
 from ..mempool.mempool import Mempool
 from ..types.block import Block, BlockID, Commit
 from ..types.event_bus import EventBus, NopEventBus
@@ -121,11 +121,15 @@ def validate_validator_updates(
                 f"validator key type {vu.pub_key_type} not in consensus params "
                 f"{params.validator.pub_key_types}"
             )
-        if vu.pub_key_type != ed25519.KEY_TYPE:
-            raise BlockExecutionError(
-                f"unsupported validator key type {vu.pub_key_type!r}"
+        try:
+            pub = keyenc.pubkey_from_type_and_bytes(
+                vu.pub_key_type, vu.pub_key_bytes
             )
-        vals.append(Validator(ed25519.PubKey(vu.pub_key_bytes), vu.power))
+        except (keyenc.UnsupportedKeyType, ValueError) as e:
+            raise BlockExecutionError(
+                f"bad validator pubkey ({vu.pub_key_type}): {e}"
+            ) from e
+        vals.append(Validator(pub, vu.power))
     return vals
 
 

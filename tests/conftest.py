@@ -25,10 +25,13 @@ import os
 # the var here only affects children, which all force JAX_PLATFORMS=cpu.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-# Kernel tests use tiny batches (V=8..64); production link-aware routing
-# would send those to the host verifier and silently skip the device
-# paths under test, so force the device threshold down for the suite.
-os.environ.setdefault("COMETBFT_TPU_DEVICE_BATCH_MIN", "1")
+# NOTE on COMETBFT_TPU_DEVICE_BATCH_MIN: kernel test modules pin it to 1
+# locally (test_comb, test_comb_smoke, test_comb_routing, test_parallel,
+# test_blocksync_replay) so tiny batches exercise the device paths under
+# test.  It must NOT be forced suite-wide: in-process consensus network
+# tests would then batch-verify 4-signature commits through freshly
+# compiling XLA programs, stalling rounds until the liveness watchdog
+# fires (observed: test_four_validator_network_commits_blocks).
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -49,6 +52,19 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tiny_device_batches(monkeypatch):
+    """Route tiny batches onto the DEVICE kernels: modules that test
+    device verification opt in via
+    `pytestmark = pytest.mark.usefixtures("tiny_device_batches")` —
+    the production link-aware threshold
+    (models/verifier._device_batch_min) would host-route their V=4..64
+    batches and silently skip the code under test.  Never force this
+    suite-wide: in-process consensus tests would stall rounds behind
+    XLA compiles and trip the liveness watchdog."""
+    monkeypatch.setenv("COMETBFT_TPU_DEVICE_BATCH_MIN", "1")
 
 
 @pytest.fixture(autouse=True)

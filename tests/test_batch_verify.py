@@ -8,6 +8,7 @@ types/validation.go:384-399).
 """
 
 import numpy as np
+import pytest
 
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.crypto import _ref25519 as ref
@@ -16,6 +17,10 @@ from cometbft_tpu.models.verifier import (
     CpuEd25519BatchVerifier,
     TpuEd25519BatchVerifier,
 )
+
+# the module's point is DEVICE verification of small batches — keep them
+# off the link-aware host routing
+pytestmark = pytest.mark.usefixtures("tiny_device_batches")
 
 rng = np.random.default_rng(42)
 

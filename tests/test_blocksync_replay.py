@@ -4,7 +4,10 @@ blame, and fallback through the double-buffered device stream."""
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow  # comb kernel compile on the CPU backend
+pytestmark = [
+    pytest.mark.slow,  # comb kernel compile on the CPU backend
+    pytest.mark.usefixtures("tiny_device_batches"),
+]
 
 from cometbft_tpu.blocksync.replay import CommitStreamVerifier
 from cometbft_tpu.crypto import ed25519 as host
@@ -245,3 +248,4 @@ def test_reactor_pipelined_rejects_bad_block_mid_stream(monkeypatch):
         assert reactor.pool.is_peer_banned("p1")
     finally:
         conns2.stop()
+

@@ -7,7 +7,10 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-pytestmark = pytest.mark.slow  # kernel compiles take minutes on the CPU backend
+pytestmark = [
+    pytest.mark.slow,  # kernel compiles take minutes on the CPU backend
+    pytest.mark.usefixtures("tiny_device_batches"),
+]
 
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.ops import comb
@@ -171,3 +174,4 @@ def test_incremental_churn_builds_only_changed_rows(monkeypatch):
         bv.add(pk, msgs[i] + (b"!" if i == 3 else b""), sig)
     ok, per = bv.verify()
     assert not ok and per == [i != 3 for i in range(len(set_10pct))]
+

@@ -10,6 +10,8 @@ from cometbft_tpu.crypto import batch as crypto_batch
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.models import comb_verifier as cv
 
+pytestmark = pytest.mark.usefixtures("tiny_device_batches")
+
 
 def _fake_entry(pubs, good_rows=None):
     """A cache entry whose verify_fn checks shapes on host instead of
@@ -200,3 +202,4 @@ def test_duplicate_pubkey_demotes_to_uncached():
     assert bv._fallback is not None  # demoted, not scattered
     ok, per = bv.verify()
     assert not ok and per == [False, True, True]
+

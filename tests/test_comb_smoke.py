@@ -11,7 +11,9 @@ from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.models.comb_verifier import CombBatchVerifier
 
 
-def test_comb_verify_smoke(monkeypatch):
+def test_comb_verify_smoke(monkeypatch, tiny_device_batches):
+    # tiny_device_batches: this smoke exists to run the comb KERNEL every
+    # fast-tier run (verdict item 7) — keep it off the host routing
     monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "8")
     n = 8
     keys = [host.PrivKey.from_seed(bytes([40 + i]) * 32) for i in range(n)]

@@ -99,3 +99,54 @@ def test_verify_commit_secp256k1_sequential_fallback():
     bad = Commit(height=3, round=0, block_id=bid, signatures=sigs)
     with pytest.raises(Exception):
         verify_commit("kt-chain", vals, bid, 3, bad)
+
+
+def test_validator_updates_accept_typed_keys():
+    """App-supplied validator updates with any params-allowed key type
+    construct real validators (state/validation.go
+    validateValidatorUpdates) — a secp update must not halt the chain."""
+    from cometbft_tpu.state.execution import (
+        BlockExecutionError,
+        validate_validator_updates,
+    )
+    from cometbft_tpu.types.params import default_consensus_params
+    from cometbft_tpu.wire import abci_pb as abci
+
+    params = default_consensus_params()
+    params.validator.pub_key_types = ["ed25519", "secp256k1"]
+    sk = _generate_priv_key("secp256k1", bytes([9]) * 32)
+    vals = validate_validator_updates(
+        [
+            abci.ValidatorUpdate(
+                power=7,
+                pub_key_type="secp256k1",
+                pub_key_bytes=sk.pub_key().bytes(),
+            )
+        ],
+        params,
+    )
+    assert vals[0].pub_key.type == "secp256k1" and vals[0].voting_power == 7
+
+    # a type missing from params still fails closed
+    with pytest.raises(BlockExecutionError):
+        validate_validator_updates(
+            [
+                abci.ValidatorUpdate(
+                    power=7,
+                    pub_key_type="bls12_381",
+                    pub_key_bytes=b"\x01" * 48,
+                )
+            ],
+            params,
+        )
+
+    # garbage key bytes of an allowed type fail closed too
+    with pytest.raises(BlockExecutionError):
+        validate_validator_updates(
+            [
+                abci.ValidatorUpdate(
+                    power=7, pub_key_type="secp256k1", pub_key_bytes=b"zz"
+                )
+            ],
+            params,
+        )

@@ -7,7 +7,25 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-pytestmark = pytest.mark.slow  # kernel compiles take minutes on the CPU backend
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_cache():
+    """Mesh-sharded executables intermittently crash XLA's persistent-
+    cache READ path (SIGSEGV/SIGABRT in get_executable_and_time) when
+    the pytest process carries the full slow tier's state — always
+    compile fresh in this module (see __graft_entry__.dryrun_multichip,
+    which does the same for the driver's multichip validation)."""
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+pytestmark = [
+    pytest.mark.slow,  # kernel compiles take minutes on the CPU backend
+    pytest.mark.usefixtures("tiny_device_batches"),
+]
 
 from cometbft_tpu.crypto import ed25519 as host
 from cometbft_tpu.crypto import merkle as hostM
@@ -129,3 +147,4 @@ def test_sharded_comb_path_matches_host(monkeypatch):
         bv.add(*items[i])
     ok, per = bv.verify()
     assert ok and per == [True] * 13
+
