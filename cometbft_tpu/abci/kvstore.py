@@ -56,7 +56,10 @@ def parse_validator_tx(tx: bytes) -> tuple[str, bytes, int]:
     power = int(power_s)
     if power < 0:
         raise ValueError(f"power cannot be negative, got {power}")
-    return key_type, pubkey, power
+    # empty type means ed25519 everywhere in this app; normalizing HERE
+    # keeps a "val:!<key>!5" tx from reaching consensus with a type that
+    # validate_validator_updates would reject after the block is decided
+    return key_type or "ed25519", pubkey, power
 
 
 def make_val_set_change_tx(pubkey: bytes, power: int, key_type: str = ed25519.KEY_TYPE) -> bytes:
@@ -173,7 +176,9 @@ class KVStoreApplication(Application):
         for k, v in _iter_prefix(self.db, VALIDATOR_PREFIX.encode()):
             addr = k[len(VALIDATOR_PREFIX):]
             key_type, pub_b64, _ = v.decode().split("!")
-            self.val_addr_to_pubkey[addr] = (key_type, base64.b64decode(pub_b64))
+            self.val_addr_to_pubkey[addr] = (
+                key_type or "ed25519", base64.b64decode(pub_b64)
+            )
 
     def _save_state(self) -> None:
         self.db.set(STATE_KEY, json.dumps({"size": self.size, "height": self.height}).encode())
@@ -452,6 +457,7 @@ class KVStoreApplication(Application):
         out = []
         for _, v in _iter_prefix(self.db, VALIDATOR_PREFIX.encode()):
             key_type, pub_b64, power = v.decode().split("!")
+            key_type = key_type or "ed25519"  # pre-normalization records
             out.append(
                 pb.ValidatorUpdate(
                     power=int(power),

@@ -125,7 +125,7 @@ def validate_validator_updates(
             pub = keyenc.pubkey_from_type_and_bytes(
                 vu.pub_key_type, vu.pub_key_bytes
             )
-        except (keyenc.UnsupportedKeyType, ValueError) as e:
+        except ValueError as e:  # includes UnsupportedKeyType
             raise BlockExecutionError(
                 f"bad validator pubkey ({vu.pub_key_type}): {e}"
             ) from e

@@ -14,13 +14,25 @@ def _no_persistent_cache():
     cache READ path (SIGSEGV/SIGABRT in get_executable_and_time) when
     the pytest process carries the full slow tier's state — always
     compile fresh in this module (see __graft_entry__.dryrun_multichip,
-    which does the same for the driver's multichip validation)."""
+    which does the same for the driver's multichip validation).
+
+    The cache object LATCHES on first use (is_cache_used memoizes), so
+    merely changing the dir config mid-process is a no-op: the enable
+    flag must flip AND reset_cache() must drop the latch, both ways."""
     import jax
 
-    old = jax.config.jax_compilation_cache_dir
-    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax._src import compilation_cache as cc
+    except ImportError:  # pragma: no cover - private API moved
+        cc = None
+    old_enabled = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    if cc is not None:
+        cc.reset_cache()
     yield
-    jax.config.update("jax_compilation_cache_dir", old)
+    jax.config.update("jax_enable_compilation_cache", old_enabled)
+    if cc is not None:
+        cc.reset_cache()
 
 pytestmark = [
     pytest.mark.slow,  # kernel compiles take minutes on the CPU backend
