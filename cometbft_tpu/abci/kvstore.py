@@ -59,7 +59,7 @@ def parse_validator_tx(tx: bytes) -> tuple[str, bytes, int]:
     # empty type means ed25519 everywhere in this app; normalizing HERE
     # keeps a "val:!<key>!5" tx from reaching consensus with a type that
     # validate_validator_updates would reject after the block is decided
-    return key_type or "ed25519", pubkey, power
+    return key_type or ed25519.KEY_TYPE, pubkey, power
 
 
 def make_val_set_change_tx(pubkey: bytes, power: int, key_type: str = ed25519.KEY_TYPE) -> bytes:
@@ -177,7 +177,7 @@ class KVStoreApplication(Application):
             addr = k[len(VALIDATOR_PREFIX):]
             key_type, pub_b64, _ = v.decode().split("!")
             self.val_addr_to_pubkey[addr] = (
-                key_type or "ed25519", base64.b64decode(pub_b64)
+                key_type or ed25519.KEY_TYPE, base64.b64decode(pub_b64)
             )
 
     def _save_state(self) -> None:
@@ -441,7 +441,7 @@ class KVStoreApplication(Application):
         # the same normalized name must flow into the address derivation,
         # the stored record, and the in-memory map — a raw "" stored here
         # would crash pubkey reconstruction on replay
-        key_type = v.pub_key_type or "ed25519"
+        key_type = v.pub_key_type or ed25519.KEY_TYPE
         pub = keyenc.pubkey_from_type_and_bytes(key_type, v.pub_key_bytes)
         addr = pub.address()
         key = VALIDATOR_PREFIX.encode() + addr
@@ -457,7 +457,7 @@ class KVStoreApplication(Application):
         out = []
         for _, v in _iter_prefix(self.db, VALIDATOR_PREFIX.encode()):
             key_type, pub_b64, power = v.decode().split("!")
-            key_type = key_type or "ed25519"  # pre-normalization records
+            key_type = key_type or ed25519.KEY_TYPE  # pre-normalization records
             out.append(
                 pb.ValidatorUpdate(
                     power=int(power),

@@ -1,0 +1,71 @@
+"""Standalone body of test_sharded_comb_path_matches_host: the
+engine's production verifier (comb-cached) sharded over an 8-device CPU
+mesh — tables on the validator lane axis, blame + all-ok via
+all_gather/psum (parallel/verify.sharded_verify_cached).
+
+Executed by tests/test_parallel.py in a FRESH interpreter because XLA's
+CPU compiler intermittently segfaults compiling mesh-sharded programs
+inside a state-laden pytest process (it never does in a clean one).
+Runnable directly too: python tests/sharded_comb_check.py
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.models import comb_verifier as cv
+from cometbft_tpu.parallel import make_mesh
+
+mesh = make_mesh(8)
+cv.set_active_mesh(mesh)
+cache = cv.ValsetCombCache()
+n = 16
+keys = [host.PrivKey.from_seed(bytes([i + 101]) * 32) for i in range(n)]
+pubs = [k.pub_key().data for k in keys]
+items = [
+    (pubs[i], b"shard-comb-%d" % i, keys[i].sign(b"shard-comb-%d" % i))
+    for i in range(n)
+]
+
+entry = cache.ensure(pubs)
+assert entry.mesh is mesh and entry.vpad % 8 == 0
+
+bv = cv.CombBatchVerifier(entry)
+for p, m, s in items:
+    bv.add(p, m, s)
+ok, per = bv.verify()
+assert ok and per == [True] * n
+
+# tampered message -> per-signature blame at the add position
+bv = cv.CombBatchVerifier(entry)
+for i, (p, m, s) in enumerate(items):
+    bv.add(p, m + (b"x" if i == 5 else b""), s)
+ok, per = bv.verify()
+assert not ok and per == [i != 5 for i in range(n)]
+
+# subset of signers (absent validators masked out)
+bv = cv.CombBatchVerifier(entry)
+for i in (12, 3, 7):
+    bv.add(*items[i])
+ok, per = bv.verify()
+assert ok and per == [True] * 3
+
+# mesh-width padding: a set not divisible by 8 pads lanes
+entry2 = cache.ensure(pubs[:13])
+assert entry2.vpad == 16 and entry2.size == 13
+bv = cv.CombBatchVerifier(entry2)
+for i in range(13):
+    bv.add(*items[i])
+ok, per = bv.verify()
+assert ok and per == [True] * 13
+
+print("sharded comb path OK")

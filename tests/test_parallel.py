@@ -23,15 +23,15 @@ def _no_persistent_cache():
 
     try:
         from jax._src import compilation_cache as cc
-    except ImportError:  # pragma: no cover - private API moved
-        cc = None
-    old_enabled = jax.config.jax_enable_compilation_cache
-    jax.config.update("jax_enable_compilation_cache", False)
-    if cc is not None:
+
+        old_enabled = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
         cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API; fail open like
+        cc = None      # __graft_entry__._disable_compile_cache
     yield
-    jax.config.update("jax_enable_compilation_cache", old_enabled)
     if cc is not None:
+        jax.config.update("jax_enable_compilation_cache", old_enabled)
         cc.reset_cache()
 
 pytestmark = [
@@ -102,61 +102,59 @@ def test_sharded_merkle_matches_host():
     )
 
 
+def _fresh_interpreter(argv: list) -> None:
+    """Run code in a clean python process, CPU-meshed like the driver.
+
+    XLA's CPU compiler intermittently SEGFAULTS compiling the
+    mesh-sharded comb programs inside a pytest process laden with the
+    full slow tier's state (leaked p2p threads, cygrpc, dozens of live
+    backends) — the same compile always succeeds in a fresh process,
+    which is also exactly how the driver invokes these entry points.
+    """
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the device tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["COMETBFT_TPU_DEVICE_BATCH_MIN"] = "1"
+    # don't rely on conftest's env mutation leaking through: the child
+    # needs the 8-device flag before its first backend init
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable] + argv,
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
 def test_graft_entry_dryrun():
-    import __graft_entry__ as g
+    _fresh_interpreter(
+        [
+            "-c",
+            "import __graft_entry__ as g\n"
+            "import jax, numpy as np\n"
+            "fn, args = g.entry()\n"
+            "assert np.asarray(jax.jit(fn)(*args)).all()\n"
+            "g.dryrun_multichip(8)\n",
+        ]
+    )
 
-    fn, args = g.entry()
-    ok = np.asarray(jax.jit(fn)(*args))
-    assert ok.all()
-    g.dryrun_multichip(8)
 
-
-def test_sharded_comb_path_matches_host(monkeypatch):
+def test_sharded_comb_path_matches_host():
     """The engine's production verifier (comb-cached) over the 8-device
     mesh: tables sharded on the validator lane axis, blame + all-ok via
-    all_gather/psum (parallel/verify.sharded_verify_cached)."""
-    from cometbft_tpu.models import comb_verifier as cv
+    all_gather/psum (parallel/verify.sharded_verify_cached).  Runs in a
+    fresh interpreter (see _fresh_interpreter) with the body in
+    tests/sharded_comb_check.py."""
+    import os
 
-    mesh = make_mesh(8)
-    monkeypatch.setattr(cv, "_MESH", mesh)
-    cache = cv.ValsetCombCache()
-    n = 16
-    keys = [host.PrivKey.from_seed(bytes([i + 101]) * 32) for i in range(n)]
-    pubs = [k.pub_key().data for k in keys]
-    items = [
-        (pubs[i], b"shard-comb-%d" % i, keys[i].sign(b"shard-comb-%d" % i))
-        for i in range(n)
-    ]
-
-    entry = cache.ensure(pubs)
-    assert entry.mesh is mesh and entry.vpad % 8 == 0
-
-    bv = cv.CombBatchVerifier(entry)
-    for p, m, s in items:
-        bv.add(p, m, s)
-    ok, per = bv.verify()
-    assert ok and per == [True] * n
-
-    # tampered message -> per-signature blame at the add position
-    bv = cv.CombBatchVerifier(entry)
-    for i, (p, m, s) in enumerate(items):
-        bv.add(p, m + (b"x" if i == 5 else b""), s)
-    ok, per = bv.verify()
-    assert not ok and per == [i != 5 for i in range(n)]
-
-    # subset of signers (absent validators masked out)
-    bv = cv.CombBatchVerifier(entry)
-    for i in (12, 3, 7):
-        bv.add(*items[i])
-    ok, per = bv.verify()
-    assert ok and per == [True] * 3
-
-    # mesh-width padding: a set not divisible by 8 pads lanes
-    entry2 = cache.ensure(pubs[:13])
-    assert entry2.vpad == 16 and entry2.size == 13
-    bv = cv.CombBatchVerifier(entry2)
-    for i in range(13):
-        bv.add(*items[i])
-    ok, per = bv.verify()
-    assert ok and per == [True] * 13
-
+    here = os.path.dirname(os.path.abspath(__file__))
+    _fresh_interpreter([os.path.join(here, "sharded_comb_check.py")])
