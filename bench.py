@@ -68,15 +68,21 @@ def emit_and_exit(code: int = 0) -> None:
 def backend_available() -> tuple[bool, str]:
     """Probe the accelerator backend in a throwaway subprocess.
 
-    Runs `jax.devices()` in a subprocess with a timeout: a wedged tunnel
-    blocks forever in backend init (no exception), which is unkillable
-    in-process.  The subprocess exits before this process attaches, so
-    the device is never held by two processes at once.  Popen + poll
-    deadline rather than subprocess.run(timeout=...): run() reaps the
-    killed child with an unbounded communicate(), and a child wedged in
-    uninterruptible device I/O would hang the reap — the exact failure
-    this probe exists to detect.  Returns (ok, platform-or-error).
+    Runs `jax.devices()` in a subprocess with a hard timeout: a wedged
+    tunnel blocks forever in backend init (no exception), which is
+    unkillable in-process.  The subprocess exits before this process
+    attaches, so the device is never held by two processes at once.
+    Popen + poll deadline rather than subprocess.run(timeout=...): run()
+    reaps the killed child with an unbounded communicate(), and a child
+    wedged in uninterruptible device I/O would hang the reap — the exact
+    failure this probe exists to detect.  The child runs in its own
+    session so the kill escalation (SIGKILL to the whole group) also
+    takes out any plugin helper processes it spawned; nothing here ever
+    blocks on the child's pipes after a kill.  Returns
+    (ok, platform-or-error).
     """
+    import signal
+
     code = "import jax; print(jax.devices()[0].platform)"
     with open(os.devnull, "wb") as devnull:
         proc = subprocess.Popen(
@@ -84,13 +90,17 @@ def backend_available() -> tuple[bool, str]:
             stdout=subprocess.PIPE,
             stderr=devnull,
             text=True,
+            start_new_session=True,
         )
         timeout_s = _probe_timeout_s()
         deadline = time.monotonic() + timeout_s
         while proc.poll() is None and time.monotonic() < deadline:
             time.sleep(0.5)
         if proc.poll() is None:
-            proc.kill()
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
             return False, (
                 f"jax.devices() hung >{timeout_s}s (wedged device tunnel)"
             )
@@ -100,13 +110,42 @@ def backend_available() -> tuple[bool, str]:
     return True, out.strip().splitlines()[-1] if out.strip() else "?"
 
 
+def _arm_run_watchdog() -> None:
+    """Guarantee ONE structured JSON line even if the run wedges AFTER
+    the probe passed (the tunnel can die mid-benchmark: three driver
+    rounds recorded null artifacts from exactly that).  A daemon timer
+    prints the report with an error and hard-exits; BENCH_HARD_TIMEOUT
+    seconds, default 2400 (enough for a cold 10k table build + 12 timed
+    iterations over the tunnel), 0 disables."""
+    import threading
+
+    try:
+        budget = int(os.environ.get("BENCH_HARD_TIMEOUT", "2400") or 0)
+    except ValueError:
+        budget = 2400
+    if budget <= 0:
+        return
+
+    def fire():
+        REPORT["error"] = f"bench wedged: no result within {budget}s"
+        print(json.dumps(REPORT), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+
+
 def probe_backend() -> None:
     """Fail fast (with the structured JSON line) on a dead backend.
 
     A wedged tunnel often recovers when a stranded client's lease
     expires, so a failed probe retries a few times (BENCH_PROBE_RETRIES,
-    default 3, 120 s apart) before giving up — cheap insurance against
-    reporting value=null for a transient wedge."""
+    default 2, BENCH_PROBE_RETRY_DELAY, default 90 s apart) before
+    giving up — cheap insurance against reporting value=null for a
+    transient wedge.  The defaults deliberately keep the worst case
+    (attempts x probe timeout + sleeps) under ~10 minutes; see the
+    budget note below before changing either."""
     if os.environ.get("BENCH_SKIP_PROBE") == "1":
         return
 
@@ -149,6 +188,7 @@ def _enable_compile_cache() -> None:
 
 
 def main() -> None:
+    _arm_run_watchdog()
     probe_backend()
     _enable_compile_cache()
 

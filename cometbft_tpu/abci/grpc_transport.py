@@ -150,6 +150,15 @@ class GrpcClient(Client):
     agnostic."""
 
     def __init__(self, addr: str, must_connect: bool = True, timeout: float = 10.0):
+        """timeout bounds ONLY the initial channel-ready connect probe.
+        Per-call RPCs run with NO deadline: consensus-path methods
+        (FinalizeBlock, Commit, PrepareProposal...) legitimately run as
+        long as the application needs — a fixed per-call deadline would
+        latch a fatal ClientError on a slow block and wedge the node,
+        a failure mode the varint-socket transport deliberately avoids
+        (its reads block indefinitely).  Liveness is the operator's job,
+        exactly as in the reference grpc client (grpc_client.go uses
+        context.Background() per call)."""
         super().__init__("ABCIGrpcClient")
         self.addr = _strip_scheme(addr)
         self.must_connect = must_connect
@@ -193,7 +202,9 @@ class GrpcClient(Client):
         if self._channel is None:
             raise ClientError("grpc client not started")
         try:
-            return self._calls[method](msg, timeout=self.timeout)
+            # no deadline: see __init__ — a slow FinalizeBlock must block,
+            # not latch a fatal transport error
+            return self._calls[method](msg)
         except ClientError:
             raise
         except Exception as e:  # noqa: BLE001 — surface as client error

@@ -275,8 +275,26 @@ class BlocksyncReactor(Reactor):
 
     # how many commit verifications may be in flight on the device ahead
     # of the apply cursor (2 = double buffer: the chip verifies height
-    # h+1's commit while the host saves/applies height h)
+    # h+1's commit while the host saves/applies height h).  submit() is
+    # itself asynchronous — payload staging runs on the verifier's
+    # background thread (models/comb_verifier) — so at depth 2 the sync
+    # thread's store/apply work, height h+1's host assembly, and height
+    # h's kernel all genuinely overlap.  COMETBFT_TPU_VERIFY_AHEAD
+    # overrides for replay experiments; the comb path's slab pool double
+    # buffers, so depths > 2 only add queueing, not memory churn.
     VERIFY_AHEAD_DEPTH = 2
+
+    @classmethod
+    def _verify_ahead_depth(cls) -> int:
+        import os
+
+        v = os.environ.get("COMETBFT_TPU_VERIFY_AHEAD", "")
+        if v:
+            try:
+                return max(1, int(v))
+            except ValueError:
+                pass
+        return cls.VERIFY_AHEAD_DEPTH
 
     def _pool_routine(self) -> None:
         """Apply fetched blocks pairwise; switch to consensus when caught up
@@ -349,7 +367,7 @@ class BlocksyncReactor(Reactor):
         if set_hash == self._no_async_for:
             return  # this set probed "no async path"; don't pay the probe again
         chain_id = self.initial_state.chain_id
-        for hh in range(head_height, head_height + self.VERIFY_AHEAD_DEPTH):
+        for hh in range(head_height, head_height + self._verify_ahead_depth()):
             if hh in pending:
                 continue
             blk, _ = self.pool.peek_block(hh)

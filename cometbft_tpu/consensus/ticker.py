@@ -43,6 +43,7 @@ class TimeoutTicker:
         self._fire = fire
         self._timer: threading.Timer | None = None
         self._pending: TimeoutInfo | None = None
+        self._last_fired: TimeoutInfo | None = None
         self._mtx = threading.Lock()
         self._stopped = False
 
@@ -65,6 +66,21 @@ class TimeoutTicker:
                 return
             if self._pending is not None and _should_skip(ti, self._pending):
                 return
+            # Post-fire skip (reference timeoutRoutine: `ti` keeps the
+            # LAST timeout as the shouldSkipTick comparison point even
+            # after it fires, ticker.go:171-183): with nothing pending, a
+            # schedule that is older than — or a duplicate of — the
+            # timeout that just fired is a stale tick from before the
+            # state machine advanced; re-arming it would deliver a
+            # timeout the machine then drops as stale, leaving the round
+            # with a cancelled real timer.  Only the watchdog may re-arm
+            # a duplicate, via schedule_if_idle below.
+            if (
+                self._pending is None
+                and self._last_fired is not None
+                and _should_skip(ti, self._last_fired)
+            ):
+                return
             if self._timer is not None:
                 self._timer.cancel()
             self._arm_locked(ti)
@@ -76,7 +92,9 @@ class TimeoutTicker:
         and its re-kick, and the replacement (carrying the watchdog's stale
         (H,R,S)) would then be dropped as stale — cancelling the real
         timer.  The check and the arm happen under one lock so that window
-        does not exist."""
+        does not exist.  Deliberately bypasses the post-fire duplicate
+        skip in schedule(): the watchdog's whole job is re-arming the
+        exact (H,R,S) whose delivery evaporated."""
         with self._mtx:
             if self._stopped or self._pending is not None:
                 return False
@@ -88,6 +106,7 @@ class TimeoutTicker:
             if self._stopped or self._pending is not ti:
                 return  # replaced meanwhile
             self._pending = None
+            self._last_fired = ti  # stays the skip reference while idle
         self._fire(ti)
 
     def stop(self) -> None:
@@ -97,3 +116,4 @@ class TimeoutTicker:
                 self._timer.cancel()
                 self._timer = None
             self._pending = None
+            self._last_fired = None

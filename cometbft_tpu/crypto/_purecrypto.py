@@ -1,0 +1,206 @@
+"""Pure-Python fallbacks for the `cryptography` package primitives the
+p2p SecretConnection needs (X25519, ChaCha20-Poly1305, HKDF-SHA256).
+
+Used only when the OpenSSL-backed package is absent (minimal containers);
+outputs are bit-identical to the RFC definitions (RFC 7748, RFC 8439,
+RFC 5869), so a fallback node interoperates with an OpenSSL node.  The
+ChaCha20 core is numpy-vectorized over blocks — a 1 KB sealed frame is a
+16-block batch, so framing stays in the tens of microseconds instead of
+pure-interpreter milliseconds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+
+import numpy as np
+
+# ------------------------------------------------------------------ X25519
+
+_P = (1 << 255) - 19
+_A24 = 121665
+
+
+def _x25519_decode_scalar(k: bytes) -> int:
+    b = bytearray(k)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return int.from_bytes(bytes(b), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """RFC 7748 scalar multiplication on Curve25519 (montgomery ladder).
+
+    Raises ValueError when the result is the all-zero shared secret
+    (peer sent a small-order point) — matching the OpenSSL-backed
+    X25519PrivateKey.exchange behavior the SecretConnection handshake
+    relies on, so the fallback path aborts the same handshakes the
+    primary path aborts instead of deriving keys from public data.
+    """
+    ks = _x25519_decode_scalar(k)
+    x1 = int.from_bytes(u, "little") & ((1 << 255) - 1)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (ks >> t) & 1
+        if swap ^ kt:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = x1 * (z3 * z3 % _P) % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, _P - 2, _P) % _P
+    if out == 0:
+        raise ValueError("x25519: low-order point (all-zero shared secret)")
+    return out.to_bytes(32, "little")
+
+
+def x25519_public(k: bytes) -> bytes:
+    return x25519(k, (9).to_bytes(32, "little"))
+
+
+# ---------------------------------------------------------------- ChaCha20
+
+_SIGMA = np.frombuffer(b"expand 32-byte k", dtype="<u4").copy()
+
+
+def _rotl(x: np.ndarray, n: int) -> np.ndarray:
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _chacha20_blocks(key: bytes, nonce: bytes, counter: int, nblocks: int) -> bytes:
+    """nblocks of ChaCha20 keystream, all blocks evaluated in lockstep."""
+    state = np.empty((16, nblocks), dtype=np.uint32)
+    state[0:4] = _SIGMA[:, None]
+    state[4:12] = np.frombuffer(key, dtype="<u4")[:, None]
+    state[12] = np.arange(counter, counter + nblocks, dtype=np.uint32)
+    state[13:16] = np.frombuffer(nonce, dtype="<u4")[:, None]
+    x = state.copy()
+
+    def qr(a, b, c, d):
+        x[a] += x[b]
+        x[d] = _rotl(x[d] ^ x[a], 16)
+        x[c] += x[d]
+        x[b] = _rotl(x[b] ^ x[c], 12)
+        x[a] += x[b]
+        x[d] = _rotl(x[d] ^ x[a], 8)
+        x[c] += x[d]
+        x[b] = _rotl(x[b] ^ x[c], 7)
+
+    for _ in range(10):
+        qr(0, 4, 8, 12)
+        qr(1, 5, 9, 13)
+        qr(2, 6, 10, 14)
+        qr(3, 7, 11, 15)
+        qr(0, 5, 10, 15)
+        qr(1, 6, 11, 12)
+        qr(2, 7, 8, 13)
+        qr(3, 4, 9, 14)
+    x += state
+    # per block: 16 LE words -> 64 bytes; blocks concatenated in order
+    return x.T.astype("<u4").tobytes()
+
+
+def _chacha20_xor(key: bytes, nonce: bytes, counter: int, data: bytes) -> bytes:
+    n = len(data)
+    if n == 0:
+        return b""
+    stream = _chacha20_blocks(key, nonce, counter, (n + 63) // 64)
+    return (
+        np.frombuffer(data, dtype=np.uint8)
+        ^ np.frombuffer(stream[:n], dtype=np.uint8)
+    ).tobytes()
+
+
+# ---------------------------------------------------------------- Poly1305
+
+
+def _poly1305(key: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key[16:32], "little")
+    p = (1 << 130) - 5
+    h = 0
+    for i in range(0, len(msg), 16):
+        chunk = msg[i : i + 16]
+        h = (h + int.from_bytes(chunk, "little") + (1 << (8 * len(chunk)))) * r % p
+    return ((h + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+class ChaCha20Poly1305:
+    """RFC 8439 AEAD with the construction's standard API shape:
+    encrypt(nonce, data, aad) -> ciphertext || 16-byte tag."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        otk = _chacha20_blocks(self._key, nonce, 0, 1)[:32]
+        mac_data = (
+            aad
+            + _pad16(aad)
+            + ct
+            + _pad16(ct)
+            + len(aad).to_bytes(8, "little")
+            + len(ct).to_bytes(8, "little")
+        )
+        return _poly1305(otk, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = aad or b""
+        ct = _chacha20_xor(self._key, nonce, 1, data)
+        return ct + self._tag(nonce, aad, ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise ValueError("ciphertext too short")
+        aad = aad or b""
+        ct, tag = data[:-16], data[-16:]
+        if not _hmac.compare_digest(self._tag(nonce, aad, ct), tag):
+            raise ValueError("authentication tag mismatch")
+        return _chacha20_xor(self._key, nonce, 1, ct)
+
+
+# ------------------------------------------------------------- HKDF-SHA256
+
+
+def hkdf_sha256(ikm: bytes, length: int, info: bytes, salt: bytes | None = None) -> bytes:
+    """RFC 5869 extract-and-expand with SHA-256."""
+    if salt is None:
+        salt = b"\x00" * hashlib.sha256().digest_size
+    prk = _hmac.new(salt, ikm, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    counter = 1
+    while len(okm) < length:
+        t = _hmac.new(prk, t + info + bytes([counter]), hashlib.sha256).digest()
+        okm += t
+        counter += 1
+    return okm[:length]

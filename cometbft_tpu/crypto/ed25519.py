@@ -7,23 +7,112 @@ the reference validator (ed25519.go:36-42).  OpenSSL-accepted signatures
 satisfy the cofactorless equation, which implies the cofactored one, so the
 fast path never accepts anything ZIP-215 would reject.
 
+The `cryptography` dependency is GATED: on hosts without it (minimal
+containers), signing/derivation fall back to the pure-Python reference
+implementation (_ref25519) — identical RFC 8032 outputs, ~3 ms per
+operation instead of microseconds.  A seed->pubkey memo keeps repeated
+derivations (every PrivKey.sign recomputes A) off the slow path.
+
 Batch verification lives behind the BatchVerifier seam
 (cometbft_tpu.crypto.batch), where the TPU provider plugs in.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pure-Python fallback below
+    _HAVE_OPENSSL = False
 
 from . import hash as tmhash
 from . import _ref25519 as ref
+
+
+_BASE_COMB: list | None = None
+
+
+def _base_comb() -> list:
+    """Fixed-base radix-16 comb for the pure-Python fallback: entry
+    [i][j] = j * 16^i * B.  One-time ~1k point adds; cuts a base-point
+    scalar mul from ~380 group ops (double-and-add) to <= 64 adds, which
+    is what keeps fallback signing fast enough for the in-process
+    consensus tests' liveness windows."""
+    global _BASE_COMB
+    if _BASE_COMB is None:
+        tab = []
+        p = ref.BASE
+        for _ in range(64):
+            row = [ref.IDENT]
+            for _j in range(15):
+                row.append(ref.pt_add(row[-1], p))
+            tab.append(row)
+            p = ref.pt_add(row[8], row[8])  # 16*p = 2 * (8*p)
+        _BASE_COMB = tab
+    return _BASE_COMB
+
+
+def _mul_base(k: int):
+    tab = _base_comb()
+    q = ref.IDENT
+    i = 0
+    while k:
+        d = k & 15
+        if d:
+            q = ref.pt_add(q, tab[i][d])
+        k >>= 4
+        i += 1
+    return q
+
+
+@functools.lru_cache(maxsize=4096)
+def _ref_expand(seed: bytes):
+    return ref.secret_expand(seed)
+
+
+@functools.lru_cache(maxsize=4096)
+def _ref_public_key(seed: bytes) -> bytes:
+    a, _ = _ref_expand(seed)
+    return ref.compress(_mul_base(a))
+
+
+def _ref_sign(seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 signing via the comb (identical bytes to ref.sign)."""
+    a, prefix = _ref_expand(seed)
+    A = _ref_public_key(seed)
+    r = int.from_bytes(ref.sha512(prefix + msg), "little") % ref.L
+    R = ref.compress(_mul_base(r))
+    k = int.from_bytes(ref.sha512(R + A + msg), "little") % ref.L
+    s = (r + k * a) % ref.L
+    return R + s.to_bytes(32, "little")
+
+
+def _ref_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """ZIP-215 verification, comb-accelerated for the fixed-base term;
+    semantics identical to ref.verify."""
+    if len(sig) != 64:
+        return False
+    A = ref.decompress(pub)
+    R = ref.decompress(sig[:32])
+    if A is None or R is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= ref.L:
+        return False
+    k = int.from_bytes(ref.sha512(sig[:32] + pub + msg), "little") % ref.L
+    q = ref.pt_add(_mul_base(s), ref.pt_neg(ref.pt_add(ref.pt_mul(k, A), R)))
+    for _ in range(3):
+        q = ref.pt_double(q)
+    return ref.pt_is_identity(q)
 
 KEY_TYPE = "ed25519"
 PUBKEY_SIZE = 32
@@ -35,6 +124,8 @@ def verify_signature(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """ZIP-215 single verification."""
     if len(sig) != SIGNATURE_SIZE or len(pub) != PUBKEY_SIZE:
         return False
+    if not _HAVE_OPENSSL:
+        return _ref_verify(pub, msg, sig)
     try:
         Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
         return True
@@ -89,6 +180,8 @@ class PrivKey:
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "PrivKey":
+        if not _HAVE_OPENSSL:
+            return cls(seed + _ref_public_key(seed))
         sk = Ed25519PrivateKey.from_private_bytes(seed)
         pub = sk.public_key().public_bytes_raw()
         return cls(seed + pub)
@@ -96,10 +189,14 @@ class PrivKey:
     def pub_key(self) -> PubKey:
         if len(self.data) == PRIVKEY_SIZE:
             return PubKey(self.data[32:])
+        if not _HAVE_OPENSSL:
+            return PubKey(_ref_public_key(self.seed))
         sk = Ed25519PrivateKey.from_private_bytes(self.seed)
         return PubKey(sk.public_key().public_bytes_raw())
 
     def sign(self, msg: bytes) -> bytes:
+        if not _HAVE_OPENSSL:
+            return _ref_sign(self.seed, msg)
         sk = Ed25519PrivateKey.from_private_bytes(self.seed)
         return sk.sign(msg)
 

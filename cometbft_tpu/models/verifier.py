@@ -109,9 +109,18 @@ class TpuEd25519BatchVerifier:
         return _VERIFY_JIT
 
     def verify(self) -> tuple[bool, list[bool]]:
+        return self.collect(self.submit())
+
+    def submit(self):
+        """Dispatch without waiting — the same async seam the comb-cached
+        verifier exposes (models/comb_verifier.CombBatchVerifier.submit),
+        so the blocksync verify-ahead pipeline can overlap host work with
+        device execution even while comb tables are still warming (the
+        async-build window) or for foreign-key sets.  Returns an opaque
+        ticket for collect()."""
         n = len(self._items)
         if n == 0:
-            return False, []
+            return ("sync", (False, []))
         # Below the device threshold the dispatch overhead (and, on first
         # use, compile time) dwarfs the arithmetic — verify on host.  The
         # hot configs (150-val light blocks, 10k-val commits) always take
@@ -119,10 +128,19 @@ class TpuEd25519BatchVerifier:
         if n < _device_batch_min():
             cpu = CpuEd25519BatchVerifier()
             cpu._items = self._items
-            return cpu.verify()
-        return self._verify_device(n)
+            return ("sync", cpu.verify())
+        return ("dev", (self._submit_device(n), n))
 
-    def _verify_device(self, n: int) -> tuple[bool, list[bool]]:
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        kind, payload = ticket
+        if kind == "sync":
+            return payload
+        out, n = payload
+        ok = np.asarray(out)[:n]  # blocks until the device result lands
+        res = [bool(x) for x in ok]
+        return all(res), res
+
+    def _submit_device(self, n: int):
         import jax.numpy as jnp
         from ..ops import sha2
 
@@ -142,14 +160,11 @@ class TpuEd25519BatchVerifier:
             hashed.append(hashed[0])
         blocks, active = sha2.pad_messages_sha512(hashed)
         fn = self._compiled()
-        ok = np.asarray(
-            fn(
-                jnp.asarray(a),
-                jnp.asarray(r),
-                jnp.asarray(s),
-                jnp.asarray(blocks),
-                jnp.asarray(active),
-            )
-        )[:n]
-        res = [bool(x) for x in ok]
-        return all(res), res
+        # device dispatch is asynchronous: the returned array is a future
+        return fn(
+            jnp.asarray(a),
+            jnp.asarray(r),
+            jnp.asarray(s),
+            jnp.asarray(blocks),
+            jnp.asarray(active),
+        )

@@ -133,6 +133,53 @@ def niels_identity_like(n: Niels) -> Niels:
     return Niels(F.one(shape), F.one(shape), F.zero(shape))
 
 
+_INV_D_L = F.to_limbs(pow(ref.D, ref.P - 2, ref.P))
+
+
+def niels_to_extended(n: Niels) -> Point:
+    """Niels (y+x, y-x, 2dxy) -> extended (2x : 2y : 2 : 2xy).
+
+    One field mul (t2d * d^-1); the uniform projective scale by 2 is
+    free.  Lets precomputed table entries join unified additions — in
+    particular the log-depth tree fold of tree_reduce_points, whose
+    inputs must be full extended points.  Works for the identity
+    ((1,1,0) -> (0:2:2:0)) and for sign-flipped entries
+    ((y-x, y+x, -2dxy) -> (-2x : 2y : 2 : -2xy)).
+    """
+    x2 = F.sub(n.yplusx, n.yminusx)
+    y2 = F.add(n.yplusx, n.yminusx)
+    batch = x2.shape[:-2] + x2.shape[-1:]
+    one = F.one(batch)
+    return Point(x2, y2, F.add(one, one), F.mul(n.t2d, _c(_INV_D_L)))
+
+
+def tree_reduce_points(p: Point) -> Point:
+    """Sum a stacked (N, ..., 22, L) Point along its leading axis with a
+    binary tree of batched unified additions: ceil(log2(N)) dependent
+    rounds instead of an (N-1)-deep sequential accumulation chain.  The
+    addition law is complete, so identity entries and odd-level
+    carry-overs are safe anywhere in the tree.  This is the comb verify
+    kernel's accumulation primitive (ops/comb._accumulate_tree): its
+    87-point stack folds in 7 rounds instead of 86.
+    """
+    n = p.x.shape[0]
+    while n > 1:
+        half = n // 2
+        a = Point(*(c[:half] for c in p))
+        b = Point(*(c[half : 2 * half] for c in p))
+        s = add(a, b)
+        if n & 1:
+            s = Point(
+                *(
+                    jnp.concatenate([cs, cp[2 * half :]], axis=0)
+                    for cs, cp in zip(s, p)
+                )
+            )
+        p = s
+        n = (n + 1) // 2
+    return Point(*(c[0] for c in p))
+
+
 # ------------------------------------------------------------ (de)compress
 
 

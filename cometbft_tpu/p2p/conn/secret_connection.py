@@ -33,13 +33,20 @@ import os
 import struct
 import threading
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    _HAVE_OPENSSL = True
+except ImportError:  # no OpenSSL bindings: RFC-exact pure-Python fallback
+    from ...crypto._purecrypto import ChaCha20Poly1305  # noqa: F401
+
+    _HAVE_OPENSSL = False
 
 from ...crypto import ed25519
 
@@ -152,12 +159,40 @@ class SecretConnection:
             pass
 
 
+def _gen_ephemeral() -> tuple[object, bytes]:
+    """X25519 keypair: (handle for _exchange, raw 32-byte public)."""
+    if _HAVE_OPENSSL:
+        eph_priv = X25519PrivateKey.generate()
+        return eph_priv, eph_priv.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+    from ...crypto import _purecrypto
+
+    seed = os.urandom(32)
+    return seed, _purecrypto.x25519_public(seed)
+
+
+def _exchange(eph_priv, remote_eph: bytes) -> bytes:
+    if _HAVE_OPENSSL:
+        return eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+    from ...crypto import _purecrypto
+
+    return _purecrypto.x25519(eph_priv, remote_eph)
+
+
+def _hkdf_derive(shared: bytes, info: bytes, length: int) -> bytes:
+    if _HAVE_OPENSSL:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=length, salt=None, info=info
+        ).derive(shared)
+    from ...crypto import _purecrypto
+
+    return _purecrypto.hkdf_sha256(shared, length, info)
+
+
 def make_secret_connection(sock, priv_key: ed25519.PrivKey) -> SecretConnection:
     """Perform the STS handshake over sock (blocking)."""
-    eph_priv = X25519PrivateKey.generate()
-    eph_pub = eph_priv.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    eph_priv, eph_pub = _gen_ephemeral()
 
     # 1. exchange ephemerals (raw 32 bytes each way)
     sock.sendall(eph_pub)
@@ -174,13 +209,12 @@ def make_secret_connection(sock, priv_key: ed25519.PrivKey) -> SecretConnection:
     we_are_lo = eph_pub == lo
 
     # 2. shared secret -> directional keys + challenge
-    shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
-    okm = HKDF(
-        algorithm=hashes.SHA256(),
-        length=96,
-        salt=None,
-        info=b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN" + lo + hi,
-    ).derive(shared)
+    shared = _exchange(eph_priv, remote_eph)
+    okm = _hkdf_derive(
+        shared,
+        b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN" + lo + hi,
+        96,
+    )
     key_lo, key_hi, challenge = okm[:32], okm[32:64], okm[64:]
     send_key, recv_key = (key_lo, key_hi) if we_are_lo else (key_hi, key_lo)
 

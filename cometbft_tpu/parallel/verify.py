@@ -80,7 +80,7 @@ def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_acti
 
 
 @functools.lru_cache(maxsize=8)
-def _comb_verify_fn(mesh: Mesh):
+def _comb_verify_fn(mesh: Mesh, tree: bool):
     """Sharded comb-cached commit verification — the engine's production
     path (models/comb_verifier.py) over a device mesh.
 
@@ -91,6 +91,11 @@ def _comb_verify_fn(mesh: Mesh):
     bitmap is all_gathered and packed on every device (replicated).
     A 10k-validator set's 1.5 GB of tables become ~190 MB per chip on an
     8-chip mesh — the component that most needs sharding.
+
+    tree selects the accumulation path (ops/comb tree_enabled) and is
+    part of the cache key, so flipping COMETBFT_TPU_COMB_TREE between
+    calls never serves a stale compiled program.  Both paths are
+    lane-local over the validator axis, so sharding is unaffected.
     """
     axis = mesh.axis_names[0]
     import jax.numpy as jnp
@@ -102,7 +107,7 @@ def _comb_verify_fn(mesh: Mesh):
     def local(tables, valid, pubs, payload):
         r, s, blocks, active, live = sha2.parse_verify_payload(payload, pubs)
         dig = sha2.sha512_blocks(blocks, active)
-        ok = comb.verify_cached(tables, valid, r, s, dig, bt)
+        ok = comb.verify_cached(tables, valid, r, s, dig, bt, tree=tree)
         bad = jnp.sum((~(ok | ~live)).astype(jnp.int32))
         total_bad = jax.lax.psum(bad, axis)
         ok_all = jax.lax.all_gather(ok & live, axis, tiled=True)
@@ -136,7 +141,9 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
     Returns one uint8 array [packbits(ok & live) | all_ok byte] — the
     same single-fetch contract as models/comb_verifier._device_verify.
     """
-    return _comb_verify_fn(mesh)(tables, valid, pubs, payload)
+    from ..ops import comb
+
+    return _comb_verify_fn(mesh, comb.tree_enabled())(tables, valid, pubs, payload)
 
 
 @functools.lru_cache(maxsize=8)

@@ -44,6 +44,24 @@ GRPC_PATHS: dict[str, str] = {
 _STREAM_PATH = f"/{_BLOCK}/GetLatestHeight"
 
 
+def _status_for(e: ValueError):
+    """Map a handler ValueError onto the gRPC status code the reference
+    services return for the same condition: missing data -> NOT_FOUND
+    (height outside the store, pruned results), a service the node isn't
+    running -> UNIMPLEMENTED (pruning without a pruner), anything else
+    about the request itself -> INVALID_ARGUMENT.  Without this mapping
+    grpcio turns every handler exception into UNKNOWN, which clients
+    can't distinguish from a server bug."""
+    import grpc
+
+    msg = str(e).lower()
+    if "not found" in msg or "not in store range" in msg or "no block results" in msg:
+        return grpc.StatusCode.NOT_FOUND
+    if "not enabled" in msg:
+        return grpc.StatusCode.UNIMPLEMENTED
+    return grpc.StatusCode.INVALID_ARGUMENT
+
+
 class GrpcCompanionServer(Service):
     """gRPC front end over the companion-service handlers.
 
@@ -88,8 +106,15 @@ class GrpcCompanionServer(Service):
                     return None  # wrong listener for this service
                 handler = _HANDLERS[method]
 
-                def unary(payload: bytes, _ctx):
-                    return handler(inner, payload)
+                def unary(payload: bytes, ctx):
+                    try:
+                        return handler(inner, payload)
+                    except ValueError as e:
+                        # map domain errors to proper status codes — the
+                        # reference services return NotFound/
+                        # InvalidArgument, not UNKNOWN
+                        # (blockservice/service.go GetByHeight)
+                        ctx.abort(_status_for(e), str(e))
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary,

@@ -358,12 +358,17 @@ def submit_verify_commit_light(
     cache: SignatureCache | None = None,
 ) -> PendingCommitVerification | None:
     """Asynchronous verify_commit_light (reactor.go:547's hot path,
-    pipelined): run every host-side phase now — basic checks, batch
-    assembly, power tally, all of which raise immediately — and dispatch
-    the device kernel WITHOUT waiting for its verdict.  Returns None when
-    the commit doesn't take the device-cached batch path (small set,
-    heterogeneous keys, cpu backend): the caller must then run
-    verify_commit_light synchronously."""
+    pipelined): run every host-side phase that can raise immediately —
+    basic checks, batch assembly, power tally — and dispatch the device
+    work WITHOUT waiting for its verdict.  Both device verifiers expose
+    the submit()/collect() seam (the comb-cached CombBatchVerifier, whose
+    submit also offloads payload staging to a background thread, and the
+    uncached TpuEd25519BatchVerifier that covers the table-warming
+    window), so a pipelined caller overlaps the next block's host work
+    with this one's assembly AND kernel.  Returns None when the commit
+    doesn't take a device batch path at all (small set, heterogeneous
+    keys, cpu backend): the caller must then run verify_commit_light
+    synchronously."""
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     if not should_batch_verify(vals, commit):
         return None
@@ -372,7 +377,7 @@ def submit_verify_commit_light(
         proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
     )
     if not hasattr(bv, "submit"):
-        return None  # no async seam outside the comb-cached verifier
+        return None  # host verifier: no async seam, caller runs sync
     voting_power_needed = vals.total_voting_power() * 2 // 3
     batch_sig_idxs, sign_bytes_at = _assemble_commit_batch(
         bv, chain_id, vals, commit, voting_power_needed,

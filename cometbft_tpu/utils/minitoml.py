@@ -1,0 +1,149 @@
+"""Minimal TOML reader for Python < 3.11 hosts without `tomllib`.
+
+Covers exactly the subset this framework emits (config._emit) and its
+tests write by hand: one level of `[section]` tables, `key = value`
+lines with bool / int / float / double-quoted string (\\ and \" escapes)
+/ single-line array values, and `#` comments.  Anything richer (dotted
+keys, multiline strings, datetimes, nested tables) raises ValueError —
+better loud than silently misread configuration.
+"""
+
+from __future__ import annotations
+
+
+class TOMLDecodeError(ValueError):
+    pass
+
+
+def load(fp) -> dict:
+    data = fp.read()
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return loads(data)
+
+
+def loads(text: str) -> dict:
+    root: dict = {}
+    table = root
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if line.startswith("["):
+            if not line.endswith("]"):
+                raise TOMLDecodeError(f"line {lineno}: malformed table header")
+            name = line[1:-1].strip()
+            if not name or "[" in name or '"' in name:
+                raise TOMLDecodeError(f"line {lineno}: unsupported table {name!r}")
+            table = root.setdefault(name, {})
+            if not isinstance(table, dict):
+                raise TOMLDecodeError(f"line {lineno}: {name!r} redefined")
+            continue
+        if "=" not in line:
+            raise TOMLDecodeError(f"line {lineno}: expected key = value")
+        key, _, val = line.partition("=")
+        key = key.strip()
+        if key.startswith('"') and key.endswith('"') and len(key) >= 2:
+            key = key[1:-1]
+        if not key or "." in key or " " in key:
+            raise TOMLDecodeError(f"line {lineno}: unsupported key {key!r}")
+        table[key] = _value(val.strip(), lineno)
+    return root
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing # comment, respecting double-quoted strings."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if in_str and c == "\\" and i + 1 < len(line):
+            out.append(line[i : i + 2])
+            i += 2
+            continue
+        if c == '"':
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _value(tok: str, lineno: int):
+    if tok == "true":
+        return True
+    if tok == "false":
+        return False
+    if tok.startswith('"'):
+        return _string(tok, lineno)
+    if tok.startswith("[") and tok.endswith("]"):
+        inner = tok[1:-1].strip()
+        if not inner:
+            return []
+        return [_value(p.strip(), lineno) for p in _split_array(inner, lineno)]
+    try:
+        return int(tok, 0) if not any(c in tok for c in ".eE") else float(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        raise TOMLDecodeError(f"line {lineno}: unsupported value {tok!r}") from None
+
+
+def _string(tok: str, lineno: int) -> str:
+    if len(tok) < 2 or not tok.endswith('"'):
+        raise TOMLDecodeError(f"line {lineno}: unterminated string {tok!r}")
+    body = tok[1:-1]
+    out = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\":
+            if i + 1 >= len(body):
+                raise TOMLDecodeError(f"line {lineno}: dangling escape")
+            nxt = body[i + 1]
+            mapped = {"\\": "\\", '"': '"', "n": "\n", "t": "\t", "r": "\r"}.get(nxt)
+            if mapped is None:
+                raise TOMLDecodeError(f"line {lineno}: unsupported escape \\{nxt}")
+            out.append(mapped)
+            i += 2
+            continue
+        if c == '"':
+            raise TOMLDecodeError(f"line {lineno}: stray quote in {tok!r}")
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _split_array(inner: str, lineno: int) -> list[str]:
+    parts = []
+    depth = 0
+    in_str = False
+    cur = []
+    i = 0
+    while i < len(inner):
+        c = inner[i]
+        if in_str and c == "\\":
+            cur.append(inner[i : i + 2])
+            i += 2
+            continue
+        if c == '"':
+            in_str = not in_str
+        elif not in_str:
+            if c == "[":
+                depth += 1
+            elif c == "]":
+                depth -= 1
+            elif c == "," and depth == 0:
+                parts.append("".join(cur))
+                cur = []
+                i += 1
+                continue
+        cur.append(c)
+        i += 1
+    if cur:
+        parts.append("".join(cur))
+    return parts

@@ -1,6 +1,15 @@
-"""Phase profiler for the comb-cached VerifyCommit kernel: table build,
-scalar reduce, R decompression, A/B comb loops, single field ops — run on
-the real chip to direct optimization (numbers recorded in BASELINE.md).
+"""Phase profiler for the comb-cached VerifyCommit path: host assembly,
+H2D+dispatch, kernel (tree-reduced AND sequential accumulation), result
+fetch, table build, scalar reduce, R decompression, A/B comb loops,
+single field ops — run on the real chip to direct optimization (numbers
+recorded in BASELINE.md).
+
+The headline lines:
+  assembly_ms   — host staging-slab fill (models/comb_verifier), the
+                  phase the round-5 capture measured at ~22 ms
+  kernel tree/seq — verify_cached with the log-depth tree fold
+                  (acc depth 7) vs the 87-step sequential chain
+  fetch_ms      — the one packed device->host result readback
 
 Layout note: field elements are limbs-first (..., 22, V) since round 4
 (see ops/field.py); the comb tables are (64, 9, 3, 22, V)."""
@@ -54,7 +63,59 @@ def timeit(name, f, *args):
         t0=time.perf_counter(); o=f(*args); jax.tree_util.tree_map(lambda x: x.block_until_ready(), o); ts.append(time.perf_counter()-t0)
     print(f"{name}: {1e3*min(ts):.1f} ms   (first {compile_s:.1f}s)", flush=True)
 
-timeit("full verify_cached", jax.jit(comb.verify_cached), tables, valid, ra, sa, da, bt)
+print(
+    f"accumulation: tree={comb.tree_enabled()} "
+    f"dependent_depth={comb.accumulation_depth()} "
+    f"(sequential chain would be {comb.NPOS_A + comb.NPOS_B + 1})",
+    flush=True,
+)
+timeit(
+    "full verify_cached (tree)",
+    jax.jit(lambda *x: comb.verify_cached(*x, tree=True)),
+    tables, valid, ra, sa, da, bt,
+)
+timeit(
+    "full verify_cached (seq)",
+    jax.jit(lambda *x: comb.verify_cached(*x, tree=False)),
+    tables, valid, ra, sa, da, bt,
+)
+
+# ---- host assembly phase: the staging-slab fill the engine's submit()
+# runs (models/comb_verifier._fill_payload) on a commit-shaped batch —
+# all V validators signing ~100-byte sign-bytes in row order.  First
+# call allocates + writes every column; steady-state calls (same row
+# layout) rewrite only R | s | msg.  The ~22 ms round-5 capture is the
+# number this phase replaces.
+from cometbft_tpu.models import comb_verifier as _cv
+
+items = []
+for i, sk in enumerate(keys):
+    msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|prof-comb"
+    sig = sk.sign(msg)
+    items.append((pubs[i], msg, sig))
+rows = np.arange(V, dtype=np.int64)
+slab = _cv._PayloadSlab(V, _cv._payload_width(items))
+t0 = time.perf_counter(); _cv._fill_payload(slab, items, rows)
+cold = (time.perf_counter() - t0) * 1e3
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter(); payload_host = _cv._fill_payload(slab, items, rows)
+    ts.append((time.perf_counter() - t0) * 1e3)
+print(f"assembly_ms (slab fill): {min(ts):.2f} ms   (cold {cold:.2f} ms)", flush=True)
+
+# H2D + dispatch and the single packed result fetch, measured around the
+# jitted engine program on the same payload
+pl_dev = jnp.asarray(payload_host); pl_dev.block_until_ready()
+t0 = time.perf_counter(); pl_dev = jnp.asarray(payload_host); pl_dev.block_until_ready()
+print(f"h2d_ms (payload transfer): {(time.perf_counter()-t0)*1e3:.2f} ms", flush=True)
+_vc = jax.jit(
+    lambda *x: jnp.concatenate(
+        [jnp.packbits(comb.verify_cached(*x)), jnp.ones((1,), jnp.uint8)]
+    )
+)  # the engine's packed [bitmap | all_ok] single-fetch contract
+out = _vc(tables, valid, ra, sa, da, bt); out.block_until_ready()
+t0 = time.perf_counter(); _ = np.asarray(out)
+print(f"fetch_ms (packed result readback): {(time.perf_counter()-t0)*1e3:.2f} ms", flush=True)
 
 # device SHA-512 digest phase (the engine path hashes on device now)
 msgs = [b"m%d" % i for i in range(V)]

@@ -44,8 +44,8 @@ def test_unavailable_backend_yields_structured_error():
         {
             "JAX_PLATFORMS": "no_such_platform",
             "BENCH_PROBE_TIMEOUT": "60",
-            # one attempt, no retry sleep: the retry ladder (default 3 x
-            # 120 s, for wedged-tunnel recovery) would outlive the 120 s
+            # one attempt, no retry sleep: the retry ladder (default 2 x
+            # 90 s, for wedged-tunnel recovery) would outlive the 120 s
             # subprocess timeout and break the emit-one-line contract
             "BENCH_PROBE_RETRIES": "1",
             "BENCH_PROBE_RETRY_DELAY": "0",

@@ -1,0 +1,125 @@
+"""Pipelined submit()/collect() and the zero-copy staging-slab assembly
+(models/comb_verifier): per-signature blame ordering must survive deep
+pipelining, and slab reuse must mask every stale row exactly like a
+fresh buffer.  Device programs reuse the V=8 shapes of
+tests/test_comb_smoke.py, so a warm persistent compile cache keeps this
+fast-tier."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.usefixtures("tiny_device_batches")
+
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.models import comb_verifier as cv
+
+
+def _valset(n, base):
+    keys = [host.PrivKey.from_seed(bytes([base + i]) * 32) for i in range(n)]
+    return keys, [k.pub_key().data for k in keys]
+
+
+def test_pipelined_submit_preserves_blame_order():
+    """Regression: with two submits in flight before any collect (and
+    collects out of submission order), each batch's per-signature blame
+    list must still follow ITS OWN add() order — the row mapping is
+    captured per ticket at submit time, not at collect time."""
+    n = 8
+    keys, pubs = _valset(n, 40)  # same seeds as test_comb_smoke: shared shapes
+    entry = cv.ValsetCombCache().ensure(pubs)
+
+    def batch(order, tamper_pos, tag):
+        bv = cv.CombBatchVerifier(entry)
+        for pos, i in enumerate(order):
+            msg = b"%s-%d" % (tag, i)
+            sig = keys[i].sign(msg)
+            if pos == tamper_pos:
+                msg += b"!"
+            bv.add(pubs[i], msg, sig)
+        return bv
+
+    bv_a = batch([5, 0, 3, 7, 1], tamper_pos=2, tag=b"pipe-a")
+    bv_b = batch([2, 6, 4, 0, 5, 1], tamper_pos=4, tag=b"pipe-b")
+    t_a = bv_a.submit()
+    t_b = bv_b.submit()  # both staged before either result is drained
+    ok_b, per_b = bv_b.collect(t_b)  # collect OUT of submission order
+    ok_a, per_a = bv_a.collect(t_a)
+    assert not ok_a and per_a == [pos != 2 for pos in range(5)]
+    assert not ok_b and per_b == [pos != 4 for pos in range(6)]
+
+
+def test_slab_reuse_masks_stale_rows():
+    """Successive verifies on one entry recycle the same staging slabs;
+    rows live in call N but absent in call N+1 must be fully retired
+    (the device result can never leak a previous call's signature)."""
+    n = 8
+    keys, pubs = _valset(n, 40)
+    entry = cv.ValsetCombCache().ensure(pubs)
+
+    def verify(idxs, tag, tamper=None):
+        bv = cv.CombBatchVerifier(entry)
+        for i in idxs:
+            msg = b"%s-%d" % (tag, i)
+            sig = keys[i].sign(msg)
+            if i == tamper:
+                msg += b"!"
+            bv.add(pubs[i], msg, sig)
+        return bv.verify()
+
+    ok, per = verify(range(n), b"full0")
+    assert ok and per == [True] * n
+    # subset after full set: rows 0,2,4,5,7 were live last call and must
+    # now be dead; the live ones must verify against the NEW messages
+    ok, per = verify([6, 1, 3], b"sub")
+    assert ok and per == [True] * 3
+    ok, per = verify([6, 1, 3], b"sub2", tamper=1)
+    assert not ok and per == [True, False, True]
+    # full set again (slab layout flips back), fresh messages
+    ok, per = verify(range(n), b"full1")
+    assert ok and per == [True] * n
+
+
+def test_fill_payload_matches_fresh_assembly():
+    """Numpy-only: a recycled slab's effective payload must be
+    equivalent to a fresh assemble_payload buffer — byte-identical on a
+    same-layout reuse, and dead-row live flags retired on a layout
+    change (stale bytes past a row's mlen are masked on device and may
+    differ)."""
+    vpad = 6
+    mk = lambda tag, n: [
+        (bytes([i]) * 32, b"%s-%d" % (tag, i), bytes([0x40 + i]) * 64)
+        for i in range(n)
+    ]
+    rows4 = np.arange(4, dtype=np.int64)
+    items = mk(b"one", 4)
+    slab = cv._PayloadSlab(vpad, cv._payload_width(items))
+    p1 = cv._fill_payload(slab, items, rows4).copy()
+    assert (p1 == cv.assemble_payload(items, rows4, vpad)).all()
+
+    # same layout (same rows, same mlen): header columns survive, the
+    # refill is byte-identical to a from-scratch assembly
+    items2 = mk(b"two", 4)
+    p2 = cv._fill_payload(slab, items2, rows4).copy()
+    assert (p2 == cv.assemble_payload(items2, rows4, vpad)).all()
+
+    # layout change to a sparse subset: previously-live rows retire
+    sub_rows = np.asarray([1, 3], dtype=np.int64)
+    sub_items = [items2[1], items2[3]]
+    p3 = cv._fill_payload(slab, sub_items, sub_rows)
+    assert p3[0, 67] == 0 and p3[2, 67] == 0 and p3[4, 67] == 0
+    assert p3[1, 67] == 1 and p3[3, 67] == 1
+    fresh = cv.assemble_payload(sub_items, sub_rows, vpad)
+    for r in (1, 3):  # live rows match a fresh buffer exactly
+        assert (p3[r] == fresh[r]).all()
+
+    # unequal message lengths take the per-row path with per-row mlen
+    uneq = [
+        (b"\x01" * 32, b"x" * 5, b"\x11" * 64),
+        (b"\x02" * 32, b"y" * 20, b"\x22" * 64),
+    ]
+    urows = np.asarray([2, 0], dtype=np.int64)
+    pu = cv._fill_payload(
+        cv._PayloadSlab(vpad, cv._payload_width(uneq)), uneq, urows
+    )
+    assert pu[2, 64] == 5 and pu[0, 64] == 20
+    assert (pu == cv.assemble_payload(uneq, urows, vpad)).all()

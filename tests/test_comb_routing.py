@@ -3,6 +3,8 @@ seam routing (crypto/batch.create_batch_verifier), row scatter/mask
 ordering, foreign-key fallback demotion, and cache keying.  The device
 math itself is covered by the slow tier (tests/test_comb.py)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -25,6 +27,8 @@ def _fake_entry(pubs, good_rows=None):
     e.size = len(pubs)
     e.vpad = len(pubs)
     e.mesh = None
+    e._slabs = {}
+    e._slab_mtx = threading.Lock()
 
     def fake_verify(tables, valid, entry_pubs, payload):
         payload = np.asarray(payload)

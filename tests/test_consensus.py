@@ -340,3 +340,37 @@ def test_ticker_schedule_if_idle_never_replaces_pending():
     # stopped ticker declines everything
     t.stop()
     assert t.schedule_if_idle(TimeoutInfo(0.0, 5, 1, 4)) is False
+
+
+def test_ticker_post_fire_skips_stale_reschedule():
+    """Reference timeoutRoutine keeps the fired TimeoutInfo as the
+    shouldSkipTick comparison point: after (H,R,S) fires, a schedule()
+    for the SAME or an OLDER (H,R,S) is a stale tick from before the
+    state machine advanced and must not re-arm; a genuinely newer one
+    must.  (The watchdog's schedule_if_idle path deliberately bypasses
+    this — covered above.)"""
+    from cometbft_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+
+    fired = []
+    t = TimeoutTicker(fired.append)
+    ti = TimeoutInfo(0.02, height=7, round=2, step=4)
+    t.schedule(ti)
+    time.sleep(0.2)
+    assert fired == [ti]
+    # duplicate of the fired timeout: skipped (would re-deliver a tick
+    # the machine already consumed)
+    t.schedule(TimeoutInfo(0.01, 7, 2, 4))
+    # older round: skipped
+    t.schedule(TimeoutInfo(0.01, 7, 1, 6))
+    time.sleep(0.15)
+    assert fired == [ti]
+    # a NEWER step after the fire arms normally
+    nxt = TimeoutInfo(0.02, height=7, round=2, step=6)
+    t.schedule(nxt)
+    time.sleep(0.2)
+    assert fired == [ti, nxt]
+    # the watchdog path may still re-arm the exact fired (H,R,S)
+    assert t.schedule_if_idle(TimeoutInfo(0.01, 7, 2, 6)) is True
+    time.sleep(0.15)
+    assert len(fired) == 3
+    t.stop()

@@ -78,6 +78,23 @@ def test_grpc_latest_height_stream(net):
     stream.cancel()
 
 
+def test_grpc_domain_errors_carry_status_codes(net):
+    """Handler ValueErrors must surface as proper gRPC status codes via
+    ctx.abort — NOT_FOUND for missing heights/results, INVALID_ARGUMENT
+    for bad requests — never the indistinct UNKNOWN grpcio default."""
+    import grpc as _grpc
+
+    _, _, cli, _, _ = net
+    with pytest.raises(_grpc.RpcError) as ei:
+        cli.get_by_height(9999)  # beyond the 6-block store
+    assert ei.value.code() == _grpc.StatusCode.NOT_FOUND
+    assert "not in store range" in ei.value.details()
+
+    with pytest.raises(_grpc.RpcError) as ei:
+        cli.get_block_results(9999)
+    assert ei.value.code() == _grpc.StatusCode.NOT_FOUND
+
+
 def test_grpc_privileged_split(net):
     import grpc as _grpc
 
