@@ -14,7 +14,15 @@ validator set outside the timed region and reported in table_build_s).
 
 Prints ONE JSON line and always exits 0:
   {"metric": "verify_commit_p50_10k_ms", "value": <p50 ms>, "unit": "ms",
-   "vs_baseline": <Go-CPU-baseline / ours, i.e. speedup>, "phases": {...}}
+   "vs_baseline": <Go-CPU-baseline / ours, i.e. speedup>, "phases": {...},
+   "phase_attribution": {phase: {"p50_ms", "share_of_wall"}, ...}}
+phase_attribution is the per-phase median over ALL timed iterations,
+keyed verbatim by the last_timings keys models/comb_verifier.py records
+per call (assembly_ms / h2d_dispatch_ms / staging_wait_ms /
+device_wait_ms / submit_ms / kernel_ms); BENCH_TRACE=<path> additionally
+exports a Chrome trace of the timed region (utils/tracing) and sets
+"traced": true so traced values are never compared against untraced
+baselines.
 On any failure (the round-3 bench died with rc=1 when the TPU backend was
 unreachable) the line carries "error" plus whatever phases completed, so
 the driver always records a parseable data point.  The backend is probed
@@ -229,12 +237,49 @@ def main() -> None:
 
     for _ in range(warmup):
         run_once()
+
+    # BENCH_TRACE=/path.trace.json captures the TIMED iterations with the
+    # span tracer on and exports a Chrome trace (open in Perfetto) —
+    # enabled only after warmup so compile/cold-cache spans neither show
+    # up in the artifact nor evict timed-region events from the ring.
+    trace_path = os.environ.get("BENCH_TRACE", "")
+    if trace_path:
+        from cometbft_tpu.utils import tracing
+
+        tracing.set_enabled(True)
+        tracing.reset()
+        # traced iterations pay per-span clock reads inside the timed
+        # region: flag the artifact so regression tracking never compares
+        # a traced "value" against untraced baselines
+        REPORT["traced"] = True
+
     runs = sorted((run_once() for _ in range(iters)), key=lambda r: r[0])
     p50, timings = runs[len(runs) // 2]
     REPORT["value"] = round(p50, 3)
     REPORT["vs_baseline"] = round(baseline_ms / p50, 2)
     for k, v in timings.items():
         REPORT["phases"][k] = round(v, 2)
+
+    # Phase attribution: per-phase medians across ALL timed iterations
+    # (the pipeline phases — assembly, h2d_dispatch, device_wait — run on
+    # the staging thread and OVERLAP the caller-visible wall time, so
+    # shares are each phase's own duration over the p50 wall clock and
+    # need not sum to 1).
+    phase_samples: dict[str, list[float]] = {}
+    for _, t in runs:
+        for k, v in t.items():
+            phase_samples.setdefault(k, []).append(v)
+    REPORT["phase_attribution"] = {
+        k: {
+            "p50_ms": round(sorted(vs)[len(vs) // 2], 3),
+            "share_of_wall": round(sorted(vs)[len(vs) // 2] / p50, 3),
+        }
+        for k, vs in sorted(phase_samples.items())
+    }
+
+    if trace_path:
+        REPORT["trace_events"] = tracing.export_chrome_trace(trace_path)
+        REPORT["trace"] = trace_path
     emit_and_exit()
 
 

@@ -17,6 +17,7 @@ import time
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
 from ..types.block import Block, ExtendedCommit
+from ..utils import tracing
 from ..utils.log import get_logger
 from ..wire import blocksync_pb as pb
 from .pool import BlockPool, BlockRequest, PeerError
@@ -377,11 +378,17 @@ class BlocksyncReactor(Reactor):
             if blk.header.validators_hash != set_hash:
                 continue
             try:
-                parts = blk.make_part_set()
-                bid = BlockID(hash=blk.hash(), part_set_header=parts.header)
-                p = submit_verify_commit_light(
-                    chain_id, vals, bid, hh, nxt.last_commit
-                )
+                with tracing.span(
+                    "blocksync.verify_ahead_submit",
+                    {"height": hh} if tracing.enabled() else None,
+                ):
+                    parts = blk.make_part_set()
+                    bid = BlockID(
+                        hash=blk.hash(), part_set_header=parts.header
+                    )
+                    p = submit_verify_commit_light(
+                        chain_id, vals, bid, hh, nxt.last_commit
+                    )
             except Exception:  # noqa: BLE001
                 # structurally bad / malformed peer data (bad commit, odd
                 # sig lengths, ...): leave it for the serial path, which
@@ -412,20 +419,32 @@ class BlocksyncReactor(Reactor):
             # pipeline submitted it; collect raises like verify_commit_light
             first_parts = pend.parts
             first_id = pend.block_id
-            pend.verification.collect()
+            with tracing.span(
+                "blocksync.verify_ahead_collect",
+                {"height": first.header.height} if tracing.enabled() else None,
+            ):
+                pend.verification.collect()
         else:
             first_parts = first.make_part_set()
             first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
 
             # the TPU-batched signature check (types/validation.go VerifyCommitLight)
-            verify_commit_light(
-                chain_id,
-                state.validators,
-                first_id,
-                first.header.height,
-                second.last_commit,
-            )
-        self.block_exec.validate_block(state, first)
+            with tracing.span(
+                "blocksync.verify_sync",
+                {"height": first.header.height} if tracing.enabled() else None,
+            ):
+                verify_commit_light(
+                    chain_id,
+                    state.validators,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )
+        with tracing.span(
+            "blocksync.validate",
+            {"height": first.header.height} if tracing.enabled() else None,
+        ):
+            self.block_exec.validate_block(state, first)
 
         extensions_enabled = state.consensus_params.feature.vote_extensions_enabled(
             first.header.height
@@ -442,9 +461,13 @@ class BlocksyncReactor(Reactor):
             self.store.save_block(first, first_parts, second.last_commit)
         self.pool.pop_request()
 
-        return self.block_exec.apply_verified_block(
-            state, first_id, first, syncing_to_height=self.pool.max_height()
-        )
+        with tracing.span(
+            "blocksync.apply",
+            {"height": first.header.height} if tracing.enabled() else None,
+        ):
+            return self.block_exec.apply_verified_block(
+                state, first_id, first, syncing_to_height=self.pool.max_height()
+            )
 
     # ------------------------------------------------- switch to consensus
 

@@ -29,6 +29,8 @@ from ..types.proposal import Proposal
 from ..types.validators import ValidatorSet
 from ..types.vote import Vote, VoteError
 from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
+from ..utils import tracing
+from ..utils.flightrec import recorder as _flightrec
 from ..utils.log import get_logger
 from ..utils.service import Service
 from ..wire import wal_pb
@@ -337,6 +339,15 @@ class ConsensusState(Service):
                 import traceback
 
                 traceback.print_exc()
+                # post-mortem: flight-recorder ring + thread dump to a
+                # file before the state machine goes dark
+                try:
+                    from ..utils.debugdump import crash_report
+
+                    path = crash_report(f"consensus failure: {e!r}")
+                    self.logger.error(f"crash report written to {path}")
+                except Exception:  # noqa: BLE001 — never mask the cause
+                    pass
                 return
 
     def _wal_write_msg(self, mi: MsgInfo) -> None:
@@ -467,6 +478,9 @@ class ConsensusState(Service):
                         fired = True
                     if fired:
                         ConsensusState.watchdog_fire_count += 1
+                        _flightrec().record(
+                            "watchdog", height=cur[0], round=cur[1], step=cur[2]
+                        )
                         self.logger.error(
                             f"{self.WATCHDOG_LOG_TOKEN}: no progress at "
                             f"h={cur[0]} r={cur[1]} step={cur[2]}, "
@@ -479,10 +493,20 @@ class ConsensusState(Service):
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs
-        if ti.height != rs.height or ti.round < rs.round or (
+        stale = ti.height != rs.height or ti.round < rs.round or (
             ti.round == rs.round and ti.step < rs.step
-        ):
-            return  # stale
+        )
+        if not self._replay_mode:
+            _flightrec().record(
+                "timeout",
+                height=ti.height,
+                round=ti.round,
+                step=ti.step,
+                duration_s=ti.duration,
+                stale=stale,
+            )
+        if stale:
+            return
         if ti.step == STEP_NEW_HEIGHT:
             self._enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:
@@ -509,6 +533,17 @@ class ConsensusState(Service):
     def _update_round_step(self, round: int, step: int) -> None:
         self.rs.round = round
         self.rs.step = step
+        if not self._replay_mode:
+            # same guard as the event-bus publishes: WAL-replayed history
+            # must not flood the post-mortem ring with stale entries
+            _flightrec().record(
+                "step", height=self.rs.height, round=round, step=step
+            )
+        if tracing.enabled():
+            tracing.instant(
+                "cs.step",
+                {"height": self.rs.height, "round": round, "step": step},
+            )
         ev = self.rs.round_state_event()
         if not self._replay_mode:
             self.event_bus.publish_new_round_step(ev)
@@ -711,6 +746,14 @@ class ConsensusState(Service):
             _mhub().cs_proposal_receive_count.inc(status="rejected")
             raise ConsensusError("invalid proposal signature")
         _mhub().cs_proposal_receive_count.inc(status="accepted")
+        if not self._replay_mode:
+            _flightrec().record(
+                "proposal",
+                height=proposal.height,
+                round=proposal.round,
+                pol_round=proposal.pol_round,
+                block=proposal.block_id.hash.hex()[:12],
+            )
         rs.proposal = proposal
         rs.proposal_receive_time_ns = receive_time_ns
         if rs.proposal_block_parts is None:
@@ -1002,6 +1045,15 @@ class ConsensusState(Service):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return
+        if not self._replay_mode:
+            _flightrec().record(
+                "vote",
+                height=vote.height,
+                round=vote.round,
+                vote_type=vote.type,
+                val_index=vote.validator_index,
+                peer=peer_id or "self",
+            )
         self.event_bus.publish_vote(vote)
         if self.has_vote_hook is not None and not self._replay_mode:
             self.has_vote_hook(vote)

@@ -12,6 +12,9 @@ import threading
 from dataclasses import dataclass
 from typing import Callable
 
+from ..utils import tracing
+from ..utils.metrics import hub as _mhub
+
 
 @dataclass(frozen=True)
 class TimeoutInfo:
@@ -107,6 +110,12 @@ class TimeoutTicker:
                 return  # replaced meanwhile
             self._pending = None
             self._last_fired = ti  # stays the skip reference while idle
+        _mhub().cs_timeout_fired.inc(step=str(ti.step))
+        if tracing.enabled():
+            tracing.instant(
+                "cs.timeout_fire",
+                {"height": ti.height, "round": ti.round, "step": ti.step},
+            )
         self._fire(ti)
 
     def stop(self) -> None:

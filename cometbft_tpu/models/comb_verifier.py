@@ -29,6 +29,9 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils import tracing
+from ..utils.metrics import hub as _mhub
+
 
 class _CacheEntry:
     __slots__ = (
@@ -56,7 +59,10 @@ class _CacheEntry:
         with self._slab_mtx:
             pool = self._slabs.get(width)
             if pool:
-                return pool.pop()
+                slab = pool.pop()
+                _mhub().verify_slab_requests.inc(result="hit")
+                return slab
+        _mhub().verify_slab_requests.inc(result="miss")
         return _PayloadSlab(self.vpad, width)
 
     def release_slab(self, slab: "_PayloadSlab") -> None:
@@ -87,6 +93,16 @@ class _PayloadSlab:
         self.buf = np.zeros((vpad, width), dtype=np.uint8)
         self.dirty = None  # previous use's live rows (array or slice)
         self.layout = None  # (kind, n, mlen) of the previous use
+
+    def retire(self) -> None:
+        """Forget every previous/partial fill: all live flags cleared,
+        full header rewrite forced on next use.  The safe state for
+        returning a slab to the pool from an ERROR path, where a partial
+        fill may have set live flags the dirty bookkeeping doesn't
+        cover."""
+        self.buf[:, 67] = 0
+        self.dirty = None
+        self.layout = None
 
 
 def active_mesh():
@@ -154,7 +170,7 @@ class ValsetCombCache:
                 self._entries.move_to_end(fp)
             return e
 
-    def ensure(self, pubkeys: list[bytes]) -> _CacheEntry:
+    def ensure(self, pubkeys: list[bytes], _count: bool = True) -> _CacheEntry:
         """Return the entry for this exact pubkey list, building the
         tables on first sight (one-time per validator set).  Concurrent
         first calls for the same set serialize on a per-fingerprint lock —
@@ -165,13 +181,22 @@ class ValsetCombCache:
         fp = self.fingerprint(pubkeys)
         e = self.get(fp)
         if e is not None:
+            if _count:
+                _mhub().comb_table_cache.inc(result="hit")
             return e
         with self._mtx:
             build_lock = self._building.setdefault(fp, threading.Lock())
         with build_lock:
             e = self.get(fp)  # the race loser finds the winner's entry
             if e is not None:
+                if _count:
+                    # served by a build another caller performed — a
+                    # "building" wait, not a second miss: misses must
+                    # stay 1:1 with actual table builds
+                    _mhub().comb_table_cache.inc(result="building")
                 return e
+            if _count:
+                _mhub().comb_table_cache.inc(result="miss")
             base = self._newest()
             entry = self._build(pubkeys, base)
             with self._mtx:
@@ -194,11 +219,14 @@ class ValsetCombCache:
         fp = self.fingerprint(pubkeys)
         e = self.get(fp)
         if e is not None:
+            _mhub().comb_table_cache.inc(result="hit")
             return e
         with self._mtx:
             if fp in self._async_inflight:
+                _mhub().comb_table_cache.inc(result="building")
                 return None  # background build already running
             self._async_inflight.add(fp)
+        _mhub().comb_table_cache.inc(result="miss")
         pubkeys = list(pubkeys)
 
         def build():
@@ -206,7 +234,8 @@ class ValsetCombCache:
                 # ensure() owns the per-fingerprint build lock, so a
                 # concurrent synchronous caller can never duplicate the
                 # build — whoever wins, the loser finds the entry
-                self.ensure(pubkeys)
+                # (_count=False: this lookup was already tallied above)
+                self.ensure(pubkeys, _count=False)
             finally:
                 with self._mtx:
                     self._async_inflight.discard(fp)
@@ -509,6 +538,7 @@ class CombBatchVerifier:
         n = len(self._rows)
         if n == 0:
             return ("sync", (False, []))
+        _mhub().verify_batch_width.observe(float(n))
         # Link-aware routing, same rule as the uncached kernel: through a
         # remote device tunnel a call pays ~170 ms of round trips, so a
         # small batch (few signers of a large cached set) finishes sooner
@@ -518,7 +548,8 @@ class CombBatchVerifier:
         if n < _device_batch_min():
             cpu = CpuEd25519BatchVerifier()
             cpu._items = self._items
-            return ("sync", cpu.verify())
+            with tracing.span("verify.host_route"):
+                return ("sync", cpu.verify())
 
         idx = np.asarray(self._rows, dtype=np.int64)
         # real snapshot for the staging thread: a verifier is one batch
@@ -527,6 +558,8 @@ class CombBatchVerifier:
         items = list(self._items)
         entry = self._entry
         fn = self._verify_fn()  # bind outside the worker (mutates entry)
+        m = _mhub()
+        m.verify_submit_queue_depth.add(1)
 
         def stage():
             import time
@@ -534,24 +567,49 @@ class CombBatchVerifier:
             import jax.numpy as jnp
 
             timings = {}
-            t0 = time.perf_counter()
-            # One TIGHT (V, 68 + maxm) row: R | s | mlen(3B LE) | live |
-            # msg.  The device link runs ~10 MB/s with ~85 ms/transfer
-            # latency, so the call ships only irreducible bytes in ONE
-            # transfer: no SHA padding (rebuilt on device,
-            # ops/sha2.ram_blocks_from_parts), no pubkeys (device-resident
-            # in the cache entry), no zero blocks.  The slab is recycled
-            # host memory — steady state allocates nothing.
-            slab = entry.acquire_slab(_payload_width(items))
-            payload = _fill_payload(slab, items, idx)
-            t1 = time.perf_counter()
-            out = fn(entry.tables, entry.valid, entry.pubs, jnp.asarray(payload))
-            t2 = time.perf_counter()
-            timings["assembly_ms"] = (t1 - t0) * 1e3
-            timings["h2d_dispatch_ms"] = (t2 - t1) * 1e3
-            return out, slab, timings
+            slab = None
+            try:
+                t0 = time.perf_counter()
+                # One TIGHT (V, 68 + maxm) row: R | s | mlen(3B LE) | live |
+                # msg.  The device link runs ~10 MB/s with ~85 ms/transfer
+                # latency, so the call ships only irreducible bytes in ONE
+                # transfer: no SHA padding (rebuilt on device,
+                # ops/sha2.ram_blocks_from_parts), no pubkeys (device-resident
+                # in the cache entry), no zero blocks.  The slab is recycled
+                # host memory — steady state allocates nothing.
+                with tracing.span("verify.slab_fill"):
+                    slab = entry.acquire_slab(_payload_width(items))
+                    payload = _fill_payload(slab, items, idx)
+                t1 = time.perf_counter()
+                with tracing.span("verify.h2d_dispatch"):
+                    out = fn(
+                        entry.tables, entry.valid, entry.pubs,
+                        jnp.asarray(payload),
+                    )
+                t2 = time.perf_counter()
+                timings["assembly_ms"] = (t1 - t0) * 1e3
+                timings["h2d_dispatch_ms"] = (t2 - t1) * 1e3
+                m.verify_phase_seconds.observe(t1 - t0, phase="assembly")
+                m.verify_phase_seconds.observe(t2 - t1, phase="h2d_dispatch")
+                m.verify_staging_busy.inc(t2 - t0)
+                return out, slab, timings
+            except BaseException:
+                # a failed fill/dispatch must not leak the pooled slab —
+                # each loss would put steady state back on fresh
+                # allocations
+                if slab is not None:
+                    slab.retire()
+                    entry.release_slab(slab)
+                raise
+            finally:
+                m.verify_submit_queue_depth.add(-1)
 
-        return ("dev", (_staging_executor().submit(stage), idx))
+        try:
+            fut = _staging_executor().submit(stage)
+        except BaseException:
+            m.verify_submit_queue_depth.add(-1)  # stage() never ran
+            raise
+        return ("dev", (fut, idx))
 
     def collect(self, ticket) -> tuple[bool, list[bool]]:
         """Wait for a submit() ticket and unpack (all_ok, per-signature).
@@ -565,24 +623,50 @@ class CombBatchVerifier:
         if kind == "sync":
             return payload
         fut, idx = payload
-        out, slab, timings = fut.result()
-        host = np.asarray(out)  # the one blocking device fetch
+        import time as _time
+
+        # Two distinct waits, measured separately: fut.result() blocks
+        # until the STAGING thread finishes (queue + slab fill + H2D +
+        # dispatch — in the submit-then-collect-immediately pattern this
+        # covers the whole staging pass, which must not be billed to the
+        # device), then np.asarray blocks until the KERNEL's result lands.
+        t0 = _time.perf_counter()
+        with tracing.span("verify.staging_wait"):
+            out, slab, timings = fut.result()
+        t1 = _time.perf_counter()
+        try:
+            with tracing.span("verify.device_wait"):
+                host = np.asarray(out)  # the one blocking device fetch
+        except BaseException:
+            # async dispatch errors surface at this fetch (dropped
+            # tunnel, device OOM): same no-leak invariant as stage()
+            slab.retire()
+            self._entry.release_slab(slab)
+            raise
+        t2 = _time.perf_counter()
+        timings["staging_wait_ms"] = (t1 - t0) * 1e3
+        timings["device_wait_ms"] = (t2 - t1) * 1e3
+        m = _mhub()
+        m.verify_phase_seconds.observe(t1 - t0, phase="staging_wait")
+        m.verify_phase_seconds.observe(t2 - t1, phase="device_wait")
         # the kernel has consumed the staged payload; recycle the slab
         self._entry.release_slab(slab)
         self.last_timings.update(timings)
-        all_ok = bool(host[-1])
-        picked = (
-            np.unpackbits(host[:-1], count=self._entry.vpad)
-            .astype(bool)[idx]
-        )
-        return all_ok, picked.tolist()
+        with tracing.span("verify.blame_unpack"):
+            all_ok = bool(host[-1])
+            picked = (
+                np.unpackbits(host[:-1], count=self._entry.vpad)
+                .astype(bool)[idx]
+            )
+            return all_ok, picked.tolist()
 
     def verify(self) -> tuple[bool, list[bool]]:
         import time
 
         self.last_timings = {}
         t0 = time.perf_counter()
-        ticket = self.submit()
+        with tracing.span("verify.submit"):
+            ticket = self.submit()
         t1 = time.perf_counter()
         result = self.collect(ticket)
         t2 = time.perf_counter()

@@ -19,6 +19,8 @@ from typing import Protocol
 import numpy as np
 
 from ..crypto import ed25519 as host_ed25519
+from ..utils import tracing
+from ..utils.metrics import hub as _metrics_hub
 
 _VERIFY_JIT = None
 
@@ -121,6 +123,7 @@ class TpuEd25519BatchVerifier:
         n = len(self._items)
         if n == 0:
             return ("sync", (False, []))
+        _metrics_hub().verify_batch_width.observe(float(n))
         # Below the device threshold the dispatch overhead (and, on first
         # use, compile time) dwarfs the arithmetic — verify on host.  The
         # hot configs (150-val light blocks, 10k-val commits) always take
@@ -128,7 +131,8 @@ class TpuEd25519BatchVerifier:
         if n < _device_batch_min():
             cpu = CpuEd25519BatchVerifier()
             cpu._items = self._items
-            return ("sync", cpu.verify())
+            with tracing.span("verify.host_route"):
+                return ("sync", cpu.verify())
         return ("dev", (self._submit_device(n), n))
 
     def collect(self, ticket) -> tuple[bool, list[bool]]:
@@ -136,35 +140,58 @@ class TpuEd25519BatchVerifier:
         if kind == "sync":
             return payload
         out, n = payload
-        ok = np.asarray(out)[:n]  # blocks until the device result lands
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with tracing.span("verify.device_wait"):
+            ok = np.asarray(out)[:n]  # blocks until the device result lands
+        _metrics_hub().verify_phase_seconds.observe(
+            _time.perf_counter() - t0, phase="device_wait"
+        )
         res = [bool(x) for x in ok]
         return all(res), res
 
     def _submit_device(self, n: int):
+        import time as _time
+
         import jax.numpy as jnp
         from ..ops import sha2
 
-        bucket = _next_bucket(n)
-        a = np.zeros((bucket, 32), dtype=np.uint8)
-        r = np.zeros((bucket, 32), dtype=np.uint8)
-        s = np.zeros((bucket, 32), dtype=np.uint8)
-        hashed = []
-        for i, (pub, msg, sig) in enumerate(self._items):
-            a[i] = np.frombuffer(pub, dtype=np.uint8)
-            r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-            s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-            hashed.append(sig[:32] + pub + msg)
-        # Pad rows repeat row 0 so padded lanes do real-but-ignored work.
-        for i in range(n, bucket):
-            a[i], r[i], s[i] = a[0], r[0], s[0]
-            hashed.append(hashed[0])
-        blocks, active = sha2.pad_messages_sha512(hashed)
+        t0 = _time.perf_counter()
+        with tracing.span("verify.uncached_assemble"):
+            bucket = _next_bucket(n)
+            a = np.zeros((bucket, 32), dtype=np.uint8)
+            r = np.zeros((bucket, 32), dtype=np.uint8)
+            s = np.zeros((bucket, 32), dtype=np.uint8)
+            hashed = []
+            for i, (pub, msg, sig) in enumerate(self._items):
+                a[i] = np.frombuffer(pub, dtype=np.uint8)
+                r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+                s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+                hashed.append(sig[:32] + pub + msg)
+            # Pad rows repeat row 0 so padded lanes do real-but-ignored work.
+            for i in range(n, bucket):
+                a[i], r[i], s[i] = a[0], r[0], s[0]
+                hashed.append(hashed[0])
+            blocks, active = sha2.pad_messages_sha512(hashed)
         fn = self._compiled()
-        # device dispatch is asynchronous: the returned array is a future
-        return fn(
-            jnp.asarray(a),
-            jnp.asarray(r),
-            jnp.asarray(s),
-            jnp.asarray(blocks),
-            jnp.asarray(active),
+        t1 = _time.perf_counter()
+        # device dispatch is asynchronous: the returned array is a future.
+        # NOTE: a first call at a new bucket shape compiles inside fn(...),
+        # so that one observation (span and histogram alike) carries the
+        # XLA compile — same caveat as the comb path; warm calls are pure
+        # transfer+dispatch.
+        with tracing.span("verify.h2d_dispatch"):
+            out = fn(
+                jnp.asarray(a),
+                jnp.asarray(r),
+                jnp.asarray(s),
+                jnp.asarray(blocks),
+                jnp.asarray(active),
+            )
+        m = _metrics_hub()
+        m.verify_phase_seconds.observe(t1 - t0, phase="assembly")
+        m.verify_phase_seconds.observe(
+            _time.perf_counter() - t1, phase="h2d_dispatch"
         )
+        return out

@@ -203,9 +203,13 @@ class MConnection(Service):
                     if pkt is None:
                         break
                     frame = self._frame(p2p_pb.Packet(msg=pkt))
-                    _metrics_hub().p2p_send_bytes.inc(
-                        len(frame), ch_id=str(pkt.channel_id)
-                    )
+                    m = _metrics_hub()
+                    m.p2p_send_bytes.inc(len(frame), ch_id=str(pkt.channel_id))
+                    if pkt.eof:
+                        # count MESSAGES on the eof chunk, not packets —
+                        # the count counter pairs with the byte counter
+                        # the way the reference's MessageSendBytes does
+                        m.p2p_send_count.inc(ch_id=str(pkt.channel_id))
                     out += frame
                 if out:
                     self.send_monitor.throttle(len(out))
@@ -287,4 +291,5 @@ class MConnection(Service):
         if pkt.eof:
             msg = bytes(st.recv_buf)
             st.recv_buf = bytearray()
+            _metrics_hub().p2p_recv_count.inc(ch_id=str(pkt.channel_id))
             self.on_receive(pkt.channel_id, msg)

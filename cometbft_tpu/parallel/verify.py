@@ -43,6 +43,7 @@ def shard_map(f, *, mesh, in_specs, out_specs):
 
 from ..ops import ed25519 as E
 from ..ops import merkle as M
+from ..utils import tracing
 
 
 @functools.lru_cache(maxsize=8)
@@ -76,7 +77,11 @@ def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_acti
     Returns (all_valid: bool scalar, valid: (N,) bool fully replicated).
     N must be divisible by the mesh size (callers pad to bucket sizes).
     """
-    return _verify_fn(mesh)(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
+    with tracing.span(
+        "verify.shard_dispatch",
+        {"devices": int(mesh.devices.size)} if tracing.enabled() else None,
+    ):
+        return _verify_fn(mesh)(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
 
 
 @functools.lru_cache(maxsize=8)
@@ -143,7 +148,13 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
     """
     from ..ops import comb
 
-    return _comb_verify_fn(mesh, comb.tree_enabled())(tables, valid, pubs, payload)
+    with tracing.span(
+        "verify.shard_dispatch",
+        {"devices": int(mesh.devices.size)} if tracing.enabled() else None,
+    ):
+        return _comb_verify_fn(mesh, comb.tree_enabled())(
+            tables, valid, pubs, payload
+        )
 
 
 @functools.lru_cache(maxsize=8)

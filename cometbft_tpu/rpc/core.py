@@ -675,6 +675,17 @@ class Environment:
             }
         }
 
+    def dump_consensus_trace(self) -> dict:
+        """Flight-recorder dump (ours, no reference analogue): the
+        bounded ring of recent step transitions, vote/proposal arrivals,
+        timeout fires, and watchdog re-kicks — the TEMPORAL complement
+        to dump_consensus_state's point-in-time deep dump.  Entries are
+        oldest-first; `evicted` says how much history scrolled out of
+        the ring (utils/flightrec.py)."""
+        from ..utils.flightrec import recorder
+
+        return recorder().dump()
+
     def consensus_params(self, height=None) -> dict:
         h = self._height_or_latest(height)
         params = self.node.state_store.load_consensus_params(h)
@@ -774,5 +785,6 @@ ROUTES = {
     "num_unconfirmed_txs": ("", Environment.num_unconfirmed_txs),
     "consensus_state": ("", Environment.consensus_state),
     "dump_consensus_state": ("", Environment.dump_consensus_state),
+    "dump_consensus_trace": ("", Environment.dump_consensus_trace),
     "consensus_params": ("height", Environment.consensus_params),
 }

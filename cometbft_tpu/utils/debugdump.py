@@ -7,8 +7,11 @@ sys._current_frames and gc/tracemalloc summaries).
 from __future__ import annotations
 
 import gc
+import json
+import os
 import sys
 import threading
+import time
 import traceback
 
 
@@ -51,3 +54,35 @@ def heap_summary(top: int = 25) -> str:
     ]
     out.extend(f"{n:>9}  {t}" for t, n in top_types)
     return "\n".join(out)
+
+
+def flight_record_text() -> str:
+    """The consensus flight recorder's ring as pretty JSON (the same
+    payload /dump_consensus_trace serves)."""
+    from .flightrec import recorder
+
+    return json.dumps(recorder().dump(), indent=1, default=str)
+
+
+def crash_report(reason: str, directory: str | None = None) -> str:
+    """Write a post-mortem bundle — reason, consensus flight-recorder
+    dump, all-thread stack dump — to a file and return its path.  Called
+    from the consensus receive routine's fatal-error branch so the last
+    N state-machine events survive the crash; must never raise (it runs
+    inside an exception handler)."""
+    import tempfile
+
+    directory = directory or tempfile.gettempdir()
+    path = os.path.join(
+        directory, f"cometbft-crash-{os.getpid()}-{time.time_ns()}.txt"
+    )
+    sections = [
+        f"=== crash report ===\nreason: {reason}\nwall_ns: {time.time_ns()}\n",
+        "=== consensus flight recorder ===",
+        flight_record_text(),
+        "=== threads ===",
+        thread_dump(),
+    ]
+    with open(path, "w") as f:
+        f.write("\n".join(sections))
+    return path

@@ -1,0 +1,126 @@
+"""Consensus flight recorder: a bounded ring of recent state-machine
+events — step transitions, vote/proposal arrivals, timeout fires,
+watchdog re-kicks — tagged with height/round/step and wall-clock time.
+
+The recorder is always on (recording is one lock + one dict + one
+bounded append; the consensus loop already pays a WAL write per input)
+so that when a node wedges or crashes, the last N events are available
+without having had to anticipate the incident: on demand via the
+`/dump_consensus_trace` RPC (rpc/core.py) and automatically in the
+crash report utils/debugdump.crash_report writes when the consensus
+receive routine dies.
+
+This is the black-box analogue of the reference's `dump_consensus_state`
+deep-dump, but *temporal*: not "where is the machine now" but "what were
+the last 1024 things that happened to it".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class FlightRecorder:
+    """Bounded rings of consensus events.  Thread-safe; eviction counts
+    are kept so a dump says how much history scrolled away.
+
+    High-rate per-signature events (vote arrivals: ~2·V per height, so
+    ~20k/height at the 10k-validator target scale) go to their OWN ring —
+    otherwise one height of votes would evict every step/timeout/
+    proposal/watchdog entry and the black-box would be blind to exactly
+    the state-machine transitions it exists to capture."""
+
+    HIGH_RATE_KINDS = frozenset({"vote"})
+
+    def __init__(self, capacity: int = 1024, vote_capacity: int | None = None):
+        self._ring: deque[dict] = deque(maxlen=max(1, capacity))
+        self._votes: deque[dict] = deque(
+            maxlen=max(1, capacity if vote_capacity is None else vote_capacity)
+        )
+        self._mtx = threading.Lock()
+        self._seq = 0
+        self._evicted = 0
+        self._votes_evicted = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def record(
+        self,
+        kind: str,
+        height: int = 0,
+        round: int = -1,
+        step: int = -1,
+        **detail,
+    ) -> None:
+        e = {
+            "kind": kind,
+            "height": height,
+            "round": round,
+            "step": step,
+            "wall_ns": time.time_ns(),
+            "mono_ns": time.perf_counter_ns(),
+        }
+        if detail:
+            e["detail"] = detail
+        with self._mtx:
+            self._seq += 1
+            e["seq"] = self._seq
+            if kind in self.HIGH_RATE_KINDS:
+                if len(self._votes) == self._votes.maxlen:
+                    self._votes_evicted += 1
+                self._votes.append(e)
+            else:
+                if len(self._ring) == self._ring.maxlen:
+                    self._evicted += 1
+                self._ring.append(e)
+
+    def dump(self) -> dict:
+        """Snapshot, oldest first (both rings merged in arrival order):
+        {"entries": [...], "count", "evicted", "votes_evicted",
+        "capacity", "vote_capacity"} — JSON-serializable as-is (the RPC
+        handler returns it verbatim)."""
+        with self._mtx:
+            entries = sorted(
+                list(self._ring) + list(self._votes), key=lambda e: e["seq"]
+            )
+            return {
+                "entries": entries,
+                "count": len(entries),
+                "evicted": self._evicted,
+                "votes_evicted": self._votes_evicted,
+                "capacity": self._ring.maxlen,
+                "vote_capacity": self._votes.maxlen,
+            }
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._ring.clear()
+            self._votes.clear()
+            self._evicted = 0
+            self._votes_evicted = 0
+
+
+def _capacity_from_env() -> int:
+    import os
+
+    try:
+        return max(
+            1, int(os.environ.get("COMETBFT_TPU_FLIGHTREC", "") or 1024)
+        )
+    except ValueError:
+        return 1024
+
+
+_REC = FlightRecorder(_capacity_from_env())
+
+
+def recorder() -> FlightRecorder:
+    """The process-global recorder.  Multi-node test processes share it
+    (like the metrics hub); entries carry height/round so interleaved
+    nodes remain distinguishable, and the multi-process e2e harness
+    gives each node its own."""
+    return _REC

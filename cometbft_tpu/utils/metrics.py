@@ -31,10 +31,22 @@ class _Metric:
         return tuple(sorted((labels or {}).items()))
 
     @staticmethod
-    def _fmt_labels(key: tuple) -> str:
+    def _esc_label(v) -> str:
+        """Label-value escaping per the Prometheus text format: backslash,
+        double-quote, and line feed must be escaped or the exposition is
+        unparseable (backslash FIRST, or the other escapes double up)."""
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _fmt_labels(cls, key: tuple) -> str:
         if not key:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        inner = ",".join(f'{k}="{cls._esc_label(v)}"' for k, v in key)
         return "{" + inner + "}"
 
 
@@ -144,21 +156,57 @@ class Registry:
     def __init__(self, namespace: str = "cometbft"):
         self.namespace = namespace
         self._metrics: list[_Metric] = []
+        self._by_name: dict[str, _Metric] = {}
         self._mtx = threading.Lock()
 
     def _register(self, m: _Metric) -> None:
+        """Direct registration (Metric(..., registry=r)): a duplicate name
+        is a programming error — two instances exposing the same series
+        with conflicting values produce an unscrapable /metrics."""
         with self._mtx:
+            if m.name in self._by_name:
+                raise ValueError(f"metric {m.name!r} already registered")
+            self._by_name[m.name] = m
             self._metrics.append(m)
 
+    def _get_or_make(self, full_name: str, cls, help_: str, **kw) -> _Metric:
+        """The factory helpers are get-or-create: re-declaring a metric
+        (e.g. two subsystems sharing one registry, or a re-constructed
+        metric set on a shared hub) returns the ONE existing instance so
+        the exposition never carries the name twice.  A re-declaration
+        under a different metric type is a conflict and raises."""
+        with self._mtx:
+            existing = self._by_name.get(full_name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {full_name!r} already registered as "
+                        f"{type(existing).__name__}, not {cls.__name__}"
+                    )
+                if "buckets" in kw and existing.buckets != tuple(
+                    sorted(kw["buckets"])
+                ):
+                    # silently keeping the first declaration's bounds would
+                    # bin the second caller's observations wrongly
+                    raise ValueError(
+                        f"histogram {full_name!r} re-declared with different "
+                        f"buckets: {existing.buckets} vs {kw['buckets']}"
+                    )
+                return existing
+            m = cls(full_name, help_, registry=None, **kw)
+            self._by_name[full_name] = m
+            self._metrics.append(m)
+            return m
+
     def counter(self, name: str, help_: str = "") -> Counter:
-        return Counter(f"{self.namespace}_{name}", help_, registry=self)
+        return self._get_or_make(f"{self.namespace}_{name}", Counter, help_)
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        return Gauge(f"{self.namespace}_{name}", help_, registry=self)
+        return self._get_or_make(f"{self.namespace}_{name}", Gauge, help_)
 
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return Histogram(
-            f"{self.namespace}_{name}", help_, buckets, registry=self
+        return self._get_or_make(
+            f"{self.namespace}_{name}", Histogram, help_, buckets=buckets
         )
 
     def expose_text(self) -> str:
@@ -248,11 +296,59 @@ class Hub:
         self.p2p_recv_bytes = r.counter(
             "p2p_message_receive_bytes_total", "Bytes received (label ch_id)"
         )
+        self.p2p_send_count = r.counter(
+            "p2p_message_send_count", "Complete messages sent (label ch_id)"
+        )
+        self.p2p_recv_count = r.counter(
+            "p2p_message_receive_count",
+            "Complete messages received (label ch_id)",
+        )
+        # ---- consensus control plane
+        self.cs_timeout_fired = r.counter(
+            "consensus_timeout_fired_total",
+            "Consensus timeouts fired by the ticker (label step)",
+        )
         # ---- stores (store/metrics.go BlockStore access durations)
         self.store_access_seconds = r.histogram(
             "store_block_store_access_duration_seconds",
             "Block/state store op latency (label method)",
             buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0),
+        )
+        # ---- verification plane (ours: the TPU VerifyCommit pipeline)
+        self.verify_submit_queue_depth = r.gauge(
+            "verify_submit_queue_depth",
+            "VerifyCommit submissions queued or staging on the comb "
+            "staging thread",
+        )
+        self.verify_slab_requests = r.counter(
+            "verify_slab_requests_total",
+            "Staging-slab acquisitions (label result=hit|miss; hit = "
+            "recycled from the per-entry pool, no allocation)",
+        )
+        self.verify_batch_width = r.histogram(
+            "verify_batch_width_sigs",
+            "Signatures per batch-verifier submission",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+        )
+        self.verify_staging_busy = r.counter(
+            "verify_staging_busy_seconds_total",
+            "Cumulative busy time of the comb staging thread (ratio to "
+            "wall clock = staging-thread occupancy)",
+        )
+        self.comb_table_cache = r.counter(
+            "verify_comb_table_cache_total",
+            "Valset comb-table cache lookups (label result=hit|miss|"
+            "building; building = async build in flight, batch routed "
+            "to the uncached kernel)",
+        )
+        self.verify_phase_seconds = r.histogram(
+            "verify_phase_seconds",
+            "Per-phase VerifyCommit pipeline latency (label phase="
+            "assembly|h2d_dispatch|staging_wait|device_wait; first call "
+            "at a new shape carries the XLA compile in h2d_dispatch)",
+            buckets=(
+                0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5,
+            ),
         )
 
 

@@ -1,0 +1,305 @@
+"""In-process span tracer for the verification plane and consensus core.
+
+A low-overhead tracer in the spirit of the Chrome trace-event profile
+format: call sites open monotonic-clock spans (`with tracing.span("x")`)
+or drop instant markers (`tracing.instant("y")`); finished events land in
+a per-thread buffer (appends touch no lock) that drains in chunks into
+one process-global bounded ring, and the whole ring exports as Chrome
+trace-event JSON — open the file in Perfetto (ui.perfetto.dev) or
+chrome://tracing to see the VerifyCommit pipeline (slab fill, H2D,
+kernel dispatch, device wait, collect) laid out across the caller,
+staging, and blocksync threads.
+
+Cost model: tracing is OFF by default and the disabled path is a single
+module-bool check returning a shared no-op context manager — no
+allocation, no clock read — so the hot paths stay instrumented in
+production builds.  Enabled, a span is two perf_counter_ns reads plus a
+tuple append; the ring bounds total memory however long the run.
+
+Enable with COMETBFT_TPU_TRACE=1 (drain via export_chrome_trace / the
+API) or COMETBFT_TPU_TRACE=/path/to/out.trace.json to also auto-export
+at interpreter exit.  COMETBFT_TPU_TRACE_RING sizes the ring (events,
+default 65536).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+
+_OFF_VALUES = ("", "0", "false", "off", "no")
+_ON_VALUES = ("1", "true", "on", "yes")
+
+# events drain from thread-local buffers to the ring in chunks this big;
+# small enough that an export misses at most a few dozen in-flight events
+_CHUNK = 64
+_DEFAULT_RING = 65536
+
+_ENABLED = False
+_EXPORT_PATH: str | None = None
+
+_ring_mtx = threading.Lock()
+_ring: list = []  # bounded manually (deque has no atomic bulk-swap)
+_ring_cap = _DEFAULT_RING
+_dropped = 0
+
+_bufs_mtx = threading.Lock()
+_bufs: list = []  # [(weakref-to-thread, buf list, tid), ...]
+_thread_names: dict[int, str] = {}
+# registration-time pruning threshold: beyond this many registered
+# buffers, dead threads' buffers are flushed and dropped so per-peer
+# thread churn can't grow _bufs/_thread_names for the process lifetime
+_PRUNE_AT = 256
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool, ring_capacity: int | None = None) -> None:
+    """Runtime switch (tests, the trace script, bench).  Turning tracing
+    on never clears previously collected events; call reset() for a
+    clean capture window."""
+    global _ENABLED, _ring_cap
+    if ring_capacity is not None:
+        with _ring_mtx:
+            _ring_cap = max(1, int(ring_capacity))
+            del _ring[: max(0, len(_ring) - _ring_cap)]
+    _ENABLED = bool(on)
+
+
+def reset() -> None:
+    """Drop every buffered event (thread-local and ring)."""
+    global _dropped
+    with _bufs_mtx:
+        entries = list(_bufs)
+    for _tref, buf, _tid in entries:
+        del buf[:]
+    with _ring_mtx:
+        del _ring[:]
+        _dropped = 0
+
+
+def dropped_count() -> int:
+    """Events evicted from the ring since the last reset()."""
+    return _dropped
+
+
+# ------------------------------------------------------------- recording
+
+
+_tid_counter = 0
+
+
+def _buf() -> list:
+    b = getattr(_tls, "buf", None)
+    if b is None:
+        global _tid_counter
+        b = _tls.buf = []
+        t = threading.current_thread()
+        with _bufs_mtx:
+            if len(_bufs) >= _PRUNE_AT:
+                _prune_dead_locked()
+            # synthetic per-thread track id: OS thread idents are recycled
+            # after thread exit, which would merge a dead thread's events
+            # onto a new thread's track in the export
+            _tid_counter += 1
+            _tls.tid = _tid_counter
+            _bufs.append((weakref.ref(t), b, _tls.tid))
+            _thread_names[_tls.tid] = t.name
+    return b
+
+
+def _prune_dead_locked() -> None:
+    """Flush and drop buffers (and name entries) of exited threads —
+    caller holds _bufs_mtx.  Ring events from pruned threads keep their
+    synthetic tid; only the name label for the track is lost."""
+    keep = []
+    for tref, b, tid in _bufs:
+        if tref() is not None:
+            keep.append((tref, b, tid))
+        else:
+            if b:
+                _flush(b)
+            _thread_names.pop(tid, None)
+    _bufs[:] = keep
+
+
+def _flush(b: list) -> None:
+    """Move a buffer's events into the bounded ring.  The copy+delete and
+    the ring extend happen under ONE lock: the owner thread's chunk flush
+    and an exporter's drain may race on the same buffer, and an unlocked
+    copy would insert the same chunk twice."""
+    global _dropped
+    with _ring_mtx:
+        items = b[:]
+        del b[: len(items)]
+        _ring.extend(items)
+        overflow = len(_ring) - _ring_cap
+        if overflow > 0:
+            del _ring[:overflow]
+            _dropped += overflow
+
+
+def _emit(ph: str, name: str, ts_ns: int, dur_ns: int, labels) -> None:
+    b = _buf()
+    b.append((ph, name, ts_ns, dur_ns, _tls.tid, labels))
+    if len(b) >= _CHUNK:
+        _flush(b)
+
+
+class _Span:
+    """One 'X' (complete) trace event, recorded at __exit__."""
+
+    __slots__ = ("_name", "_labels", "_t0")
+
+    def __init__(self, name: str, labels: dict | None):
+        self._name = name
+        self._labels = labels
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t0 = self._t0
+        _emit("X", self._name, t0, time.perf_counter_ns() - t0, self._labels)
+        return False
+
+
+class _NopSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOP = _NopSpan()
+
+
+def span(name: str, labels: dict | None = None):
+    """Context manager timing one pipeline phase.  Disabled: returns a
+    shared no-op — the call site pays one bool check and no allocation
+    (pass labels as a prebuilt dict, not kwargs, to keep that true)."""
+    if not _ENABLED:
+        return _NOP
+    return _Span(name, labels)
+
+
+def instant(name: str, labels: dict | None = None) -> None:
+    """A zero-duration marker (step transitions, timeout fires)."""
+    if not _ENABLED:
+        return
+    _emit("i", name, time.perf_counter_ns(), 0, labels)
+
+
+# --------------------------------------------------------------- export
+
+
+def _drain_all() -> tuple[list, dict]:
+    """Flush every thread buffer into the ring, prune buffers AND name
+    entries of dead threads, and return (ring snapshot, thread-name
+    snapshot).  The name snapshot is taken before the prune, so the
+    export in progress still labels just-exited threads' tracks; later
+    exports show their remaining ring events on an unnamed track — the
+    cosmetic price of keeping _thread_names bounded under thread churn.
+    The ring itself is not cleared: repeat exports see a superset."""
+    with _bufs_mtx:
+        entries = list(_bufs)
+        names = dict(_thread_names)
+        live = [(tr, b, tid) for tr, b, tid in entries if tr() is not None]
+        for tr, _b, tid in entries:
+            if tr() is None:
+                _thread_names.pop(tid, None)
+        _bufs[:] = live
+    for _tref, buf, _tid in entries:
+        if buf:
+            _flush(buf)
+    with _ring_mtx:
+        return list(_ring), names
+
+
+def chrome_trace_events() -> list[dict]:
+    """The buffered events as Chrome trace-event dicts (plus thread-name
+    metadata records), timestamp-sorted."""
+    events, names = _drain_all()
+    pid = os.getpid()
+    out: list[dict] = [
+        {
+            "ph": "M",
+            "name": "thread_name",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        }
+        for tid, tname in sorted(names.items())
+    ]
+    for ph, name, ts_ns, dur_ns, tid, labels in sorted(
+        events, key=lambda e: e[2]
+    ):
+        e = {
+            "ph": ph,
+            "name": name,
+            "cat": "cometbft",
+            "pid": pid,
+            "tid": tid,
+            "ts": ts_ns / 1e3,  # trace-event timestamps are microseconds
+        }
+        if ph == "X":
+            e["dur"] = dur_ns / 1e3
+        elif ph == "i":
+            e["s"] = "t"  # thread-scoped instant
+        if labels:
+            e["args"] = {k: _jsonable(v) for k, v in labels.items()}
+        out.append(e)
+    return out
+
+
+def _jsonable(v):
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write {"traceEvents": [...]} JSON; returns the number of span /
+    instant events written (metadata records excluded)."""
+    events = chrome_trace_events()
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return sum(1 for e in events if e["ph"] != "M")
+
+
+# --------------------------------------------------- env-var resolution
+
+def _atexit_export() -> None:
+    try:
+        export_chrome_trace(_EXPORT_PATH)
+    except Exception:  # noqa: BLE001 — never traceback on interpreter exit
+        pass
+
+
+_v = os.environ.get("COMETBFT_TPU_TRACE", "")
+if _v.lower() not in _OFF_VALUES:
+    _ENABLED = True
+    if _v.lower() not in _ON_VALUES and (os.sep in _v or _v.endswith(".json")):
+        # unambiguously a path: auto-export the ring at process exit.
+        # Other truthy values ("2", "debug", ...) just enable recording —
+        # they must not turn into a stray file named after themselves.
+        _EXPORT_PATH = _v
+        import atexit
+
+        atexit.register(_atexit_export)
+try:
+    _ring_cap = max(1, int(os.environ.get("COMETBFT_TPU_TRACE_RING", "") or _DEFAULT_RING))
+except ValueError:
+    _ring_cap = _DEFAULT_RING
+del _v

@@ -1,0 +1,184 @@
+"""Prometheus text-format exposition round trip (utils/metrics): a small
+parser validates expose_text() output — escaped label values, histogram
+`le` cumulativity, the +Inf/_sum/_count invariants — plus the registry's
+duplicate-name handling and the new verify-plane metric set."""
+
+import math
+
+import pytest
+
+from cometbft_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Hub,
+    Registry,
+)
+
+# --------------------------------------------------- tiny text-format parser
+
+_ESCAPES = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _parse_labels(s: str) -> dict:
+    labels = {}
+    i = 0
+    while i < len(s):
+        j = s.index("=", i)
+        key = s[i:j]
+        assert s[j + 1] == '"', f"label value must be quoted: {s!r}"
+        i = j + 2
+        out = []
+        while True:
+            c = s[i]
+            if c == "\\":
+                out.append(_ESCAPES[s[i + 1]])  # KeyError = illegal escape
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                assert c != "\n", "raw newline inside a label value"
+                out.append(c)
+                i += 1
+        labels[key] = "".join(out)
+        if i < len(s):
+            assert s[i] == ","
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str):
+    """-> (types: {name: type}, samples: [(name, labels, value)])."""
+    assert text.endswith("\n"), "exposition must end with a line feed"
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict, float]] = []
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            assert typ in ("counter", "gauge", "histogram")
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = typ
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels_str, value_str = rest.rsplit("} ", 1)
+            labels = _parse_labels(labels_str)
+        else:
+            name, value_str = line.rsplit(" ", 1)
+            labels = {}
+        samples.append((name, labels, float(value_str)))
+    return types, samples
+
+
+# ------------------------------------------------------------------- tests
+
+
+def test_label_escaping_round_trips():
+    nasty = 'he said "hi",\nthen a back\\slash'
+    r = Registry(namespace="t")
+    c = r.counter("events_total", "with weird labels")
+    c.inc(3, kind=nasty)
+    c.inc(2, kind="plain")
+    types, samples = parse_exposition(r.expose_text())
+    assert types["t_events_total"] == "counter"
+    by_label = {s[1].get("kind"): s[2] for s in samples}
+    assert by_label[nasty] == 3.0  # byte-exact after unescaping
+    assert by_label["plain"] == 2.0
+
+
+def test_histogram_invariants_per_labelset():
+    r = Registry(namespace="t")
+    h = r.histogram("lat_seconds", "l", buckets=(0.1, 1.0, 5.0))
+    obs = {"a": [0.05, 0.5, 0.5, 2.0, 99.0], "b": [0.2]}
+    for phase, vals in obs.items():
+        for v in vals:
+            h.observe(v, phase=phase)
+    types, samples = parse_exposition(r.expose_text())
+    assert types["t_lat_seconds"] == "histogram"
+    for phase, vals in obs.items():
+        buckets = [
+            (float(lbl["le"]) if lbl["le"] != "+Inf" else math.inf, val)
+            for name, lbl, val in samples
+            if name == "t_lat_seconds_bucket" and lbl.get("phase") == phase
+        ]
+        assert [le for le, _ in buckets] == sorted(le for le, _ in buckets)
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        # each bucket holds exactly the observations <= its bound
+        for le, c in buckets:
+            assert c == sum(1 for v in vals if v <= le)
+        (count,) = [
+            v for n, lbl, v in samples
+            if n == "t_lat_seconds_count" and lbl.get("phase") == phase
+        ]
+        (total,) = [
+            v for n, lbl, v in samples
+            if n == "t_lat_seconds_sum" and lbl.get("phase") == phase
+        ]
+        assert buckets[-1][0] == math.inf and buckets[-1][1] == count == len(vals)
+        assert total == pytest.approx(sum(vals))
+
+
+def test_registry_deduplicates_factory_declarations():
+    """Satellite: re-declaring a metric returns THE existing instance —
+    the same name never appears twice in the exposition."""
+    r = Registry(namespace="t")
+    c1 = r.counter("dup_total", "first")
+    c2 = r.counter("dup_total", "second declaration")
+    assert c1 is c2
+    c1.inc(5)
+    types, samples = parse_exposition(r.expose_text())
+    dup = [s for s in samples if s[0] == "t_dup_total"]
+    assert dup == [("t_dup_total", {}, 5.0)]
+    # same for gauges/histograms
+    assert r.gauge("g", "") is r.gauge("g", "")
+    assert r.histogram("h", "") is r.histogram("h", "")
+    # a histogram re-declared with DIFFERENT buckets would silently bin
+    # the second caller's observations wrongly — that's a conflict
+    with pytest.raises(ValueError):
+        r.histogram("h", "", buckets=(1.0, 2.0))
+
+
+def test_registry_rejects_type_conflicts_and_direct_duplicates():
+    r = Registry(namespace="t")
+    r.counter("x_total", "")
+    with pytest.raises(ValueError):
+        r.gauge("x_total", "")  # same name, different type
+    Counter("t_direct", registry=r)
+    with pytest.raises(ValueError):
+        Gauge("t_direct", registry=r)  # direct registration: duplicate name
+    with pytest.raises(ValueError):
+        Counter("t_direct", registry=r)
+
+
+def test_hub_exposition_parses_clean_and_has_verify_plane():
+    """The full hub (per-package sets + the new verify-plane metrics)
+    must expose a parseable document with unique series names."""
+    hub = Hub(Registry())
+    hub.verify_slab_requests.inc(result="hit")
+    hub.verify_phase_seconds.observe(0.002, phase="assembly")
+    hub.comb_table_cache.inc(result="miss")
+    hub.verify_batch_width.observe(128)
+    hub.verify_submit_queue_depth.set(1)
+    hub.verify_staging_busy.inc(0.5)
+    hub.cs_timeout_fired.inc(step="4")
+    hub.p2p_send_count.inc(ch_id="64")
+    hub.p2p_recv_count.inc(ch_id="64")
+    types, samples = parse_exposition(hub.registry.expose_text())
+    for name in (
+        "cometbft_verify_submit_queue_depth",
+        "cometbft_verify_slab_requests_total",
+        "cometbft_verify_batch_width_sigs",
+        "cometbft_verify_staging_busy_seconds_total",
+        "cometbft_verify_comb_table_cache_total",
+        "cometbft_verify_phase_seconds",
+        "cometbft_consensus_timeout_fired_total",
+        "cometbft_p2p_message_send_count",
+        "cometbft_p2p_message_receive_count",
+    ):
+        assert name in types, f"{name} missing from the hub exposition"
+    assert ("cometbft_p2p_message_send_count", {"ch_id": "64"}, 1.0) in samples

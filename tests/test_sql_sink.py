@@ -89,11 +89,19 @@ def test_adapters_via_indexer_service(sink):
         bus.publish_tx(3, 0, b"k=v", res)
         import time
 
+        # the tx and block events arrive on separate subscription pumps:
+        # wait for BOTH rows, not just tx_results, or a slow block pump
+        # flakes the attributes assertion below
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
             cur = sink._conn.cursor()
             cur.execute("SELECT COUNT(*) FROM tx_results")
-            if cur.fetchone()[0] >= 1:
+            ntx = cur.fetchone()[0]
+            cur.execute(
+                "SELECT COUNT(*) FROM attributes WHERE composite_key='epoch.n'"
+            )
+            nattr = cur.fetchone()[0]
+            if ntx >= 1 and nattr >= 1:
                 break
             time.sleep(0.05)
         cur = sink._conn.cursor()
