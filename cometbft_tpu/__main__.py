@@ -1,5 +1,13 @@
 import sys
 
-from .cli import main
+# The lock-order witness must patch threading.Lock/RLock BEFORE the
+# framework's import closure creates its module-level locks (tracing
+# rings, metrics hub, ...) — importing .cli below drags all of that in.
+# .analysis.lockwitness itself only touches the stdlib.
+from .analysis import lockwitness
+
+lockwitness.maybe_install()
+
+from .cli import main  # noqa: E402
 
 sys.exit(main())

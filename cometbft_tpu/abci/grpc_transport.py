@@ -125,7 +125,10 @@ class GrpcServer(Service):
                 )
 
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=self._max_workers),
+            futures.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="abci-grpc",
+            ),
             handlers=(Handler(),),
         )
         self.port = self._server.add_insecure_port(self.addr)

@@ -56,7 +56,8 @@ class SocketServer(Service):
                 return
             self._conns.append(conn)
             threading.Thread(
-                target=self._handle_conn, args=(conn,), daemon=True
+                target=self._handle_conn, args=(conn,), daemon=True,
+                name="abci-conn",
             ).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:

@@ -1,0 +1,23 @@
+"""Concurrency & invariant analysis for the cometbft_tpu codebase.
+
+Two halves:
+
+* a stdlib-``ast`` static linter (``linter.py`` + one module per check)
+  with repo-specific checks — lock held across a blocking call,
+  swallowed exceptions in thread run-loops, raw ``COMETBFT_TPU_*`` env
+  reads outside the knob registry, host side effects inside jitted
+  kernel bodies, metric construction outside the Registry factories,
+  and unnamed threads.  Entry point: ``scripts/lint.py`` (the single
+  CLI — it owns the ``[tool.cometbft-tpu-lint]`` config, stale-entry
+  reporting, and exit-code contract).
+
+* a runtime lock-order witness (``lockwitness.py``), enabled by
+  ``COMETBFT_TPU_LOCKCHECK=1``: every ``threading.Lock``/``RLock``
+  acquisition feeds a per-process acquisition-order graph, and an order
+  inversion (potential deadlock) or a ``time.sleep`` while holding a
+  witnessed lock is reported with both stacks.  The test conftest
+  installs it, so every suite run doubles as a deadlock hunt.
+
+This package imports nothing heavyweight (no JAX, no numpy) so the
+linter runs anywhere the stdlib does.
+"""

@@ -1,0 +1,192 @@
+"""Check: jax-purity.
+
+Host side effects inside jitted bodies in ``ops/`` and ``parallel/``:
+``print`` (runs at trace time only, then never again), env reads (baked
+into the compiled program — recompiles silently keep the stale value),
+file I/O, host clock reads, ``.item()``/``float(arg)`` on traced values
+(forces a device sync mid-trace or a ConcretizationTypeError).  These
+are the bug class the XLA layer cannot diagnose for us: the program
+traces fine once and then behaves differently on the cached executable.
+
+Jitted bodies are found statically: functions decorated with
+``jax.jit``/``jit``/``partial(jax.jit, ...)``, functions passed to
+``jax.jit(...)`` by name, and bodies handed to ``lax`` control flow
+(``fori_loop``/``while_loop``/``scan``/``cond``/``switch``/``map``) —
+then closed transitively over same-module calls.  Statements under
+``with jax.ensure_compile_time_eval():`` are exempt (explicitly marked
+host-side constant folding).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, dotted_name, terminal_name
+
+CHECK_ID = "jax-purity"
+SUMMARY = "host side effect / env read / device sync inside a jitted body"
+
+SCOPE_DIRS = {"ops", "parallel"}
+
+_LAX_HOFS = {"fori_loop", "while_loop", "scan", "cond", "switch", "map"}
+_CLOCK_CALLS = {
+    "time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+    "sleep",
+}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit, ...)"""
+    d = dotted_name(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and terminal_name(node.func) == "partial":
+        return bool(node.args) and _is_jit_expr(node.args[0])
+    return False
+
+
+def _collect_functions(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    funcs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # later defs shadow earlier same-named ones; fine for linting
+            funcs[node.name] = node
+    return funcs
+
+
+def _jit_roots(tree: ast.AST, funcs: dict[str, ast.FunctionDef]) -> set[str]:
+    roots: set[str] = set()
+    for name, fn in funcs.items():
+        if any(_is_jit_expr(dec) for dec in fn.decorator_list):
+            roots.add(name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_expr(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in funcs:
+                    roots.add(arg.id)
+        tn = terminal_name(node.func)
+        if tn in _LAX_HOFS:
+            d = dotted_name(node.func) or ""
+            if d.startswith(("lax.", "jax.lax.")) or d in _LAX_HOFS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in funcs:
+                        roots.add(arg.id)
+    return roots
+
+
+def _call_edges(funcs: dict[str, ast.FunctionDef]) -> dict[str, set[str]]:
+    edges: dict[str, set[str]] = {}
+    for name, fn in funcs.items():
+        callees: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                tn = terminal_name(node.func)
+                if tn in funcs:
+                    callees.add(tn)
+            elif isinstance(node, ast.Name) and node.id in funcs:
+                # passed by reference (e.g. into lax control flow)
+                callees.add(node.id)
+        callees.discard(name)
+        edges[name] = callees
+    return edges
+
+
+def _traced_closure(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    funcs = _collect_functions(tree)
+    roots = _jit_roots(tree, funcs)
+    edges = _call_edges(funcs)
+    traced: set[str] = set()
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if n in traced:
+            continue
+        traced.add(n)
+        stack.extend(edges.get(n, ()))
+    return {n: funcs[n] for n in traced}
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, fn: ast.FunctionDef):
+        self.mod = mod
+        self.fn = fn
+        self.params = {
+            a.arg
+            for a in (
+                list(fn.args.posonlyargs) + list(fn.args.args)
+                + list(fn.args.kwonlyargs)
+            )
+        }
+        self.findings: list[Finding] = []
+
+    def _add(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                CHECK_ID, self.mod.path, node.lineno, node.col_offset,
+                f"{msg} inside jitted body {self.fn.name!r}",
+            )
+        )
+
+    def visit_With(self, node: ast.With):  # noqa: N802
+        for item in node.items:
+            d = dotted_name(
+                item.context_expr.func
+                if isinstance(item.context_expr, ast.Call)
+                else item.context_expr
+            )
+            if d and d.endswith("ensure_compile_time_eval"):
+                return  # explicitly-marked host-side constant folding
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        d = dotted_name(node.func) or ""
+        tn = terminal_name(node.func)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "print":
+                self._add(node, "host print() (use jax.debug.print)")
+            elif node.func.id == "open":
+                self._add(node, "host file I/O")
+            elif (
+                node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in self.params
+            ):
+                self._add(
+                    node,
+                    f"{node.func.id}() on parameter "
+                    f"{node.args[0].id!r} (concretizes a traced value)",
+                )
+        if d == "getenv" or d.endswith(".getenv") or ".environ" in d or d.startswith("environ"):
+            self._add(node, "env read (baked in at trace time)")
+        elif "envknobs." in d and (tn or "").startswith(("get", "raw")):
+            self._add(node, "envknobs read (baked in at trace time)")
+        elif isinstance(node.func, ast.Attribute):
+            base = dotted_name(node.func.value)
+            if tn == "item":
+                self._add(node, ".item() device sync")
+            elif tn in _CLOCK_CALLS and base == "time":
+                self._add(node, f"host clock/time.{tn}()")
+            elif tn in ("save", "load") and base in ("np", "numpy"):
+                self._add(node, f"host file I/O (np.{tn})")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):  # noqa: N802
+        d = dotted_name(node.value)
+        if d and (d == "environ" or d.endswith(".environ")):
+            self._add(node, "env read (baked in at trace time)")
+        self.generic_visit(node)
+
+
+def check(mod: Module) -> list[Finding]:
+    if not SCOPE_DIRS.intersection(mod.parts[:-1]):
+        return []
+    findings: list[Finding] = []
+    for fn in _traced_closure(mod.tree).values():
+        v = _BodyVisitor(mod, fn)
+        for stmt in fn.body:
+            v.visit(stmt)
+        findings.extend(v.findings)
+    return findings

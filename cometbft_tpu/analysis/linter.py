@@ -1,0 +1,234 @@
+"""Static-analysis driver: file discovery, check dispatch, allowlist.
+
+The Python analogue of the ``go vet`` wiring the reference codebase gets
+for free: each check module (one per check, same directory) exports
+``CHECK_ID``, ``SUMMARY``, and ``check(module) -> list[Finding]``; this
+driver parses each source file once, fans it out to the enabled checks,
+and filters findings through the explicit checked-in allowlist
+(``analysis/allowlist.txt``) so suppressions are loud, reviewed debt —
+never an inline comment that silently rots.
+
+Machine entry points: :func:`lint_paths` (used by ``scripts/lint.py``
+and the gate test in ``tests/test_static_analysis.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str  # posix-style, as discovered (relative when input was)
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.check}] {self.message}"
+
+
+class Module:
+    """One parsed source file, shared across checks."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+
+    @property
+    def parts(self) -> tuple[str, ...]:
+        return tuple(self.path.split("/"))
+
+
+# ------------------------------------------------------------- allowlist
+
+@dataclass
+class AllowEntry:
+    check: str
+    path: str
+    line: int | None  # None = whole file for this check
+    lineno: int  # where in allowlist.txt, for stale-entry reports
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        if self.check != f.check:
+            return False
+        if self.line is not None and self.line != f.line:
+            return False
+        # suffix match on a '/' boundary: entries are repo-relative but
+        # the linter may be invoked with absolute or differently-rooted
+        # paths
+        return f.path == self.path or f.path.endswith("/" + self.path)
+
+
+class Allowlist:
+    """``check-id path[:line]  # justification`` per line.  Blank lines
+    and ``#`` comments ignored.  A justification comment is REQUIRED by
+    policy (docs/static_analysis.md); the gate test enforces it."""
+
+    def __init__(self, entries: list[AllowEntry], raw_lines: list[str]):
+        self.entries = entries
+        self.raw_lines = raw_lines
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except FileNotFoundError:
+            return cls([], [])
+        return cls.parse(text)
+
+    @classmethod
+    def parse(cls, text: str) -> "Allowlist":
+        entries: list[AllowEntry] = []
+        lines = text.splitlines()
+        for lineno, raw in enumerate(lines, 1):
+            body = raw.split("#", 1)[0].strip()
+            if not body:
+                continue
+            fields = body.split()
+            if len(fields) != 2:
+                raise ValueError(
+                    f"allowlist line {lineno}: expected "
+                    f"'check-id path[:line]', got {raw!r}"
+                )
+            check, target = fields
+            line: int | None = None
+            if ":" in target:
+                target, _, linestr = target.rpartition(":")
+                try:
+                    line = int(linestr)
+                except ValueError:
+                    raise ValueError(
+                        f"allowlist line {lineno}: bad line number in {raw!r}"
+                    ) from None
+            entries.append(
+                AllowEntry(check, target.replace(os.sep, "/"), line, lineno)
+            )
+        return cls(entries, lines)
+
+    def suppresses(self, f: Finding) -> bool:
+        hit = False
+        for e in self.entries:
+            if e.matches(f):
+                e.used = True
+                hit = True
+        return hit
+
+    def unused(self) -> list[AllowEntry]:
+        """Stale suppressions: entries that matched nothing this run.
+        Reported (not fatal) so the allowlist shrinks as debt is paid."""
+        return [e for e in self.entries if not e.used]
+
+
+# --------------------------------------------------------------- checks
+
+def all_checks() -> dict[str, object]:
+    """check-id -> check module, discovery order stable."""
+    from . import (
+        jax_purity,
+        lock_blocking,
+        metrics_registry,
+        raw_env,
+        swallowed_exc,
+        thread_names,
+    )
+
+    mods = (
+        lock_blocking,
+        swallowed_exc,
+        raw_env,
+        jax_purity,
+        metrics_registry,
+        thread_names,
+    )
+    return {m.CHECK_ID: m for m in mods}
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    """Expand dirs to their .py files.  A path that is neither a
+    directory nor an existing .py file raises: a typo'd CI invocation
+    linting zero files must not read as a clean pass."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py") and os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(
+                f"lint path {p!r} is neither a directory nor a .py file"
+            )
+    return out
+
+
+def lint_paths(
+    paths: list[str],
+    checks: dict[str, object] | None = None,
+    allowlist: Allowlist | None = None,
+    disable: set[str] | frozenset[str] = frozenset(),
+) -> tuple[list[Finding], list[AllowEntry]]:
+    """Run every enabled check over every file; returns
+    ``(non-allowlisted findings, stale allowlist entries)``."""
+    checks = checks if checks is not None else all_checks()
+    allowlist = allowlist if allowlist is not None else Allowlist([], [])
+    enabled = [m for cid, m in checks.items() if cid not in disable]
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mod = Module(path, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(
+                Finding("parse-error", path.replace(os.sep, "/"), 1, 0, str(e))
+            )
+            continue
+        for m in enabled:
+            findings.extend(m.check(mod))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    kept = [f for f in findings if not allowlist.suppresses(f)]
+    return kept, allowlist.unused()
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "allowlist.txt")
+
+
+# ----------------------------------------------------- shared AST helpers
+
+def terminal_name(node: ast.expr) -> str | None:
+    """The rightmost identifier of a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def keyword_names(call: ast.Call) -> set[str]:
+    return {k.arg for k in call.keywords if k.arg is not None}

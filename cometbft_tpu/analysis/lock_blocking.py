@@ -1,0 +1,143 @@
+"""Check: lock-held-across-blocking-call.
+
+A ``with <lock>:`` body that performs an unbounded blocking operation —
+``join()`` with no timeout, socket ``recv``/``sendall``/``accept``/
+``connect``, ``queue.get()``/``Event.wait()``/``Future.result()``
+without a timeout, ``time.sleep``, or a device sync
+(``block_until_ready``) — serializes every other thread contending for
+that lock behind I/O, and is one hung peer away from a deadlock.  The
+consensus hot path (VerifyCommit staging, vote routing) runs under
+small mutexes; none of them may ever wait on the outside world.
+
+Lock recognition is lexical: a ``with`` context expression whose
+terminal identifier contains ``lock``, ``mtx``, or ``mutex`` — the
+repo's naming convention, enforced cheaply here.  Nested ``def``/
+``lambda`` bodies are skipped (they execute later, not under the lock).
+The runtime half of this check is analysis/lockwitness, which catches
+``time.sleep`` under any witnessed lock no matter how it was named.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, dotted_name, keyword_names, terminal_name
+
+CHECK_ID = "lock-held-across-blocking-call"
+SUMMARY = "a `with lock:` body calls an unbounded blocking operation"
+
+_LOCK_HINTS = ("lock", "mtx", "mutex")
+
+# attribute calls that block regardless of arguments
+_ALWAYS_BLOCKING = {
+    "recv", "recvfrom", "recv_into", "sendall", "accept", "connect",
+    "block_until_ready",
+}
+# attribute calls that block only when called with no bounding timeout.
+# (`.acquire()` is deliberately absent: nested lock acquisition is the
+# lock-order witness's territory, and `with a: with b:` — the same
+# shape — can't be flagged here either.)
+_NO_TIMEOUT_BLOCKING = {"get", "wait", "result"}
+
+
+def _is_lockish(expr: ast.expr) -> str | None:
+    name = terminal_name(expr)
+    if name is None:
+        return None
+    low = name.lower()
+    if any(h in low for h in _LOCK_HINTS):
+        return dotted_name(expr) or name
+    return None
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    func = call.func
+    name = terminal_name(func)
+    if name is None:
+        return None
+    if name == "sleep":
+        # time.sleep / from time import sleep — jitter-sleep helpers too
+        return "sleep()"
+    if name == "join":
+        # unbounded join() only: str.join always takes an argument, and
+        # join(timeout) is bounded
+        if not call.args and "timeout" not in keyword_names(call):
+            return "join() with no timeout"
+        return None
+    if name == "select" and len(call.args) < 4:
+        return "select() with no timeout"
+    if isinstance(func, ast.Attribute):
+        if name in _ALWAYS_BLOCKING:
+            return f"{name}()"
+        if name in _NO_TIMEOUT_BLOCKING:
+            kws = keyword_names(call)
+            if "timeout" in kws:
+                return None
+            if not call.args and not kws:
+                return f"{name}() with no timeout"
+            # get(True) / wait(True) / acquire(True): blocking flag set,
+            # still unbounded
+            if (
+                len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is True
+            ):
+                return f"{name}(True) with no timeout"
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.findings: list[Finding] = []
+        self._held: list[tuple[str, int]] = []  # (lock name, acquire line)
+
+    # deferred bodies never run under the enclosing with
+    def visit_FunctionDef(self, node):  # noqa: N802
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):  # noqa: N802
+        n_acquired = 0
+        for item in node.items:
+            # item N's context expression is evaluated with items 1..N-1
+            # (and any enclosing with-locks) already held: a blocking
+            # call used AS a context manager — `with lock: with
+            # closing(sock.accept()): ...` — blocks right here
+            self.visit(item.context_expr)
+            lock = _is_lockish(item.context_expr)
+            if lock is not None:
+                self._held.append((lock, node.lineno))
+                n_acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if n_acquired:
+            del self._held[-n_acquired:]
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        if self._held:
+            reason = _blocking_reason(node)
+            if reason is not None:
+                lock, line = self._held[-1]
+                self.findings.append(
+                    Finding(
+                        CHECK_ID,
+                        self.mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{reason} while holding {lock!r} "
+                        f"(acquired line {line})",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def check(mod: Module) -> list[Finding]:
+    v = _Visitor(mod)
+    v.visit(mod.tree)
+    return v.findings

@@ -1,0 +1,449 @@
+"""Runtime lock-order witness — the dynamic half of the analysis pass.
+
+When installed (``COMETBFT_TPU_LOCKCHECK=1`` via :func:`maybe_install`,
+or unconditionally via :func:`install` — the test conftest does the
+latter), ``threading.Lock``/``threading.RLock`` construction is wrapped
+so every acquisition feeds a per-process *acquisition-order graph*:
+holding lock A while acquiring lock B records the edge A→B, with the
+full stack captured the first time each edge appears.  Two detectors
+run on that graph:
+
+* **order cycle**: recording an edge that closes a cycle (the classic
+  A→B vs B→A inversion, any length) means two threads can deadlock;
+  the violation carries the stack that recorded the new edge AND the
+  stacks stored for every edge on the pre-existing return path.  Edges
+  are recorded when a blocking acquire is *attempted*, not when it
+  succeeds — so an inversion that is actually deadlocking right now
+  still reports (both threads are parked inside the inner acquire and
+  would never reach a post-acquire hook).
+
+* **blocking while locked**: ``time.sleep`` called while the thread
+  holds any witnessed lock — the runtime mirror of the static
+  ``lock-held-across-blocking-call`` check, catching locks the lexical
+  naming heuristic can't see.
+
+Violations are recorded (:func:`violations`) and printed to stderr once
+each; ``COMETBFT_TPU_LOCKCHECK=raise`` raises in the acquiring thread
+instead, for pinpointing in a debugger.  The witness never takes any
+lock other than its own private raw mutex, so it cannot deadlock the
+program it watches.
+
+Nodes are lock *instances* (labelled by creation site), not creation
+sites: a reported cycle involves the very same objects acquired in
+inverted order — no site-aliasing false positives, at the cost of not
+generalizing across instances the way kernel lockdep does.
+
+Locks created *before* :func:`install` are invisible; install early
+(the conftest installs before any ``cometbft_tpu`` import).  ``RLock``
+wrappers implement the ``_release_save``/``_acquire_restore``/
+``_is_owned`` protocol so ``threading.Condition`` (and therefore
+``queue.Queue``) keeps the held-set bookkeeping exact across ``wait()``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+import weakref
+from dataclasses import dataclass, field
+
+# Bool spellings for the raw COMETBFT_TPU_LOCKCHECK read (maybe_install
+# here, and tests/conftest.py): must stay identical to envknobs._TRUE/
+# _FALSE, which this module cannot import (install must precede the
+# registry's import closure) — a test asserts the two stay in sync.
+TRUE_SPELLINGS = frozenset({"1", "true", "yes", "on"})
+FALSE_SPELLINGS = frozenset({"0", "false", "no", "off"})
+
+# raw mutex allocated before any patching can occur; the witness's own
+# state is guarded by an UNwitnessed lock by construction
+_state_mtx = threading.Lock()
+_tls = threading.local()
+
+_installed = False
+_raise_on_violation = False
+_orig_lock = None
+_orig_rlock = None
+_orig_sleep = None
+
+_edges: dict[int, set[int]] = {}  # adjacency: lock id -> set of lock ids
+_edge_stacks: dict[tuple[int, int], str] = {}
+_names: dict[int, str] = {}  # lock id -> creation site "file:line"
+_violations: list["Violation"] = []
+_violations_dropped = 0
+_sleep_seen: set[tuple[str, str]] = set()  # (lock site, sleep site) dedup
+_MAX_VIOLATIONS = 200  # a long-lived node must not grow stacks unboundedly
+
+
+@dataclass
+class Violation:
+    kind: str  # "order-cycle" | "blocking-while-locked"
+    message: str
+    stacks: list[str] = field(default_factory=list)  # labelled stacks
+
+    def render(self) -> str:
+        out = [f"[lockwitness:{self.kind}] {self.message}"]
+        out.extend(self.stacks)
+        return "\n".join(out)
+
+
+# ------------------------------------------------------------- internals
+
+def _site(depth_hint: int = 2) -> str:
+    """file:line of the nearest caller frame outside this module."""
+    f = sys._getframe(depth_hint)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _emit(v: Violation) -> None:
+    global _violations_dropped
+    if len(_violations) >= _MAX_VIOLATIONS:
+        _violations_dropped += 1
+        if _raise_on_violation:
+            raise RuntimeError(v.render())
+        return
+    _violations.append(v)
+    try:
+        print(v.render(), file=sys.stderr)
+    except (OSError, ValueError):  # closed/broken stderr — keep the record
+        pass
+    if _raise_on_violation:
+        raise RuntimeError(v.render())
+
+
+def _find_path(src: int, dst: int) -> list[int] | None:
+    """DFS over _edges; caller holds _state_mtx."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_edge(held_lock, new_lock) -> None:
+    hid, nid = id(held_lock), id(new_lock)
+    key = (hid, nid)
+    # lock-free fast path for the steady state (edge already known):
+    # a GIL-atomic dict read; a racy miss just takes the slow path,
+    # which re-checks under the mutex.  Keeps the expensive stack
+    # capture off every nested acquisition after the first.
+    if key in _edge_stacks:
+        return
+    here = "".join(traceback.format_stack(sys._getframe(2)))
+    violation = None
+    with _state_mtx:
+        _flush_dead()
+        if key in _edge_stacks:
+            return
+        # does nid already reach hid?  then hid -> nid closes a cycle
+        path = _find_path(nid, hid)
+        _edge_stacks[key] = here
+        _edges.setdefault(hid, set()).add(nid)
+        if path is not None:
+            cyc = path + [nid]
+            labels = " -> ".join(_names.get(i, f"<lock {i:#x}>") for i in cyc)
+            stacks = [
+                f"--- stack recording new edge "
+                f"{_names.get(hid, hex(hid))} -> {_names.get(nid, hex(nid))} "
+                f"(this thread, {threading.current_thread().name}):\n{here}"
+            ]
+            for a, b in zip(path, path[1:]):
+                st = _edge_stacks.get((a, b))
+                if st:
+                    stacks.append(
+                        f"--- stack that recorded prior edge "
+                        f"{_names.get(a, hex(a))} -> {_names.get(b, hex(b))}:"
+                        f"\n{st}"
+                    )
+            violation = Violation(
+                "order-cycle",
+                f"lock acquisition order cycle: {labels} (potential "
+                "deadlock between these call sites)",
+                stacks,
+            )
+    if violation is not None:
+        _emit(violation)
+
+
+def _note_attempt(wl) -> None:
+    """Record an order edge from every held lock to ``wl``."""
+    held = getattr(_tls, "held", None)
+    if held:
+        wid = id(wl)
+        for h, _s in held:
+            if id(h) != wid:
+                _record_edge(h, wl)
+
+
+def _remove_held(lst: list, wl) -> None:
+    for i in range(len(lst) - 1, -1, -1):
+        if lst[i][0] is wl:
+            del lst[i]
+            return
+
+
+def _note_release(wl) -> None:
+    held = getattr(_tls, "held", None)
+    if held:
+        _remove_held(held, wl)
+
+
+_dead: list[int] = []
+
+
+def _prune(lock_id: int) -> None:
+    """Queue a GC'd lock for removal from the graph.  CPython recycles
+    object ids, so keeping a dead lock's edges could alias them onto a
+    newly allocated lock and fabricate a cycle no live pair can form.
+
+    This is a weakref.finalize callback: it can fire during ANY
+    allocation, including one made while _state_mtx is already held by
+    this very thread — so it must only do a lock-free list append; the
+    actual graph surgery happens in _flush_dead under the mutex."""
+    _dead.append(lock_id)
+
+
+def _flush_dead() -> None:
+    """Apply queued prunes.  Caller holds _state_mtx."""
+    while _dead:
+        lock_id = _dead.pop()
+        _names.pop(lock_id, None)
+        _edges.pop(lock_id, None)
+        for dsts in _edges.values():
+            dsts.discard(lock_id)
+        for key in [k for k in _edge_stacks if lock_id in k]:
+            del _edge_stacks[key]
+
+
+class _WitnessLock:
+    __slots__ = ("_inner", "_held_in", "__weakref__")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._held_in = None  # the held-list the last acquire landed in
+        with _state_mtx:
+            _flush_dead()  # creation ~ death rate: keeps churn bounded
+            kind = type(self).__name__.replace("_Witness", "")
+            _names[id(self)] = f"{kind}@{_site()}"
+        weakref.finalize(self, _prune, id(self))
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # Order edges are recorded BEFORE a blocking acquire: when an
+        # inversion is deadlocking RIGHT NOW, both threads are parked
+        # inside inner.acquire, so a post-acquire note would never run
+        # and the one run that most needs the report would hang
+        # silently.  The attempt establishes the order (kernel lockdep
+        # semantics); in raise mode the cycle raises before the lock
+        # is touched, so there is nothing to hand back.
+        if blocking:
+            _note_attempt(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if not blocking:
+                # try-acquire cannot deadlock; record the order only
+                # once it actually holds, handing the lock back if
+                # raise mode fires on the recorded edge
+                try:
+                    _note_attempt(self)
+                except BaseException:
+                    self._inner.release()
+                    raise
+            held = _held()
+            held.append((self, _site()))
+            # remember WHICH thread's held-list the entry went into: a
+            # plain Lock may legally be released by a different thread
+            # (handoff), and scrubbing the wrong thread's list would
+            # leave a phantom hold generating bogus edges forever
+            self._held_in = held
+        return ok
+
+    def release(self) -> None:
+        # scrub bookkeeping BEFORE the inner release: the moment the
+        # inner lock frees, a blocked acquirer can run and set
+        # self._held_in to ITS list — reading it afterwards would scrub
+        # the new owner's entry and leave ours as a phantom hold.
+        # (A plain Lock has at most one outstanding hold, so the single
+        # slot is exact; double-release finds None and changes nothing.)
+        lst = self._held_in
+        self._held_in = None
+        if lst is not None:
+            _remove_held(lst, self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+    def __repr__(self) -> str:
+        return f"<{_names.get(id(self), 'witnessed lock')} {self._inner!r}>"
+
+
+class _WitnessRLock(_WitnessLock):
+    """Adds the Condition protocol so ``Condition(RLock())`` — and
+    everything built on it, ``queue.Queue`` included — keeps the
+    held-set exact across ``wait()`` (which fully releases and later
+    reacquires the underlying lock outside acquire()/release())."""
+
+    __slots__ = ()
+
+    def release(self) -> None:
+        # RLock release is owner-thread-only by contract, so the
+        # current thread's held-list is always the right one — and the
+        # reentrant case needs one entry removed per release, which the
+        # base class's single _held_in slot cannot express.
+        self._inner.release()
+        _note_release(self)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        held = getattr(_tls, "held", None) or []
+        count = sum(1 for h, _s in held if h is self)
+        for _ in range(count):
+            _note_release(self)
+        return (self._inner._release_save(), count)
+
+    def _acquire_restore(self, state):
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        held = _held()
+        site = _site()
+        for _ in range(count):
+            held.append((self, site))
+        self._held_in = held
+
+
+def _witness_sleep(secs):
+    held = getattr(_tls, "held", None)
+    if held:
+        wl, acq_site = held[-1]
+        name = _names.get(id(wl), "<lock>")
+        f = sys._getframe(1)
+        sleep_site = f"{f.f_code.co_filename}:{f.f_lineno}"
+        # one report per (lock site, sleep site): a benign recurring
+        # backoff loop must not grow _violations (and spam stderr) on
+        # every iteration.  GIL-atomic set ops; a racy duplicate emit
+        # is harmless.
+        key = (name, sleep_site)
+        if key not in _sleep_seen:
+            _sleep_seen.add(key)
+            here = "".join(traceback.format_stack(f))
+            _emit(
+                Violation(
+                    "blocking-while-locked",
+                    f"time.sleep({secs!r}) while holding {name} "
+                    f"(acquired at {acq_site}) on thread "
+                    f"{threading.current_thread().name}",
+                    [f"--- sleeping thread stack:\n{here}"],
+                )
+            )
+    return _orig_sleep(secs)
+
+
+# ------------------------------------------------------------ public API
+
+def install(raise_on_violation: bool = False) -> None:
+    """Patch threading.Lock/RLock and time.sleep.  Idempotent."""
+    global _installed, _raise_on_violation, _orig_lock, _orig_rlock, _orig_sleep
+    if _installed:
+        _raise_on_violation = raise_on_violation
+        return
+    import time as _time
+
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    _orig_sleep = _time.sleep
+    threading.Lock = lambda: _WitnessLock(_orig_lock())
+    threading.RLock = lambda: _WitnessRLock(_orig_rlock())
+    _time.sleep = _witness_sleep
+    _raise_on_violation = raise_on_violation
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the originals.  Already-created witness locks keep
+    working (they wrap real locks); they just stop feeding the graph
+    once released, since notes are cheap no-ops on an empty held set."""
+    global _installed
+    if not _installed:
+        return
+    import time as _time
+
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _time.sleep = _orig_sleep
+    _installed = False
+
+
+def maybe_install() -> bool:
+    """Install iff the COMETBFT_TPU_LOCKCHECK knob asks for it
+    (production entry points call this; the test conftest installs
+    unconditionally).
+
+    The knob is read raw, NOT via utils.envknobs: importing the registry
+    executes ``utils/__init__`` (service, logging) BEFORE threading.Lock
+    is patched, so any module-level lock those modules ever grow would be
+    silently unwitnessed in production while the test conftest (which
+    reads raw for the same reason) covers it — coverage drift with no
+    signal.  The knob stays declared in the registry for docs/knobs.md;
+    TRUE_SPELLINGS mirrors envknobs.get_bool exactly (empty = unset =
+    default off)."""
+    import os
+
+    raw = os.environ.get("COMETBFT_TPU_LOCKCHECK", "").strip().lower()
+    if raw == "raise":
+        install(raise_on_violation=True)
+        return True
+    if raw in TRUE_SPELLINGS:
+        install()
+        return True
+    return False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def violations() -> list[Violation]:
+    with _state_mtx:
+        return list(_violations)
+
+
+def clear() -> None:
+    """Drop recorded violations AND the order graph (tests isolate
+    scenarios with this; edges from torn-down locks would otherwise
+    link unrelated scenarios through recycled ids)."""
+    global _violations_dropped
+    with _state_mtx:
+        _flush_dead()
+        _violations.clear()
+        _violations_dropped = 0
+        _sleep_seen.clear()
+        _edges.clear()
+        _edge_stacks.clear()

@@ -1,0 +1,75 @@
+"""Check: metrics-via-registry.
+
+Direct construction of ``Counter``/``Gauge``/``Histogram`` from
+``utils.metrics`` anywhere outside that module.  PR 2 made the Registry
+factories (``registry.counter(...)`` etc.) get-or-create with type- and
+bucket-conflict detection precisely because two bare instances exposing
+the same series produce an unscrapable ``/metrics``; constructing the
+classes directly bypasses that de-duplication.  Import tracking keeps
+``collections.Counter`` and friends out of scope — only names actually
+imported from the metrics module (or attribute access on an import of
+it) are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, dotted_name
+
+CHECK_ID = "metrics-via-registry"
+SUMMARY = "metric constructed directly instead of via Registry factories"
+
+_CLASSES = {"Counter", "Gauge", "Histogram"}
+_EXEMPT_SUFFIX = "utils/metrics.py"
+
+
+def _metrics_bindings(tree: ast.AST) -> tuple[set[str], set[str]]:
+    """(names bound to metric classes, names bound to the metrics module)."""
+    class_names: set[str] = set()
+    module_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "metrics" or node.module.endswith(".metrics") \
+                    or node.module.endswith("utils.metrics"):
+                for alias in node.names:
+                    if alias.name in _CLASSES:
+                        class_names.add(alias.asname or alias.name)
+            if node.module.endswith("utils") or node.module == "utils":
+                for alias in node.names:
+                    if alias.name == "metrics":
+                        module_names.add(alias.asname or "metrics")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith(".metrics"):
+                    module_names.add(alias.asname or alias.name)
+    return class_names, module_names
+
+
+def check(mod: Module) -> list[Finding]:
+    if mod.path.endswith(_EXEMPT_SUFFIX):
+        return []
+    class_names, module_names = _metrics_bindings(mod.tree)
+    if not class_names and not module_names:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit: str | None = None
+        if isinstance(node.func, ast.Name) and node.func.id in class_names:
+            hit = node.func.id
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _CLASSES:
+            base = dotted_name(node.func.value)
+            if base in module_names:
+                hit = f"{base}.{node.func.attr}"
+        if hit is not None:
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, node.lineno, node.col_offset,
+                    f"direct {hit}(...) construction — use the Registry "
+                    "factories (registry.counter/gauge/histogram) so "
+                    "declarations de-duplicate and conflicts raise",
+                )
+            )
+    return findings

@@ -1,0 +1,79 @@
+"""Check: raw-env-read.
+
+Any ``os.environ``/``os.getenv`` READ of a ``COMETBFT_TPU_*`` name
+outside ``utils/envknobs.py``.  Every knob must be declared once in the
+registry (type, default, one-line doc) and read through its typed
+getters — that is what keeps ``docs/knobs.md`` the complete inventory
+and gives every reader the same malformed-value fallback.  Writes
+(``os.environ[k] = v``, ``pop``) are not flagged: the e2e runner
+legitimately scrubs and injects knobs into child-process environments.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, dotted_name
+
+CHECK_ID = "raw-env-read"
+SUMMARY = "COMETBFT_TPU_* env read outside utils/envknobs.py"
+
+PREFIX = "COMETBFT_TPU_"
+_EXEMPT_SUFFIX = "utils/envknobs.py"
+
+
+def _knob_literal(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value.startswith(PREFIX):
+            return node.value
+    return None
+
+
+def _is_environ(node: ast.expr) -> bool:
+    d = dotted_name(node)
+    return d is not None and (d == "environ" or d.endswith(".environ"))
+
+
+def check(mod: Module) -> list[Finding]:
+    if mod.path.endswith(_EXEMPT_SUFFIX):
+        return []
+    findings: list[Finding] = []
+
+    def add(node: ast.AST, name: str, how: str) -> None:
+        findings.append(
+            Finding(
+                CHECK_ID, mod.path, node.lineno, node.col_offset,
+                f"raw {how} of {name!r} — declare it in "
+                "utils/envknobs.py and read via the typed getters",
+            )
+        )
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None and node.args:
+                name = _knob_literal(node.args[0])
+                if name is None:
+                    continue
+                if d == "getenv" or d.endswith(".getenv"):
+                    add(node, name, "os.getenv")
+                elif (d == "environ.get" or d.endswith(".environ.get")):
+                    add(node, name, "os.environ.get")
+        elif isinstance(node, ast.Subscript):
+            if (
+                isinstance(node.ctx, ast.Load)
+                and _is_environ(node.value)
+            ):
+                name = _knob_literal(node.slice)
+                if name is not None:
+                    add(node, name, "os.environ[...] read")
+        elif isinstance(node, ast.Compare):
+            if (
+                len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and _is_environ(node.comparators[0])
+            ):
+                name = _knob_literal(node.left)
+                if name is not None:
+                    add(node, name, "`in os.environ` membership test")
+    return findings

@@ -1,0 +1,69 @@
+"""Check: swallowed-exception-in-thread.
+
+A bare ``except:`` anywhere, or a broad ``except Exception/BaseException``
+whose body is nothing but ``pass``/``...``.  In a daemon-thread run-loop
+this is the worst failure mode the repo has: the thread dies or skips
+work silently, consensus stalls, and nothing is logged, counted, or
+dumped — the exact bug class PR 2's flight recorder exists to expose.
+The fix is always one of: narrow the exception type, log at warning
+with context and bump an error counter, or both.
+
+Bare ``except:`` is flagged even with a non-trivial body because it also
+catches ``SystemExit``/``KeyboardInterrupt`` and breaks shutdown.
+Broad handlers that log/re-raise/record are fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, terminal_name
+
+CHECK_ID = "swallowed-exception-in-thread"
+SUMMARY = "bare `except:` or broad except-with-`pass`-only body"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_trivial_body(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            # `except Exception: continue` in a loop drops the error just
+            # as silently as `pass` — the iteration vanishes untraced
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None or isinstance(stmt.value, ast.Constant)
+        ):
+            # bailing out with a bare/constant return hides the error the
+            # same way; returning a computed fallback is a real handler
+            continue
+        return False
+    return True
+
+
+def check(mod: Module) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, node.lineno, node.col_offset,
+                    "bare `except:` also swallows SystemExit/"
+                    "KeyboardInterrupt — name the exception type",
+                )
+            )
+            continue
+        if terminal_name(node.type) in _BROAD and _is_trivial_body(node.body):
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, node.lineno, node.col_offset,
+                    f"`except {terminal_name(node.type)}` swallows the "
+                    "error with a pass-only body — log at warning with "
+                    "context and bump an error counter, or narrow the type",
+                )
+            )
+    return findings

@@ -1,0 +1,45 @@
+"""Check: unnamed-thread.
+
+Every ``threading.Thread(...)`` must pass ``name=`` and every
+``ThreadPoolExecutor(...)`` must pass ``thread_name_prefix=``: the
+lock-witness reports, flight-recorder thread dumps
+(utils/debugdump), and Perfetto traces (utils/tracing exports thread
+name metadata) are unreadable when half the rows say ``Thread-7``.
+This check makes the one-time naming sweep a permanent invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, Module, keyword_names, terminal_name
+
+CHECK_ID = "unnamed-thread"
+SUMMARY = "thread spawned without a human-readable name"
+
+
+def check(mod: Module) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tn = terminal_name(node.func)
+        if tn == "Thread" and "name" not in keyword_names(node):
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, node.lineno, node.col_offset,
+                    "threading.Thread(...) without name= — witness "
+                    "reports and trace exports need readable thread names",
+                )
+            )
+        elif (
+            tn == "ThreadPoolExecutor"
+            and "thread_name_prefix" not in keyword_names(node)
+        ):
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, node.lineno, node.col_offset,
+                    "ThreadPoolExecutor(...) without thread_name_prefix=",
+                )
+            )
+    return findings

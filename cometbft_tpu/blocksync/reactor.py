@@ -287,14 +287,11 @@ class BlocksyncReactor(Reactor):
 
     @classmethod
     def _verify_ahead_depth(cls) -> int:
-        import os
+        from ..utils import envknobs
 
-        v = os.environ.get("COMETBFT_TPU_VERIFY_AHEAD", "")
-        if v:
-            try:
-                return max(1, int(v))
-            except ValueError:
-                pass
+        v = envknobs.get_opt_int(envknobs.VERIFY_AHEAD)
+        if v is not None:
+            return max(1, v)
         return cls.VERIFY_AHEAD_DEPTH
 
     def _pool_routine(self) -> None:
@@ -389,11 +386,15 @@ class BlocksyncReactor(Reactor):
                     p = submit_verify_commit_light(
                         chain_id, vals, bid, hh, nxt.last_commit
                     )
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 # structurally bad / malformed peer data (bad commit, odd
                 # sig lengths, ...): leave it for the serial path, which
                 # owns the ban/refetch bookkeeping — never kill the sync
                 # thread over untrusted bytes
+                self.logger.debug(
+                    f"verify-ahead skip h={hh}: {e!r} "
+                    "(serial path owns ban/refetch)"
+                )
                 continue
             if p is None:
                 self._no_async_for = set_hash

@@ -599,6 +599,15 @@ def cmd_version(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # Opt-in runtime deadlock hunting (COMETBFT_TPU_LOCKCHECK=1|raise).
+    # Full coverage needs the install before the framework's import
+    # closure runs — __main__.py does that for `python -m cometbft_tpu`.
+    # This idempotent call is best-effort for in-process callers of
+    # main(): locks created at import time (tracing ring, metrics hub)
+    # are already raw and stay unwitnessed here.
+    from .analysis import lockwitness
+
+    lockwitness.maybe_install()
     p = argparse.ArgumentParser(prog="cometbft-tpu")
     p.add_argument("--home", default=os.environ.get("CMTHOME", DEFAULT_HOME))
     sub = p.add_subparsers(dest="command", required=True)

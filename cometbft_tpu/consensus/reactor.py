@@ -215,14 +215,18 @@ class ConsensusReactor(Reactor):
         # post-kill stall, root-caused round 5).
         if not self.wait_sync:
             self._send_round_step(peer)
+        short = peer.id[:8]
         threading.Thread(
-            target=self._gossip_data_routine, args=(peer, ps), daemon=True
+            target=self._gossip_data_routine, args=(peer, ps), daemon=True,
+            name=f"cs-gossip-data-{short}",
         ).start()
         threading.Thread(
-            target=self._gossip_votes_routine, args=(peer, ps), daemon=True
+            target=self._gossip_votes_routine, args=(peer, ps), daemon=True,
+            name=f"cs-gossip-votes-{short}",
         ).start()
         threading.Thread(
-            target=self._query_maj23_routine, args=(peer, ps), daemon=True
+            target=self._query_maj23_routine, args=(peer, ps), daemon=True,
+            name=f"cs-maj23-{short}",
         ).start()
 
     def remove_peer(self, peer, reason: str = "") -> None:

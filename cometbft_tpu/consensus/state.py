@@ -346,8 +346,11 @@ class ConsensusState(Service):
 
                     path = crash_report(f"consensus failure: {e!r}")
                     self.logger.error(f"crash report written to {path}")
-                except Exception:  # noqa: BLE001 — never mask the cause
-                    pass
+                except Exception as dump_err:  # noqa: BLE001 — never mask the cause
+                    self.logger.warning(
+                        f"crash report failed (original error {e!r} "
+                        f"stands): {dump_err!r}"
+                    )
                 return
 
     def _wal_write_msg(self, mi: MsgInfo) -> None:
@@ -604,7 +607,9 @@ class ConsensusState(Service):
             self.tx_notifier.txs_available().wait()
             self._queue.put(TimeoutInfo(0, height, round, STEP_NEW_ROUND))
 
-        threading.Thread(target=waiter, daemon=True).start()
+        threading.Thread(
+            target=waiter, daemon=True, name="cs-tx-waiter"
+        ).start()
 
     # ------------------------------------------------------------ propose
 

@@ -14,20 +14,19 @@ CPU backend in tests).
 
 from __future__ import annotations
 
-import os
-
 from ..models.verifier import (
     BatchVerifier,
     CpuEd25519BatchVerifier,
     TpuEd25519BatchVerifier,
 )
+from ..utils import envknobs
 from . import ed25519
 
 _BATCH_MIN = 2  # below this, single verification is cheaper (validation.go:15)
 
 
 def backend() -> str:
-    return os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND", "auto")
+    return envknobs.get_str(envknobs.CRYPTO_BACKEND)
 
 
 def supports_batch_verifier(key_type: str) -> bool:
@@ -39,10 +38,7 @@ def comb_min() -> int:
     Below it the one-time table build + per-set compiled program don't pay
     for themselves (and the CPU-backend test suite stays off the
     minutes-long comb compile)."""
-    try:
-        return int(os.environ.get("COMETBFT_TPU_COMB_MIN", "512"))
-    except ValueError:
-        return 512
+    return envknobs.get_int(envknobs.COMB_MIN)
 
 
 def comb_async_min() -> int:
@@ -52,10 +48,7 @@ def comb_async_min() -> int:
     expanded-key LRU likewise fills lazily, ed25519.go:43,68).  Smaller
     sets build synchronously: their build is fast and callers (and
     tests) get the comb verifier deterministically on first use."""
-    try:
-        return int(os.environ.get("COMETBFT_TPU_COMB_ASYNC_MIN", "2048"))
-    except ValueError:
-        return 2048
+    return envknobs.get_int(envknobs.COMB_ASYNC_MIN)
 
 
 def create_batch_verifier(

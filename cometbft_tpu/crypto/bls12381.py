@@ -1046,10 +1046,10 @@ def fast_aggregate_verify(pubkeys: list["PubKey"], msg: bytes, agg_sig: bytes) -
     pairings always run on host (SURVEY §7 staging)."""
     if not pubkeys:
         return False
-    import os as _os
+    from ..utils import envknobs
 
     agg_aff = None
-    if _os.environ.get("COMETBFT_TPU_BLS_DEVICE") == "1" and len(pubkeys) >= 8:
+    if envknobs.get_bool(envknobs.BLS_DEVICE) and len(pubkeys) >= 8:
         from ..ops import bls381 as _dev
 
         # pass the already-validated affine points; re-decompressing the

@@ -143,7 +143,10 @@ class LoadGenerator:
                     time.sleep(sleep)
 
         threads = [
-            threading.Thread(target=conn_worker, args=(i,), daemon=True)
+            threading.Thread(
+                target=conn_worker, args=(i,), daemon=True,
+                name=f"load-conn-{i}",
+            )
             for i in range(self.connections)
         ]
         for t in threads:

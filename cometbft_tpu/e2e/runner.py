@@ -17,6 +17,9 @@ from dataclasses import dataclass, field
 
 from ..cli import main as cli_main
 from ..config import load_config, save_config
+from ..utils.log import get_logger
+
+_log = get_logger("e2e.runner")
 
 
 @dataclass
@@ -244,8 +247,15 @@ class Runner:
                 if spec.state_sync:
                     try:
                         self._configure_statesync(node, spec)
-                    except Exception:  # noqa: BLE001
-                        continue  # trust root not available yet; retry
+                    except Exception as e:  # noqa: BLE001 — retried next round
+                        # usually just "trust root not available yet", but a
+                        # persistent failure (config write error) must be
+                        # findable, not an eternally silent non-start
+                        _log.debug(
+                            f"statesync config for {node.name} not ready, "
+                            f"will retry: {e!r}"
+                        )
+                        continue
                 node.start()
 
     def _configure_statesync(self, node: E2ENode, spec: NodeSpec) -> None:
@@ -273,14 +283,23 @@ class Runner:
         for node in self.nodes:
             if node.proc is None:
                 continue
+            failed = 0
+            last_err: Exception | None = None
             for j in range(self.m.load_tx_per_round):
                 tx = f"load-{round_id}-{j}={node.name}".encode()
                 try:
                     import base64
 
                     node.rpc("broadcast_tx_sync", tx=base64.b64encode(tx).decode())
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — load-gen rides out node restarts
+                    failed += 1
+                    last_err = e
+            if failed:
+                _log.warning(
+                    f"load round {round_id} via {node.name}: {failed}/"
+                    f"{self.m.load_tx_per_round} submissions failed "
+                    f"(last: {last_err!r})"
+                )
             break
 
     def perturb(self) -> None:
@@ -349,8 +368,8 @@ class Runner:
                 continue
             try:
                 apps.add(node.rpc("status")["sync_info"]["latest_app_hash"])
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — probing possibly-dead nodes
+                _log.debug(f"status probe of {node.name} failed: {e!r}")
         # nodes may be at different heights; only flag if everyone reports
         # the same height but different app hashes
         heights = set(self._heights(only_running=True))
@@ -415,6 +434,6 @@ class Runner:
                 continue
             try:
                 out.append(node.height())
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — probing possibly-dead nodes
+                _log.debug(f"height probe of {node.name} failed: {e!r}")
         return out

@@ -41,7 +41,8 @@ class EvidenceReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         threading.Thread(
-            target=self._broadcast_routine, args=(peer,), daemon=True
+            target=self._broadcast_routine, args=(peer,), daemon=True,
+            name=f"ev-broadcast-{peer.id[:8]}",
         ).start()
 
     def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:

@@ -15,6 +15,10 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils.log import get_logger
+
+_log = get_logger("indexer.sink")
+
 # {pk} / {blob} swap per SQL dialect (sqlite vs postgres)
 SCHEMA = [
     """CREATE TABLE IF NOT EXISTS blocks (
@@ -198,8 +202,8 @@ class SQLEventSink:
     def close(self) -> None:
         try:
             self._conn.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — dialect-specific close errors
+            _log.debug(f"indexer sink close failed: {e!r}")
 
 
 class TxSinkAdapter:

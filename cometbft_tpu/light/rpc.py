@@ -25,6 +25,7 @@ import base64
 import datetime
 
 from ..crypto.encoding import pubkey_from_type_and_bytes
+from ..utils.log import get_logger
 from ..types.tx import tx_hash, tx_proof
 from ..types.block import BlockID, Commit, CommitSig, Header, PartSetHeader
 from ..types.light_block import LightBlock, SignedHeader
@@ -32,6 +33,8 @@ from ..types.validators import Validator, ValidatorSet
 from ..wire import types_pb as pb
 from ..wire.canonical import Timestamp
 from .provider import ErrBadLightBlock, ErrHeightTooHigh, ErrLightBlockNotFound
+
+_log = get_logger("light.rpc")
 
 _AMINO_TO_KEY_TYPE = {
     "tendermint/PubKeyEd25519": "ed25519",
@@ -175,8 +178,8 @@ class HTTPProvider:
         # broadcast_evidence over RPC (provider/http reports attacks back)
         try:
             self.rpc.call("broadcast_evidence", evidence=ev)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — best-effort report to one provider
+            _log.warning(f"evidence report to provider failed: {e!r}")
 
     def consensus_params(self, height: int):
         """params_source seam for the statesync state provider

@@ -109,7 +109,8 @@ class MempoolReactor(Reactor):
     def add_peer(self, peer) -> None:
         if self.mempool.config.broadcast:
             threading.Thread(
-                target=self._broadcast_tx_routine, args=(peer,), daemon=True
+                target=self._broadcast_tx_routine, args=(peer,), daemon=True,
+                name=f"mp-broadcast-{peer.id[:8]}",
             ).start()
 
     # ------------------------------------------------------------ receive

@@ -116,9 +116,9 @@ def active_mesh():
     """
     global _MESH
     if _MESH is _UNSET:
-        import os
+        from ..utils import envknobs
 
-        n = int(os.environ.get("COMETBFT_TPU_MESH", "0") or 0)
+        n = envknobs.get_int(envknobs.MESH)
         if n <= 1:
             _MESH = None
         else:

@@ -62,12 +62,11 @@ def _next_bucket(n: int) -> int:
 def _device_batch_min() -> int:
     import os
 
-    v = os.environ.get("COMETBFT_TPU_DEVICE_BATCH_MIN", "")
-    if v:
-        try:
-            return int(v)
-        except ValueError:
-            pass
+    from ..utils import envknobs
+
+    v = envknobs.get_opt_int(envknobs.DEVICE_BATCH_MIN)
+    if v is not None:
+        return v
     # Default is link-aware: through a remote device tunnel (axon) every
     # call pays ~85 ms host->device latency plus ~85 ms per result fetch
     # (measured, scripts/profile_tunnel.py), so batches under ~2k

@@ -609,6 +609,15 @@ class Node:
                 ).start()
                 self.logger.info(f"debug/profiling endpoints on {paddr}")
 
+    def _stop_quietly(self, label: str, fn) -> None:
+        """Shutdown must reach every subsystem even when one of them
+        fails to die cleanly — but a failure is a leak suspect (socket,
+        thread, fd), never silent."""
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — keep tearing down the rest
+            self.logger.warning(f"{label} shutdown failed: {e!r}")
+
     def stop(self) -> None:
         from .types import validation as _validation
 
@@ -617,48 +626,31 @@ class Node:
         ):
             _validation.VERIFY_LATENCY_OBSERVER = None
         if self._metrics_httpd is not None:
-            try:
-                self._metrics_httpd.shutdown()
-                self._metrics_httpd.server_close()
-            except Exception:  # noqa: BLE001
-                pass
+            self._stop_quietly("metrics httpd", self._metrics_httpd.shutdown)
+            self._stop_quietly("metrics httpd", self._metrics_httpd.server_close)
         if self._pprof_httpd is not None:
-            try:
-                self._pprof_httpd.shutdown()
-                self._pprof_httpd.server_close()
-            except Exception:  # noqa: BLE001
-                pass
+            self._stop_quietly("pprof httpd", self._pprof_httpd.shutdown)
+            self._stop_quietly("pprof httpd", self._pprof_httpd.server_close)
         if self.rpc_server is not None:
-            try:
-                self.rpc_server.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            self._stop_quietly("rpc server", self.rpc_server.stop)
         if self.companion_server is not None:
-            try:
-                self.companion_server.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            self._stop_quietly("companion server", self.companion_server.stop)
         if self.companion_privileged_server is not None:
-            try:
-                self.companion_privileged_server.stop()
-            except Exception:  # noqa: BLE001
-                pass
-        try:
-            self.switch.stop()
-        except Exception:  # noqa: BLE001
-            pass
+            self._stop_quietly(
+                "companion privileged server",
+                self.companion_privileged_server.stop,
+            )
+        self._stop_quietly("switch", self.switch.stop)
         if self.indexer_service.is_running():
-            self.indexer_service.stop()
+            self._stop_quietly("indexer service", self.indexer_service.stop)
         if self.pruner.is_running():
-            self.pruner.stop()
+            self._stop_quietly("pruner", self.pruner.stop)
         if self.signer_endpoint is not None:
-            self.signer_endpoint.close()
+            self._stop_quietly("signer endpoint", self.signer_endpoint.close)
         if self.pex_reactor is not None:
-            try:
-                self.addr_book.save()  # keep PEX-learned peers for restart
-            except Exception:  # noqa: BLE001
-                pass
-        self.app_conns.stop()
+            # keep PEX-learned peers for restart
+            self._stop_quietly("addr book save", self.addr_book.save)
+        self._stop_quietly("abci connections", self.app_conns.stop)
 
     def is_running(self) -> bool:
         return self.switch.is_running()

@@ -237,7 +237,9 @@ def _b_tables_cached() -> np.ndarray:
     """Disk-cache the constant table next to the JAX compile cache."""
     import os
 
-    cache = os.environ.get("COMETBFT_TPU_BTAB_CACHE", "")
+    from ..utils import envknobs
+
+    cache = envknobs.get_str(envknobs.BTAB_CACHE)
     if cache and not cache.endswith(".npy"):
         cache += ".npy"  # np.save appends it; np.load would miss the file
     if cache:
@@ -267,9 +269,9 @@ def tree_enabled() -> bool:
     log-depth tree reduction.  Read at TRACE time: programs already
     compiled keep the path they were traced with, so flip the flag
     before the first verify of a process (or use a fresh jit wrapper)."""
-    import os
+    from ..utils import envknobs
 
-    return os.environ.get("COMETBFT_TPU_COMB_TREE", "1") != "0"
+    return envknobs.get_bool(envknobs.COMB_TREE)
 
 
 def accumulation_depth() -> int:

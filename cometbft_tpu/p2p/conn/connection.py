@@ -140,8 +140,13 @@ class MConnection(Service):
             self._errored = True
             try:
                 self.stop()
-            except Exception:
-                pass
+            except Exception as stop_err:  # noqa: BLE001 — on_error must still fire
+                # the teardown failing is secondary to the original error
+                # `e`, but a silent drop here hides leaked sockets/threads
+                self.logger.warning(
+                    f"mconn stop failed while handling {e!r}: {stop_err!r}"
+                )
+                _metrics_hub().p2p_errors.inc(site="mconn_stop")
             self.on_error(e)
 
     # ------------------------------------------------------------ sending

@@ -71,7 +71,8 @@ class PexReactor(Reactor):
                 self.book.add_address(f"{peer.id}@{host}", src=peer.id)
         if peer.has_channel(PEX_STREAM):
             threading.Thread(
-                target=self._request_routine, args=(peer,), daemon=True
+                target=self._request_routine, args=(peer,), daemon=True,
+                name=f"pex-request-{peer.id[:8]}",
             ).start()
 
     def remove_peer(self, peer, reason: str = "") -> None:

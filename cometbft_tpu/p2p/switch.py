@@ -13,6 +13,7 @@ import threading
 import time
 
 from ..utils.log import get_logger
+from ..utils.metrics import hub as _metrics_hub
 from ..utils.service import Service
 from .conn.connection import StreamDescriptor
 from .peer import Peer, PeerSet
@@ -135,7 +136,8 @@ class Switch(Service):
         if persistent:
             self.persistent_addrs.add(addr)
         threading.Thread(
-            target=self._dial_routine, args=(addr, persistent), daemon=True
+            target=self._dial_routine, args=(addr, persistent), daemon=True,
+            name=f"switch-dial-{addr}",
         ).start()
 
     def dial_peers_async(self, addrs: list[str], persistent: bool = False) -> None:
@@ -265,8 +267,14 @@ class Switch(Service):
         try:
             if peer.is_running():
                 peer.stop()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown must reach every reactor
+            # a peer that fails to stop cleanly still leaves the PeerSet;
+            # dropping the error silently would hide socket/thread leaks
+            self.logger.warning(
+                f"peer {peer.id[:8]} stop failed "
+                f"(reason={reason or 'unspecified'!s}): {e!r}"
+            )
+            _metrics_hub().p2p_errors.inc(site="peer_stop")
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)

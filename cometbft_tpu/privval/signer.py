@@ -125,8 +125,12 @@ class SignerListenerEndpoint:
         self._conn = None
         self._conn_ready = threading.Event()
         self._stopped = False
-        threading.Thread(target=self._accept_routine, daemon=True).start()
-        threading.Thread(target=self._ping_routine, daemon=True).start()
+        threading.Thread(
+            target=self._accept_routine, daemon=True, name="privval-accept"
+        ).start()
+        threading.Thread(
+            target=self._ping_routine, daemon=True, name="privval-ping"
+        ).start()
 
     def _accept_routine(self) -> None:
         while not self._stopped:
@@ -364,7 +368,9 @@ class SignerServer:
         self._active = None  # the live conn, closed by stop()
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="privval-serve"
+        )
         self._thread.start()
 
     def stop(self) -> None:

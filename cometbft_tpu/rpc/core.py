@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 
 from ..mempool.mempool import MempoolError
+from ..utils.log import get_logger
 from ..types.event_bus import EventQueryTx
 from ..wire import abci_pb as abci
 from ..indexer import tx_hash
@@ -25,6 +26,8 @@ from .serializers import (
     tx_result_json,
     validator_json,
 )
+
+_log = get_logger("rpc.core")
 
 
 class RPCError(Exception):
@@ -567,15 +570,18 @@ class Environment:
         from ..crypto import hash as tmhash
 
         threading.Thread(
-            target=self._check_tx_quiet, args=(tx,), daemon=True
+            target=self._check_tx_quiet, args=(tx,), daemon=True,
+            name="rpc-checktx",
         ).start()
         return {"code": 0, "data": "", "log": "", "hash": hex_up(tmhash.sum(tx))}
 
     def _check_tx_quiet(self, tx: bytes) -> None:
         try:
             self.node.mempool.check_tx(tx)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — async broadcast reports nothing
+            # rejected txs are normal here (broadcast_tx_async has no
+            # reply channel); debug keeps the reason findable without spam
+            _log.debug(f"async check_tx failed: {e!r}")
 
     def broadcast_tx_sync(self, tx: bytes) -> dict:
         from ..crypto import hash as tmhash

@@ -123,7 +123,10 @@ class GrpcCompanionServer(Service):
                 )
 
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=4), handlers=(Handler(),)
+            futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="rpc-grpc"
+            ),
+            handlers=(Handler(),)
         )
         self.port = self._server.add_insecure_port(self.addr)
         if self.port == 0:

@@ -270,7 +270,8 @@ class RPCServer:
                         continue
                     subs[q] = sub
                     threading.Thread(
-                        target=pump, args=(q, sub), daemon=True
+                        target=pump, args=(q, sub), daemon=True,
+                        name="rpc-ws-pump",
                     ).start()
                     send_text({"jsonrpc": "2.0", "id": rid, "result": {}})
                 elif method == "unsubscribe":

@@ -123,7 +123,8 @@ class CompanionServiceServer(Service):
             with self._mtx:
                 self._conns.add(conn)
             threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="svc-conn",
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -140,6 +141,7 @@ class CompanionServiceServer(Service):
                         target=self._stream_latest_height,
                         args=(conn, send_mtx, req.id),
                         daemon=True,
+                        name="svc-height-stream",
                     ).start()
                     continue
                 resp = self._dispatch(req)
@@ -230,8 +232,8 @@ class CompanionServiceServer(Service):
             if sub is not None:
                 try:
                     self.event_bus.unsubscribe(subscriber, EventQueryNewBlock)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — teardown after stream end
+                    self.logger.debug(f"unsubscribe {subscriber} failed: {e!r}")
 
     def _stream_latest_height(self, conn, send_mtx, req_id: int) -> None:
         """Socket framing over latest_heights(); the subscription is torn

@@ -192,6 +192,7 @@ class StatesyncReactor(Reactor):
             target=self._sync_routine,
             args=(state_store, block_store, discovery_time, max_discovery_time),
             daemon=True,
+            name="statesync-sync",
         ).start()
 
     def _sync_routine(

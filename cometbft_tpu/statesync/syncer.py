@@ -178,11 +178,12 @@ class Syncer:
 
             self._offer_snapshot(snapshot)
 
-            for _ in range(self.chunk_fetchers):
+            for i in range(self.chunk_fetchers):
                 threading.Thread(
                     target=self._fetch_chunks,
                     args=(snapshot, chunks, stop_fetch),
                     daemon=True,
+                    name=f"statesync-fetch-{i}",
                 ).start()
 
             # optimistically build the post-snapshot state so light-client

@@ -1,0 +1,231 @@
+"""Central registry of every COMETBFT_TPU_* environment knob.
+
+Each knob is declared exactly once — name, type, default, and a one-line
+doc — and read through the typed getters below.  Reading a knob that was
+never declared raises ``KeyError`` loudly: the registry IS the inventory,
+and the static linter (analysis/raw_env) rejects any
+``os.environ``/``getenv`` read of a ``COMETBFT_TPU_*`` name outside this
+module, so a knob cannot exist without documentation.
+
+``docs/knobs.md`` is generated from this registry
+(``python -m cometbft_tpu.utils.envknobs``); a test asserts the checked-in
+copy matches, so the doc cannot drift.
+
+Parsing is deliberately forgiving (malformed values fall back to the
+declared default) because knobs are operator input read on hot-path
+module imports — a typo must degrade to the default, never crash a node.
+This module imports only the stdlib so every subsystem (logging included)
+can depend on it without cycles.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_TRUE = frozenset({"1", "true", "yes", "on"})
+_FALSE = frozenset({"0", "false", "no", "off"})
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str  # "str" | "int" | "bool" | "int?"
+    default: object
+    doc: str
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+
+def _declare(name: str, type_: str, default, doc: str) -> str:
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name!r} declared twice")
+    _REGISTRY[name] = Knob(name, type_, default, doc)
+    return name
+
+
+# --------------------------------------------------------------- knobs
+# (grouped by subsystem; order is the order docs/knobs.md renders)
+
+# crypto / verification plane
+CRYPTO_BACKEND = _declare(
+    "COMETBFT_TPU_CRYPTO_BACKEND", "str", "auto",
+    "Batch-verifier backend: `tpu` | `cpu` | `auto` "
+    "(auto = accelerator kernel whenever JAX is importable).",
+)
+COMB_MIN = _declare(
+    "COMETBFT_TPU_COMB_MIN", "int", 512,
+    "Minimum validator-set size for the device-resident comb-table path; "
+    "below it the table build + per-set compiled program don't pay off.",
+)
+COMB_ASYNC_MIN = _declare(
+    "COMETBFT_TPU_COMB_ASYNC_MIN", "int", 2048,
+    "Set size at/above which a missing comb table builds in the background "
+    "while verification proceeds through the uncached kernel.",
+)
+COMB_TREE = _declare(
+    "COMETBFT_TPU_COMB_TREE", "bool", True,
+    "`0` selects the sequential fori_loop comb accumulation (the bit-exact "
+    "cross-check path) instead of the log-depth tree reduction.",
+)
+BTAB_CACHE = _declare(
+    "COMETBFT_TPU_BTAB_CACHE", "str", "",
+    "Path (`.npy` appended if missing) disk-caching the constant "
+    "basepoint comb tables across processes.",
+)
+MESH = _declare(
+    "COMETBFT_TPU_MESH", "int", 0,
+    "Shard comb tables + signature rows over the first N devices (N > 1); "
+    "unset/0/1 keeps the single-device program.",
+)
+DEVICE_BATCH_MIN = _declare(
+    "COMETBFT_TPU_DEVICE_BATCH_MIN", "int?", None,
+    "Batch width at/above which signatures route to the device kernels; "
+    "unset = link-aware default (2048 through the axon tunnel, else 32).",
+)
+BLS_DEVICE = _declare(
+    "COMETBFT_TPU_BLS_DEVICE", "bool", False,
+    "`1` tree-reduces BLS pubkey aggregation on the accelerator "
+    "(ops/bls381); pairings always run on host.",
+)
+
+# blocksync
+VERIFY_AHEAD = _declare(
+    "COMETBFT_TPU_VERIFY_AHEAD", "int?", None,
+    "Blocksync verify-ahead pipeline depth; unset = "
+    "BlocksyncReactor.VERIFY_AHEAD_DEPTH (2).  Clamped to >= 1.",
+)
+
+# observability
+LOG_LEVEL = _declare(
+    "COMETBFT_TPU_LOG_LEVEL", "str", "INFO",
+    "Root level for the `cometbft_tpu` logger tree.",
+)
+TRACE = _declare(
+    "COMETBFT_TPU_TRACE", "str", "",
+    "Span tracer switch: any truthy value records; a path value "
+    "(contains the os separator or ends in `.json`) also auto-exports "
+    "Chrome trace JSON at interpreter exit.",
+)
+TRACE_RING = _declare(
+    "COMETBFT_TPU_TRACE_RING", "int", 65536,
+    "Tracer ring capacity in events (clamped to >= 1).",
+)
+FLIGHTREC = _declare(
+    "COMETBFT_TPU_FLIGHTREC", "int", 1024,
+    "Consensus flight-recorder ring capacity (clamped to >= 1).",
+)
+
+# analysis / correctness tooling
+LOCKCHECK = _declare(
+    "COMETBFT_TPU_LOCKCHECK", "bool", False,
+    "`1` installs the runtime lock-order witness "
+    "(analysis/lockwitness): lock acquisitions build an order graph and "
+    "inversions/blocking-while-locked are reported with both stacks.  "
+    "The special value `raise` additionally raises in the acquiring "
+    "thread (read raw by `maybe_install`, not via `get_bool`, which "
+    "treats it as unset).  The test conftest turns the witness on for "
+    "every suite run.",
+)
+
+# test-only
+TEST_LATENCY_MS = _declare(
+    "COMETBFT_TPU_TEST_LATENCY_MS", "str", "",
+    "Inject `delay` or `delay:jitter` milliseconds on every p2p "
+    "connection (e2e perturbation harness only; never set in production).",
+)
+
+
+# -------------------------------------------------------------- getters
+
+def knob(name: str) -> Knob:
+    return _REGISTRY[name]
+
+
+def all_knobs() -> list[Knob]:
+    return list(_REGISTRY.values())
+
+
+def raw(name: str) -> str | None:
+    """The raw env value, or None when unset.  For the rare reader whose
+    semantics don't fit the typed getters (e.g. the tracer's
+    truthy-or-path switch); the knob must still be declared."""
+    _REGISTRY[name]  # undeclared knob = programming error
+    return os.environ.get(name)
+
+
+def get_str(name: str) -> str:
+    k = _REGISTRY[name]
+    v = os.environ.get(name)
+    return v if v is not None else k.default
+
+
+def get_int(name: str) -> int:
+    k = _REGISTRY[name]
+    v = os.environ.get(name, "")
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return k.default
+
+
+def get_opt_int(name: str) -> int | None:
+    """None when unset/empty/malformed — the caller owns the fallback
+    (used for knobs whose default is computed, not constant)."""
+    _REGISTRY[name]
+    v = os.environ.get(name, "")
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return None
+
+
+def get_bool(name: str) -> bool:
+    k = _REGISTRY[name]
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        # set-but-empty (`KNOB= cmd` shell idiom) means "default", not
+        # False — flipping a kernel-path knob on an empty string would
+        # silently select a different compiled program
+        return k.default
+    s = v.strip().lower()
+    if s in _TRUE:
+        return True
+    if s in _FALSE:
+        return False
+    return k.default
+
+
+# --------------------------------------------------------- doc generation
+
+def to_markdown() -> str:
+    """Render docs/knobs.md — regenerate with
+    ``python -m cometbft_tpu.utils.envknobs > docs/knobs.md``."""
+    lines = [
+        "# Environment knobs",
+        "",
+        "Generated from `cometbft_tpu/utils/envknobs.py` — do not edit by "
+        "hand; regenerate with `python -m cometbft_tpu.utils.envknobs > "
+        "docs/knobs.md`.  Every `COMETBFT_TPU_*` knob is declared in that "
+        "registry and read through its typed getters; the static linter "
+        "(`scripts/lint.py`, check `raw-env-read`) rejects reads anywhere "
+        "else, so this table is the complete inventory.",
+        "",
+        "| Knob | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for k in all_knobs():
+        default = "*(unset)*" if k.default is None else f"`{k.default!r}`"
+        doc = k.doc.replace("|", "\\|")
+        lines.append(f"| `{k.name}` | {k.type} | {default} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(to_markdown(), end="")

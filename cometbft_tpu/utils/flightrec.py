@@ -105,14 +105,9 @@ class FlightRecorder:
 
 
 def _capacity_from_env() -> int:
-    import os
+    from . import envknobs
 
-    try:
-        return max(
-            1, int(os.environ.get("COMETBFT_TPU_FLIGHTREC", "") or 1024)
-        )
-    except ValueError:
-        return 1024
+    return max(1, envknobs.get_int(envknobs.FLIGHTREC))
 
 
 _REC = FlightRecorder(_capacity_from_env())

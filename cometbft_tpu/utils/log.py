@@ -4,8 +4,9 @@ libs/log/, filter.go)."""
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from . import envknobs
 
 _CONFIGURED = False
 
@@ -14,7 +15,7 @@ def _configure() -> None:
     global _CONFIGURED
     if _CONFIGURED:
         return
-    level = os.environ.get("COMETBFT_TPU_LOG_LEVEL", "INFO").upper()
+    level = envknobs.get_str(envknobs.LOG_LEVEL).upper()
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(
         logging.Formatter(

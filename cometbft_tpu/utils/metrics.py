@@ -303,6 +303,11 @@ class Hub:
             "p2p_message_receive_count",
             "Complete messages received (label ch_id)",
         )
+        self.p2p_errors = r.counter(
+            "p2p_errors_total",
+            "Non-fatal p2p errors that were logged and swallowed "
+            "(label site=peer_stop|mconn_stop|...)",
+        )
         # ---- consensus control plane
         self.cs_timeout_fired = r.counter(
             "consensus_timeout_fired_total",

@@ -95,9 +95,9 @@ def maybe_shape_latency(conn):
     """Wrap conn in LatencyConn when COMETBFT_TPU_TEST_LATENCY_MS is set
     (value 'delay' or 'delay:jitter', milliseconds).  Production nodes
     never set it; the e2e runner sets it per node process."""
-    import os
+    from . import envknobs
 
-    spec = os.environ.get("COMETBFT_TPU_TEST_LATENCY_MS", "")
+    spec = envknobs.get_str(envknobs.TEST_LATENCY_MS)
     if not spec:
         return conn
     try:

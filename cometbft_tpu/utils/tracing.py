@@ -30,6 +30,8 @@ import threading
 import time
 import weakref
 
+from . import envknobs
+
 _OFF_VALUES = ("", "0", "false", "off", "no")
 _ON_VALUES = ("1", "true", "on", "yes")
 
@@ -287,7 +289,7 @@ def _atexit_export() -> None:
         pass
 
 
-_v = os.environ.get("COMETBFT_TPU_TRACE", "")
+_v = envknobs.get_str(envknobs.TRACE)
 if _v.lower() not in _OFF_VALUES:
     _ENABLED = True
     if _v.lower() not in _ON_VALUES and (os.sep in _v or _v.endswith(".json")):
@@ -298,8 +300,5 @@ if _v.lower() not in _OFF_VALUES:
         import atexit
 
         atexit.register(_atexit_export)
-try:
-    _ring_cap = max(1, int(os.environ.get("COMETBFT_TPU_TRACE_RING", "") or _DEFAULT_RING))
-except ValueError:
-    _ring_cap = _DEFAULT_RING
+_ring_cap = max(1, envknobs.get_int(envknobs.TRACE_RING))
 del _v

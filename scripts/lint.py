@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Repo linter entry point — the `go vet` of this codebase.
+
+    python scripts/lint.py [paths...] [--json] [--list-checks]
+
+Runs every check in cometbft_tpu/analysis over the given paths (default:
+the cometbft_tpu package), filters through the checked-in allowlist
+(cometbft_tpu/analysis/allowlist.txt), and exits non-zero when any
+non-allowlisted finding remains.  Stale allowlist entries are reported
+on stderr (and under "stale_allowlist" in --json) but don't fail the
+run.  Check toggles live in pyproject.toml:
+
+    [tool.cometbft-tpu-lint]
+    disable = ["check-id", ...]
+    allowlist = "cometbft_tpu/analysis/allowlist.txt"
+
+The gate test (tests/test_static_analysis.py) runs the same machinery,
+so a finding that would fail this script also fails the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.analysis import linter  # noqa: E402
+
+try:
+    import tomllib
+except ImportError:  # py3.10 host: the repo's minimal reader
+    from cometbft_tpu.utils import minitoml as tomllib
+
+
+def load_config(pyproject: str) -> dict:
+    """The [tool.cometbft-tpu-lint] table, {} when absent.  Handles both
+    real tomllib nesting and minitoml's flat dotted-header tables."""
+    try:
+        with open(pyproject, "rb") as f:
+            data = tomllib.load(f)
+    except (FileNotFoundError, ValueError):
+        return {}
+    flat = data.get("tool.cometbft-tpu-lint")
+    if isinstance(flat, dict):
+        return flat
+    nested = data.get("tool", {}).get("cometbft-tpu-lint")
+    return nested if isinstance(nested, dict) else {}
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument(
+        "--config",
+        default=os.path.join(repo_root, "pyproject.toml"),
+        help="pyproject.toml with [tool.cometbft-tpu-lint]",
+    )
+    ap.add_argument(
+        "--allowlist",
+        default=None,
+        help="override the allowlist path (config/default otherwise)",
+    )
+    args = ap.parse_args(argv)
+
+    checks = linter.all_checks()
+    if args.list_checks:
+        for cid, m in checks.items():
+            print(f"{cid}: {m.SUMMARY}")
+        return 0
+
+    cfg = load_config(args.config)
+    disable = set(cfg.get("disable", ()))
+    unknown = disable - set(checks)
+    if unknown:
+        print(f"config disables unknown check(s): {sorted(unknown)}",
+              file=sys.stderr)
+        return 2
+    allowlist_path = args.allowlist or cfg.get(
+        "allowlist", linter.default_allowlist_path()
+    )
+    if not os.path.isabs(allowlist_path) and not os.path.exists(allowlist_path):
+        allowlist_path = os.path.join(repo_root, allowlist_path)
+
+    paths = args.paths or [os.path.join(repo_root, "cometbft_tpu")]
+    allowlist = linter.Allowlist.load(allowlist_path)
+    try:
+        findings, stale = linter.lint_paths(
+            paths, checks=checks, allowlist=allowlist, disable=disable
+        )
+    except FileNotFoundError as e:
+        # a typo'd path linting zero files must not read as a clean pass
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "check": f.check, "path": f.path, "line": f.line,
+                        "col": f.col, "message": f.message,
+                    }
+                    for f in findings
+                ],
+                "stale_allowlist": [
+                    {"check": e.check, "path": e.path, "line": e.line,
+                     "allowlist_line": e.lineno}
+                    for e in stale
+                ],
+                "ok": not findings,
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(
+                f"stale allowlist entry (line {e.lineno}): {e.check} "
+                f"{e.path}{':' + str(e.line) if e.line else ''} — "
+                "matched nothing; remove it",
+                file=sys.stderr,
+            )
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Lock-order witness (analysis/lockwitness): ON for every suite run —
+# each run doubles as a deadlock hunt — unless explicitly disabled with
+# COMETBFT_TPU_LOCKCHECK=0.  Installed here, after jax (whose import-time
+# internals we don't want to witness) and BEFORE any other cometbft_tpu
+# module import, so every lock the framework creates is wrapped.  The
+# knob is read raw because importing utils.envknobs would drag in
+# utils/__init__ (service, logging) ahead of the install; lockwitness
+# itself is stdlib-only and exports the get_bool-mirroring spellings.
+from cometbft_tpu.analysis import lockwitness as _lockwitness  # noqa: E402
+
+_lockcheck = os.environ.get("COMETBFT_TPU_LOCKCHECK", "").strip().lower()
+if _lockcheck not in _lockwitness.FALSE_SPELLINGS:
+    _lockwitness.install(raise_on_violation=_lockcheck == "raise")
+else:
+    _lockwitness = None
+
 # Persistent compilation cache: the Ed25519 kernel takes minutes to compile
 # on the CPU backend; cache compiled executables across test runs.
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
@@ -83,6 +99,29 @@ def _watchdog_must_not_fire():
     assert after == before, (
         f"consensus watchdog re-kicked {after - before}x during this test: "
         "a scheduled timeout evaporated (see state.py _watchdog_routine)"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_lock_order_violations():
+    """Fail the test during which the lock witness recorded an order
+    cycle or a sleep-while-locked, pinning the blame to the scenario
+    that produced it (mirrors the watchdog fixture above).  Violations
+    raised by background daemon threads land on whichever test is
+    running — close enough to identify the culprit."""
+    if _lockwitness is None:
+        yield
+        return
+    # snapshot by identity, not index: lockwitness.clear() (used by the
+    # witness's own tests to scrub intentional violations) would strand
+    # an index snapshot past the list end and mask later real violations
+    before = _lockwitness.violations()  # pins the objects against id reuse
+    before_ids = {id(v) for v in before}
+    yield
+    new = [v for v in _lockwitness.violations() if id(v) not in before_ids]
+    assert not new, (
+        "lock witness recorded violation(s) during this test:\n"
+        + "\n".join(v.render() for v in new)
     )
 
 

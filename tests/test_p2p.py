@@ -289,8 +289,18 @@ def test_switch_broadcast_reaches_all_peers():
         sw.start()
         sw.dial_peer_async(addr)
     try:
+        # wait until the peers are not just counted but RUNNING: the
+        # switch registers a peer in the PeerSet before peer.start(), so
+        # a broadcast in that window try_sends into a stopped mconn and
+        # is (by design — broadcast is best-effort) silently dropped
         deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and center.num_peers() < 3:
+        while time.monotonic() < deadline and (
+            center.num_peers() < 3
+            or not all(
+                p.is_running() and p.mconn.is_running()
+                for p in center.peers.list()
+            )
+        ):
             time.sleep(0.05)
         assert center.num_peers() == 3
         center.broadcast(1, b"announce")
