@@ -1,15 +1,25 @@
 """Concurrency & invariant analysis for the cometbft_tpu codebase.
 
-Two halves:
+Three halves:
 
 * a stdlib-``ast`` static linter (``linter.py`` + one module per check)
   with repo-specific checks — lock held across a blocking call,
   swallowed exceptions in thread run-loops, raw ``COMETBFT_TPU_*`` env
   reads outside the knob registry, host side effects inside jitted
   kernel bodies, metric construction outside the Registry factories,
-  and unnamed threads.  Entry point: ``scripts/lint.py`` (the single
-  CLI — it owns the ``[tool.cometbft-tpu-lint]`` config, stale-entry
-  reporting, and exit-code contract).
+  unnamed threads, and the kernel-plane trio (unregistered ``jax.jit``
+  sites, host syncs outside declared collect boundaries, dtype-changing
+  literal arithmetic in jitted bodies).  Entry point: ``scripts/lint.py``
+  (the single CLI — it owns the ``[tool.cometbft-tpu-lint]`` config,
+  stale-entry reporting, and exit-code contract).
+
+* the kernel contract checker (``kernelcheck.py`` over the declarations
+  in ``kernel_manifest.py``): every jitted verify-plane entry point is
+  abstract-interpreted via ``jax.make_jaxpr`` under ``JAX_PLATFORMS=cpu``
+  and held to dtype closure, jaxpr purity, and the checked-in
+  fingerprint goldens (``kernel_fingerprints.json``) — see
+  docs/kernel_contracts.md.  ``scripts/lint.py --check kernel`` runs it;
+  ``scripts/lint.py regen-fingerprints`` re-blesses deliberate drift.
 
 * a runtime lock-order witness (``lockwitness.py``), enabled by
   ``COMETBFT_TPU_LOCKCHECK=1``: every ``threading.Lock``/``RLock``
@@ -18,6 +28,7 @@ Two halves:
   witnessed lock is reported with both stacks.  The test conftest
   installs it, so every suite run doubles as a deadlock hunt.
 
-This package imports nothing heavyweight (no JAX, no numpy) so the
-linter runs anywhere the stdlib does.
+The linter half imports nothing heavyweight (no JAX, no numpy) so it
+runs anywhere the stdlib does; only ``kernelcheck`` defers to JAX, at
+call time.
 """

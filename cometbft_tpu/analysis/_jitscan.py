@@ -1,0 +1,163 @@
+"""Shared static discovery of jitted code: jit sites, jit roots, and the
+module-local traced closure.
+
+Three checks need the same answers from a parsed module — "where are the
+``jax.jit`` sites and what do they jit?" (untracked-jit), "which function
+bodies end up inside a trace?" (jax-purity, weak-type-literal) — so the
+machinery lives here once.  Discovery is purely syntactic:
+
+* a *jit site* is a ``jax.jit``/``jit``/``partial(jax.jit, ...)``
+  decorator or call.  A call site's target is the jitted function's own
+  name when it is passed by name (``jax.jit(build_a_tables)``,
+  ``jax.jit(E.verify_batch)``) and the ENCLOSING function otherwise
+  (``jax.jit(shard_map(local))`` inside a factory — the factory is the
+  stable, manifest-addressable name).
+* *jit roots* are the module-local functions those sites jit, plus
+  bodies handed to ``lax`` control flow; checks may seed EXTRA roots
+  (``kernel_manifest.traced_roots``) for functions jitted from another
+  module, which a per-module scan cannot see.
+* the *traced closure* follows same-module calls (and by-reference uses,
+  e.g. into ``lax.fori_loop``) transitively from the roots.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .linter import dotted_name, terminal_name
+
+LAX_HOFS = {"fori_loop", "while_loop", "scan", "cond", "switch", "map"}
+
+
+def is_jit_expr(node: ast.expr) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit, ...)"""
+    d = dotted_name(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and terminal_name(node.func) == "partial":
+        return bool(node.args) and is_jit_expr(node.args[0])
+    return False
+
+
+@dataclass(frozen=True)
+class JitSite:
+    """One ``jax.jit`` decorator or call in a module."""
+
+    lineno: int
+    col: int
+    target: str | None  # manifest-addressable name; None when unresolvable
+    via: str  # "decorator" | "call"
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    """Collect jit sites with enclosing-function attribution."""
+
+    def __init__(self) -> None:
+        self.sites: list[JitSite] = []
+        self._stack: list[str] = []
+
+    def _visit_fn(self, node):
+        for dec in node.decorator_list:
+            if is_jit_expr(dec):
+                self.sites.append(
+                    JitSite(dec.lineno, dec.col_offset, node.name, "decorator")
+                )
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn  # noqa: N815
+    visit_AsyncFunctionDef = _visit_fn  # noqa: N815
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        if is_jit_expr(node.func):
+            target: str | None = None
+            if node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    target = terminal_name(arg)
+            if target is None and self._stack:
+                # composed site (jax.jit(shard_map(local))): the enclosing
+                # factory is the registrable name
+                target = self._stack[-1]
+            self.sites.append(
+                JitSite(node.lineno, node.col_offset, target, "call")
+            )
+        self.generic_visit(node)
+
+
+def iter_jit_sites(tree: ast.AST) -> list[JitSite]:
+    v = _SiteVisitor()
+    v.visit(tree)
+    return v.sites
+
+
+def collect_functions(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    funcs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # later defs shadow earlier same-named ones; fine for linting
+            funcs[node.name] = node
+    return funcs
+
+
+def jit_roots(tree: ast.AST, funcs: dict[str, ast.FunctionDef]) -> set[str]:
+    """Module-local functions that are jitted or handed to lax control
+    flow — the trace entry points a per-module scan can see."""
+    roots: set[str] = set()
+    for name, fn in funcs.items():
+        if any(is_jit_expr(dec) for dec in fn.decorator_list):
+            roots.add(name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if is_jit_expr(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in funcs:
+                    roots.add(arg.id)
+        tn = terminal_name(node.func)
+        if tn in LAX_HOFS:
+            d = dotted_name(node.func) or ""
+            if d.startswith(("lax.", "jax.lax.")) or d in LAX_HOFS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in funcs:
+                        roots.add(arg.id)
+    return roots
+
+
+def call_edges(funcs: dict[str, ast.FunctionDef]) -> dict[str, set[str]]:
+    edges: dict[str, set[str]] = {}
+    for name, fn in funcs.items():
+        callees: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                tn = terminal_name(node.func)
+                if tn in funcs:
+                    callees.add(tn)
+            elif isinstance(node, ast.Name) and node.id in funcs:
+                # passed by reference (e.g. into lax control flow)
+                callees.add(node.id)
+        callees.discard(name)
+        edges[name] = callees
+    return edges
+
+
+def traced_closure(
+    tree: ast.AST, extra_roots: set[str] | frozenset[str] = frozenset()
+) -> dict[str, ast.FunctionDef]:
+    """name -> FunctionDef for every module-local function reachable from
+    a jit root (or an extra seed, e.g. a manifest-declared entry point
+    jitted from another module) via same-module calls."""
+    funcs = collect_functions(tree)
+    roots = jit_roots(tree, funcs) | {r for r in extra_roots if r in funcs}
+    edges = call_edges(funcs)
+    traced: set[str] = set()
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if n in traced:
+            continue
+        traced.add(n)
+        stack.extend(edges.get(n, ()))
+    return {n: funcs[n] for n in traced}

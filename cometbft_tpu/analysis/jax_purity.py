@@ -8,11 +8,15 @@ file I/O, host clock reads, ``.item()``/``float(arg)`` on traced values
 are the bug class the XLA layer cannot diagnose for us: the program
 traces fine once and then behaves differently on the cached executable.
 
-Jitted bodies are found statically: functions decorated with
-``jax.jit``/``jit``/``partial(jax.jit, ...)``, functions passed to
-``jax.jit(...)`` by name, and bodies handed to ``lax`` control flow
-(``fori_loop``/``while_loop``/``scan``/``cond``/``switch``/``map``) —
-then closed transitively over same-module calls.  Statements under
+Jitted bodies are found statically (the shared ``_jitscan`` machinery):
+functions decorated with ``jax.jit``/``jit``/``partial(jax.jit, ...)``,
+functions passed to ``jax.jit(...)`` by name, bodies handed to ``lax``
+control flow (``fori_loop``/``while_loop``/``scan``/``cond``/``switch``/
+``map``), plus the kernel manifest's declared entry points — functions
+jitted from ANOTHER module (``ops/sha2.sha512_blocks`` is jitted via
+``models/``) are invisible to a per-module site scan but not to
+``kernel_manifest.traced_roots`` — then closed transitively over
+same-module calls.  Statements under
 ``with jax.ensure_compile_time_eval():`` are exempt (explicitly marked
 host-side constant folding).
 """
@@ -21,6 +25,8 @@ from __future__ import annotations
 
 import ast
 
+from . import kernel_manifest as manifest
+from ._jitscan import traced_closure
 from .linter import Finding, Module, dotted_name, terminal_name
 
 CHECK_ID = "jax-purity"
@@ -28,84 +34,10 @@ SUMMARY = "host side effect / env read / device sync inside a jitted body"
 
 SCOPE_DIRS = {"ops", "parallel"}
 
-_LAX_HOFS = {"fori_loop", "while_loop", "scan", "cond", "switch", "map"}
 _CLOCK_CALLS = {
     "time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
     "sleep",
 }
-
-
-def _is_jit_expr(node: ast.expr) -> bool:
-    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit, ...)"""
-    d = dotted_name(node)
-    if d in ("jax.jit", "jit"):
-        return True
-    if isinstance(node, ast.Call) and terminal_name(node.func) == "partial":
-        return bool(node.args) and _is_jit_expr(node.args[0])
-    return False
-
-
-def _collect_functions(tree: ast.AST) -> dict[str, ast.FunctionDef]:
-    funcs: dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # later defs shadow earlier same-named ones; fine for linting
-            funcs[node.name] = node
-    return funcs
-
-
-def _jit_roots(tree: ast.AST, funcs: dict[str, ast.FunctionDef]) -> set[str]:
-    roots: set[str] = set()
-    for name, fn in funcs.items():
-        if any(_is_jit_expr(dec) for dec in fn.decorator_list):
-            roots.add(name)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_jit_expr(node.func):
-            for arg in node.args[:1]:
-                if isinstance(arg, ast.Name) and arg.id in funcs:
-                    roots.add(arg.id)
-        tn = terminal_name(node.func)
-        if tn in _LAX_HOFS:
-            d = dotted_name(node.func) or ""
-            if d.startswith(("lax.", "jax.lax.")) or d in _LAX_HOFS:
-                for arg in node.args:
-                    if isinstance(arg, ast.Name) and arg.id in funcs:
-                        roots.add(arg.id)
-    return roots
-
-
-def _call_edges(funcs: dict[str, ast.FunctionDef]) -> dict[str, set[str]]:
-    edges: dict[str, set[str]] = {}
-    for name, fn in funcs.items():
-        callees: set[str] = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                tn = terminal_name(node.func)
-                if tn in funcs:
-                    callees.add(tn)
-            elif isinstance(node, ast.Name) and node.id in funcs:
-                # passed by reference (e.g. into lax control flow)
-                callees.add(node.id)
-        callees.discard(name)
-        edges[name] = callees
-    return edges
-
-
-def _traced_closure(tree: ast.AST) -> dict[str, ast.FunctionDef]:
-    funcs = _collect_functions(tree)
-    roots = _jit_roots(tree, funcs)
-    edges = _call_edges(funcs)
-    traced: set[str] = set()
-    stack = list(roots)
-    while stack:
-        n = stack.pop()
-        if n in traced:
-            continue
-        traced.add(n)
-        stack.extend(edges.get(n, ()))
-    return {n: funcs[n] for n in traced}
 
 
 class _BodyVisitor(ast.NodeVisitor):
@@ -184,7 +116,8 @@ def check(mod: Module) -> list[Finding]:
     if not SCOPE_DIRS.intersection(mod.parts[:-1]):
         return []
     findings: list[Finding] = []
-    for fn in _traced_closure(mod.tree).values():
+    closure = traced_closure(mod.tree, manifest.traced_roots(mod.path))
+    for fn in closure.values():
         v = _BodyVisitor(mod, fn)
         for stmt in fn.body:
             v.visit(stmt)

@@ -1,0 +1,346 @@
+"""The kernel manifest: every jitted entry point of the TPU verify
+plane, declared once.
+
+This file is the single source of truth three consumers share:
+
+* ``analysis/kernelcheck.py`` abstract-interprets each declared kernel
+  (``jax.make_jaxpr`` under ``JAX_PLATFORMS=cpu``) and enforces the
+  numeric contract — dtype closure, jaxpr purity, primitive-budget /
+  fingerprint drift (``analysis/kernel_fingerprints.json``).
+* the ``untracked-jit`` AST check fails any ``jax.jit`` site in the
+  kernel plane that is not registered in :data:`JIT_SITES` — a new jit
+  entry point cannot land without a manifest row (and therefore without
+  a traced fingerprint).
+* the ``weak-type-literal`` / ``jax-purity`` checks seed their traced
+  closures from :func:`traced_roots` — functions jitted from *another*
+  module (``ops/sha2.sha512_blocks`` is jitted via ``models/``) are
+  invisible to a per-module jit-root scan, but not to the manifest.
+
+Deliberately stdlib-only (no jax, no numpy): the AST linter half must
+run anywhere the stdlib does.  Shapes here are the CANONICAL trace
+shapes — small enough to trace in milliseconds, shaped exactly like
+production (batch lane minor, limbs on sublanes) so the traced program
+is the production program at a smaller lane count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Canonical trace sizes.  V = validator lanes for the comb path, N =
+# signature batch for the uncached path.  Small on purpose: jaxpr shape
+# and primitive mix do not depend on the lane count, only trace time
+# does.
+V = 4  # comb-path validator lanes
+N = 8  # uncached-path signature lanes
+MAXM = 32  # payload message bucket (models/comb_verifier._bucket_mlen floor)
+PAYLOAD_W = 68 + MAXM  # R(32) | s(32) | mlen(3) | live(1) | msg
+
+
+@dataclass(frozen=True)
+class Arg:
+    """One traced input/output leaf: shape + dtype name."""
+
+    shape: tuple[int, ...]
+    dtype: str
+
+
+def u8(*shape: int) -> Arg:
+    return Arg(shape, "uint8")
+
+
+def i32(*shape: int) -> Arg:
+    return Arg(shape, "int32")
+
+
+def f32(*shape: int) -> Arg:
+    return Arg(shape, "float32")
+
+
+def boolean(*shape: int) -> Arg:
+    return Arg(shape, "bool")
+
+
+@dataclass(frozen=True)
+class Kernel:
+    """One jitted entry point: where it lives, how to trace it, what it
+    must produce.
+
+    fn            : "package.module:function".  With needs_mesh, the
+                    function is a FACTORY taking (mesh, *mesh_static)
+                    and returning the jitted callable (the
+                    parallel/verify.py pattern).
+    args          : canonical input leaves, in call order.
+    out           : expected output leaves (flattened pytree order) —
+                    checked against the traced out_avals, so an output
+                    shape/dtype drift fails before any fingerprint
+                    comparison.
+    static_kwargs : Python-level keyword arguments bound before tracing
+                    (trace-time constants: the comb tree flag, churn V);
+                    with needs_mesh they are bound onto the factory call.
+    needs_mesh    : build a 1-device CPU mesh and call fn as a factory.
+    mesh_static   : extra factory positionals after the mesh.
+    """
+
+    name: str
+    fn: str
+    args: tuple[Arg, ...]
+    out: tuple[Arg, ...]
+    static_kwargs: tuple[tuple[str, object], ...] = ()
+    needs_mesh: bool = False
+    mesh_static: tuple = ()
+
+
+_TABLES = i32(64, 9, 3, 22, V)  # ops/comb.py layout: validator axis minor
+_B_TABLES = f32(22, 66, 4096)  # shared radix-4096 base-point comb
+
+
+KERNELS: tuple[Kernel, ...] = (
+    # ---- ops/comb.py — the validator-set fast path
+    Kernel(
+        name="comb_build_a_tables",
+        fn="cometbft_tpu.ops.comb:build_a_tables",
+        args=(u8(V, 32),),
+        out=(_TABLES, boolean(V)),
+    ),
+    Kernel(
+        name="comb_verify_cached_tree",
+        fn="cometbft_tpu.ops.comb:verify_cached",
+        args=(_TABLES, boolean(V), u8(V, 32), u8(V, 32), u8(V, 64), _B_TABLES),
+        out=(boolean(V),),
+        static_kwargs=(("tree", True),),
+    ),
+    Kernel(
+        # the sequential cross-check path must stay pinned too: it is the
+        # bit-exactness witness for the tree path (COMETBFT_TPU_COMB_TREE=0)
+        name="comb_verify_cached_seq",
+        fn="cometbft_tpu.ops.comb:verify_cached",
+        args=(_TABLES, boolean(V), u8(V, 32), u8(V, 32), u8(V, 64), _B_TABLES),
+        out=(boolean(V),),
+        static_kwargs=(("tree", False),),
+    ),
+    # ---- ops/ed25519.py — the uncached Straus kernel
+    Kernel(
+        name="ed25519_verify_batch",
+        fn="cometbft_tpu.ops.ed25519:verify_batch",
+        args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
+        out=(boolean(N),),
+    ),
+    # ---- ops/sha2.py — challenge hashing + device payload assembly
+    Kernel(
+        name="sha256_blocks",
+        fn="cometbft_tpu.ops.sha2:sha256_blocks",
+        args=(u8(N, 2, 64), i32(N)),
+        out=(u8(N, 32),),
+    ),
+    Kernel(
+        name="sha512_blocks",
+        fn="cometbft_tpu.ops.sha2:sha512_blocks",
+        args=(u8(N, 2, 128), i32(N)),
+        out=(u8(N, 64),),
+    ),
+    Kernel(
+        name="sha2_parse_verify_payload",
+        fn="cometbft_tpu.ops.sha2:parse_verify_payload",
+        args=(u8(N, PAYLOAD_W), u8(N, 32)),
+        out=(u8(N, 32), u8(N, 32), u8(N, 1, 128), i32(N), boolean(N)),
+    ),
+    # ---- ops/merkle.py — the block-hash pass
+    Kernel(
+        name="merkle_root_from_leaves",
+        fn="cometbft_tpu.ops.merkle:root_from_leaves",
+        args=(u8(N, 1, 64), i32(N)),
+        out=(u8(32),),
+    ),
+    # ---- ops/bls381.py — G1 aggregation (FastAggregateVerify data plane)
+    Kernel(
+        name="bls381_aggregate_g1",
+        fn="cometbft_tpu.ops.bls381:aggregate_g1",
+        args=(i32(N, 32), i32(N, 32), i32(N, 32)),
+        out=(i32(32), i32(32), i32(32)),
+    ),
+    # ---- models/comb_verifier.py — cache assembly + the device program
+    Kernel(
+        name="comb_assemble_churn",
+        fn="cometbft_tpu.models.comb_verifier:_assemble_churn",
+        args=(
+            _TABLES, boolean(V),
+            i32(64, 9, 3, 22, 2), boolean(2),  # freshly built bucket (2 keys)
+            i32(2), i32(2), i32(2),  # new_rows, base_rows, fresh_rows
+        ),
+        out=(_TABLES, boolean(V)),
+        static_kwargs=(("V", V),),
+    ),
+    Kernel(
+        name="comb_device_verify",
+        fn="cometbft_tpu.models.comb_verifier:_device_verify",
+        args=(_TABLES, boolean(V), u8(V, 32), u8(V, PAYLOAD_W)),
+        out=(u8(2),),  # packbits(V=4 lanes) -> 1 byte, + the all-ok byte
+    ),
+    # ---- parallel/verify.py — the mesh-sharded programs (1-device CPU
+    # mesh for the trace; the collective mix is what the fingerprint pins)
+    Kernel(
+        name="sharded_verify_batch",
+        fn="cometbft_tpu.parallel.verify:_verify_fn",
+        args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
+        out=(boolean(), boolean(N)),
+        needs_mesh=True,
+    ),
+    Kernel(
+        name="sharded_verify_cached",
+        fn="cometbft_tpu.parallel.verify:_comb_verify_fn",
+        args=(_TABLES, boolean(V), u8(V, 32), u8(V, PAYLOAD_W)),
+        out=(u8(2),),
+        needs_mesh=True,
+        mesh_static=(True,),  # tree=True, part of the jit cache key
+    ),
+    Kernel(
+        name="sharded_merkle_root",
+        fn="cometbft_tpu.parallel.verify:_merkle_fn",
+        args=(u8(N, 1, 64), i32(N)),
+        out=(u8(32),),
+        needs_mesh=True,
+    ),
+)
+
+
+# --------------------------------------------------------------- jit sites
+#
+# Every ``jax.jit`` call/decorator site in the kernel plane (ops/,
+# parallel/, models/, crypto/), keyed "path::target" where target is the
+# jitted function's name (or the enclosing factory for composed sites
+# like ``jax.jit(shard_map(local))``).  The value names the manifest
+# kernel whose trace covers the site.  The ``untracked-jit`` check fails
+# any site missing here; kernelcheck fails any value naming no kernel.
+
+JIT_SITES: dict[str, str] = {
+    "cometbft_tpu/ops/comb.py::build_a_tables": "comb_build_a_tables",
+    "cometbft_tpu/ops/bls381.py::aggregate_g1": "bls381_aggregate_g1",
+    # models/verifier.py jits ops/ed25519.verify_batch (the uncached path)
+    "cometbft_tpu/models/verifier.py::verify_batch": "ed25519_verify_batch",
+    "cometbft_tpu/models/comb_verifier.py::_assemble_churn": "comb_assemble_churn",
+    "cometbft_tpu/models/comb_verifier.py::_device_verify": "comb_device_verify",
+    # parallel factories: jax.jit(shard_map(local)) — registered under the
+    # enclosing factory name, traced through a 1-device mesh
+    "cometbft_tpu/parallel/verify.py::_verify_fn": "sharded_verify_batch",
+    "cometbft_tpu/parallel/verify.py::_comb_verify_fn": "sharded_verify_cached",
+    "cometbft_tpu/parallel/verify.py::_merkle_fn": "sharded_merkle_root",
+    # crypto/merkle.py jits ops/merkle.root_from_leaves for host callers
+    "cometbft_tpu/crypto/merkle.py::root_from_leaves": "merkle_root_from_leaves",
+}
+
+
+# ------------------------------------------------------ collect boundaries
+#
+# Functions in ops//parallel/ that are DECLARED host<->device collect
+# points: the documented places where a device value is fetched to host
+# (np.asarray on a device array, the one blocking sync of a pipeline).
+# The ``host-sync-in-hot-path`` check exempts these; anywhere else in
+# the hot path a sync is a finding.
+
+COLLECT_BOUNDARIES: dict[str, str] = {
+    "cometbft_tpu/ops/bls381.py::aggregate_pubkeys_device": (
+        "the BLS host bridge: one blocking fetch of the aggregated point"
+    ),
+    "cometbft_tpu/ops/bls381.py::from_limbs": (
+        "host-side limb decoder; receives the already-fetched aggregate"
+    ),
+    "cometbft_tpu/ops/field.py::from_limbs": (
+        "host-side limb decoder used by tests and host bridges"
+    ),
+}
+# NOT boundaries: the parallel/mesh.py factories' np.array calls wrap
+# the host device list — the host-sync check recognizes devices()
+# dataflow itself, so the fetch-boundary registry stays exactly the
+# set of real host<->device collect points.
+
+
+def collect_boundary(path: str, target: str) -> bool:
+    """True when ``path::target`` is a declared host boundary (suffix
+    match on a '/' boundary, same rule as :func:`site_registered`)."""
+    for site in COLLECT_BOUNDARIES:
+        rpath, _, rtarget = site.partition("::")
+        if target != rtarget:
+            continue
+        if path == rpath or path.endswith("/" + rpath):
+            return True
+    return False
+
+
+# ------------------------------------------------------- dtype conversions
+#
+# Every ``convert_element_type`` a manifest kernel is allowed to contain,
+# as (src, dst) dtype-name pairs.  Anything outside this set fails the
+# dtype-closure gate: an unlisted conversion is exactly how silent
+# promotion creep lands.  Keep each pair justified.
+
+ALLOWED_CONVERSIONS: frozenset[tuple[str, str]] = frozenset(
+    {
+        # byte <-> word unpacking at kernel edges
+        ("uint8", "int32"),  # payload/scalar bytes -> limb arithmetic
+        ("uint8", "uint32"),  # SHA message bytes -> 32-bit words
+        ("uint32", "uint8"),  # digest words -> output bytes
+        ("int32", "uint8"),  # packed flags / byte stores
+        # the one-hot MXU matmul round trip (ops/comb.py b-part lookup:
+        # 12-bit Niels limbs are exact in f32; HIGHEST precision)
+        ("int32", "float32"),
+        ("float32", "int32"),
+        # masks and validity plumbing
+        ("bool", "uint32"),  # SHA-512 (hi, lo) pair addition: the carry
+        #   of each 32-bit lane add is (lo < al).astype(uint32)
+        #   (ops/sha2._add64) — 64-bit words don't exist on TPU
+        ("bool", "int32"),  # invalid-count psum accumulators
+        ("bool", "uint8"),  # the all-ok byte of the packed result
+        ("bool", "float32"),  # one-hot select masks on the MXU path
+        ("int32", "bool"),  # borrow-chain compare results
+        ("uint8", "bool"),  # live-row flags decoded from the payload
+    }
+)
+
+# Jaxpr-level dtypes that must NEVER appear in a kernel: 64-bit creep
+# either silently doubles HBM traffic or (under the default x64-disabled
+# config) silently truncates — both are contract violations.
+FORBIDDEN_DTYPES: frozenset[str] = frozenset(
+    {"int64", "uint64", "float64", "complex64", "complex128"}
+)
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def by_name() -> dict[str, Kernel]:
+    return {k.name: k for k in KERNELS}
+
+
+def module_path(k: Kernel) -> str:
+    """'package.module:fn' -> 'package/module.py' (repo-relative)."""
+    mod = k.fn.split(":", 1)[0]
+    return mod.replace(".", "/") + ".py"
+
+
+def fn_name(k: Kernel) -> str:
+    return k.fn.split(":", 1)[1]
+
+
+def traced_roots(path: str) -> set[str]:
+    """Manifest-declared traced entry points living in ``path`` (a
+    repo-relative or absolute module path) — the extra closure roots the
+    AST checks seed beyond per-module ``jax.jit`` discovery."""
+    roots: set[str] = set()
+    for k in KERNELS:
+        mp = module_path(k)
+        if path == mp or path.endswith("/" + mp):
+            roots.add(fn_name(k))
+    return roots
+
+
+def site_registered(path: str, target: str) -> bool:
+    """True when ``path::target`` matches a JIT_SITES entry (suffix match
+    on a '/' boundary, same rule as the allowlist)."""
+    for site in JIT_SITES:
+        rpath, _, rtarget = site.partition("::")
+        if target != rtarget:
+            continue
+        if path == rpath or path.endswith("/" + rpath):
+            return True
+    return False

@@ -131,12 +131,15 @@ class Allowlist:
 def all_checks() -> dict[str, object]:
     """check-id -> check module, discovery order stable."""
     from . import (
+        host_sync,
         jax_purity,
         lock_blocking,
         metrics_registry,
         raw_env,
         swallowed_exc,
         thread_names,
+        untracked_jit,
+        weak_type_literal,
     )
 
     mods = (
@@ -146,8 +149,17 @@ def all_checks() -> dict[str, object]:
         jax_purity,
         metrics_registry,
         thread_names,
+        untracked_jit,
+        host_sync,
+        weak_type_literal,
     )
     return {m.CHECK_ID: m for m in mods}
+
+
+#: The kernel-plane subset: the three checks that feed the kernel
+#: contract gate (scripts/lint.py --check kernel) alongside the
+#: kernelcheck trace pass.
+KERNEL_CHECK_IDS = ("untracked-jit", "host-sync-in-hot-path", "weak-type-literal")
 
 
 def iter_py_files(paths: list[str]) -> list[str]:
@@ -199,7 +211,14 @@ def lint_paths(
         for m in enabled:
             findings.extend(m.check(mod))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
-    kept = [f for f in findings if not allowlist.suppresses(f)]
+    # a check module may declare ALLOWLIST_EXEMPT = True: its findings
+    # are never suppressible (untracked-jit — the manifest is the only
+    # way out, by design); entries targeting such a check read as stale
+    exempt = {m.CHECK_ID for m in enabled if getattr(m, "ALLOWLIST_EXEMPT", False)}
+    kept = [
+        f for f in findings
+        if f.check in exempt or not allowlist.suppresses(f)
+    ]
     return kept, allowlist.unused()
 
 

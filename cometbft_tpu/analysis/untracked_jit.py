@@ -1,0 +1,53 @@
+"""Check: untracked-jit.
+
+Every ``jax.jit`` site in the kernel plane (``ops/``, ``parallel/``,
+``models/``, ``crypto/``) must be registered in
+``kernel_manifest.JIT_SITES``.  Registration is what gives a jit entry
+point a traced contract: a manifest row pins its canonical shapes and
+dtypes, and the kernelcheck drift gate pins its jaxpr fingerprint —
+an unregistered site is a compiled program with no static verification
+at all, exactly the gap this pass exists to close.
+
+A site is keyed ``path::target``: the jitted function's own name when it
+is jitted by name (``jax.jit(build_a_tables)``, decorator forms), or the
+enclosing factory when the jitted expression is composed
+(``jax.jit(shard_map(local))`` — the factory is the stable name).  Fix a
+finding by adding the site to ``JIT_SITES`` and, for a new entry point,
+a ``Kernel`` row + regenerated fingerprint; there is no allowlist escape
+that skips the manifest, by design.
+"""
+
+from __future__ import annotations
+
+from . import kernel_manifest as manifest
+from ._jitscan import iter_jit_sites
+from .linter import Finding, Module
+
+CHECK_ID = "untracked-jit"
+SUMMARY = "jax.jit site in the kernel plane not registered in the kernel manifest"
+
+# The driver refuses allowlist suppression for this check: an entry in
+# allowlist.txt would let a compiled program ship with no traced
+# contract — registration in the manifest is the only way out.
+ALLOWLIST_EXEMPT = True
+
+SCOPE_DIRS = {"ops", "parallel", "models", "crypto"}
+
+
+def check(mod: Module) -> list[Finding]:
+    if not SCOPE_DIRS.intersection(mod.parts[:-1]):
+        return []
+    findings: list[Finding] = []
+    for site in iter_jit_sites(mod.tree):
+        target = site.target or "<module>"
+        if manifest.site_registered(mod.path, target):
+            continue
+        findings.append(
+            Finding(
+                CHECK_ID, mod.path, site.lineno, site.col,
+                f"jit site {mod.path}::{target} ({site.via}) is not in "
+                "kernel_manifest.JIT_SITES — register it and declare a "
+                "manifest Kernel so the contract checker traces it",
+            )
+        )
+    return findings

@@ -1,0 +1,126 @@
+"""Check: weak-type-literal.
+
+Bare Python literal arithmetic inside a jitted body is how dtype drift
+lands: a literal is WEAK-typed, so the result dtype is decided by
+promotion rules instead of the kernel author.  This check flags the
+dtype-CHANGING cases statically, in the kernel plane's jitted bodies
+(found via the same traced-closure scan as jax-purity, seeded with the
+manifest's cross-module entry points):
+
+* a float literal in arithmetic (``x * 0.5``) — promotes integer kernel
+  data to float, the exact creep the dtype-closure trace gate exists to
+  catch, reported here at the offending source line;
+* true division ``/`` — produces float whatever the operands; integer
+  kernels must use ``//``;
+* an int literal outside int32 range — silently wraps under the
+  x64-disabled config the kernels are contracted to (or promotes to
+  int64 where it isn't).
+
+In-range int literals (``i + 1``, ``total * 8``) are deliberately NOT
+findings: a weak int against any strongly-typed array adopts the array's
+dtype, which is the intended, deterministic behavior — and the jaxpr
+pass double-checks the residue (weak-typed kernel OUTPUTS and forbidden
+64-bit dtypes both fail the trace gate).  Statements under
+``jax.ensure_compile_time_eval()`` are host-side folding and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import kernel_manifest as manifest
+from ._jitscan import traced_closure
+from .linter import Finding, Module, dotted_name
+
+CHECK_ID = "weak-type-literal"
+SUMMARY = "dtype-changing bare literal arithmetic inside a jitted body"
+
+SCOPE_DIRS = {"ops", "parallel", "models"}
+
+_ARITH_OPS = (
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+)
+_I32_MAX = 2**31 - 1
+
+
+def _literal(node: ast.expr):
+    """The numeric constant under an optional unary +/- , else None."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        if isinstance(node.value, bool):
+            return None
+        return node.value
+    return None
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, fn_name: str):
+        self.mod = mod
+        self.fn_name = fn_name
+        self.findings: list[Finding] = []
+
+    def _add(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                CHECK_ID, self.mod.path, node.lineno, node.col_offset,
+                f"{msg} inside jitted body {self.fn_name!r}",
+            )
+        )
+
+    def visit_With(self, node: ast.With):  # noqa: N802
+        for item in node.items:
+            d = dotted_name(
+                item.context_expr.func
+                if isinstance(item.context_expr, ast.Call)
+                else item.context_expr
+            )
+            if d and d.endswith("ensure_compile_time_eval"):
+                return  # explicitly-marked host-side constant folding
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp):  # noqa: N802
+        if isinstance(node.op, _ARITH_OPS):
+            lits = [_literal(n) for n in (node.left, node.right)]
+            for v in lits:
+                if isinstance(v, float):
+                    self._add(
+                        node,
+                        f"bare float literal {v!r} in arithmetic — promotes "
+                        "to float by weak-type rules; pin it "
+                        "(np.float32(...)/jnp.float32(...))",
+                    )
+                elif isinstance(v, int) and abs(v) > _I32_MAX:
+                    self._add(
+                        node,
+                        f"int literal {v!r} exceeds int32 — wraps under the "
+                        "x64-disabled kernel config; restructure or pin an "
+                        "explicit wide representation",
+                    )
+            if (
+                isinstance(node.op, ast.Div)
+                and None in lits
+                and not any(isinstance(v, float) for v in lits)
+            ):
+                # const/const folds on host; anything else makes floats.
+                # A float literal operand was already reported above —
+                # one finding per offending line, not two
+                self._add(
+                    node,
+                    "true division '/' produces float whatever the "
+                    "operands; integer kernels must use '//'",
+                )
+        self.generic_visit(node)
+
+
+def check(mod: Module) -> list[Finding]:
+    if not SCOPE_DIRS.intersection(mod.parts[:-1]):
+        return []
+    findings: list[Finding] = []
+    closure = traced_closure(mod.tree, manifest.traced_roots(mod.path))
+    for name, fn in closure.items():
+        v = _BodyVisitor(mod, name)
+        for stmt in fn.body:
+            v.visit(stmt)
+        findings.extend(v.findings)
+    return findings

@@ -64,6 +64,8 @@ def _root_from_leaf_hashes_host(hashes: list[bytes]) -> bytes:
 
 
 def _root_device(items: list[bytes]) -> bytes:
+    # jit site registered in kernel_manifest.JIT_SITES (manifest kernel
+    # ``merkle_root_from_leaves``)
     global _JIT_ROOT
     import jax
     import jax.numpy as jnp

@@ -337,7 +337,9 @@ def _assemble_churn(base_t, base_v, new_t, new_v, new_rows, base_rows, fresh_row
     """One fused gather/scatter: reused rows from the old tables + freshly
     built rows into a V-lane table.  The validator axis is the tables'
     LAST axis (ops/comb.py layout); new_t may carry bucket padding beyond
-    len(fresh_rows) lanes, which the scatter never reads."""
+    len(fresh_rows) lanes, which the scatter never reads.
+
+    Manifest kernel ``comb_assemble_churn`` (V is the static argument)."""
     import jax.numpy as jnp
 
     tables = jnp.zeros(tuple(base_t.shape[:-1]) + (V,), base_t.dtype)
@@ -713,6 +715,11 @@ def _device_verify(tables, valid, pubs, payload):
     payload rows: R(32) | s(32) | mlen(3B LE) | live(1B) | msg(maxm).
     Returns ONE uint8 array [packbits(ok & live) | all_ok] so the caller
     pays a single device->host fetch.
+
+    Manifest kernel ``comb_device_verify``.  The trace resolves
+    comb.tree_enabled() (the kernelcheck gate pins the knob to its
+    default while fingerprinting, so goldens always describe the tree
+    path).
     """
     import jax.numpy as jnp
 

@@ -100,7 +100,9 @@ class TpuEd25519BatchVerifier:
     @staticmethod
     def _compiled():
         """One jitted entry point; jax.jit caches per input shape, and the
-        power-of-two bucketing above keeps the shape set small."""
+        power-of-two bucketing above keeps the shape set small.  The jit
+        site is registered in kernel_manifest.JIT_SITES (manifest kernel
+        ``ed25519_verify_batch``)."""
         global _VERIFY_JIT
         if _VERIFY_JIT is None:
             import jax

@@ -264,7 +264,12 @@ def g1_add(X1, Y1, Z1, X2, Y2, Z2):
 def aggregate_g1(X, Y, Z):
     """Tree-reduce a (N, 32) batch of Jacobian points to one sum — the
     device analogue of blst P1 aggregate.  N must be a power of two
-    (callers pad with infinities)."""
+    (callers pad with infinities).
+
+    Manifest kernel ``bls381_aggregate_g1`` (analysis/kernel_manifest):
+    the contract checker traces this signature and pins its jaxpr
+    fingerprint; jit sites must stay registered in JIT_SITES.
+    """
     n = X.shape[0]
     while n > 1:
         half = n // 2

@@ -66,6 +66,9 @@ def build_a_tables(a_enc):
     Montgomery batch inversion (3 muls/entry amortized instead of a
     ~265-mul chain each), so the per-verify additions are the cheap
     7-multiply add_niels.
+
+    Manifest kernel ``comb_build_a_tables``: shape/dtype/jaxpr contract
+    enforced by analysis/kernelcheck.
     """
     pt, valid = E.decompress(a_enc)
     p0 = E.neg(pt)  # tables hold multiples of -A
@@ -302,6 +305,10 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables, tree=None
 
     Returns (V,) bool.  Rows whose validator did not sign carry dummy
     inputs; callers mask the result.
+
+    Manifest kernels ``comb_verify_cached_tree`` / ``_seq`` (one per
+    accumulation path — both fingerprints are pinned, since the
+    sequential path is the tree path's bit-exactness witness).
     """
     k_limbs = scalar.reduce_mod_l(scalar.bytes_to_limbs(k_digest, scalar.NL_X))
     # signed radix-16 digits in [-8, 7]: |d| selects the entry, the sign
@@ -337,6 +344,8 @@ def _accumulate_sequential(tables, k_dig, s_dig, b_tables, r_pt):
         dig = lax.dynamic_index_in_dim(k_dig, i, axis=0, keepdims=False)
         neg = dig < 0
         absd = jnp.abs(dig)
+        # int32 one-hot: the select stays in the tables' own dtype end to
+        # end (no float round trip; dtype-closure audited, no promotion)
         onehot = (ents_a == absd[None, :]).astype(jnp.int32)  # (9, V)
         sel = jnp.sum(slab * onehot[:, None, None, :], axis=0)  # (3, 22, V)
         yplusx = F.select(neg, sel[1], sel[0])
@@ -398,6 +407,9 @@ def _accumulate_tree(tables, k_dig, s_dig, b_tables, r_pt):
     # ---- B part: 22 independent one-hot MXU matmuls (no add chain);
     # unrolled so each keeps the (4096, V) onehot transient of the
     # sequential path instead of one (22, 4096, V) monster
+    # f32 one-hot for the MXU path: int32 -> float32 -> int32 is exact
+    # for the 12-bit Niels limbs (both conversions are in the manifest's
+    # justified ALLOWED_CONVERSIONS set; HIGHEST forbids bf16 passes)
     ents_b = jnp.arange(NENT_B, dtype=jnp.int32)[:, None]
     sels = []
     for i in range(NPOS_B):

@@ -83,5 +83,8 @@ def pad_leaves(leaves: list[bytes]):
 
 
 def root_from_leaves(blocks, active):
-    """Full device pipeline: host-padded leaves -> root.  Jit-friendly."""
+    """Full device pipeline: host-padded leaves -> root.  Jit-friendly.
+
+    Manifest kernel ``merkle_root_from_leaves`` (jitted from
+    crypto/merkle.py)."""
     return root_from_leaf_hashes(leaf_hashes_from_padded(blocks, active))

@@ -84,6 +84,8 @@ def sha256_blocks(blocks, active_blocks=None):
     active_blocks: optional (...,) int32 per-row live block count (rows with
     shorter messages stop updating state after their own final block, since
     SHA-2 padding is minimal per message while the array shape is static).
+
+    Manifest kernel ``sha256_blocks`` (jitted via models//crypto callers).
     """
     nblocks = blocks.shape[-2]
     w0 = blocks.astype(jnp.uint32).reshape(blocks.shape[:-1] + (16, 4))
@@ -171,6 +173,8 @@ def _shr64(hi, lo, n):
 
 def _add64(ah, al, bh, bl):
     lo = al + bl
+    # bool -> uint32 is the justified carry conversion of the (hi, lo)
+    # pair representation (kernel_manifest.ALLOWED_CONVERSIONS)
     carry = (lo < al).astype(jnp.uint32)
     return ah + bh + carry, lo
 
@@ -187,6 +191,8 @@ def sha512_blocks(blocks, active_blocks=None):
 
     active_blocks: optional (...,) int32 per-row live block count (see
     sha256_blocks).
+
+    Manifest kernel ``sha512_blocks``.
     """
     nblocks = blocks.shape[-2]
     w0 = blocks.astype(jnp.uint32).reshape(blocks.shape[:-1] + (16, 8))
@@ -357,6 +363,8 @@ def parse_verify_payload(payload, pubs):
     the payload row layout, shared by the single-device program
     (models/comb_verifier._device_verify) and the mesh-sharded one
     (parallel/verify).  active is 0 for non-live rows.
+
+    Manifest kernel ``sha2_parse_verify_payload``.
     """
     maxm = payload.shape[1] - 68
     nblocks = (64 + maxm + 17 + 127) // 128

@@ -1,4 +1,8 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers.
+
+The ``np.array`` calls below wrap the host device list — they never
+materialize a device array; the host-sync-in-hot-path check recognizes
+``jax.devices()`` dataflow and does not flag them."""
 
 from __future__ import annotations
 

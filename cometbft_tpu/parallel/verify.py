@@ -51,7 +51,12 @@ def _verify_fn(mesh: Mesh):
     """jit-wrapped sharded verifier, cached per mesh — without the jit
     every call re-traces the whole kernel and nothing reaches the
     persistent compile cache (this made the un-jitted path effectively
-    un-runnable on the CPU backend)."""
+    un-runnable on the CPU backend).
+
+    Manifest kernel ``sharded_verify_batch``: the contract checker calls
+    this factory with a 1-device CPU mesh and pins the traced program
+    (the collective mix — psum/all_gather — is part of the fingerprint).
+    """
     axis = mesh.axis_names[0]
 
     def local(a, r, s, blocks, active):
@@ -101,6 +106,8 @@ def _comb_verify_fn(mesh: Mesh, tree: bool):
     part of the cache key, so flipping COMETBFT_TPU_COMB_TREE between
     calls never serves a stale compiled program.  Both paths are
     lane-local over the validator axis, so sharding is unaffected.
+
+    Manifest kernel ``sharded_verify_cached`` (traced with tree=True).
     """
     axis = mesh.axis_names[0]
     import jax.numpy as jnp
@@ -159,6 +166,7 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
 
 @functools.lru_cache(maxsize=8)
 def _merkle_fn(mesh: Mesh):
+    # Manifest kernel ``sharded_merkle_root``.
     axis = mesh.axis_names[0]
 
     def local(blocks, active):

@@ -2,13 +2,26 @@
 """Repo linter entry point — the `go vet` of this codebase.
 
     python scripts/lint.py [paths...] [--json] [--list-checks]
+                           [--check ID ...]
+    python scripts/lint.py regen-fingerprints
 
 Runs every check in cometbft_tpu/analysis over the given paths (default:
 the cometbft_tpu package), filters through the checked-in allowlist
 (cometbft_tpu/analysis/allowlist.txt), and exits non-zero when any
 non-allowlisted finding remains.  Stale allowlist entries are reported
 on stderr (and under "stale_allowlist" in --json) but don't fail the
-run.  Check toggles live in pyproject.toml:
+run.
+
+--check restricts the run to the named check id(s).  The special id
+``kernel`` selects the kernel contract gate: the three kernel-plane AST
+checks (untracked-jit, host-sync-in-hot-path, weak-type-literal) PLUS
+the kernelcheck trace pass — every manifest kernel abstract-interpreted
+under JAX_PLATFORMS=cpu and diffed against the checked-in fingerprints
+(docs/kernel_contracts.md).  ``regen-fingerprints`` re-traces everything
+and rewrites cometbft_tpu/analysis/kernel_fingerprints.json after a
+DELIBERATE kernel change (contract violations still refuse).
+
+Check toggles live in pyproject.toml:
 
     [tool.cometbft-tpu-lint]
     disable = ["check-id", ...]
@@ -50,12 +63,43 @@ def load_config(pyproject: str) -> dict:
     return nested if isinstance(nested, dict) else {}
 
 
+def regen_fingerprints() -> int:
+    """Re-trace every manifest kernel and rewrite the golden file."""
+    from cometbft_tpu.analysis import kernelcheck
+
+    findings, traces = kernelcheck.regenerate()
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"\n{len(findings)} contract finding(s) — regeneration only "
+            "blesses drift, never a broken contract; goldens NOT written",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"traced {len(traces)} kernels -> {kernelcheck.FINGERPRINTS_PATH}"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "regen-fingerprints":
+        return regen_fingerprints()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
     ap.add_argument("--json", action="store_true", help="machine output")
     ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument(
+        "--check",
+        action="append",
+        metavar="ID",
+        help="restrict to the given check id(s); 'kernel' = the three "
+        "kernel-plane AST checks + the kernelcheck trace/fingerprint gate",
+    )
     ap.add_argument(
         "--config",
         default=os.path.join(repo_root, "pyproject.toml"),
@@ -69,14 +113,32 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     checks = linter.all_checks()
+    all_ids = set(checks)
     if args.list_checks:
         for cid, m in checks.items():
             print(f"{cid}: {m.SUMMARY}")
+        print("kernel: the kernel contract gate (kernel AST checks + "
+              "kernelcheck trace/fingerprint pass)")
         return 0
+
+    run_trace = False
+    if args.check:
+        ids: list[str] = []
+        for c in args.check:
+            if c == "kernel":
+                run_trace = True
+                ids.extend(linter.KERNEL_CHECK_IDS)
+            else:
+                ids.append(c)
+        unknown_ids = set(ids) - set(checks)
+        if unknown_ids:
+            print(f"unknown check(s): {sorted(unknown_ids)}", file=sys.stderr)
+            return 2
+        checks = {cid: m for cid, m in checks.items() if cid in set(ids)}
 
     cfg = load_config(args.config)
     disable = set(cfg.get("disable", ()))
-    unknown = disable - set(checks)
+    unknown = disable - all_ids  # not the --check-restricted subset
     if unknown:
         print(f"config disables unknown check(s): {sorted(unknown)}",
               file=sys.stderr)
@@ -98,6 +160,24 @@ def main(argv: list[str] | None = None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
+    kernel_summary = None
+    if run_trace:
+        from cometbft_tpu.analysis import kernelcheck
+
+        kfindings, traces = kernelcheck.run_check()
+        kfindings = [f for f in kfindings if not allowlist.suppresses(f)]
+        findings = findings + kfindings
+        kernel_summary = kernelcheck.summary(kfindings, traces)
+        stale = allowlist.unused()  # kernel findings may have used entries
+
+    if args.check:
+        # a restricted run must not call entries for checks that never
+        # ran "stale" — only full runs can prove an entry matches nothing
+        enabled_ids = set(checks) | (
+            set(kernelcheck.FINDING_CHECK_IDS) if run_trace else set()
+        )
+        stale = [e for e in stale if e.check in enabled_ids]
+
     if args.json:
         print(json.dumps(
             {
@@ -114,6 +194,7 @@ def main(argv: list[str] | None = None) -> int:
                     for e in stale
                 ],
                 "ok": not findings,
+                **({"kernel": kernel_summary} if kernel_summary else {}),
             },
             indent=2,
         ))

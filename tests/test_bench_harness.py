@@ -49,12 +49,18 @@ def test_unavailable_backend_yields_structured_error():
             # subprocess timeout and break the emit-one-line contract
             "BENCH_PROBE_RETRIES": "1",
             "BENCH_PROBE_RETRY_DELAY": "0",
+            # the embedded kernel contract pass is ~2-3 min of CPU
+            # tracing — same subprocess-timeout problem as the retry
+            # ladder; its wiring is covered by
+            # tests/test_kernelcheck.py::test_bench_reports_kernelcheck_when_backend_unavailable
+            "BENCH_KERNELCHECK": "0",
         }
     )
     assert out["metric"] == "verify_commit_p50_10k_ms"
     assert out["value"] is None
     assert "error" in out and "backend-unavailable" in out["error"]
     assert isinstance(out["phases"], dict)
+    assert "kernelcheck" not in out  # BENCH_KERNELCHECK=0 honored
 
 
 def test_crash_after_probe_yields_structured_error():
