@@ -239,10 +239,135 @@ def _enable_compile_cache() -> None:
         pass
 
 
+def _run_mixed() -> None:
+    """BENCH_WORKLOAD=mixed: consensus commit verification and mempool
+    CheckTx signature checks driven CONCURRENTLY through the unified
+    verify service (verifysvc/), to show the scheduler's class
+    separation under contention.  The JSON line carries per-class
+    latency percentiles plus the service's flush/queue tallies — the
+    claim to check is that consensus p50 under mempool load stays near
+    its unloaded value while mempool requests coalesce into wide
+    deadline-flushed batches.
+
+    Sizes: BENCH_N commit signatures (default 10000), BENCH_MIXED_SECONDS
+    of concurrent load (default 20), BENCH_MIXED_SENDERS CheckTx threads
+    (default 8)."""
+    import threading
+
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import ed25519 as host
+    from cometbft_tpu.verifysvc import checktx
+    from cometbft_tpu.verifysvc.service import global_service
+
+    N = int(os.environ.get("BENCH_N", "10000"))
+    seconds = float(os.environ.get("BENCH_MIXED_SECONDS", "20"))
+    senders = int(os.environ.get("BENCH_MIXED_SENDERS", "8"))
+    REPORT["metric"] = "verify_mixed_consensus_p50_ms"
+    REPORT["workload"] = "mixed"
+    REPORT["n_sigs"] = N
+    REPORT["mixed_seconds"] = seconds
+    REPORT["mixed_senders"] = senders
+
+    rng = np.random.default_rng(11)
+    keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(N)]
+    pubs = [k.pub_key().data for k in keys]
+    items = []
+    for i, sk in enumerate(keys):
+        msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|chain-mixed"
+        items.append((pubs[i], msg, sk.sign(msg)))
+
+    t0 = time.perf_counter()
+    crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+    REPORT["phases"]["table_build_s"] = round(time.perf_counter() - t0, 1)
+
+    tx_keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(64)]
+    txs = [
+        checktx.make_signed_tx(k, b"mixed-payload-%d" % i)
+        for i, k in enumerate(tx_keys)
+    ]
+
+    stop = threading.Event()
+    lat: dict[str, list[float]] = {"consensus": [], "mempool": []}
+    lat_mtx = threading.Lock()
+    errors: list[str] = []
+
+    def consensus_loop():
+        try:
+            while not stop.is_set():
+                v = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+                t = time.perf_counter()
+                for pub, msg, sig in items:
+                    v.add(pub, msg, sig)
+                ok, per = v.verify()
+                dt = (time.perf_counter() - t) * 1e3
+                assert ok and len(per) == N
+                with lat_mtx:
+                    lat["consensus"].append(dt)
+        except BaseException as e:  # noqa: BLE001 — report, don't hang the bench
+            errors.append(f"consensus: {type(e).__name__}: {e}")
+            stop.set()
+
+    def mempool_loop(i: int):
+        try:
+            j = i
+            while not stop.is_set():
+                t = time.perf_counter()
+                ok = checktx.verify_tx_signature(txs[j % len(txs)])
+                dt = (time.perf_counter() - t) * 1e3
+                assert ok is True
+                with lat_mtx:
+                    lat["mempool"].append(dt)
+                j += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"mempool-{i}: {type(e).__name__}: {e}")
+            stop.set()
+
+    threads = [threading.Thread(target=consensus_loop, name="bench-consensus")]
+    threads += [
+        threading.Thread(target=mempool_loop, args=(i,), name=f"bench-mempool-{i}")
+        for i in range(senders)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 3)
+
+    stats = global_service().stats()
+    REPORT["value"] = pct(lat["consensus"], 0.5)
+    REPORT["classes"] = {
+        k: {
+            "count": len(v),
+            "p50_ms": pct(v, 0.5),
+            "p95_ms": pct(v, 0.95),
+        }
+        for k, v in lat.items()
+    }
+    REPORT["scheduler"] = {
+        "dispatched_batches": stats["dispatched_batches"],
+        "rejected": stats["rejected"],
+        "batch_max": stats["batch_max"],
+        "deadline_ms": stats["deadline_ms"],
+    }
+    if errors:
+        REPORT["error"] = "; ".join(errors[:4])
+    emit_and_exit()
+
+
 def main() -> None:
     _arm_run_watchdog()
     probe_backend()
     _enable_compile_cache()
+
+    if os.environ.get("BENCH_WORKLOAD", "") == "mixed":
+        _run_mixed()
 
     N = int(os.environ.get("BENCH_N", "10000"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))

@@ -357,6 +357,7 @@ class BlocksyncReactor(Reactor):
         at use time in _process_block."""
         from ..types.block import BlockID
         from ..types.validation import submit_verify_commit_light
+        from ..verifysvc.service import Klass
 
         vals = state.validators
         if vals is None:
@@ -384,7 +385,8 @@ class BlocksyncReactor(Reactor):
                         hash=blk.hash(), part_set_header=parts.header
                     )
                     p = submit_verify_commit_light(
-                        chain_id, vals, bid, hh, nxt.last_commit
+                        chain_id, vals, bid, hh, nxt.last_commit,
+                        klass=Klass.BLOCKSYNC,
                     )
             except Exception as e:  # noqa: BLE001
                 # structurally bad / malformed peer data (bad commit, odd
@@ -408,6 +410,7 @@ class BlocksyncReactor(Reactor):
         apply."""
         from ..types.block import BlockID
         from ..types.validation import verify_commit_light
+        from ..verifysvc.service import Klass
 
         chain_id = self.initial_state.chain_id
         if (
@@ -440,12 +443,13 @@ class BlocksyncReactor(Reactor):
                     first_id,
                     first.header.height,
                     second.last_commit,
+                    klass=Klass.BLOCKSYNC,
                 )
         with tracing.span(
             "blocksync.validate",
             {"height": first.header.height} if tracing.enabled() else None,
         ):
-            self.block_exec.validate_block(state, first)
+            self.block_exec.validate_block(state, first, klass=Klass.BLOCKSYNC)
 
         extensions_enabled = state.consensus_params.feature.vote_extensions_enabled(
             first.header.height

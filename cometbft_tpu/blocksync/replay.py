@@ -10,10 +10,12 @@ comb tables resident (models/comb_verifier.py) each block costs one
 ~V*130-byte transfer + one kernel dispatch; the doubling chains and
 pubkey decompressions that dominate cold verification are gone.
 
-The pipeline is a thin scheduler over CombBatchVerifier.submit()/
-collect() — all assembly, transfer, and readback logic lives in
-models/comb_verifier.py, so blocksync replay can never diverge from the
-consensus verifier's semantics.
+The pipeline is a thin scheduler over the verify service's blocksync
+class (verifysvc.ServiceBatchVerifier bound to the stream's comb cache
+entry) — all assembly, transfer, and readback logic lives in
+models/comb_verifier.py behind the service, so blocksync replay can
+never diverge from the consensus verifier's semantics and its batches
+never cut ahead of consensus-class work.
 """
 
 from __future__ import annotations
@@ -41,10 +43,13 @@ class CommitStreamVerifier:
     ) -> Iterator[tuple[bool, list[bool]]]:
         """Stream commits (each a list of (pubkey, msg, sig)) through the
         pipeline, yielding (all_ok, per_signature) in order."""
-        from ..models.comb_verifier import CombBatchVerifier
+        from ..verifysvc.client import ServiceBatchVerifier
+        from ..verifysvc.service import Klass
 
         for items in commits:
-            bv = CombBatchVerifier(self._entry)
+            bv = ServiceBatchVerifier(
+                Klass.BLOCKSYNC, mode=("comb", self._entry)
+            )
             for pub, msg, sig in items:
                 bv.add(pub, msg, sig)
             self._inflight.append((bv, bv.submit()))

@@ -33,6 +33,7 @@ from ..utils import tracing
 from ..utils.flightrec import recorder as _flightrec
 from ..utils.log import get_logger
 from ..utils.service import Service
+from ..verifysvc.service import Klass as _VerifyKlass
 from ..wire import wal_pb
 from ..wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE, Timestamp
 from .config import ConsensusConfig
@@ -847,7 +848,9 @@ class ConsensusState(Service):
                     self._sign_add_vote(PREVOTE_TYPE, b"", None)
                     return
         try:
-            self.block_exec.validate_block(self.state, rs.proposal_block)
+            self.block_exec.validate_block(
+                self.state, rs.proposal_block, klass=_VerifyKlass.CONSENSUS
+            )
             accepted = self.block_exec.process_proposal(rs.proposal_block, self.state)
         except Exception as e:  # noqa: BLE001
             self.logger.error(f"prevote: invalid proposal block: {e}")
@@ -901,7 +904,9 @@ class ConsensusState(Service):
         if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
             # lock onto the polka block
             try:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
+                self.block_exec.validate_block(
+                    self.state, rs.proposal_block, klass=_VerifyKlass.CONSENSUS
+                )
             except Exception as e:
                 raise ConsensusError(f"precommit: +2/3 prevoted an invalid block: {e}")
             rs.locked_round = round
@@ -966,7 +971,9 @@ class ConsensusState(Service):
         bid, _ = rs.votes.precommits(rs.commit_round).two_thirds_majority()
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
 
-        self.block_exec.validate_block(self.state, block)
+        self.block_exec.validate_block(
+            self.state, block, klass=_VerifyKlass.CONSENSUS
+        )
 
         from ..utils.fail import fail_point
 

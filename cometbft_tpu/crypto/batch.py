@@ -14,11 +14,7 @@ CPU backend in tests).
 
 from __future__ import annotations
 
-from ..models.verifier import (
-    BatchVerifier,
-    CpuEd25519BatchVerifier,
-    TpuEd25519BatchVerifier,
-)
+from ..models.verifier import BatchVerifier, CpuEd25519BatchVerifier
 from ..utils import envknobs
 from . import ed25519
 
@@ -51,30 +47,41 @@ def comb_async_min() -> int:
     return envknobs.get_int(envknobs.COMB_ASYNC_MIN)
 
 
-def create_batch_verifier(
-    key_type: str, pubkeys: list[bytes] | None = None
-) -> BatchVerifier:
-    """(crypto/batch/batch.go:10)  When the caller knows the validator
-    set (pubkeys, in set order), large sets route to the comb-cached
-    verifier: tables stay device-resident across calls, keyed by the set
-    (the reference's expanded-key LRU, ed25519.go:43,68, writ large)."""
-    if not supports_batch_verifier(key_type):
-        raise ValueError(f"no batch verifier for key type {key_type!r}")
+def device_capable() -> bool:
+    """Whether the accelerator data plane is selectable at all: the
+    backend knob allows it AND (in `auto`) JAX is importable.  The
+    verify-service clients (verifysvc/) use this to decide between the
+    scheduled device path and an inline host check."""
     be = backend()
     if be == "cpu":
-        return CpuEd25519BatchVerifier()
+        return False
     if be != "tpu":  # "auto": accelerator only when JAX is importable
         try:
             import jax  # noqa: F401
         except ImportError:
-            return CpuEd25519BatchVerifier()
-    if pubkeys is not None and len(pubkeys) >= comb_min():
-        from ..models.comb_verifier import CombBatchVerifier, global_cache
+            return False
+    return True
 
-        if len(pubkeys) >= comb_async_min():
-            entry = global_cache().ensure_async(list(pubkeys))
-            if entry is None:
-                return TpuEd25519BatchVerifier()  # tables still warming
-            return CombBatchVerifier(entry)
-        return CombBatchVerifier(global_cache().ensure(list(pubkeys)))
-    return TpuEd25519BatchVerifier()
+
+def create_batch_verifier(
+    key_type: str, pubkeys: list[bytes] | None = None, klass=None
+) -> BatchVerifier:
+    """(crypto/batch/batch.go:10)  Device-capable backends return a
+    verify-service client (verifysvc.ServiceBatchVerifier) bound to the
+    caller's priority class (default: consensus) — the service owns all
+    batching, scheduling, and device dispatch.  When the caller knows
+    the validator set (pubkeys, in set order), large sets bind to the
+    comb-cached program here, in the caller's thread: tables stay
+    device-resident across calls, keyed by the set (the reference's
+    expanded-key LRU, ed25519.go:43,68, writ large), and a first-sight
+    table build never runs on the shared scheduler thread."""
+    if not supports_batch_verifier(key_type):
+        raise ValueError(f"no batch verifier for key type {key_type!r}")
+    if not device_capable():
+        return CpuEd25519BatchVerifier()
+    from ..verifysvc.client import ServiceBatchVerifier, resolve_mode
+    from ..verifysvc.service import Klass
+
+    return ServiceBatchVerifier(
+        Klass.CONSENSUS if klass is None else klass, resolve_mode(pubkeys)
+    )

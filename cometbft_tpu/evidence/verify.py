@@ -16,6 +16,7 @@ from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
 )
+from ..verifysvc.service import Klass as _VerifyKlass
 
 DEFAULT_TRUST_LEVEL = Fraction(1, 3)  # light.DefaultTrustLevel
 
@@ -144,12 +145,18 @@ def verify_light_client_attack(
     cb = ev.conflicting_block
     if common_sh.header.height != cb.height:
         # lunatic: single trusting jump from the common header
+        # CONSENSUS class, not background: evidence carried by a
+        # proposed block verifies on the consensus critical path
+        # (BlockExecutor.validate_block -> check_evidence), and a
+        # lower class here would let mempool load starve prevotes on
+        # exactly the blocks that carry evidence
         verify_commit_light_trusting(
             chain_id,
             common_vals,
             cb.signed_header.commit,
             DEFAULT_TRUST_LEVEL,
             count_all_signatures=True,
+            klass=_VerifyKlass.CONSENSUS,
         )
     elif ev.conflicting_header_is_invalid(trusted_sh.header):
         raise EvidenceVerificationError(
@@ -164,6 +171,7 @@ def verify_light_client_attack(
         cb.height,
         cb.signed_header.commit,
         count_all_signatures=True,
+        klass=_VerifyKlass.CONSENSUS,
     )
 
     if ev.total_voting_power != common_vals.total_voting_power():

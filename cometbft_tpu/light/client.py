@@ -122,6 +122,7 @@ class Client:
         lb.validate_basic(self.chain_id)
         # 2/3 of its own claimed set must have signed it
         from ..types.validation import verify_commit_light
+        from ..verifysvc.service import Klass
 
         verify_commit_light(
             self.chain_id,
@@ -129,6 +130,7 @@ class Client:
             lb.signed_header.commit.block_id,
             lb.height,
             lb.signed_header.commit,
+            klass=Klass.BACKGROUND,
         )
         self.store.save_light_block(lb)
 

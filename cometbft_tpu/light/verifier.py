@@ -18,6 +18,7 @@ from ..types.validation import (
     verify_commit_light,
     verify_commit_light_trusting,
 )
+from ..verifysvc.service import Klass as _VerifyKlass
 
 DEFAULT_TRUST_LEVEL = Fraction(1, 3)
 DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000  # client.go:38
@@ -121,6 +122,7 @@ def verify_adjacent(
             untrusted_sh.commit.block_id,
             untrusted_sh.header.height,
             untrusted_sh.commit,
+            klass=_VerifyKlass.BACKGROUND,
         )
     except Exception as e:  # noqa: BLE001
         raise ErrInvalidHeader(f"invalid commit: {e}") from e
@@ -156,6 +158,7 @@ def verify_non_adjacent(
             untrusted_sh.commit,
             trust_level,
             cache=cache,
+            klass=_VerifyKlass.BACKGROUND,
         )
     except NotEnoughVotingPowerError as e:
         raise ErrNewValSetCantBeTrusted(str(e)) from e
@@ -169,6 +172,7 @@ def verify_non_adjacent(
             untrusted_sh.header.height,
             untrusted_sh.commit,
             cache=cache,
+            klass=_VerifyKlass.BACKGROUND,
         )
     except Exception as e:  # noqa: BLE001
         raise ErrInvalidHeader(f"invalid commit: {e}") from e

@@ -3,6 +3,7 @@
 """
 
 from .mempool import (
+    InvalidTxSignatureError,
     Mempool,
     MempoolError,
     TxInCacheError,
@@ -15,6 +16,7 @@ from .nop import NopMempool
 from .cache import LRUTxCache, NopTxCache
 
 __all__ = [
+    "InvalidTxSignatureError",
     "Mempool",
     "MempoolError",
     "TxInCacheError",

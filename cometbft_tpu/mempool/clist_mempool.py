@@ -27,6 +27,7 @@ from ..wire.proto import encode_varint
 from .cache import LRUTxCache, NopTxCache
 from .mempool import (
     AppCheckError,
+    InvalidTxSignatureError,
     Mempool,
     MempoolFullError,
     TxInCacheError,
@@ -186,6 +187,22 @@ class CListMempool(Mempool):
                         entry.senders.add(sender)
                     raise TxInMempoolError
             raise TxInCacheError
+        # Signed-envelope admission gate — the verify service's mempool
+        # client (verifysvc/checktx): per-tx ed25519 checks from
+        # concurrent senders coalesce into one device batch; unsigned
+        # txs pass through untouched.  Runs AFTER the cache dedup (a
+        # replayed tx never re-verifies) and BEFORE the app round trip.
+        try:
+            self._check_tx_signature(tx, key)
+        except InvalidTxSignatureError:
+            raise  # cache already handled per keep_invalid_txs_in_cache
+        except Exception:
+            # transient verify-plane failure: the tx was never judged —
+            # same contract as an app-connection error below, the key
+            # must leave the cache or the tx is unsubmittable until
+            # LRU eviction
+            self.cache.remove(key)
+            raise
         try:
             res = self.proxy_app.check_tx(
                 pb.CheckTxRequest(tx=tx, type=pb.CHECK_TX_TYPE_CHECK)
@@ -194,6 +211,21 @@ class CListMempool(Mempool):
             self.cache.remove(key)
             raise
         self._handle_check_result(tx, key, sender, res)
+
+    def _check_tx_signature(self, tx: bytes, key: bytes) -> None:
+        from ..utils import envknobs
+        from ..utils.metrics import hub as _mhub
+
+        if not envknobs.get_bool(envknobs.VERIFYSVC_CHECKTX):
+            return
+        from ..verifysvc import checktx as _checktx
+
+        sig_ok = _checktx.verify_tx_signature(tx)
+        if sig_ok is False:
+            _mhub().mp_failed_txs.inc()
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            raise InvalidTxSignatureError()
 
     def _handle_check_result(
         self, tx: bytes, key: bytes, sender: str, res: pb.CheckTxResponse

@@ -36,6 +36,16 @@ class PreCheckError(MempoolError):
     pass
 
 
+class InvalidTxSignatureError(MempoolError):
+    """The tx carries the signed-tx envelope (verifysvc/checktx) and its
+    ed25519 signature does not verify — rejected before the app ever
+    sees it."""
+
+    def __init__(self):
+        super().__init__("invalid tx signature (ed25519 envelope)")
+        self.code = -2  # node-side rejection, distinct from app codes
+
+
 class AppCheckError(MempoolError):
     """CheckTx returned a non-OK code (mempool.ErrInvalidTx)."""
 

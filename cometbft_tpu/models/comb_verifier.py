@@ -13,6 +13,11 @@ on device (ops/sha2.sha512_blocks) so the host never runs a per-signature
 hash loop, and the result comes back as one packed bitmap + one all-ok
 scalar instead of a per-row bool array.
 
+Like the uncached verifier, CombBatchVerifier is data plane only:
+production consumers reach it through the unified verify service
+(verifysvc/ — a request bound to a cache entry via
+``mode=("comb", entry)`` dispatches as one solo batch on the scheduler).
+
 Shapes are keyed by the validator-set size V, not a power-of-two bucket:
 commits verify against a fixed known set, so one compiled program per
 chain (10,000 lanes for the 10k-validator config, not 16,384).  Rows for

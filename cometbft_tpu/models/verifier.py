@@ -10,6 +10,12 @@ The TPU provider assembles the batch on host (numpy), pads to a
 power-of-two bucket so XLA compiles a handful of shapes, and runs the
 fully fused kernel from ops/ed25519.verify_batch.  A CPU provider with
 identical semantics backs tests and TPU-less hosts.
+
+These classes are the DATA PLANE only: production consumers never
+submit to them directly — all scheduling, batching, and dispatch goes
+through the unified verify service (verifysvc/service.py), whose
+scheduler constructs these verifiers per dispatched batch
+(docs/verify_service.md).
 """
 
 from __future__ import annotations

@@ -208,14 +208,43 @@ class Switch(Service):
         )
         if addr:
             peer.set("dial_addr", addr)
-        try:
-            self.peers.add(peer)
-        except ValueError:
+        if self.peers.has(peer.id):
+            # duplicate (e.g. simultaneous dial+accept): cheap pre-check
+            # before spending a peer.start(); the authoritative dedup is
+            # the add() below
             conn.close()
             return
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
+        # start BEFORE registering in the PeerSet: a peer must never be
+        # visible to broadcast() until its mconn is running, or an
+        # immediate best-effort broadcast try_sends into a stopped mconn
+        # and is silently dropped (the add-before-start race PR 3 could
+        # only harden a test against)
         peer.start()
+        try:
+            self.peers.add(peer)
+        except ValueError:
+            # lost a simultaneous-connect race after start: tear down
+            # ours, the registered winner carries the traffic
+            try:
+                peer.stop()
+            except Exception as e:  # noqa: BLE001 — same contract as stop_peer
+                self.logger.warning(
+                    f"duplicate peer {peer.id[:8]} stop failed: {e!r}"
+                )
+                _metrics_hub().p2p_errors.inc(site="peer_stop")
+            return
+        if not peer.is_running() or not peer.mconn.is_running():
+            # died between start() and add() (remote hung up instantly):
+            # its on_error fired while the peer was unregistered, so
+            # stop_peer() no-opped — finish the teardown now that it IS
+            # registered, reaching every reactor's remove_peer.  The
+            # mconn check matters on its own: an mconn error stops only
+            # the mconn (suppressing further callbacks), leaving the
+            # Peer service "running" but permanently undeliverable
+            self.stop_peer(peer, "peer died during handshake")
+            return
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
         self.logger.info(

@@ -692,6 +692,16 @@ class Environment:
 
         return recorder().dump()
 
+    def verify_svc_status(self) -> dict:
+        """Verify-service scheduler snapshot (ours, no reference
+        analogue): per-class queue depths, dispatched/rejected batch
+        tallies, and the effective batch/deadline/weight configuration
+        (verifysvc/service.py).  Complements the `verify_svc_*` series
+        on /metrics with an on-demand structured view."""
+        from ..verifysvc.service import global_service
+
+        return global_service().stats()
+
     def consensus_params(self, height=None) -> dict:
         h = self._height_or_latest(height)
         params = self.node.state_store.load_consensus_params(h)
@@ -792,5 +802,6 @@ ROUTES = {
     "consensus_state": ("", Environment.consensus_state),
     "dump_consensus_state": ("", Environment.dump_consensus_state),
     "dump_consensus_trace": ("", Environment.dump_consensus_trace),
+    "verify_svc_status": ("", Environment.verify_svc_status),
     "consensus_params": ("height", Environment.consensus_params),
 }

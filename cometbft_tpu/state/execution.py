@@ -133,8 +133,13 @@ def validate_validator_updates(
     return vals
 
 
-def validate_block(state: State, block: Block) -> None:
-    """Full contextual validation (state/validation.go:17 validateBlock)."""
+def validate_block(state: State, block: Block, klass=None) -> None:
+    """Full contextual validation (state/validation.go:17 validateBlock).
+
+    klass: the caller's verify-service priority class for the LastCommit
+    device batch (verifysvc.Klass; None = consensus) — consensus proposal
+    validation and blocksync catch-up share this code path but must not
+    share a scheduling class."""
     block.validate_basic()
 
     h = block.header
@@ -189,6 +194,7 @@ def validate_block(state: State, block: Block) -> None:
             state.last_block_id,
             h.height - 1,
             block.last_commit,
+            klass=klass,
         )
 
     if len(h.proposer_address) != 20:
@@ -370,9 +376,9 @@ class BlockExecutor:
 
     # ------------------------------------------------------- validating
 
-    def validate_block(self, state: State, block: Block) -> None:
+    def validate_block(self, state: State, block: Block, klass=None) -> None:
         """Contextual validation + evidence checks (execution.go:201)."""
-        validate_block(state, block)
+        validate_block(state, block, klass=klass)
         self.ev_pool.check_evidence(block.evidence)
 
     # --------------------------------------------------------- applying

@@ -3,7 +3,10 @@ types/validation.go, 529 LoC; "the heart of the north star" per SURVEY.md).
 
 verify_commit* assemble a batch of (pubkey, sign-bytes, signature) triples
 and hand it to the BatchVerifier seam (crypto/batch.create_batch_verifier),
-where the TPU provider runs the fused Ed25519 kernel; on batch failure the
+which routes device-capable backends through the unified verify service
+(verifysvc/: priority-scheduled batching; the `klass` parameter below is
+the caller's priority class — consensus by default, blocksync for the
+catch-up path, background for light/evidence); on batch failure the
 per-signature validity vector assigns blame exactly like the reference
 (validation.go:384-399), and a sequential fallback covers heterogeneous
 key sets (shouldBatchVerify, validation.go:17-21).
@@ -79,6 +82,7 @@ def verify_commit(
     block_id: BlockID,
     height: int,
     commit: Commit,
+    klass=None,
 ) -> None:
     """+2/3 of the set signed this commit; checks ALL signatures (the ABCI
     app's incentive logic depends on every flag being right)
@@ -91,6 +95,7 @@ def verify_commit(
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=True, lookup_by_index=True, cache=None,
+            klass=klass,
         )
     else:
         _verify_commit_single(
@@ -107,6 +112,7 @@ def verify_commit_light(
     commit: Commit,
     count_all_signatures: bool = False,
     cache: SignatureCache | None = None,
+    klass=None,
 ) -> None:
     """+2/3 check that may exit early — the light-client / blocksync path
     (validation.go:65-147)."""
@@ -118,7 +124,7 @@ def verify_commit_light(
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=count_all_signatures, lookup_by_index=True,
-            cache=cache,
+            cache=cache, klass=klass,
         )
     else:
         _verify_commit_single(
@@ -135,6 +141,7 @@ def verify_commit_light_trusting(
     trust_level: Fraction = Fraction(1, 3),
     count_all_signatures: bool = False,
     cache: SignatureCache | None = None,
+    klass=None,
 ) -> None:
     """trustLevel of a *trusted* set signed this commit; validators are
     looked up by address since the sets differ (validation.go:150-253)."""
@@ -152,7 +159,7 @@ def verify_commit_light_trusting(
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=count_all_signatures, lookup_by_index=False,
-            cache=cache,
+            cache=cache, klass=klass,
         )
     else:
         _verify_commit_single(
@@ -297,11 +304,13 @@ def _verify_commit_batch(
     count_all_signatures: bool,
     lookup_by_index: bool,
     cache: SignatureCache | None,
+    klass=None,
 ) -> None:
-    """(validation.go:265) — batch assembly, power tally, TPU verify, blame."""
+    """(validation.go:265) — batch assembly, power tally, verify-service
+    dispatch (TPU), blame."""
     proposer = vals.get_proposer()
     bv = crypto_batch.create_batch_verifier(
-        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
+        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes(), klass=klass
     )
     batch_sig_idxs, sign_bytes_at = _assemble_commit_batch(
         bv, chain_id, vals, commit, voting_power_needed, ignore_sig,
@@ -356,6 +365,7 @@ def submit_verify_commit_light(
     commit: Commit,
     count_all_signatures: bool = False,
     cache: SignatureCache | None = None,
+    klass=None,
 ) -> PendingCommitVerification | None:
     """Asynchronous verify_commit_light (reactor.go:547's hot path,
     pipelined): run every host-side phase that can raise immediately —
@@ -374,7 +384,7 @@ def submit_verify_commit_light(
         return None
     proposer = vals.get_proposer()
     bv = crypto_batch.create_batch_verifier(
-        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes()
+        proposer.pub_key.type, pubkeys=vals.pub_keys_bytes(), klass=klass
     )
     if not hasattr(bv, "submit"):
         return None  # host verifier: no async seam, caller runs sync

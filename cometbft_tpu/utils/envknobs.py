@@ -90,6 +90,49 @@ BLS_DEVICE = _declare(
     "(ops/bls381); pairings always run on host.",
 )
 
+# verify service (verifysvc/ — priority-scheduled device batching)
+VERIFYSVC_BATCH_MAX = _declare(
+    "COMETBFT_TPU_VERIFYSVC_BATCH_MAX", "int", 4096,
+    "Verify-service batch width: a class's queue flushes as `full` once "
+    "this many signatures are pending (clamped to >= 1).",
+)
+VERIFYSVC_QUEUE_MAX = _declare(
+    "COMETBFT_TPU_VERIFYSVC_QUEUE_MAX", "int", 16384,
+    "Per-class queue bound in signatures; a submit beyond it is rejected "
+    "with backpressure and the caller falls back to host verification.",
+)
+VERIFYSVC_DEADLINE_CONSENSUS_MS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_DEADLINE_CONSENSUS_MS", "int", 0,
+    "Flush deadline (ms) for the consensus class: 0 = dispatch the "
+    "moment the scheduler sees a request.",
+)
+VERIFYSVC_DEADLINE_BLOCKSYNC_MS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_DEADLINE_BLOCKSYNC_MS", "int", 2,
+    "Flush deadline (ms) for the blocksync class.",
+)
+VERIFYSVC_DEADLINE_MEMPOOL_MS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_DEADLINE_MEMPOOL_MS", "int", 5,
+    "Flush deadline (ms) for the mempool class — the coalescing window "
+    "that merges per-tx CheckTx signature checks from concurrent "
+    "senders into one device batch.",
+)
+VERIFYSVC_DEADLINE_BACKGROUND_MS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_DEADLINE_BACKGROUND_MS", "int", 25,
+    "Flush deadline (ms) for the background class (light client, "
+    "evidence).",
+)
+VERIFYSVC_WEIGHTS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_WEIGHTS", "str", "",
+    "Optional weighted interleave of READY classes, e.g. "
+    "`consensus=8,blocksync=4,mempool=2,background=1`; empty/malformed "
+    "= strict priority (consensus > blocksync > mempool > background).",
+)
+VERIFYSVC_CHECKTX = _declare(
+    "COMETBFT_TPU_VERIFYSVC_CHECKTX", "bool", True,
+    "`0` disables the mempool CheckTx ed25519 envelope gate "
+    "(verifysvc/checktx); unsigned txs always pass through untouched.",
+)
+
 # blocksync
 VERIFY_AHEAD = _declare(
     "COMETBFT_TPU_VERIFY_AHEAD", "int?", None,

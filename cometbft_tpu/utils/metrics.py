@@ -346,6 +346,33 @@ class Hub:
             "building; building = async build in flight, batch routed "
             "to the uncached kernel)",
         )
+        # ---- verify service scheduler (verifysvc/service.py)
+        self.verify_svc_queue_depth = r.gauge(
+            "verify_svc_queue_depth",
+            "Signatures queued per verify-service priority class "
+            "(label class=consensus|blocksync|mempool|background)",
+        )
+        self.verify_svc_flush = r.counter(
+            "verify_svc_flush_total",
+            "Verify-service batch flushes (labels class, reason=full|"
+            "deadline: full = batch width reached, deadline = class "
+            "flush deadline expired first)",
+        )
+        self.verify_svc_rejected = r.counter(
+            "verify_svc_rejected_total",
+            "Verify-service submissions rejected with backpressure "
+            "(label class); callers fall back to host verification",
+        )
+        self.verify_svc_queue_wait = r.histogram(
+            "verify_svc_queue_wait_seconds",
+            "Time a request spent queued in the verify service before "
+            "dispatch (label class) — consensus should pin the lowest "
+            "buckets regardless of mempool load",
+            buckets=(
+                0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.5,
+            ),
+        )
         self.verify_phase_seconds = r.histogram(
             "verify_phase_seconds",
             "Per-phase VerifyCommit pipeline latency (label phase="

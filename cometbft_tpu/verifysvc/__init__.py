@@ -1,0 +1,37 @@
+"""Unified verify service: priority-scheduled device batching for every
+signature-verification workload (see service.py for the design).
+
+Clients:
+  * consensus VerifyCommit + evidence checks — Klass.CONSENSUS
+    (types/validation.py; evidence/verify.py runs on the proposal
+    validation path, so it shares the consensus class)
+  * blocksync verify-ahead/replay — Klass.BLOCKSYNC (blocksync/)
+  * light client                  — Klass.BACKGROUND (light/)
+  * mempool CheckTx               — Klass.MEMPOOL (checktx.py)
+
+A new workload joins by calling ``global_service().submit(items, klass)``
+or by constructing a :class:`ServiceBatchVerifier` — never by driving
+models/verifier.py or models/comb_verifier.py directly (docs/
+verify_service.md has the checklist).
+"""
+
+from .client import ServiceBatchVerifier, resolve_mode
+from .service import (
+    Klass,
+    Ticket,
+    VerifyService,
+    VerifyServiceBackpressure,
+    global_service,
+    reset_global_service,
+)
+
+__all__ = [
+    "Klass",
+    "ServiceBatchVerifier",
+    "Ticket",
+    "VerifyService",
+    "VerifyServiceBackpressure",
+    "global_service",
+    "reset_global_service",
+    "resolve_mode",
+]

@@ -1,0 +1,82 @@
+"""Mempool CheckTx signature gate — the verify service's fourth client.
+
+At production scale per-tx signature checks on mempool ingest dwarf
+commit verification (ROADMAP item 4; the FPGA verification-engine study
+arXiv:2112.02229 makes the same point for permissioned chains), and
+before this module they never touched the accelerator: the reference
+delegates tx signature checking entirely to the application.
+
+This module defines a minimal *signed-tx envelope* the node itself can
+verify before the tx ever reaches the app's CheckTx:
+
+    ``MAGIC(8) | pubkey(32) | signature(64) | payload``
+
+with the ed25519 signature over ``SIGN_DOMAIN + payload`` (domain
+separation: a tx signature can never be replayed as a vote signature or
+vice versa).  Transactions that don't start with the magic are passed
+through untouched — the gate is opt-in per tx, so apps with their own
+signature schemes lose nothing.
+
+Each CheckTx caller submits its single (pubkey, msg, sig) to the verify
+service's MEMPOOL class; the class's flush deadline is the coalescing
+window that merges checks from concurrent senders (p2p gossip threads,
+RPC broadcast handlers) into one device batch.  When the device backend
+isn't selectable, or the service pushes back, the check runs on the host
+(``crypto/ed25519.verify_signature``) — bit-identical semantics either
+way (both ends are ZIP-215; tests/test_comb_tree.py pins kernel == host).
+"""
+
+from __future__ import annotations
+
+from ..crypto import ed25519 as host_ed25519
+from .service import Klass, VerifyService, VerifyServiceBackpressure, global_service
+
+MAGIC = b"\xd0sigtx1\x00"
+SIGN_DOMAIN = b"cometbft-tpu/sigtx/v1|"
+_HEADER_LEN = len(MAGIC) + 32 + 64
+
+
+def make_signed_tx(priv_key, payload: bytes) -> bytes:
+    """Wrap payload in the signed envelope (tests, loadgen, bench)."""
+    sig = priv_key.sign(SIGN_DOMAIN + payload)
+    return MAGIC + priv_key.pub_key().data + sig + payload
+
+
+def parse_signed_tx(tx: bytes) -> tuple[bytes, bytes, bytes] | None:
+    """(pubkey, signature, payload) when tx carries the envelope, else
+    None (an unsigned tx — not an error)."""
+    if len(tx) < _HEADER_LEN or not tx.startswith(MAGIC):
+        return None
+    off = len(MAGIC)
+    return tx[off : off + 32], tx[off + 32 : off + 96], tx[_HEADER_LEN:]
+
+
+def verify_tx_signature(
+    tx: bytes, service: VerifyService | None = None
+) -> bool | None:
+    """Verify a tx's envelope signature through the verify service.
+
+    Returns None for unsigned txs (no envelope), True/False for signed
+    ones.  Device-batched through the MEMPOOL class when the accelerator
+    backend is selectable; host verification otherwise and on
+    backpressure — the caller never needs to know which path ran."""
+    parsed = parse_signed_tx(tx)
+    if parsed is None:
+        return None
+    pub, sig, payload = parsed
+    msg = SIGN_DOMAIN + payload
+    svc = service
+    if svc is None:
+        from ..crypto import batch as crypto_batch
+
+        if crypto_batch.device_capable():
+            svc = global_service()
+    if svc is not None:
+        try:
+            _, per = svc.submit([(pub, msg, sig)], Klass.MEMPOOL).collect()
+            return bool(per and per[0])
+        except VerifyServiceBackpressure:
+            pass  # admission control said no: fall through to the host
+        except ValueError:
+            return False  # malformed pubkey/sig lengths can't be valid
+    return host_ed25519.verify_signature(pub, msg, sig)

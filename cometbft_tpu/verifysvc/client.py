@@ -1,0 +1,129 @@
+"""Client-side adapters for the verify service.
+
+:class:`ServiceBatchVerifier` implements the BatchVerifier contract
+(crypto/crypto.go:47-55) — add() accumulates, verify()/submit()/collect()
+resolve — but routes the batch through the process-global
+:class:`~cometbft_tpu.verifysvc.service.VerifyService` instead of driving
+a device verifier directly.  crypto/batch.create_batch_verifier returns
+one of these whenever the device backend is selectable, so every legacy
+call site (types/validation, blocksync, light, evidence) became a verify
+-service client without changing its own shape.
+
+Backpressure handling lives here, on the caller's side of the seam: a
+rejected submit degrades to an inline host verification
+(`verify.svc_fallback` span) — correct results, no device batching, and
+the rejection is already counted/flight-recorded by the service.
+"""
+
+from __future__ import annotations
+
+from ..utils import tracing
+from .service import (
+    MODE_PLAIN,
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+    global_service,
+)
+
+
+def resolve_mode(pubkeys: list[bytes] | None):
+    """Bind a request to its device program up front, in the CALLER's
+    thread — exactly where the comb-table ensure()/ensure_async() cost
+    landed before the service existed (a 10k-validator table build must
+    never run on, and block, the shared scheduler thread).
+
+    Mirrors the pre-service routing of crypto/batch.create_batch_verifier:
+    large known validator sets use the comb-cached program (background
+    build while warming -> uncached), everything else the uncached
+    kernel."""
+    if pubkeys is None:
+        return MODE_PLAIN
+    from ..crypto import batch as crypto_batch
+
+    if len(pubkeys) < crypto_batch.comb_min():
+        return MODE_PLAIN
+    from ..models.comb_verifier import global_cache
+
+    if len(pubkeys) >= crypto_batch.comb_async_min():
+        entry = global_cache().ensure_async(list(pubkeys))
+        if entry is None:
+            return MODE_PLAIN  # tables still warming: uncached kernel
+        return ("comb", entry)
+    return ("comb", global_cache().ensure(list(pubkeys)))
+
+
+class ServiceBatchVerifier:
+    """BatchVerifier bound to a priority class of the verify service.
+
+    Exposes the same async submit()/collect() seam as the device
+    verifiers it replaced, so pipelined callers (blocksync verify-ahead,
+    types/validation.submit_verify_commit_light) work unchanged."""
+
+    def __init__(
+        self,
+        klass: Klass = Klass.CONSENSUS,
+        mode=MODE_PLAIN,
+        service: VerifyService | None = None,
+    ):
+        self._klass = klass
+        self._mode = mode
+        self._svc = service
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+        self.last_timings: dict[str, float] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def klass(self) -> Klass:
+        return self._klass
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        if len(pub_key) != 32 or len(sig) != 64:
+            raise ValueError("malformed ed25519 pubkey or signature")
+        if len(msg) >= 1 << 24:
+            # the comb payload's mlen field is 3 bytes (models/
+            # comb_verifier); raise at add() time like CombBatchVerifier
+            # did, not as a deferred dispatch failure
+            raise ValueError("message too large for batch verification")
+        self._items.append((pub_key, msg, sig))
+
+    def _service(self) -> VerifyService:
+        if self._svc is None:
+            self._svc = global_service()
+        return self._svc
+
+    def submit(self):
+        """Enqueue with the service and return an opaque ticket for
+        collect().  On backpressure the batch is verified inline on the
+        host — the caller-side fallback of the admission-control loop."""
+        if not self._items:
+            return ("sync", (False, []))
+        try:
+            return ("svc", self._service().submit(
+                list(self._items), self._klass, self._mode
+            ))
+        except VerifyServiceBackpressure:
+            from ..models.verifier import CpuEd25519BatchVerifier
+
+            cpu = CpuEd25519BatchVerifier()
+            cpu._items = list(self._items)
+            with tracing.span(
+                "verify.svc_fallback",
+                {"class": self._klass.label, "sigs": len(cpu._items)}
+                if tracing.enabled() else None,
+            ):
+                return ("sync", cpu.verify())
+
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        kind, payload = ticket
+        if kind == "sync":
+            return payload
+        result = payload.collect()
+        if payload.timings:
+            self.last_timings.update(payload.timings)
+        return result
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        return self.collect(self.submit())

@@ -1,0 +1,643 @@
+"""The unified verify service: one priority-scheduled seam in front of
+the device verify pipeline.
+
+Every signature-verification workload in the node — consensus
+VerifyCommit, blocksync verify-ahead, the uncached fallback during comb
+table warming, and mempool CheckTx — submits through this service
+instead of driving the device verifiers (models/verifier.py,
+models/comb_verifier.py) directly.  The service owns:
+
+  * **Priority classes** (consensus > blocksync > mempool > background):
+    a strict-priority scheduler dispatches ready consensus batches
+    before anything else, so a flood of mempool CheckTx traffic can
+    never delay a commit verification behind it.  An optional weighted
+    mode (``COMETBFT_TPU_VERIFYSVC_WEIGHTS``) trades strictness for
+    proportional interleave when starvation of low classes matters more
+    than worst-case consensus latency.
+  * **Adaptive batch formation**: a class's queue flushes when the
+    pending signature count reaches the batch width
+    (``COMETBFT_TPU_VERIFYSVC_BATCH_MAX``, reason=``full``) or when its
+    oldest request has waited the class's flush deadline
+    (``COMETBFT_TPU_VERIFYSVC_DEADLINE_<CLASS>_MS``, reason=
+    ``deadline``), whichever comes first.  Consensus's deadline is 0 —
+    its batches dispatch the moment the scheduler sees them — while
+    mempool's small deadline is the coalescing window that merges per-tx
+    CheckTx signature checks from concurrent senders into one device
+    batch (the batch-width lever of arXiv:2302.00418; the
+    tx-offload argument of arXiv:2112.02229).
+  * **Bounded queues + backpressure**: each class's queue admits at most
+    ``COMETBFT_TPU_VERIFYSVC_QUEUE_MAX`` signatures; a submit beyond
+    that raises :class:`VerifyServiceBackpressure` (counted in
+    ``verify_svc_rejected_total{class}``, flight-recorded) and the
+    caller falls back to host verification — admission control, not an
+    unbounded latency cliff.
+
+Requests within one class that carry no validator-set binding coalesce
+into shared batches; comb-bound requests (a whole commit against a
+cached validator set) dispatch solo, because the comb program scatters
+one row per validator.  Per-request blame order is preserved exactly:
+each ticket's per-signature list follows its own add() order however
+batches were merged or completed.
+
+The scheduler thread only *dispatches* (the underlying submit() seam is
+asynchronous — payload staging runs on the comb staging thread); a
+separate collector thread drains results in dispatch order and resolves
+tickets, so the scheduler is free to form the next batch while the
+device runs the previous one.  Batches whose submit() does real inline
+work — host-routed verifies below the device threshold, demoted comb
+batches, and the uncached path's assembly/compile — go to a dedicated
+host worker draining a CLASS-PRIORITY queue instead: that compute on
+the scheduler thread would delay a consensus dispatch behind a mempool
+batch, the inversion the class system exists to prevent, and the
+priority queue bounds a queued consensus batch's extra wait to at most
+one in-flight lower-class task.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from enum import IntEnum
+
+from ..utils import envknobs, tracing
+from ..utils.flightrec import recorder as _flightrec
+from ..utils.log import get_logger
+from ..utils.metrics import hub as _mhub
+
+
+class Klass(IntEnum):
+    """Priority classes, highest first (lower value = dispatched first)."""
+
+    CONSENSUS = 0
+    BLOCKSYNC = 1
+    MEMPOOL = 2
+    BACKGROUND = 3
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+_DEADLINE_KNOBS = {
+    Klass.CONSENSUS: envknobs.VERIFYSVC_DEADLINE_CONSENSUS_MS,
+    Klass.BLOCKSYNC: envknobs.VERIFYSVC_DEADLINE_BLOCKSYNC_MS,
+    Klass.MEMPOOL: envknobs.VERIFYSVC_DEADLINE_MEMPOOL_MS,
+    Klass.BACKGROUND: envknobs.VERIFYSVC_DEADLINE_BACKGROUND_MS,
+}
+
+# request modes: how the dispatcher binds a batch to a device program.
+# ("plain",)        -> uncached kernel (power-of-two bucket shapes);
+#                      coalescible with other plain requests of the class
+# ("comb", entry)   -> comb-cached program bound to a valset cache entry
+#                      (models/comb_verifier); dispatches solo — the
+#                      scatter is one row per validator, so two commits
+#                      against the same set cannot share a program call
+MODE_PLAIN = ("plain",)
+
+# host-queue shutdown sentinel: sorts after every real class so queued
+# work settles before the worker exits
+_HOST_SENTINEL_PRIO = 1 << 30
+
+
+class VerifyServiceBackpressure(Exception):
+    """A class's queue is at its signature bound; the caller must fall
+    back to host verification (or shed the request)."""
+
+    def __init__(self, klass: Klass, queued: int, limit: int):
+        super().__init__(
+            f"verify service backpressure: class {klass.label} has "
+            f"{queued} signatures queued (limit {limit})"
+        )
+        self.klass = klass
+        self.queued = queued
+        self.limit = limit
+
+
+class Ticket:
+    """Handle for one submitted request; collect() blocks for
+    (all_ok, per_signature) in the request's own add() order, or raises
+    whatever the dispatch/collect path raised."""
+
+    __slots__ = ("_ev", "_result", "_exc", "nsigs", "timings")
+
+    def __init__(self, nsigs: int):
+        self._ev = threading.Event()
+        self._result: tuple[bool, list[bool]] | None = None
+        self._exc: BaseException | None = None
+        self.nsigs = nsigs
+        self.timings: dict[str, float] = {}
+
+    def _resolve(self, result, timings=None) -> None:
+        self._result = result
+        if timings:
+            self.timings = dict(timings)
+        self._ev.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def collect(self, timeout: float | None = None) -> tuple[bool, list[bool]]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("verify service ticket not resolved in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Request:
+    __slots__ = ("items", "klass", "mode", "ticket", "enq")
+
+    def __init__(self, items, klass: Klass, mode):
+        self.items = items
+        self.klass = klass
+        self.mode = mode
+        self.ticket = Ticket(len(items))
+        self.enq = time.monotonic()
+
+
+def _parse_weights(spec: str) -> dict[Klass, int]:
+    """``"consensus=8,blocksync=4,mempool=2,background=1"`` -> weights.
+    Forgiving like the rest of the knob layer: malformed entries are
+    dropped, an empty result means strict priority."""
+    out: dict[Klass, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        try:
+            k = Klass[name.strip().upper()]
+            w = int(val)
+        except (KeyError, ValueError):
+            continue
+        if w >= 1:
+            out[k] = w
+    return out
+
+
+class VerifyService:
+    """Priority-scheduled batching front of the device verify pipeline.
+
+    Construction reads the ``COMETBFT_TPU_VERIFYSVC_*`` knobs once;
+    explicit constructor arguments override them (tests).  Threads start
+    lazily on first submit and are daemons; :meth:`stop` tears them down
+    (in-flight tickets are failed, not leaked).
+    """
+
+    def __init__(
+        self,
+        batch_max: int | None = None,
+        queue_max: int | None = None,
+        deadlines_ms: dict[Klass, float] | None = None,
+        weights: dict[Klass, int] | None = None,
+    ):
+        self.batch_max = max(
+            1, batch_max if batch_max is not None
+            else envknobs.get_int(envknobs.VERIFYSVC_BATCH_MAX)
+        )
+        self.queue_max = max(
+            1, queue_max if queue_max is not None
+            else envknobs.get_int(envknobs.VERIFYSVC_QUEUE_MAX)
+        )
+        if deadlines_ms is None:
+            deadlines_ms = {
+                k: max(0, envknobs.get_int(knob))
+                for k, knob in _DEADLINE_KNOBS.items()
+            }
+        self._deadline_s = {
+            k: float(deadlines_ms.get(k, 0)) / 1e3 for k in Klass
+        }
+        self._weights = (
+            dict(weights) if weights is not None
+            else _parse_weights(envknobs.get_str(envknobs.VERIFYSVC_WEIGHTS))
+        )
+        self._credits: dict[Klass, int] = {}
+        self._queues: dict[Klass, list[_Request]] = {k: [] for k in Klass}
+        self._queued_sigs: dict[Klass, int] = {k: 0 for k in Klass}
+        self._cond = threading.Condition()
+        self._collectq: queue.Queue = queue.Queue()
+        # class-priority queue for batches whose submit() runs real work
+        # inline (host routes, uncached assembly, cold-shape compiles):
+        # entries (klass_value, seq, (bv, batch)); lower tuples first so
+        # a queued consensus batch always overtakes queued mempool work
+        self._hostq: queue.PriorityQueue = queue.PriorityQueue()
+        self._hostseq = 0
+        self._running = False
+        self._threads: list[threading.Thread] = []
+        self._start_once = threading.Lock()
+        self.logger = get_logger("verifysvc")
+        # service-local tallies mirrored to hub metrics; the RPC status
+        # endpoint reads these without scraping /metrics
+        self._dispatched: dict[str, int] = {k.label: 0 for k in Klass}
+        self._rejected: dict[str, int] = {k.label: 0 for k in Klass}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _ensure_started(self) -> None:
+        if self._running:
+            return
+        with self._start_once:
+            if self._running:
+                return
+            self._running = True
+            self._threads = [
+                threading.Thread(
+                    target=self._sched_loop, name="verifysvc-sched",
+                    daemon=True,
+                ),
+                threading.Thread(
+                    target=self._collect_loop, name="verifysvc-collect",
+                    daemon=True,
+                ),
+                threading.Thread(
+                    target=self._host_loop, name="verifysvc-host",
+                    daemon=True,
+                ),
+            ]
+            for t in self._threads:
+                t.start()
+
+    def stop(self) -> None:
+        """Tear down the scheduler/collector (tests).  Queued requests
+        are failed with backpressure so no caller blocks forever."""
+        with self._cond:
+            if not self._running:
+                return
+            self._running = False
+            stranded = [r for q in self._queues.values() for r in q]
+            for k in Klass:
+                self._queues[k] = []
+                self._queued_sigs[k] = 0
+            self._cond.notify_all()
+        self._collectq.put(None)
+        self._hostq.put((_HOST_SENTINEL_PRIO, 0, None))
+        for r in stranded:
+            r.ticket._fail(
+                VerifyServiceBackpressure(r.klass, 0, self.queue_max)
+            )
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        # a dispatch racing the sentinels can land its batch AFTER a
+        # worker exited: fail those tickets too — stop() must never
+        # leave a caller parked in collect() forever
+        def _fail_batch(batch):
+            for r in batch:
+                r.ticket._fail(
+                    VerifyServiceBackpressure(r.klass, 0, self.queue_max)
+                )
+
+        while True:
+            try:
+                item = self._collectq.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                _fail_batch(item[2])
+        while True:
+            try:
+                _, _, payload = self._hostq.get_nowait()
+            except queue.Empty:
+                break
+            if payload is not None:
+                _fail_batch(payload[1])
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, items, klass: Klass, mode=MODE_PLAIN) -> Ticket:
+        """Enqueue one verification request (a list of
+        (pubkey, msg, sig) triples, verified as a unit) and return its
+        ticket.  Raises :class:`VerifyServiceBackpressure` when the
+        class's queue is at its signature bound."""
+        items = list(items)
+        if not items:
+            t = Ticket(0)
+            t._resolve((False, []))  # empty-batch contract of the verifiers
+            return t
+        self._ensure_started()
+        n = len(items)
+        m = _mhub()
+        with self._cond:
+            if not self._running:
+                # stop() won the race after _ensure_started: enqueueing
+                # onto a dead scheduler would park the caller forever —
+                # reject so they take their host fallback instead
+                raise VerifyServiceBackpressure(klass, 0, self.queue_max)
+            queued = self._queued_sigs[klass]
+            if queued + n > self.queue_max:
+                self._rejected[klass.label] += 1
+                rejected = self._rejected[klass.label]
+            else:
+                req = _Request(items, klass, mode)
+                self._queues[klass].append(req)
+                self._queued_sigs[klass] = queued + n
+                depth = queued + n
+                self._cond.notify()
+                rejected = None
+        if rejected is not None:
+            # admission control: count it, flight-record it, and push the
+            # decision back to the caller (host fallback / shed)
+            m.verify_svc_rejected.inc(**{"class": klass.label})
+            _flightrec().record(
+                "verifysvc_backpressure",
+                klass=klass.label, queued=queued, sigs=n, limit=self.queue_max,
+            )
+            tracing.instant(
+                "verify.sched.reject",
+                {"class": klass.label, "queued": queued, "sigs": n}
+                if tracing.enabled() else None,
+            )
+            raise VerifyServiceBackpressure(klass, queued, self.queue_max)
+        m.verify_svc_queue_depth.set(depth, **{"class": klass.label})
+        return req.ticket
+
+    def verify(self, items, klass: Klass, mode=MODE_PLAIN) -> tuple[bool, list[bool]]:
+        """submit() + collect() in one call (synchronous callers)."""
+        return self.submit(items, klass, mode).collect()
+
+    # ---------------------------------------------------------- scheduler
+
+    def _ready_locked(self, klass: Klass, now: float) -> bool:
+        q = self._queues[klass]
+        if not q:
+            return False
+        if self._queued_sigs[klass] >= self.batch_max:
+            return True
+        return (now - q[0].enq) >= self._deadline_s[klass]
+
+    def _next_deadline_locked(self, now: float) -> float | None:
+        """Seconds until the earliest not-yet-ready class flushes, or
+        None when every queue is empty."""
+        best = None
+        for k in Klass:
+            q = self._queues[k]
+            if not q:
+                continue
+            remain = self._deadline_s[k] - (now - q[0].enq)
+            if best is None or remain < best:
+                best = remain
+        return best
+
+    def _pick_class_locked(self, now: float) -> Klass | None:
+        ready = [k for k in Klass if self._ready_locked(k, now)]
+        if not ready:
+            return None
+        if not self._weights:
+            return ready[0]  # strict priority: Klass order
+        # weighted interleave: spend per-class credits in priority order,
+        # replenish when every ready class is out
+        for k in ready:
+            if self._credits.get(k, 0) > 0:
+                self._credits[k] -= 1
+                return k
+        for k in Klass:
+            self._credits[k] = self._weights.get(k, 1)
+        self._credits[ready[0]] -= 1
+        return ready[0]
+
+    def _form_batch_locked(self, klass: Klass) -> tuple[list[_Request], str]:
+        """Pop the head batch of a ready class.  Comb-bound requests go
+        solo; plain requests coalesce up to the batch width."""
+        q = self._queues[klass]
+        # the flush reason is what made the CLASS ready, decided before
+        # popping: a width-triggered flush whose head dispatches solo
+        # (comb) must not read as a deadline expiry on the dashboards
+        was_full = self._queued_sigs[klass] >= self.batch_max
+        head = q.pop(0)
+        batch = [head]
+        total = len(head.items)
+        if head.mode[0] != "comb":
+            while q and q[0].mode[0] != "comb" and total < self.batch_max:
+                nxt = q.pop(0)
+                batch.append(nxt)
+                total += len(nxt.items)
+        self._queued_sigs[klass] -= total
+        reason = "full" if (was_full or total >= self.batch_max) else "deadline"
+        return batch, reason
+
+    def _sched_loop(self) -> None:
+        m = _mhub()
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                now = time.monotonic()
+                klass = self._pick_class_locked(now)
+                if klass is None:
+                    remain = self._next_deadline_locked(now)
+                    # bounded wait (never a bare wait(): new submissions
+                    # notify, deadlines cap the sleep, and an idle tick
+                    # keeps shutdown prompt)
+                    self._cond.wait(
+                        0.5 if remain is None else max(0.0, min(remain, 0.5))
+                    )
+                    continue
+                batch, reason = self._form_batch_locked(klass)
+                depth = self._queued_sigs[klass]
+            m.verify_svc_queue_depth.set(depth, **{"class": klass.label})
+            self._dispatch(klass, batch, reason)
+
+    def _make_verifier(self, mode):
+        """Bind a batch to a device verifier.  The ONLY constructor seam
+        for the data plane — tests monkeypatch this to observe dispatch
+        order without touching a real kernel."""
+        if mode[0] == "comb":
+            from ..models.comb_verifier import CombBatchVerifier
+
+            return CombBatchVerifier(mode[1])
+        from ..models.verifier import TpuEd25519BatchVerifier
+
+        return TpuEd25519BatchVerifier()
+
+    @staticmethod
+    def _submit_is_offloaded(bv, nsigs: int) -> bool:
+        """Whether bv.submit() must run on the host worker instead of
+        the scheduler thread.  Only the comb-cached staging path is
+        genuinely cheap at submit time (the slab fill + H2D + dispatch
+        run on the comb staging thread): everything else does real work
+        inline — sub-threshold batches verify on host, demoted comb
+        batches resolve their fallback synchronously, and the uncached
+        device path runs host assembly plus, at a new bucket shape, the
+        XLA compile.  Any of those on the scheduler thread would delay
+        a consensus dispatch behind lower-class work."""
+        if getattr(bv, "_entry", None) is None:  # plain/uncached path
+            return True
+        if getattr(bv, "_fallback", None) is not None:  # demoted comb
+            return True
+        from ..models.verifier import _device_batch_min
+
+        return nsigs < _device_batch_min()  # comb submit host-routes
+
+    def _dispatch(self, klass: Klass, batch: list[_Request], reason: str) -> None:
+        m = _mhub()
+        nsigs = sum(len(r.items) for r in batch)
+        now = time.monotonic()
+        for r in batch:
+            m.verify_svc_queue_wait.observe(
+                now - r.enq, **{"class": klass.label}
+            )
+        m.verify_svc_flush.inc(**{"class": klass.label, "reason": reason})
+        self._dispatched[klass.label] += 1
+        labels = (
+            {"class": klass.label, "reason": reason,
+             "sigs": nsigs, "requests": len(batch)}
+            if tracing.enabled() else None
+        )
+        with tracing.span("verify.sched.dispatch", labels):
+            try:
+                bv = self._make_verifier(batch[0].mode)
+                for r in batch:
+                    for pub, msg, sig in r.items:
+                        bv.add(pub, msg, sig)
+                if self._submit_is_offloaded(bv, nsigs):
+                    # real submit-time work: hand it to the host worker
+                    # (class-priority queue) so the scheduler stays free
+                    # to dispatch the next, possibly higher-class, batch
+                    self._hostseq += 1
+                    self._hostq.put(
+                        (int(klass), self._hostseq, (bv, batch))
+                    )
+                    return
+                ticket = bv.submit()  # comb staging seam: cheap dispatch
+            except BaseException as e:  # noqa: BLE001 — fail the tickets, keep scheduling
+                self.logger.error(
+                    f"dispatch failed (class={klass.label}, sigs={nsigs}): {e!r}"
+                )
+                for r in batch:
+                    r.ticket._fail(e)
+                return
+        self._collectq.put((bv, ticket, batch))
+
+    def _host_loop(self) -> None:
+        """Drain submit-time work in class-priority order: queued
+        consensus batches overtake queued lower-class ones (the worker
+        can't preempt an in-flight verify/compile, so the worst-case
+        consensus delay is ONE lower-class task, not a whole backlog)."""
+        while True:
+            _, _, payload = self._hostq.get()
+            if payload is None:
+                return
+            bv, batch = payload
+            klass = batch[0].klass
+            labels = (
+                {"class": klass.label, "requests": len(batch)}
+                if tracing.enabled() else None
+            )
+            with tracing.span("verify.sched.hostwork", labels):
+                try:
+                    ticket = bv.submit()  # the inline work happens here
+                except BaseException as e:  # noqa: BLE001 — fail the tickets, keep serving
+                    self.logger.error(
+                        f"host-route verify failed (class={klass.label}): {e!r}"
+                    )
+                    for r in batch:
+                        r.ticket._fail(e)
+                    continue
+            if ticket[0] == "sync":
+                self._settle(bv, ticket, batch)  # resolved already
+            else:
+                # device ticket (uncached path): the collector owns the
+                # blocking result wait, freeing this worker immediately
+                self._collectq.put((bv, ticket, batch))
+
+    # ---------------------------------------------------------- collector
+
+    def _collect_loop(self) -> None:
+        while True:
+            item = self._collectq.get()
+            if item is None:
+                return
+            self._settle(*item)
+
+    def _settle(self, bv, ticket, batch: list[_Request]) -> None:
+        """Resolve a dispatched batch's tickets from its verifier
+        ticket, splitting the result vector back per request."""
+        labels = (
+            {"class": batch[0].klass.label,
+             "requests": len(batch)}
+            if tracing.enabled() else None
+        )
+        with tracing.span("verify.sched.collect", labels):
+            try:
+                _, res = bv.collect(ticket)
+            except BaseException as e:  # noqa: BLE001 — fail the tickets, keep draining
+                self.logger.error(
+                    f"collect failed (class={batch[0].klass.label}): {e!r}"
+                )
+                for r in batch:
+                    r.ticket._fail(e)
+                return
+        total = sum(len(r.items) for r in batch)
+        if len(res) != total:
+            err = RuntimeError(
+                f"verifier returned {len(res)} results for {total} "
+                "submitted signatures"
+            )
+            for r in batch:
+                r.ticket._fail(err)
+            return
+        timings = getattr(bv, "last_timings", None)
+        off = 0
+        for r in batch:
+            part = list(res[off : off + len(r.items)])
+            off += len(r.items)
+            # per-request verdict: the whole-batch all_ok is useless
+            # once requests are coalesced — recompute from the slice
+            # (matches the verifiers' own all(res) and bool(res))
+            r.ticket._resolve((all(part) and bool(part), part), timings)
+
+    # ------------------------------------------------------------- status
+
+    def stats(self) -> dict:
+        """Snapshot for the /verify_svc_status RPC and bench reporting."""
+        with self._cond:
+            queued = {
+                k.label: {
+                    "requests": len(self._queues[k]),
+                    "sigs": self._queued_sigs[k],
+                }
+                for k in Klass
+            }
+            dispatched = dict(self._dispatched)
+            rejected = dict(self._rejected)
+        return {
+            "running": self._running,
+            "batch_max": self.batch_max,
+            "queue_max": self.queue_max,
+            "deadline_ms": {
+                k.label: self._deadline_s[k] * 1e3 for k in Klass
+            },
+            "weights": {k.label: w for k, w in self._weights.items()},
+            "queued": queued,
+            "dispatched_batches": dispatched,
+            "rejected": rejected,
+        }
+
+
+_GLOBAL: VerifyService | None = None
+_GLOBAL_MTX = threading.Lock()
+
+
+def global_service() -> VerifyService:
+    """The process-wide service every production consumer shares — one
+    scheduler means one priority order across subsystems."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_MTX:
+            if _GLOBAL is None:
+                _GLOBAL = VerifyService()
+    return _GLOBAL
+
+
+def reset_global_service() -> None:
+    """Stop and drop the global service (tests re-reading knobs)."""
+    global _GLOBAL
+    with _GLOBAL_MTX:
+        svc, _GLOBAL = _GLOBAL, None
+    if svc is not None:
+        svc.stop()

@@ -8,7 +8,8 @@ builds."""
 
 from cometbft_tpu.crypto import batch as crypto_batch
 from cometbft_tpu.crypto import ed25519 as host
-from cometbft_tpu.models.comb_verifier import CombBatchVerifier
+from cometbft_tpu.verifysvc.client import ServiceBatchVerifier
+from cometbft_tpu.verifysvc.service import MODE_PLAIN
 
 
 def test_comb_verify_smoke(monkeypatch, tiny_device_batches):
@@ -24,7 +25,9 @@ def test_comb_verify_smoke(monkeypatch, tiny_device_batches):
     ]
 
     bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
-    assert isinstance(bv, CombBatchVerifier)
+    # the factory returns a verify-service client bound to the comb
+    # cache entry (the service's scheduler drives CombBatchVerifier)
+    assert isinstance(bv, ServiceBatchVerifier) and bv._mode[0] == "comb"
     for p, m, s in items:
         bv.add(p, m, s)
     ok, per = bv.verify()
@@ -75,7 +78,6 @@ def test_async_build_falls_back_then_warms(monkeypatch):
     import time
 
     from cometbft_tpu.models import comb_verifier as cv
-    from cometbft_tpu.models.verifier import TpuEd25519BatchVerifier
 
     monkeypatch.setenv("COMETBFT_TPU_COMB_MIN", "8")
     monkeypatch.setenv("COMETBFT_TPU_COMB_ASYNC_MIN", "8")
@@ -86,14 +88,17 @@ def test_async_build_falls_back_then_warms(monkeypatch):
     monkeypatch.setattr(cv, "_GLOBAL_CACHE", cv.ValsetCombCache())
 
     first = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
-    assert isinstance(first, TpuEd25519BatchVerifier), "must not block on build"
+    # plain mode = the uncached kernel while the table build runs
+    assert (
+        isinstance(first, ServiceBatchVerifier) and first._mode == MODE_PLAIN
+    ), "must not block on build"
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         bv = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
-        if isinstance(bv, CombBatchVerifier):
+        if bv._mode[0] == "comb":
             break
         time.sleep(0.2)
-    assert isinstance(bv, CombBatchVerifier), "background build never landed"
+    assert bv._mode[0] == "comb", "background build never landed"
     for i, pk in enumerate(pubs):
         bv.add(pk, b"warm-%d" % i, keys[i].sign(b"warm-%d" % i))
     ok, per = bv.verify()

@@ -289,20 +289,19 @@ def test_switch_broadcast_reaches_all_peers():
         sw.start()
         sw.dial_peer_async(addr)
     try:
-        # wait until the peers are not just counted but RUNNING: the
-        # switch registers a peer in the PeerSet before peer.start(), so
-        # a broadcast in that window try_sends into a stopped mconn and
-        # is (by design — broadcast is best-effort) silently dropped
+        # counted == deliverable: the switch registers a peer in the
+        # PeerSet only once its mconn is running (the add-before-start
+        # race is fixed at the source in Switch._add_peer_conn), so the
+        # moment num_peers() reports 3 a broadcast must reach all three —
+        # no mconn-running probe needed
         deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and (
-            center.num_peers() < 3
-            or not all(
-                p.is_running() and p.mconn.is_running()
-                for p in center.peers.list()
-            )
-        ):
+        while time.monotonic() < deadline and center.num_peers() < 3:
             time.sleep(0.05)
         assert center.num_peers() == 3
+        assert all(
+            p.is_running() and p.mconn.is_running()
+            for p in center.peers.list()
+        ), "registered peer without a running mconn (add-before-start race)"
         center.broadcast(1, b"announce")
         for _, r in others:
             assert r.evt.wait(5)

@@ -1,0 +1,473 @@
+"""Unified verify service (cometbft_tpu/verifysvc): priority scheduling,
+adaptive batch formation, backpressure, blame-order preservation, and the
+mempool CheckTx client.
+
+All tests are CPU-only and fast: batches stay below the link-aware
+device threshold (models/verifier._device_batch_min), so the underlying
+verifiers host-route and no XLA program compiles — the scheduler logic
+under test is identical either way.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as host
+from cometbft_tpu.utils.metrics import hub as mhub
+from cometbft_tpu.verifysvc import checktx
+from cometbft_tpu.verifysvc.client import ServiceBatchVerifier
+from cometbft_tpu.verifysvc.service import (
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+    _parse_weights,
+)
+
+WAIT = 10.0  # generous collect timeout; everything here resolves in ms
+
+
+def _sigs(n, tag=b"t", tamper=()):
+    out = []
+    for i in range(n):
+        sk = host.PrivKey.from_seed(bytes([7 + i]) * 32)
+        msg = b"%s-%d" % (tag, i)
+        sig = sk.sign(msg)
+        if i in tamper:
+            msg += b"!"
+        out.append((sk.pub_key().data, msg, sig))
+    return out
+
+
+def _flush_count(klass: str, reason: str) -> float:
+    return mhub().verify_svc_flush.value(**{"class": klass, "reason": reason})
+
+
+@pytest.fixture
+def svc():
+    services = []
+
+    def make(**kw):
+        s = VerifyService(**kw)
+        services.append(s)
+        return s
+
+    yield make
+    for s in services:
+        s.stop()
+
+
+# ------------------------------------------------------------ scheduling
+
+
+def test_consensus_never_delayed_behind_mempool(svc):
+    """The acceptance property, asserted via the per-class metrics: with
+    a mempool backlog queued (inside its coalescing deadline), a
+    consensus submission dispatches immediately — at the moment the
+    consensus batch resolves, the mempool class has flushed nothing."""
+    s = svc(
+        batch_max=64,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    # record dispatch order by class: wrap _dispatch (not the verifier
+    # factory) so the class is visible
+    order = []
+    real_dispatch = s._dispatch
+
+    def record_dispatch(klass, batch, reason):
+        order.append(klass)
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = record_dispatch
+    mp_before = _flush_count("mempool", "deadline") + _flush_count(
+        "mempool", "full"
+    )
+    mp_tickets = [s.submit(_sigs(3, b"mp%d" % i), Klass.MEMPOOL) for i in range(4)]
+    cs_ticket = s.submit(_sigs(5, b"cs"), Klass.CONSENSUS)
+    ok, per = cs_ticket.collect(WAIT)
+    assert ok and per == [True] * 5
+    # consensus flushed; mempool (deadline 60s, 12 < 64 sigs) has not
+    assert order and order[0] == Klass.CONSENSUS
+    assert (
+        _flush_count("mempool", "deadline") + _flush_count("mempool", "full")
+        == mp_before
+    )
+    assert mhub().verify_svc_queue_depth.value(**{"class": "mempool"}) == 12.0
+    assert not any(t.done() for t in mp_tickets)
+
+
+def test_deadline_triggered_flush(svc):
+    s = svc(
+        batch_max=1024,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 50, Klass.BACKGROUND: 25,
+        },
+    )
+    before = _flush_count("mempool", "deadline")
+    t0 = time.monotonic()
+    ok, per = s.submit(_sigs(2, b"dl"), Klass.MEMPOOL).collect(WAIT)
+    waited = time.monotonic() - t0
+    assert ok and per == [True, True]
+    assert waited >= 0.045  # held for the coalescing window…
+    assert _flush_count("mempool", "deadline") == before + 1  # …then flushed
+
+
+def test_full_batch_flush_and_coalescing(svc):
+    """Two sub-width requests coalesce; crossing the batch width flushes
+    with reason=full before the (absurd) deadline."""
+    s = svc(
+        batch_max=4,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    before = _flush_count("mempool", "full")
+    t1 = s.submit(_sigs(2, b"f1", tamper=(1,)), Klass.MEMPOOL)
+    t2 = s.submit(_sigs(2, b"f2"), Klass.MEMPOOL)
+    ok1, per1 = t1.collect(WAIT)
+    ok2, per2 = t2.collect(WAIT)
+    # one coalesced batch, each request judged on its own slice
+    assert not ok1 and per1 == [True, False]
+    assert ok2 and per2 == [True, True]
+    assert _flush_count("mempool", "full") == before + 1
+
+
+def test_coalesces_concurrent_senders(svc):
+    """The CheckTx shape: single-signature submissions from concurrent
+    threads merge into ONE device batch inside the class deadline."""
+    s = svc(
+        batch_max=1024,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 150, Klass.BACKGROUND: 25,
+        },
+    )
+    before_dl = _flush_count("mempool", "deadline")
+    results = {}
+
+    def sender(i):
+        results[i] = s.submit(_sigs(1, b"snd%d" % i), Klass.MEMPOOL).collect(WAIT)
+
+    threads = [
+        threading.Thread(target=sender, args=(i,), name=f"t-sender-{i}")
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(WAIT)
+    assert all(results[i] == (True, [True]) for i in range(6))
+    assert _flush_count("mempool", "deadline") == before_dl + 1
+
+
+def test_backpressure_rejection_and_caller_fallback(svc):
+    s = svc(
+        queue_max=4,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    rej_before = mhub().verify_svc_rejected.value(**{"class": "mempool"})
+    s.submit(_sigs(4, b"fill"), Klass.MEMPOOL)  # parks at the bound
+    with pytest.raises(VerifyServiceBackpressure):
+        s.submit(_sigs(1, b"over"), Klass.MEMPOOL)
+    assert mhub().verify_svc_rejected.value(**{"class": "mempool"}) == rej_before + 1
+
+    # flight-recorder event landed
+    from cometbft_tpu.utils.flightrec import recorder
+
+    kinds = [e["kind"] for e in recorder().dump()["entries"]]
+    assert "verifysvc_backpressure" in kinds
+
+    # caller-side fallback: the BatchVerifier client degrades to an
+    # inline host verification with correct results and blame order
+    bv = ServiceBatchVerifier(Klass.MEMPOOL, service=s)
+    for pub, msg, sig in _sigs(3, b"fb", tamper=(2,)):
+        bv.add(pub, msg, sig)
+    ok, per = bv.verify()
+    assert not ok and per == [True, True, False]
+
+    # other classes are unaffected by mempool's full queue
+    ok, per = s.submit(_sigs(2, b"cs-ok"), Klass.CONSENSUS).collect(WAIT)
+    assert ok and per == [True, True]
+
+
+def test_fifo_blame_order_across_classes(svc):
+    """Per-request blame follows each request's OWN add() order no
+    matter how classes interleave or in which order tickets are
+    collected."""
+    s = svc(
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 5,
+            Klass.MEMPOOL: 20, Klass.BACKGROUND: 10,
+        },
+    )
+    t_mp = s.submit(_sigs(4, b"mp", tamper=(0,)), Klass.MEMPOOL)
+    t_bg = s.submit(_sigs(3, b"bg", tamper=(1,)), Klass.BACKGROUND)
+    t_cs = s.submit(_sigs(5, b"cs", tamper=(3,)), Klass.CONSENSUS)
+    t_bs = s.submit(_sigs(2, b"bs"), Klass.BLOCKSYNC)
+    # collect out of submission AND priority order
+    ok_bg, per_bg = t_bg.collect(WAIT)
+    ok_cs, per_cs = t_cs.collect(WAIT)
+    ok_mp, per_mp = t_mp.collect(WAIT)
+    ok_bs, per_bs = t_bs.collect(WAIT)
+    assert (not ok_mp) and per_mp == [False, True, True, True]
+    assert (not ok_bg) and per_bg == [True, False, True]
+    assert (not ok_cs) and per_cs == [True, True, True, False, True]
+    assert ok_bs and per_bs == [True, True]
+
+
+def test_host_queue_respects_class_priority(svc):
+    """Submit-time work is offloaded to the host worker through a
+    class-priority queue: with the worker busy, later-queued consensus
+    work overtakes earlier-queued mempool/background work."""
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    gate = threading.Event()
+    run_order = []
+
+    class FakeBV:
+        _entry = None  # plain shape -> _submit_is_offloaded is True
+
+        def __init__(self):
+            self.items = []
+
+        def add(self, pub, msg, sig):
+            self.items.append((pub, msg, sig))
+
+        def submit(self):
+            tag = self.items[0][1].split(b"-")[0].decode()
+            if not run_order:
+                gate.wait(WAIT)  # first task parks the worker
+            run_order.append(tag)
+            return ("sync", (True, [True] * len(self.items)))
+
+        def collect(self, ticket):
+            return ticket[1]
+
+    s._make_verifier = lambda mode: FakeBV()
+    tickets = [s.submit(_sigs(1, b"bg1"), Klass.BACKGROUND)]
+    time.sleep(0.15)  # worker is now parked inside bg1's submit
+    for tag, klass in (
+        (b"mp", Klass.MEMPOOL),
+        (b"bg2", Klass.BACKGROUND),
+        (b"cs", Klass.CONSENSUS),
+    ):
+        tickets.append(s.submit(_sigs(1, tag), klass))
+        time.sleep(0.15)  # let the scheduler queue each on the host q
+    gate.set()
+    for t in tickets:
+        assert t.collect(WAIT) == (True, [True])
+    # consensus overtook the mempool/background work queued before it
+    assert run_order == ["bg1", "cs", "mp", "bg2"]
+
+
+def test_weighted_interleave_parsing():
+    assert _parse_weights("consensus=8,blocksync=4,mempool=2,background=1") == {
+        Klass.CONSENSUS: 8, Klass.BLOCKSYNC: 4,
+        Klass.MEMPOOL: 2, Klass.BACKGROUND: 1,
+    }
+    # malformed entries drop, zero/negative weights drop, empty = strict
+    assert _parse_weights("consensus=2,junk,=3,mempool=0,x=1") == {
+        Klass.CONSENSUS: 2
+    }
+    assert _parse_weights("") == {}
+
+
+def test_empty_submit_resolves_immediately(svc):
+    s = svc()
+    assert s.submit([], Klass.CONSENSUS).collect(0.1) == (False, [])
+    bv = ServiceBatchVerifier(Klass.CONSENSUS, service=s)
+    assert bv.verify() == (False, [])
+
+
+def test_dispatch_error_fails_tickets_not_service(svc):
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+
+    def boom(mode):
+        raise RuntimeError("no backend")
+
+    s._make_verifier = boom
+    with pytest.raises(RuntimeError, match="no backend"):
+        s.submit(_sigs(2, b"err"), Klass.CONSENSUS).collect(WAIT)
+    # the scheduler survived and keeps serving
+    s._make_verifier = VerifyService._make_verifier.__get__(s)
+    ok, per = s.submit(_sigs(2, b"ok"), Klass.CONSENSUS).collect(WAIT)
+    assert ok and per == [True, True]
+
+
+def test_stop_fails_stranded_tickets(svc):
+    s = svc(
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    t = s.submit(_sigs(1, b"strand"), Klass.MEMPOOL)
+    s.stop()
+    with pytest.raises(VerifyServiceBackpressure):
+        t.collect(WAIT)
+
+
+# ------------------------------------------------------- CheckTx client
+
+
+def test_signed_tx_envelope_roundtrip():
+    sk = host.PrivKey.from_seed(b"e" * 32)
+    tx = checktx.make_signed_tx(sk, b"payload-bytes")
+    pub, sig, payload = checktx.parse_signed_tx(tx)
+    assert pub == sk.pub_key().data and payload == b"payload-bytes"
+    assert checktx.parse_signed_tx(b"unsigned") is None
+    assert checktx.parse_signed_tx(checktx.MAGIC + b"short") is None
+
+
+def test_checktx_bit_identical_to_host_path(svc):
+    """Service-batched CheckTx verdicts must match the host path bit for
+    bit over valid, tampered-sig, tampered-payload, wrong-key, and
+    unsigned txs."""
+    s = svc(
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 5, Klass.BACKGROUND: 25,
+        },
+    )
+    sk = host.PrivKey.from_seed(b"c" * 32)
+    sk2 = host.PrivKey.from_seed(b"d" * 32)
+    good = checktx.make_signed_tx(sk, b"k=v")
+    bad_sig = bytearray(good)
+    bad_sig[len(checktx.MAGIC) + 40] ^= 1  # flip a signature byte
+    bad_payload = good + b"?"
+    wrong_key = (
+        checktx.MAGIC + sk2.pub_key().data + good[len(checktx.MAGIC) + 32 :]
+    )
+    corpus = [good, bytes(bad_sig), bad_payload, wrong_key, b"plain=tx", b""]
+
+    def host_verdict(tx):
+        parsed = checktx.parse_signed_tx(tx)
+        if parsed is None:
+            return None
+        pub, sig, payload = parsed
+        return host.verify_signature(pub, checktx.SIGN_DOMAIN + payload, sig)
+
+    for tx in corpus:
+        assert checktx.verify_tx_signature(tx, service=s) == host_verdict(tx)
+
+
+def test_checktx_host_fallback_on_backpressure(svc):
+    s = svc(
+        queue_max=2,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    s.submit(_sigs(2, b"clog"), Klass.MEMPOOL)  # queue now at its bound
+    sk = host.PrivKey.from_seed(b"f" * 32)
+    tx = checktx.make_signed_tx(sk, b"still-works")
+    assert checktx.verify_tx_signature(tx, service=s) is True  # host path
+
+
+def test_mempool_checktx_gate(svc):
+    """CListMempool admits valid signed txs, rejects invalid signatures
+    before the app round trip, and leaves unsigned txs untouched."""
+    from cometbft_tpu.mempool import CListMempool, MempoolConfig
+    from cometbft_tpu.mempool.mempool import InvalidTxSignatureError
+    from cometbft_tpu.wire import abci_pb as pb
+
+    class AcceptAllClient:
+        def __init__(self):
+            self.seen = []
+
+        def check_tx(self, req):
+            self.seen.append(req.tx)
+            return pb.CheckTxResponse(code=0, gas_wanted=1)
+
+        def flush(self):
+            pass
+
+    client = AcceptAllClient()
+    mp = CListMempool(MempoolConfig(), client)
+    sk = host.PrivKey.from_seed(b"g" * 32)
+
+    good = checktx.make_signed_tx(sk, b"signed-good")
+    mp.check_tx(good)
+    assert mp.size() == 1 and client.seen == [good]
+
+    bad = bytearray(checktx.make_signed_tx(sk, b"signed-bad"))
+    bad[-1] ^= 1  # corrupt the payload -> signature mismatch
+    failed_before = mhub().mp_failed_txs.value()
+    with pytest.raises(InvalidTxSignatureError):
+        mp.check_tx(bytes(bad))
+    assert mp.size() == 1
+    assert client.seen == [good]  # the app never saw the bad tx
+    assert mhub().mp_failed_txs.value() == failed_before + 1
+    # rejected tx left the cache: a corrected resubmission is not deduped
+    with pytest.raises(InvalidTxSignatureError):
+        mp.check_tx(bytes(bad))
+
+    mp.check_tx(b"unsigned=ok")  # no envelope: gate is a no-op
+    assert mp.size() == 2
+
+
+def test_mempool_checktx_gate_disabled(monkeypatch):
+    from cometbft_tpu.mempool import CListMempool, MempoolConfig
+    from cometbft_tpu.wire import abci_pb as pb
+
+    monkeypatch.setenv("COMETBFT_TPU_VERIFYSVC_CHECKTX", "0")
+
+    class AcceptAllClient:
+        def check_tx(self, req):
+            return pb.CheckTxResponse(code=0, gas_wanted=1)
+
+        def flush(self):
+            pass
+
+    mp = CListMempool(MempoolConfig(), AcceptAllClient())
+    sk = host.PrivKey.from_seed(b"h" * 32)
+    bad = bytearray(checktx.make_signed_tx(sk, b"x"))
+    bad[-1] ^= 1
+    mp.check_tx(bytes(bad))  # gate off: the app owns validation
+    assert mp.size() == 1
+
+
+# ------------------------------------------------------------- plumbing
+
+
+def test_rpc_route_registered():
+    from cometbft_tpu.rpc.core import ROUTES
+
+    assert "verify_svc_status" in ROUTES
+
+
+def test_service_stats_shape(svc):
+    s = svc()
+    ok, per = s.verify(_sigs(2, b"st"), Klass.CONSENSUS)
+    assert ok and per == [True, True]
+    st = s.stats()
+    assert st["dispatched_batches"]["consensus"] == 1
+    assert set(st["queued"]) == {"consensus", "blocksync", "mempool", "background"}
+    assert st["deadline_ms"]["consensus"] == 0.0
+
+
+def test_create_batch_verifier_routes_through_service(monkeypatch):
+    """The factory seam: device-capable backends get a verify-service
+    client; the cpu backend keeps the sequential host verifier (no
+    async seam, callers run sync)."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.models.verifier import CpuEd25519BatchVerifier
+
+    bv = crypto_batch.create_batch_verifier("ed25519")
+    assert isinstance(bv, ServiceBatchVerifier)
+    assert bv.klass == Klass.CONSENSUS
+    bv2 = crypto_batch.create_batch_verifier("ed25519", klass=Klass.BLOCKSYNC)
+    assert bv2.klass == Klass.BLOCKSYNC
+
+    monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+    bv3 = crypto_batch.create_batch_verifier("ed25519")
+    assert isinstance(bv3, CpuEd25519BatchVerifier)
+    assert not hasattr(bv3, "submit")
