@@ -32,7 +32,10 @@ rather than erroring.  When the probe reports backend-unavailable the
 line additionally carries "kernelcheck": the CPU-only static contract
 pass over every manifest kernel (analysis/kernelcheck) — a
 backend-less round still certifies that the verify plane's shapes,
-dtypes, and jaxpr fingerprints hold.
+dtypes, and jaxpr fingerprints hold — and "shardcheck": the
+sharded-plane contract pass (analysis/shardcheck) traced under a
+forced 8-device CPU mesh in a subprocess, certifying shardings,
+collective census, compile-cost budgets, and donation discipline.
 
 Baseline: curve25519-voi batch verify ~27.5 us/sig/core on the QA CPUs
 (BASELINE.md: 50-60 us single, ~2x batch gain) -> 275 ms for 10k sigs.
@@ -186,6 +189,10 @@ def probe_backend() -> None:
         "0", "false", "no", "off"
     ):
         REPORT["kernelcheck"] = _kernelcheck_report()
+    if os.environ.get("BENCH_SHARDCHECK", "1").lower() not in (
+        "0", "false", "no", "off"
+    ):
+        REPORT["shardcheck"] = _shardcheck_report()
     emit_and_exit()
 
 
@@ -225,16 +232,60 @@ def _kernelcheck_report() -> dict:
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def _shardcheck_report() -> dict:
+    """The sharded-plane contract pass (analysis/shardcheck): every
+    mesh-parameterized kernel traced under a REAL 8-way CPU mesh and
+    held to its declared shardings, collective census, compile-cost
+    budgets, and donation discipline — so a wedged-tunnel round
+    (MULTICHIP/backend-less) still carries sharded-plane signal, the
+    same pattern as the "kernelcheck" field above.  Runs entirely in a
+    forced-environment SUBPROCESS (JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count=8 exported before the child's
+    first jax import), so this process's jax state and the wedged
+    tunnel are both untouched.  ~40s; the child timeout is capped at
+    300s so that probe retries + kernelcheck + this pass still land the
+    structured JSON line inside the driver's patience — a hung trace
+    child becomes a timeout finding in the summary, not a lost round.
+    BENCH_SHARDCHECK=0 skips it (the bench-harness tests do, to stay
+    inside their subprocess timeout)."""
+    try:
+        t0 = time.monotonic()
+        from cometbft_tpu.analysis import kernelcheck, shardcheck
+
+        findings, data = shardcheck.run_subprocess(timeout=300)
+        allow = kernelcheck.default_allowlist()
+        findings = [f for f in findings if not allow.suppresses(f)]
+        return {
+            "ok": not findings,
+            "findings": len(findings),
+            "kernels": {
+                name: k.get("eqns")
+                for name, k in data.get("kernels", {}).items()
+            },
+            "device_count": data.get("device_count"),
+            "elapsed_s": round(time.monotonic() - t0, 1),
+        }
+    except BaseException as e:  # noqa: BLE001 — the JSON line must still emit
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def _enable_compile_cache() -> None:
-    """Persistent XLA compile cache — one recipe shared with the driver
-    entry points (__graft_entry__._enable_compile_cache): the comb
-    table-build program is tens of seconds of TPU compile; with the cache
-    warm, table_build_s is the arithmetic only."""
+    """Persistent XLA compile cache: the knob-driven helper
+    (utils/compilecache, COMETBFT_TPU_COMPILE_CACHE), defaulting to the
+    driver's shared tests/.jax_cache dir like
+    __graft_entry__._enable_compile_cache — the comb table-build program
+    is tens of seconds of TPU compile; with the cache warm,
+    table_build_s is the arithmetic only."""
     try:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        from __graft_entry__ import _enable_compile_cache as enable
+        from cometbft_tpu.utils import compilecache
 
-        enable()
+        compilecache.maybe_enable(
+            default_dir=os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tests", ".jax_cache",
+            )
+        )
     except Exception:  # noqa: BLE001 — cache is an optimization only
         pass
 

@@ -8,6 +8,13 @@ from .analysis import lockwitness
 
 lockwitness.maybe_install()
 
+# Persistent XLA compile cache (COMETBFT_TPU_COMPILE_CACHE): configured
+# before any kernel compiles so a warm pod restart skips XLA entirely.
+# Imports jax only when the knob is set; no-op otherwise.
+from .utils import compilecache  # noqa: E402
+
+compilecache.maybe_enable()
+
 from .cli import main  # noqa: E402
 
 sys.exit(main())

@@ -1,0 +1,190 @@
+"""Check: donated-read-after-dispatch.
+
+The sharded manifest (``kernel_manifest.SHARDED_KERNELS``) declares
+which arguments of each mesh entry point are DONATED to the device
+program (``donate_argnums``): their device buffers are consumed by the
+dispatch and may be aliased for the outputs.  Host code that reads such
+a value after the dispatch call races the device for memory the program
+already owns — on CPU it happens to work (donation is a no-op there),
+on TPU it is a use-after-free that corrupts results silently.
+
+This check walks every function that calls a donated entry point by
+name (``sharded_verify_cached(...)``) — or through a same-scope
+``functools.partial`` alias (``fn = partial(sharded_verify_cached,
+mesh); fn(tables, ..., payload)``, with the donated position shifted by
+the bound arguments) — and flags any later read of the variable passed
+in a donated position.  Rebinding the name (assignment, ``del``, a
+fresh loop target) clears the taint — the name no longer refers to the
+donated buffer.  The analysis is lexical (source order within one
+function body); a read that only executes before the dispatch at
+runtime but appears after it in source still flags, which is the
+conservative direction for a use-after-free class.
+
+KNOWN LIMIT: a dispatch handle that crosses a function boundary (the
+models/comb_verifier pattern — the partial is stored on the cache entry
+in one method and invoked in another) is invisible to a lexical
+single-scope scan; there the discipline is held by the shardcheck
+donation contract plus convention (stage the donated value inline in
+the call expression, never bind it).
+
+Fix a finding by staging a fresh array per dispatch (the
+models/comb_verifier pattern: the donated value is a ``jnp.asarray``
+created inside the call expression, never bound) or by dropping the
+donation from the manifest + kernel together (`regen-shardings`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import kernel_manifest as manifest
+from .linter import Finding, Module, terminal_name
+
+CHECK_ID = "donated-read-after-dispatch"
+SUMMARY = "host read of a buffer already donated to a device dispatch"
+
+
+def _donated_names_of_call(call: ast.Call, spec) -> list[str]:
+    """Names passed in donated positions of ``call`` (positional index
+    or keyword), per the manifest's (param name, position) spec."""
+    names: list[str] = []
+    for pname, pos in spec:
+        arg = None
+        if pos < len(call.args):
+            arg = call.args[pos]
+        else:
+            for kw in call.keywords:
+                if kw.arg == pname:
+                    arg = kw.value
+                    break
+        if isinstance(arg, ast.Name):
+            names.append(arg.id)
+    return names
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """One function body: collect donated-name taints at dispatch calls,
+    flag later loads, clear taints on rebinding."""
+
+    def __init__(self, entrypoints: dict, findings: list[Finding], path: str):
+        self.entrypoints = entrypoints
+        self.findings = findings
+        self.path = path
+        # name -> (dispatch lineno, entrypoint) — live taints
+        self.tainted: dict[str, tuple[int, str]] = {}
+        # name -> (entrypoint, shifted donated spec) — same-scope
+        # functools.partial aliases of a donated entry point
+        self.aliases: dict[str, tuple[str, tuple]] = {}
+
+    def _partial_alias(self, value) -> tuple[str, tuple] | None:
+        """(entrypoint, shifted spec) when ``value`` is
+        ``[functools.]partial(<donated entrypoint>, <bound args...>)``."""
+        if not (
+            isinstance(value, ast.Call)
+            and terminal_name(value.func) == "partial"
+            and value.args
+        ):
+            return None
+        target = terminal_name(value.args[0])
+        spec = self.entrypoints.get(target)
+        if not spec:
+            return None
+        shift = len(value.args) - 1
+        shifted = tuple(
+            (pname, pos - shift) for pname, pos in spec if pos - shift >= 0
+        )
+        return (target, shifted) if shifted else None
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        fn = terminal_name(node.func)
+        spec = self.entrypoints.get(fn)
+        label = fn
+        if spec is None and fn in self.aliases:
+            label, spec = self.aliases[fn]
+        # arguments are evaluated (read) before the call taints them
+        self.generic_visit(node)
+        if spec:
+            for name in _donated_names_of_call(node, spec):
+                self.tainted[name] = (node.lineno, label)
+
+    def _flag_read(self, name: str, lineno: int, col: int) -> None:
+        hit = self.tainted.get(name)
+        if hit and lineno > hit[0]:
+            at, fn = hit
+            self.findings.append(Finding(
+                CHECK_ID, self.path, lineno, col,
+                f"{name!r} was donated to {fn}() at line {at} "
+                "and must not be read afterwards — the device owns "
+                "the buffer; stage a fresh array per dispatch or drop "
+                "the donation from the sharded manifest",
+            ))
+
+    def visit_Name(self, node: ast.Name) -> None:  # noqa: N802
+        if isinstance(node.ctx, ast.Load):
+            self._flag_read(node.id, node.lineno, node.col_offset)
+        elif isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.tainted.pop(node.id, None)
+            self.aliases.pop(node.id, None)
+        self.generic_visit(node)
+
+    # Python evaluates the RHS before binding the target, but ast.Assign
+    # lists targets first — visiting in field order would clear the
+    # taint before the Load on the value is seen, hiding
+    # `payload = payload.sum()` after a dispatch.  Visit in evaluation
+    # order instead.
+    def visit_Assign(self, node: ast.Assign) -> None:  # noqa: N802
+        self.visit(node.value)
+        for t in node.targets:
+            self.visit(t)  # Store clears any stale taint/alias
+        alias = self._partial_alias(node.value)
+        if alias is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases[t.id] = alias
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:  # noqa: N802
+        if node.value is not None:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:  # noqa: N802
+        # `payload += x` both reads and rebinds: the read of the donated
+        # buffer is the finding; the rebind then clears the taint
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            self._flag_read(
+                node.target.id, node.target.lineno, node.target.col_offset
+            )
+            self.tainted.pop(node.target.id, None)
+        else:
+            self.visit(node.target)
+
+    # nested defs get their own scope/visitor; don't leak taints in
+    def _skip(self, node) -> None:
+        _check_function(node, self.entrypoints, self.findings, self.path)
+
+    visit_FunctionDef = _skip  # noqa: N815
+    visit_AsyncFunctionDef = _skip  # noqa: N815
+
+
+def _check_function(fn, entrypoints, findings, path) -> None:
+    v = _FnVisitor(entrypoints, findings, path)
+    for stmt in fn.body:
+        v.visit(stmt)
+
+
+def check(mod: Module) -> list[Finding]:
+    entrypoints = manifest.donated_entrypoints()
+    if not entrypoints:
+        return []
+    # cheap pre-filter: no donated entry point named in the source
+    if not any(name in mod.source for name in entrypoints):
+        return []
+    findings: list[Finding] = []
+    # the module-level visitor covers top-level dispatches (scripts);
+    # every FunctionDef it meets — top-level, method, nested — gets its
+    # own fresh-scoped visitor via the _skip interception
+    v = _FnVisitor(entrypoints, findings, mod.path)
+    for stmt in mod.tree.body:
+        v.visit(stmt)
+    return findings

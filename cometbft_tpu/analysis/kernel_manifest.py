@@ -305,6 +305,146 @@ FORBIDDEN_DTYPES: frozenset[str] = frozenset(
 )
 
 
+# ------------------------------------------------------- sharded kernels
+#
+# The sharding extension of the manifest: every mesh-parameterized
+# kernel (the parallel/verify.py factories) declares, next to its trace
+# shapes, the SHARDED-PLANE contract ``analysis/shardcheck.py`` enforces
+# under a real 8-way CPU mesh (subprocess with
+# ``XLA_FLAGS=--xla_force_host_platform_device_count=8``):
+#
+# * ``in_specs``/``out_specs`` — the intended PartitionSpec per
+#   argument/output, spelled stdlib-only as one tuple per array with an
+#   axis name (or None) per dimension; ``()`` = fully replicated.  The
+#   checker compares them against the traced shard_map's in/out names,
+#   so a silent respec (a stage suddenly receiving replicated rows it
+#   expected sharded) fails statically.
+# * ``donate_argnums`` — arguments the lowered program must actually
+#   donate (and nothing else): the staging-slab HBM-reuse discipline of
+#   ROADMAP item 1, checked on the pjit's ``donated_invars``.
+#   ``entry_donated_params`` names the same arguments as (param-name,
+#   positional-index) of the PUBLIC wrapper, for the
+#   ``donated-read-after-dispatch`` AST check.
+# * ``collectives`` — the declared collective census.  Any collective
+#   primitive (psum / all_gather / all_to_all / ppermute /
+#   sharding_constraint resharding copies, ...) the traced program
+#   contains beyond this census is a finding: silent reshard-per-stage
+#   is exactly how a pipelined handoff degrades to gather+scatter.
+# * ``max_eqns`` / ``max_loop_depth`` / ``max_device_bytes`` — the
+#   compile-cost budget: total jaxpr equation count (an unrolled table
+#   build lands thousands of flat equations — the static face of the
+#   2m34s ``jit_build_a_tables`` XLA compile), deepest nested
+#   control-flow loop, and a per-device peak-bytes estimate from the
+#   shard_map body's (already per-device) avals.
+#
+# ``name`` must match a ``needs_mesh`` Kernel row above (same fn ref) so
+# the two declarations cannot drift apart; ``args``/``out`` here are the
+# 8-way trace shapes (every sharded axis divisible by the mesh).
+
+SHARD_MESH_DEVICES = 8  # the CI mesh: forced host devices in the child
+SHARD_AXIS = "sig"
+
+V8 = 8  # validator lanes under the 8-way mesh (1 per device)
+_TABLES8 = i32(64, 9, 3, 22, V8)
+
+
+@dataclass(frozen=True)
+class ShardedKernel:
+    """One mesh-parameterized kernel's sharded-plane contract."""
+
+    name: str  # the needs_mesh Kernel row this extends
+    entrypoint: str  # public wrapper in parallel/verify.py
+    args: tuple[Arg, ...]  # 8-way trace shapes
+    out: tuple[Arg, ...]
+    in_specs: tuple[tuple, ...]  # per arg: axis-or-None per dim
+    out_specs: tuple[tuple, ...]
+    collectives: tuple[tuple[str, int], ...]  # declared census
+    max_eqns: int  # compile-cost budget: total equation count
+    max_loop_depth: int  # deepest nested scan/while body
+    max_device_bytes: int  # per-device peak-bytes estimate ceiling
+    donate_argnums: tuple[int, ...] = ()
+    # (wrapper param name, wrapper positional index) per donated arg
+    entry_donated_params: tuple[tuple[str, int], ...] = ()
+
+
+SHARDED_KERNELS: tuple[ShardedKernel, ...] = (
+    ShardedKernel(
+        name="sharded_verify_batch",
+        entrypoint="sharded_verify_batch",
+        args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
+        out=(boolean(), boolean(N)),
+        in_specs=(
+            (SHARD_AXIS,),
+            (SHARD_AXIS,),
+            (SHARD_AXIS,),
+            (SHARD_AXIS, None, None),
+            (SHARD_AXIS,),
+        ),
+        out_specs=((), ()),
+        # one psum folds the per-device bad counts, one all_gather
+        # replicates the blame vector; anything else is a reshard
+        collectives=(("all_gather", 1), ("psum", 1)),
+        # measured 76,888 eqns / loop depth 1 / ~11 KB per device at the
+        # 8-lane trace; budgets leave headroom for kernel evolution but
+        # fail an unrolled-table-build-class blowup immediately
+        max_eqns=110_000,
+        max_loop_depth=4,
+        max_device_bytes=8 << 20,
+    ),
+    ShardedKernel(
+        name="sharded_verify_cached",
+        entrypoint="sharded_verify_cached",
+        args=(_TABLES8, boolean(V8), u8(V8, 32), u8(V8, PAYLOAD_W)),
+        out=(u8(2),),
+        in_specs=(
+            (None, None, None, None, SHARD_AXIS),  # tables: lanes minor
+            (SHARD_AXIS,),
+            (SHARD_AXIS, None),  # pubs
+            (SHARD_AXIS, None),  # payload rows
+        ),
+        out_specs=((),),
+        collectives=(("all_gather", 1), ("psum", 1)),
+        # measured 39,074 eqns / loop depth 1 / ~24.9 MB per device at
+        # the 8-lane trace (the replicated radix-4096 basepoint comb is
+        # ~23.8 MB on EVERY device — the estimate is dominated by it)
+        max_eqns=60_000,
+        max_loop_depth=4,
+        max_device_bytes=48 << 20,
+        # the per-call staging payload is consumed by the dispatch
+        donate_argnums=(3,),
+        entry_donated_params=(("payload", 4),),  # wrapper: (mesh, t, v, p, payload)
+    ),
+    ShardedKernel(
+        name="sharded_merkle_root",
+        entrypoint="sharded_merkle_root",
+        args=(u8(N, 1, 64), i32(N)),
+        out=(u8(32),),
+        in_specs=((SHARD_AXIS, None, None), (SHARD_AXIS,)),
+        out_specs=((),),
+        collectives=(("all_gather", 1),),
+        # measured 633 eqns / loop depth 1 / ~4 KB per device
+        max_eqns=2_000,
+        max_loop_depth=4,
+        max_device_bytes=1 << 20,
+    ),
+)
+
+
+def sharded_by_name() -> dict[str, ShardedKernel]:
+    return {s.name: s for s in SHARDED_KERNELS}
+
+
+def donated_entrypoints() -> dict[str, tuple[tuple[str, int], ...]]:
+    """Wrapper-function name -> ((param name, positional index), ...)
+    for every sharded kernel with declared donations — the
+    ``donated-read-after-dispatch`` AST check's worklist."""
+    out: dict[str, tuple[tuple[str, int], ...]] = {}
+    for s in SHARDED_KERNELS:
+        if s.entry_donated_params:
+            out[s.entrypoint] = s.entry_donated_params
+    return out
+
+
 # ----------------------------------------------------------------- helpers
 
 

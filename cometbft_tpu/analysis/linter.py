@@ -131,6 +131,7 @@ class Allowlist:
 def all_checks() -> dict[str, object]:
     """check-id -> check module, discovery order stable."""
     from . import (
+        donated_read,
         host_sync,
         jax_purity,
         lock_blocking,
@@ -152,6 +153,7 @@ def all_checks() -> dict[str, object]:
         untracked_jit,
         host_sync,
         weak_type_literal,
+        donated_read,
     )
     return {m.CHECK_ID: m for m in mods}
 
@@ -160,6 +162,11 @@ def all_checks() -> dict[str, object]:
 #: contract gate (scripts/lint.py --check kernel) alongside the
 #: kernelcheck trace pass.
 KERNEL_CHECK_IDS = ("untracked-jit", "host-sync-in-hot-path", "weak-type-literal")
+
+#: The sharded-plane subset: the AST half of the sharding contract gate
+#: (scripts/lint.py --check sharding) alongside the shardcheck
+#: multi-device trace pass.
+SHARDING_CHECK_IDS = ("donated-read-after-dispatch",)
 
 
 def iter_py_files(paths: list[str]) -> list[str]:

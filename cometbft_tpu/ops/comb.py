@@ -308,7 +308,11 @@ def verify_cached(tables, a_valid, r_enc, s_bytes, k_digest, b_tables, tree=None
 
     Manifest kernels ``comb_verify_cached_tree`` / ``_seq`` (one per
     accumulation path — both fingerprints are pinned, since the
-    sequential path is the tree path's bit-exactness witness).
+    sequential path is the tree path's bit-exactness witness).  As the
+    shard_map body of ``sharded_verify_cached`` this must stay
+    lane-local over the validator axis: any collective it grows is
+    caught by the sharded census (analysis/shardcheck,
+    docs/sharding_contracts.md).
     """
     k_limbs = scalar.reduce_mod_l(scalar.bytes_to_limbs(k_digest, scalar.NL_X))
     # signed radix-16 digits in [-8, 7]: |d| selects the entry, the sign

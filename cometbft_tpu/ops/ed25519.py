@@ -375,7 +375,9 @@ def verify_batch(a_enc, r_enc, s_bytes, msg_blocks, msg_active):
 
     Manifest kernel ``ed25519_verify_batch`` (jitted from
     models/verifier.py — the manifest, not a per-module scan, is what
-    keeps this body visible to the static checks).
+    keeps this body visible to the static checks).  Also the lane-local
+    shard_map body of ``sharded_verify_batch``: the sharded census
+    (analysis/shardcheck) pins it to zero collectives of its own.
     """
     from . import sha2, scalar
 

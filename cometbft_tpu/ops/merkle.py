@@ -86,5 +86,7 @@ def root_from_leaves(blocks, active):
     """Full device pipeline: host-padded leaves -> root.  Jit-friendly.
 
     Manifest kernel ``merkle_root_from_leaves`` (jitted from
-    crypto/merkle.py)."""
+    crypto/merkle.py); per-device subtree body of
+    ``sharded_merkle_root`` (census: one all_gather of the D subtree
+    roots — analysis/shardcheck)."""
     return root_from_leaf_hashes(leaf_hashes_from_padded(blocks, active))

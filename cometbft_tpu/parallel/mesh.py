@@ -27,3 +27,21 @@ def make_mesh_2d(n_sig: int, n_leaf: int) -> Mesh:
     """2-D mesh: signature-parallel x leaf-parallel."""
     devs = np.array(jax.devices()[: n_sig * n_leaf]).reshape(n_sig, n_leaf)
     return Mesh(devs, ("sig", "leaf"))
+
+
+def mesh_cache_key(mesh: Mesh) -> tuple:
+    """Stable identity of a mesh for compiled-program caches.
+
+    Two meshes over the same devices (by id), same topology, and same
+    axis names run the SAME compiled program — but they are not
+    guaranteed to be the same (or even equal) Python objects across
+    `make_mesh` calls, and a cache keyed on object identity re-traces
+    and re-compiles per equivalent mesh (minutes on a cold pod).  Every
+    program cache in parallel/verify.py keys on this tuple instead;
+    analysis/shardcheck.py enforces the sharded plane's contracts on the
+    programs those caches hand out."""
+    return (
+        tuple(int(d.id) for d in mesh.devices.flat),
+        mesh.devices.shape,
+        tuple(mesh.axis_names),
+    )

@@ -17,7 +17,7 @@ verification step is the framework's flagship compiled program.
 
 from __future__ import annotations
 
-import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -44,19 +44,46 @@ def shard_map(f, *, mesh, in_specs, out_specs):
 from ..ops import ed25519 as E
 from ..ops import merkle as M
 from ..utils import tracing
+from .mesh import mesh_cache_key
+
+# Compiled sharded programs, keyed on STABLE mesh identity
+# (mesh_cache_key: device ids + topology + axis names) plus any
+# trace-time knob flag — never on Mesh object identity.  Two equivalent
+# meshes built by separate make_mesh calls hand out the SAME program
+# object, so nothing re-traces or re-compiles per mesh entry
+# (tests/test_shardcheck.py pins one-program-per-equivalent-mesh).
+_PROGRAMS: dict[tuple, object] = {}
+_PROGRAMS_MTX = threading.Lock()
 
 
-@functools.lru_cache(maxsize=8)
+def _cached_program(key: tuple):
+    with _PROGRAMS_MTX:
+        return _PROGRAMS.get(key)
+
+
+def _publish_program(key: tuple, fn):
+    """First publisher wins; a racing builder adopts the winner so every
+    caller shares one traced/compiled program per key."""
+    with _PROGRAMS_MTX:
+        return _PROGRAMS.setdefault(key, fn)
+
+
 def _verify_fn(mesh: Mesh):
-    """jit-wrapped sharded verifier, cached per mesh — without the jit
-    every call re-traces the whole kernel and nothing reaches the
+    """jit-wrapped sharded verifier, cached per equivalent mesh — without
+    the jit every call re-traces the whole kernel and nothing reaches the
     persistent compile cache (this made the un-jitted path effectively
     un-runnable on the CPU backend).
 
     Manifest kernel ``sharded_verify_batch``: the contract checker calls
     this factory with a 1-device CPU mesh and pins the traced program
-    (the collective mix — psum/all_gather — is part of the fingerprint).
+    (the collective mix — psum/all_gather — is part of the fingerprint);
+    analysis/shardcheck.py re-traces it under a real 8-way CPU mesh and
+    holds it to the declared shardings/collective census/budgets.
     """
+    key = ("verify_batch", mesh_cache_key(mesh))
+    cached = _cached_program(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
 
     def local(a, r, s, blocks, active):
@@ -66,7 +93,7 @@ def _verify_fn(mesh: Mesh):
         all_ok = jax.lax.all_gather(ok, axis, tiled=True)
         return total_bad == 0, all_ok
 
-    return jax.jit(
+    fn = jax.jit(
         shard_map(
             local,
             mesh=mesh,
@@ -74,6 +101,7 @@ def _verify_fn(mesh: Mesh):
             out_specs=(P(), P()),
         )
     )
+    return _publish_program(key, fn)
 
 
 def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active):
@@ -89,7 +117,6 @@ def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_acti
         return _verify_fn(mesh)(a_enc, r_enc, s_bytes, msg_blocks, msg_active)
 
 
-@functools.lru_cache(maxsize=8)
 def _comb_verify_fn(mesh: Mesh, tree: bool):
     """Sharded comb-cached commit verification — the engine's production
     path (models/comb_verifier.py) over a device mesh.
@@ -107,8 +134,21 @@ def _comb_verify_fn(mesh: Mesh, tree: bool):
     calls never serves a stale compiled program.  Both paths are
     lane-local over the validator axis, so sharding is unaffected.
 
+    The per-call payload rows are DONATED (donate_argnums=(3,)): the
+    staging buffer's device copy is consumed by the dispatch and its HBM
+    is reusable for the outputs — host code must never touch the device
+    payload after submit (models/comb_verifier stages a fresh
+    ``jnp.asarray`` per call and recycles only the HOST slab; the
+    ``donated-read-after-dispatch`` lint check and shardcheck's donation
+    contract keep it that way).  Tables/valid/pubs persist across calls
+    in the cache entry and are never donated.
+
     Manifest kernel ``sharded_verify_cached`` (traced with tree=True).
     """
+    key = ("verify_cached", mesh_cache_key(mesh), "tree" if tree else "seq")
+    cached = _cached_program(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
     import jax.numpy as jnp
 
@@ -128,7 +168,7 @@ def _comb_verify_fn(mesh: Mesh, tree: bool):
             [jnp.packbits(ok_all), (total_bad == 0).astype(jnp.uint8)[None]]
         )
 
-    return jax.jit(
+    fn = jax.jit(
         shard_map(
             local,
             mesh=mesh,
@@ -139,8 +179,11 @@ def _comb_verify_fn(mesh: Mesh, tree: bool):
                 P(axis, None),  # payload rows
             ),
             out_specs=P(),
-        )
+        ),
+        # the payload is a per-call staging transfer, dead after dispatch
+        donate_argnums=(3,),
     )
+    return _publish_program(key, fn)
 
 
 def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
@@ -152,6 +195,14 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
     by the mesh size (the comb cache pads entries to lane buckets).
     Returns one uint8 array [packbits(ok & live) | all_ok byte] — the
     same single-fetch contract as models/comb_verifier._device_verify.
+
+    ``payload`` is DONATED to the device program: pass a fresh per-call
+    array and never read it again after this returns.  The
+    donated-read-after-dispatch check flags violations statically at
+    direct and same-scope partial-bound call sites; for handles that
+    cross a function boundary (models/comb_verifier stores the partial
+    on its cache entry), stage the donated value inline in the call
+    expression — never bind it — as stage() does.
     """
     from ..ops import comb
 
@@ -164,9 +215,12 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
         )
 
 
-@functools.lru_cache(maxsize=8)
 def _merkle_fn(mesh: Mesh):
     # Manifest kernel ``sharded_merkle_root``.
+    key = ("merkle_root", mesh_cache_key(mesh))
+    cached = _cached_program(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
 
     def local(blocks, active):
@@ -174,7 +228,7 @@ def _merkle_fn(mesh: Mesh):
         roots = jax.lax.all_gather(sub, axis)  # (D, 32)
         return M.root_from_leaf_hashes(roots)
 
-    return jax.jit(
+    fn = jax.jit(
         shard_map(
             local,
             mesh=mesh,
@@ -182,6 +236,7 @@ def _merkle_fn(mesh: Mesh):
             out_specs=P(),
         )
     )
+    return _publish_program(key, fn)
 
 
 def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):

@@ -1,0 +1,55 @@
+"""JAX persistent compilation cache, behind COMETBFT_TPU_COMPILE_CACHE.
+
+The multi-chip cold-start problem (ROADMAP item 1, MULTICHIP_r05) is
+dominated by XLA: the fused Ed25519 kernel compiles in minutes on the
+CPU backend and tens of seconds on TPU, and the sharded comb programs
+re-pay it per (shape, mesh).  With the persistent cache pointed at a
+durable directory, a warm pod restart deserializes the executables
+instead — compile once per image, not once per process.
+
+``maybe_enable()`` is wired into the production entry (``__main__.py``)
+and ``bench.py``.  It is deliberately forgiving: an unusable directory
+or a jax too old for the config keys degrades to "no cache", never a
+startup failure.  The knob must name a DURABLE, per-host directory —
+a corrupt entry (e.g. a process killed mid-write on shared storage)
+can crash jax's cache read path, which is why there is no default dir:
+opting in is an operator decision.
+
+Call it before the first compile; flipping the config later in the
+process is a no-op for programs already compiled.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import envknobs
+from .log import get_logger
+
+logger = get_logger("compilecache")
+
+
+def maybe_enable(default_dir: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at the knob's directory
+    (or ``default_dir`` when the knob is unset).  Returns the directory
+    on success, None when disabled or unusable."""
+    cache_dir = envknobs.get_str(envknobs.COMPILE_CACHE) or default_dir
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # every kernel of the verify plane is worth persisting: the
+        # small ones are milliseconds to write, the comb/sharded ones
+        # are the minutes this cache exists to kill
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001 - the cache is an optimization only
+        logger.warning("persistent compile cache unusable at %s: %s",
+                       cache_dir, e)
+        return None
+    logger.info("persistent compile cache enabled at %s", cache_dir)
+    return cache_dir

@@ -84,6 +84,14 @@ DEVICE_BATCH_MIN = _declare(
     "Batch width at/above which signatures route to the device kernels; "
     "unset = link-aware default (2048 through the axon tunnel, else 32).",
 )
+COMPILE_CACHE = _declare(
+    "COMETBFT_TPU_COMPILE_CACHE", "str", "",
+    "Directory for JAX's persistent compilation cache "
+    "(utils/compilecache, enabled by `python -m cometbft_tpu` and "
+    "bench.py): a warm pod restart loads compiled executables from disk "
+    "instead of re-running XLA — the cold-start half of the multi-chip "
+    "plane.  Empty = cache disabled.",
+)
 BLS_DEVICE = _declare(
     "COMETBFT_TPU_BLS_DEVICE", "bool", False,
     "`1` tree-reduces BLS pubkey aggregation on the accelerator "

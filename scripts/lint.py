@@ -4,6 +4,7 @@
     python scripts/lint.py [paths...] [--json] [--list-checks]
                            [--check ID ...]
     python scripts/lint.py regen-fingerprints
+    python scripts/lint.py regen-shardings
 
 Runs every check in cometbft_tpu/analysis over the given paths (default:
 the cometbft_tpu package), filters through the checked-in allowlist
@@ -20,6 +21,18 @@ under JAX_PLATFORMS=cpu and diffed against the checked-in fingerprints
 (docs/kernel_contracts.md).  ``regen-fingerprints`` re-traces everything
 and rewrites cometbft_tpu/analysis/kernel_fingerprints.json after a
 DELIBERATE kernel change (contract violations still refuse).
+
+The special id ``sharding`` selects the sharded-program contract gate
+(docs/sharding_contracts.md): the donated-read-after-dispatch AST check
+PLUS the shardcheck trace pass — every mesh-parameterized kernel traced
+under a REAL 8-way CPU mesh in a forced-environment subprocess
+(XLA_FLAGS=--xla_force_host_platform_device_count=8, JAX_PLATFORMS=cpu,
+works on CPU-only hosts) and held to its declared shardings, collective
+census, compile-cost budgets, donation discipline, and the checked-in
+cometbft_tpu/analysis/shard_fingerprints.json goldens.
+``regen-shardings`` re-traces and rewrites the goldens; open contract
+findings refuse regeneration — blessing drift never blesses a broken
+contract.
 
 Check toggles live in pyproject.toml:
 
@@ -83,11 +96,37 @@ def regen_fingerprints() -> int:
     return 0
 
 
+def regen_shardings() -> int:
+    """Re-trace every sharded manifest kernel in the forced 8-device
+    child and rewrite the shard goldens."""
+    from cometbft_tpu.analysis import shardcheck
+
+    findings, data = shardcheck.run_subprocess(regen=True)
+    for f in findings:
+        print(f.render())
+    if findings or not data.get("regen_written"):
+        print(
+            f"\n{len(findings)} contract finding(s) — regeneration only "
+            "blesses drift, never a broken contract; shard goldens NOT "
+            "written",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"traced {len(data.get('kernels', {}))} sharded kernels on "
+        f"{data.get('device_count')} devices -> "
+        f"{shardcheck.SHARD_FINGERPRINTS_PATH}"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "regen-fingerprints":
         return regen_fingerprints()
+    if argv and argv[0] == "regen-shardings":
+        return regen_shardings()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
@@ -119,15 +158,21 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{cid}: {m.SUMMARY}")
         print("kernel: the kernel contract gate (kernel AST checks + "
               "kernelcheck trace/fingerprint pass)")
+        print("sharding: the sharded-program contract gate (donated-read "
+              "AST check + 8-device shardcheck trace/golden pass)")
         return 0
 
     run_trace = False
+    run_shard_trace = False
     if args.check:
         ids: list[str] = []
         for c in args.check:
             if c == "kernel":
                 run_trace = True
                 ids.extend(linter.KERNEL_CHECK_IDS)
+            elif c == "sharding":
+                run_shard_trace = True
+                ids.extend(linter.SHARDING_CHECK_IDS)
             else:
                 ids.append(c)
         unknown_ids = set(ids) - set(checks)
@@ -170,12 +215,37 @@ def main(argv: list[str] | None = None) -> int:
         kernel_summary = kernelcheck.summary(kfindings, traces)
         stale = allowlist.unused()  # kernel findings may have used entries
 
+    shard_summary = None
+    if run_shard_trace:
+        from cometbft_tpu.analysis import shardcheck
+
+        # the trace runs in a forced-environment child (8 CPU devices)
+        # so this works on CPU-only hosts and never touches a wedged
+        # accelerator tunnel; the child reports RAW findings and the
+        # allowlist — including an --allowlist/--config override — is
+        # applied here only, so used/stale entry bookkeeping stays exact
+        sfindings, shard_summary = shardcheck.run_subprocess()
+        sfindings = [f for f in sfindings if not allowlist.suppresses(f)]
+        findings = findings + sfindings
+        # the child's "ok" predates the allowlist; recompute both fields
+        # post-filter so a blessed state reads green here too
+        shard_summary = {
+            **shard_summary, "ok": not sfindings, "findings": len(sfindings),
+        }
+        stale = allowlist.unused()
+
     if args.check:
         # a restricted run must not call entries for checks that never
         # ran "stale" — only full runs can prove an entry matches nothing
-        enabled_ids = set(checks) | (
-            set(kernelcheck.FINDING_CHECK_IDS) if run_trace else set()
-        )
+        enabled_ids = set(checks)
+        if run_trace:
+            from cometbft_tpu.analysis import kernelcheck
+
+            enabled_ids |= set(kernelcheck.FINDING_CHECK_IDS)
+        if run_shard_trace:
+            from cometbft_tpu.analysis import shardcheck
+
+            enabled_ids |= set(shardcheck.FINDING_CHECK_IDS)
         stale = [e for e in stale if e.check in enabled_ids]
 
     if args.json:
@@ -195,6 +265,7 @@ def main(argv: list[str] | None = None) -> int:
                 ],
                 "ok": not findings,
                 **({"kernel": kernel_summary} if kernel_summary else {}),
+                **({"sharding": shard_summary} if shard_summary else {}),
             },
             indent=2,
         ))

@@ -1,0 +1,196 @@
+"""Fixture sharded kernels for the shardcheck contract tests.
+
+Each factory below is a tiny mesh-parameterized kernel (the
+parallel/verify.py needs_mesh shape) engineered to trip exactly one
+contract — or none (``shardfix_clean``).  The module exports the same
+surface the real manifest does (``SHARDED_KERNELS`` + ``KERNEL_ROWS``)
+so both the in-process checker and the forced-environment subprocess
+child (``python -m cometbft_tpu.analysis.shardcheck --fixtures
+tests.shardcheck_fixtures``) can swap it in.
+
+Tracing is milliseconds per fixture: the point is the CONTRACT logic,
+not kernel weight — the real kernels' 8-way traces live in the slow
+golden gate.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.analysis import kernel_manifest as manifest
+
+AXIS = manifest.SHARD_AXIS
+
+
+def _jit_shard(local, mesh, in_specs, out_specs, donate=()):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from cometbft_tpu.parallel.verify import shard_map
+
+    specs_in = tuple(P(*s) if s else P() for s in in_specs)
+    specs_out = (
+        tuple(P(*s) if s else P() for s in out_specs)
+        if isinstance(out_specs, tuple)
+        else out_specs
+    )
+    if len(specs_out) == 1:
+        specs_out = specs_out[0]
+    kw = {"donate_argnums": donate} if donate else {}
+    return jax.jit(
+        shard_map(local, mesh=mesh, in_specs=specs_in, out_specs=specs_out),
+        **kw,
+    )
+
+
+def make_clean(mesh):
+    """Sharded sum: one declared psum, inside every budget."""
+    import jax
+
+    def local(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),))
+
+
+def make_undeclared_collective(mesh):
+    """A ppermute the census does not declare — the silent-reshard
+    class of finding."""
+    import jax
+
+    def local(x):
+        n = mesh.devices.size
+        y = jax.lax.ppermute(
+            x, AXIS, [(i, (i + 1) % n) for i in range(n)]
+        )
+        return jax.lax.psum((x + y).sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),))
+
+
+def make_unrolled_table(mesh):
+    """A jit_build_a_tables-class unrolled table build: a Python loop
+    that lands one equation chain per step, blowing the eqn budget."""
+    import jax
+
+    def local(x):
+        rows = [x * i + i for i in range(96)]
+        acc = rows[0]
+        for r in rows[1:]:
+            acc = acc + r
+        return jax.lax.psum(acc.sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),))
+
+
+def make_deep_loops(mesh):
+    """Control flow nested past the loop-depth budget."""
+    import jax
+
+    def local(x):
+        def outer(i, a):
+            def inner(j, b):
+                return b + j
+
+            return jax.lax.fori_loop(0, 4, inner, a)
+
+        r = jax.lax.fori_loop(0, 4, outer, x.sum())
+        return jax.lax.psum(r, AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),))
+
+
+def make_broken_donation(mesh):
+    """Declares arg 0 donated (see the ShardedKernel row) but the jit
+    does not donate it — the staging-slab discipline violated."""
+    import jax
+
+    def local(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),))  # no donate_argnums
+
+
+def make_sneaky_donation(mesh):
+    """Donates arg 0 without declaring it — the reverse violation: an
+    undeclared donation invalidates a buffer host code may still hold."""
+    import jax
+
+    def local(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((AXIS,),), ((),), donate=(0,))
+
+
+def make_respec(mesh):
+    """Receives its input replicated while the manifest declares it
+    sharded — the closure mismatch that means a reshard at every call."""
+    import jax
+
+    def local(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    return _jit_shard(local, mesh, ((),), ((),))
+
+
+def make_untraceable(mesh):
+    raise RuntimeError("untraceable by design")
+
+
+def _row(name: str, factory: str) -> manifest.Kernel:
+    return manifest.Kernel(
+        name=name,
+        fn=f"tests.shardcheck_fixtures:{factory}",
+        args=(manifest.i32(16),),
+        out=(manifest.i32(),),
+        needs_mesh=True,
+    )
+
+
+def _sk(name: str, **kw) -> manifest.ShardedKernel:
+    base = dict(
+        name=name,
+        entrypoint=name,
+        args=(manifest.i32(16),),
+        out=(manifest.i32(),),
+        in_specs=((AXIS,),),
+        out_specs=((),),
+        collectives=(("psum", 1),),
+        max_eqns=64,
+        max_loop_depth=1,
+        max_device_bytes=1 << 16,
+    )
+    base.update(kw)
+    return manifest.ShardedKernel(**base)
+
+
+CLEAN = _sk("shardfix_clean")
+# same kernel traced at a different width: pure signature drift for the
+# golden-gate tests (census, specs, donation all unchanged)
+CLEAN_WIDE = _sk("shardfix_clean", args=(manifest.i32(32),))
+BAD_CENSUS = _sk("shardfix_census")
+BAD_BUDGET = _sk("shardfix_budget")
+BAD_DEPTH = _sk("shardfix_depth")
+BAD_DONATION = _sk("shardfix_donate", donate_argnums=(0,))
+SNEAKY_DONATION = _sk("shardfix_sneaky")
+BAD_SPEC = _sk("shardfix_respec")
+UNTRACEABLE = _sk("shardfix_boom")
+
+KERNEL_ROWS: dict[str, manifest.Kernel] = {
+    "shardfix_clean": _row("shardfix_clean", "make_clean"),
+    "shardfix_census": _row("shardfix_census", "make_undeclared_collective"),
+    "shardfix_budget": _row("shardfix_budget", "make_unrolled_table"),
+    "shardfix_depth": _row("shardfix_depth", "make_deep_loops"),
+    "shardfix_donate": _row("shardfix_donate", "make_broken_donation"),
+    "shardfix_sneaky": _row("shardfix_sneaky", "make_sneaky_donation"),
+    "shardfix_respec": _row("shardfix_respec", "make_respec"),
+    "shardfix_boom": _row("shardfix_boom", "make_untraceable"),
+}
+
+SHARDED_KERNELS: tuple[manifest.ShardedKernel, ...] = (
+    CLEAN,
+    BAD_CENSUS,
+    BAD_BUDGET,
+    BAD_DEPTH,
+    BAD_DONATION,
+    SNEAKY_DONATION,
+    BAD_SPEC,
+)

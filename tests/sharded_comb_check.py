@@ -68,4 +68,55 @@ for i in range(13):
 ok, per = bv.verify()
 assert ok and per == [True] * 13
 
+# ---- bit-identical to the 1-device path (ISSUE 8 smoke): the same
+# corpus through the single-device comb program must agree verdict for
+# verdict with the mesh program — including the tampered row.
+cv.set_active_mesh(None)
+cache1 = cv.ValsetCombCache()
+entry1 = cache1.ensure(pubs)
+assert entry1.mesh is None
+for tamper in (None, 5):
+    bv1 = cv.CombBatchVerifier(entry1)
+    bv8 = cv.CombBatchVerifier(entry)
+    for i, (p, m, s) in enumerate(items):
+        msg = m + (b"x" if i == tamper else b"")
+        bv1.add(p, msg, s)
+        bv8.add(p, msg, s)
+    ok1, per1 = bv1.verify()
+    ok8, per8 = bv8.verify()
+    assert (ok1, per1) == (ok8, per8), (tamper, per1, per8)
+
+# ---- and the uncached kernel: sharded_verify_batch over the mesh vs
+# the single-device jit of the same program, bit for bit.
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cometbft_tpu.ops import ed25519 as E  # noqa: E402
+from cometbft_tpu.ops import sha2  # noqa: E402
+from cometbft_tpu.parallel.verify import sharded_verify_batch  # noqa: E402
+
+n = 16
+a = np.zeros((n, 32), dtype=np.uint8)
+r = np.zeros((n, 32), dtype=np.uint8)
+s = np.zeros((n, 32), dtype=np.uint8)
+hashed = []
+for i in range(n):
+    sk = host.PrivKey.from_seed(bytes([i + 31]) * 32)
+    pub = sk.pub_key().data
+    msg = b"single-vs-mesh-%d" % i
+    sig = sk.sign(msg)
+    if i in (2, 9):  # corrupt two rows: blame must match too
+        sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+    a[i] = np.frombuffer(pub, dtype=np.uint8)
+    r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+    s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+    hashed.append(sig[:32] + pub + msg)
+blocks, active = sha2.pad_messages_sha512(hashed)
+args = (jnp.asarray(a), jnp.asarray(r), jnp.asarray(s),
+        jnp.asarray(blocks), jnp.asarray(active))
+single = np.asarray(jax.jit(E.verify_batch)(*args))
+ok, valid = sharded_verify_batch(mesh, *args)
+assert np.array_equal(np.asarray(valid), single), (single, np.asarray(valid))
+assert bool(ok) == bool(single.all()) and single.sum() == n - 2
+
 print("sharded comb path OK")

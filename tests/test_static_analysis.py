@@ -583,9 +583,11 @@ def test_linter_runs_clean_over_cometbft_tpu():
     stale allowlist entries, and every allowlist entry carries a
     justification comment.  lint_paths runs every registered check, so
     the kernel-plane trio (untracked-jit / host-sync-in-hot-path /
-    weak-type-literal, PR 4) is asserted present first — the gate must
-    not silently narrow if check registration regresses."""
+    weak-type-literal, PR 4) and the sharded-plane check
+    (donated-read-after-dispatch, PR 6) are asserted present first — the
+    gate must not silently narrow if check registration regresses."""
     assert set(linter.KERNEL_CHECK_IDS) <= set(linter.all_checks())
+    assert set(linter.SHARDING_CHECK_IDS) <= set(linter.all_checks())
     allowlist = linter.Allowlist.load(linter.default_allowlist_path())
     findings, stale = linter.lint_paths(
         [os.path.join(REPO, "cometbft_tpu")], allowlist=allowlist
