@@ -45,11 +45,19 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The ONE wedge-safe device probe (subprocess + killpg + poll deadline)
+# lives in the library now — the health sentinel runs it periodically on
+# live nodes, this bench runs it before the expensive table build.
+# utils/healthmon imports no jax, so the "jax not yet imported" contract
+# the kernelcheck fallback relies on still holds.
+from cometbft_tpu.utils import healthmon as _healthmon
 
 GO_CPU_US_PER_SIG = 27.5
 
@@ -80,49 +88,14 @@ def emit_and_exit(code: int = 0) -> None:
     raise SystemExit(code)
 
 
-def backend_available() -> tuple[bool, str]:
-    """Probe the accelerator backend in a throwaway subprocess.
-
-    Runs `jax.devices()` in a subprocess with a hard timeout: a wedged
-    tunnel blocks forever in backend init (no exception), which is
-    unkillable in-process.  The subprocess exits before this process
-    attaches, so the device is never held by two processes at once.
-    Popen + poll deadline rather than subprocess.run(timeout=...): run()
-    reaps the killed child with an unbounded communicate(), and a child
-    wedged in uninterruptible device I/O would hang the reap — the exact
-    failure this probe exists to detect.  The child runs in its own
-    session so the kill escalation (SIGKILL to the whole group) also
-    takes out any plugin helper processes it spawned; nothing here ever
-    blocks on the child's pipes after a kill.  Returns
-    (ok, platform-or-error).
-    """
-    import signal
-
-    code = "import jax; print(jax.devices()[0].platform)"
-    with open(os.devnull, "wb") as devnull:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", code],
-            stdout=subprocess.PIPE,
-            stderr=devnull,
-            text=True,
-            start_new_session=True,
-        )
-        timeout_s = _probe_timeout_s()
-        deadline = time.monotonic() + timeout_s
-        while proc.poll() is None and time.monotonic() < deadline:
-            time.sleep(0.5)
-        if proc.poll() is None:
-            try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-            except (OSError, ProcessLookupError):
-                proc.kill()
-            return False, (
-                f"jax.devices() hung >{timeout_s}s (wedged device tunnel)"
-            )
-        out = proc.stdout.read() if proc.stdout else ""
-        if proc.returncode != 0:
-            return False, f"probe exited {proc.returncode}"
-    return True, out.strip().splitlines()[-1] if out.strip() else "?"
+def backend_available() -> "_healthmon.ProbeResult":
+    """Probe the accelerator backend via the SHARED hang-proof probe
+    (cometbft_tpu/utils/healthmon.probe_devices): `jax.devices()` in a
+    throwaway subprocess of its own session, SIGKILLed (whole group) at
+    the BENCH_PROBE_TIMEOUT deadline — the same implementation the node
+    health sentinel runs periodically, so a wedge seen here and a wedge
+    seen by /tpu_health are the same measurement."""
+    return _healthmon.probe_devices(_probe_timeout_s())
 
 
 def _arm_run_watchdog() -> None:
@@ -175,16 +148,26 @@ def probe_backend() -> None:
     # rounds, but a structured line must still land within its patience
     attempts = max(1, _int_env("BENCH_PROBE_RETRIES", 2))
     delay_s = max(0, _int_env("BENCH_PROBE_RETRY_DELAY", 90))
+    results = []
     for attempt in range(attempts):
         if attempt:
             time.sleep(delay_s)
-        ok, detail = backend_available()
-        if ok:
-            REPORT["backend"] = detail
+        res = backend_available()
+        results.append(res)
+        if res.ok:
+            REPORT["backend"] = res.detail
             REPORT["probe_attempts"] = attempt + 1
             return
-    REPORT["error"] = "backend-unavailable: " + detail
+    REPORT["error"] = "backend-unavailable: " + results[-1].detail
     REPORT["probe_attempts"] = attempts
+    # the sentinel's structured wedge report, not a bespoke string: each
+    # attempt's verdict/latency/timeout flag, in order — the same shape
+    # /tpu_health serves under "last_probe" on a live node
+    REPORT["wedge_report"] = {
+        "state": "wedged" if results[-1].timed_out else "unavailable",
+        "attempts": [r.to_dict() for r in results],
+        "probe_timeout_s": _probe_timeout_s(),
+    }
     if os.environ.get("BENCH_KERNELCHECK", "1").lower() not in (
         "0", "false", "no", "off"
     ):
@@ -277,7 +260,6 @@ def _enable_compile_cache() -> None:
     is tens of seconds of TPU compile; with the cache warm,
     table_build_s is the arithmetic only."""
     try:
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from cometbft_tpu.utils import compilecache
 
         compilecache.maybe_enable(

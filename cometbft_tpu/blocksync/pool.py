@@ -15,6 +15,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..utils import healthmon
 from ..utils.flowrate import Monitor
 from ..utils.log import get_logger
 from ..utils.service import Service
@@ -145,6 +146,7 @@ class BlockPool(Service):
         """Single loop doing the work of makeRequestersRoutine plus every
         bpRequester.requestRoutine (pool.go:113,805)."""
         while self.is_running():
+            healthmon.beat("blockpool")
             if time.monotonic() - self._start_time < PEER_CONN_WAIT:
                 time.sleep(0.05)
                 continue
@@ -193,6 +195,7 @@ class BlockPool(Service):
             for brq in sends:
                 self._send_request(brq)
             time.sleep(REQUEST_INTERVAL if sends else 0.05)
+        healthmon.retire("blockpool")
 
     def _pick_peer_locked(self, height: int, exclude: str) -> _Peer | None:
         """pickIncrAvailablePeer (pool.go:455): best current rate first."""

@@ -17,7 +17,7 @@ import time
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
 from ..types.block import Block, ExtendedCommit
-from ..utils import tracing
+from ..utils import healthmon, tracing
 from ..utils.log import get_logger
 from ..wire import blocksync_pb as pb
 from .pool import BlockPool, BlockRequest, PeerError
@@ -239,6 +239,7 @@ class BlocksyncReactor(Reactor):
         handleBlockRequestsRoutine) plus the periodic status broadcast."""
         last_status = 0.0
         while self.is_running() and self.pool.is_running():
+            healthmon.beat("blocksync-events")
             now = time.monotonic()
             if now - last_status >= STATUS_UPDATE_INTERVAL:
                 last_status = now
@@ -253,6 +254,7 @@ class BlocksyncReactor(Reactor):
                 peer = self.switch.peers.get(item.peer_id) if self.switch else None
                 if peer is not None:
                     self.switch.stop_peer(peer, item.err)
+        healthmon.retire("blocksync-events")
 
     def _handle_block_request(self, rq: BlockRequest) -> None:
         peer = self.switch.peers.get(rq.peer_id) if self.switch else None
@@ -295,6 +297,14 @@ class BlocksyncReactor(Reactor):
         return cls.VERIFY_AHEAD_DEPTH
 
     def _pool_routine(self) -> None:
+        try:
+            self._pool_loop()
+        finally:
+            # handed off to consensus (or stopped): a finished pool loop
+            # must not read as a stalled heartbeat
+            healthmon.retire("blocksync-pool")
+
+    def _pool_loop(self) -> None:
         """Apply fetched blocks pairwise; switch to consensus when caught up
         (reactor.go:315 poolRoutine).
 
@@ -308,6 +318,7 @@ class BlocksyncReactor(Reactor):
         last_switch_check = 0.0
         pending: dict[int, _PendingBlock] = {}
         while self.is_running() and self.pool.is_running():
+            healthmon.beat("blocksync-pool")
             now = time.monotonic()
             if now - last_switch_check >= self.switch_interval:
                 last_switch_check = now

@@ -29,7 +29,7 @@ from ..types.proposal import Proposal
 from ..types.validators import ValidatorSet
 from ..types.vote import Vote, VoteError
 from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
-from ..utils import tracing
+from ..utils import healthmon, tracing
 from ..utils.flightrec import recorder as _flightrec
 from ..utils.log import get_logger
 from ..utils.service import Service
@@ -318,8 +318,23 @@ class ConsensusState(Service):
     # ------------------------------------------------------ receive loop
 
     def _receive_routine(self) -> None:
+        try:
+            self._receive_loop()
+        finally:
+            healthmon.retire("cs-receive")
+
+    def _receive_loop(self) -> None:
         while True:
-            item = self._queue.get()
+            # bounded get, not a bare blocking one: the heartbeat must
+            # tick while the machine idles, and go stale only while a
+            # single input is stuck in processing (e.g. a VerifyCommit
+            # against a wedged device) — exactly what the health
+            # sentinel audits
+            healthmon.beat("cs-receive")
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             try:
@@ -436,6 +451,7 @@ class ConsensusState(Service):
         last = None
         stalled_checks = 0
         while self.is_running():
+            healthmon.beat("cs-watchdog")
             time.sleep(self._WATCHDOG_INTERVAL)
             rs = self.rs
             cur = (rs.height, rs.round, rs.step)
@@ -494,6 +510,7 @@ class ConsensusState(Service):
             else:
                 stalled_checks = 0
             last = cur
+        healthmon.retire("cs-watchdog")
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs

@@ -133,6 +133,28 @@ class E2ENode:
     def height(self) -> int:
         return int(self.rpc("status")["sync_info"]["latest_block_height"])
 
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        """Poll /tpu_health until the node answers AND is not wedged —
+        the readiness wait that replaces bare fixed sleeps wherever the
+        runner holds a node handle.  The route answers even with the
+        sentinel off (`{"enabled": false}`), so on a plain node this
+        degrades to 'the RPC listener is up', which is exactly the old
+        sleep's (unchecked) assumption."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc is None or self.proc.poll() is not None:
+                return False  # process gone: readiness can never arrive
+            try:
+                h = self.rpc("tpu_health")
+            except Exception as e:  # noqa: BLE001 — RPC not up yet, keep polling
+                _log.debug(f"tpu_health poll of {self.name}: {e!r}")
+                time.sleep(0.25)
+                continue
+            if not h.get("enabled", False) or h.get("ready", True):
+                return True
+            time.sleep(0.25)
+        return False
+
     def kill(self) -> None:
         """kill -9: the crash-recovery perturbation (runner/perturb.go)."""
         if self.proc:
@@ -238,6 +260,11 @@ class Runner:
         for node, spec in zip(self.nodes, self.m.nodes):
             if spec.start_at == 0:
                 node.start()
+        # readiness, not a fixed grace sleep: the first load round used
+        # to race the RPC listeners coming up
+        for node, spec in zip(self.nodes, self.m.nodes):
+            if spec.start_at == 0 and not node.wait_ready():
+                _log.warning(f"{node.name} not ready after start")
 
     def start_late_nodes(self) -> None:
         started_heights = self._heights(only_running=True)
@@ -310,16 +337,22 @@ class Runner:
                     continue
                 if p == "kill":
                     node.kill()
-                    time.sleep(1.0)
+                    time.sleep(1.0)  # downtime under test, not readiness
                     node.start()
+                    if not node.wait_ready():
+                        _log.warning(
+                            f"{node.name} not ready after kill+restart"
+                        )
                 elif p == "pause":
                     node.pause()
                     time.sleep(3.0)
                     node.resume()
                 elif p == "restart":
                     node.terminate()
-                    time.sleep(0.5)
+                    time.sleep(0.5)  # downtime under test, not readiness
                     node.start()
+                    if not node.wait_ready():
+                        _log.warning(f"{node.name} not ready after restart")
                 elif p == "disconnect":
                     # network partition: sever sockets, not processes
                     # (runner/perturb.go:47-60); heal after a few seconds

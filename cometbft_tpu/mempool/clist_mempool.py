@@ -387,10 +387,15 @@ class CListMempool(Mempool):
                 self._txs_available.clear()
 
     def _recheck(self, entries: list[TxEntry]) -> None:
+        from ..utils import healthmon
         from ..utils.metrics import hub as _mhub
 
         _mhub().mp_recheck_times.inc(len(entries))
         for entry in entries:
+            # event-driven loop: registered informational (no deadline)
+            # in the health registry — the per-entry beat makes a recheck
+            # wedged on the app connection visible by its growing age
+            healthmon.beat("mempool-recheck")
             try:
                 res = self.proxy_app.check_tx(
                     pb.CheckTxRequest(tx=entry.tx, type=pb.CHECK_TX_TYPE_RECHECK)

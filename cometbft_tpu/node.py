@@ -479,6 +479,16 @@ class Node:
         if self.pex_reactor is not None:
             self.addr_book.save()
         self._start_metrics()
+        # health sentinel (utils/healthmon): knob-gated; off keeps every
+        # healthmon.beat() call in the loops a zero-overhead no-op
+        from .utils import healthmon as _healthmon
+
+        self._healthmon = _healthmon.maybe_start()
+        if self._healthmon is not None:
+            self.logger.info(
+                "health sentinel on: probe every "
+                f"{self._healthmon.probe_period_s:g}s, /tpu_health serving"
+            )
         self.logger.info(
             f"node {self.node_key.id()[:8]} started: p2p {self.listen_addr}"
         )
@@ -499,10 +509,13 @@ class Node:
         sub = self.event_bus.subscribe("metrics", EventQueryNewBlock)
         last_block_time = [None]
 
+        from .utils import healthmon as _healthmon
+
         def pump():
             import queue as _q
 
             while self.switch.is_running():
+                _healthmon.beat("metrics-pump")
                 try:
                     msg, _ = sub.get(timeout=0.5)
                 except _q.Empty:
@@ -521,15 +534,18 @@ class Node:
                         (t - last_block_time[0]) / 1e9
                     )
                 last_block_time[0] = t
+            _healthmon.retire("metrics-pump")
 
         def sample():
             while self.switch.is_running():
+                _healthmon.beat("metrics-sample")
                 self.metrics.mempool_size.set(self.mempool.size())
                 self.metrics.mempool_size_bytes.set(self.mempool.size_bytes())
                 self.metrics.p2p_peers.set(self.switch.num_peers())
                 rs = self.consensus_state.get_round_state()
                 self.metrics.consensus_rounds.set(max(rs.round, 0))
                 _time.sleep(2.0)
+            _healthmon.retire("metrics-sample")
 
         threading.Thread(target=pump, daemon=True, name="metrics-pump").start()
         threading.Thread(target=sample, daemon=True, name="metrics-sample").start()
@@ -621,6 +637,11 @@ class Node:
     def stop(self) -> None:
         from .types import validation as _validation
 
+        if getattr(self, "_healthmon", None) is not None:
+            from .utils import healthmon as _healthmon
+
+            self._stop_quietly("health sentinel", _healthmon.uninstall)
+            self._healthmon = None
         if _validation.VERIFY_LATENCY_OBSERVER is getattr(
             self, "_verify_observer", None
         ):

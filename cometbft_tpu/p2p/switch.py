@@ -12,6 +12,7 @@ import random
 import threading
 import time
 
+from ..utils import healthmon
 from ..utils.log import get_logger
 from ..utils.metrics import hub as _metrics_hub
 from ..utils.service import Service
@@ -93,7 +94,17 @@ class Switch(Service):
     # ------------------------------------------------------------ accept
 
     def _accept_routine(self) -> None:
+        try:
+            self._accept_loop()
+        finally:
+            healthmon.retire("switch-accept")
+
+    def _accept_loop(self) -> None:
         while self.is_running():
+            # accept() legitimately blocks until a peer dials, so this
+            # loop is registered informational (no staleness deadline):
+            # /tpu_health reports the age, the sentinel never audits it
+            healthmon.beat("switch-accept")
             if self.transport._listener is None:
                 return  # dial-only node (or listener closed)
             try:

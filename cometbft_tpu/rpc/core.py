@@ -55,7 +55,25 @@ class Environment:
     # ------------------------------------------------------------- info
 
     def health(self) -> dict:
+        """Wire-compatible liveness probe (rpc/core/health.go): an empty
+        object, by contract — it answers iff the RPC thread is alive.
+        Readiness (is the accelerator sane, are the loops beating) is
+        /tpu_health's job; keeping them separate lets a load balancer
+        drain a wedged node without a restart loop killing it."""
         return {}
+
+    def tpu_health(self) -> dict:
+        """Deep node-health view (ours, no reference analogue): the
+        health sentinel's snapshot — tri-state `state` (ok | degraded |
+        wedged), the last hang-proof accelerator probe, per-loop
+        heartbeat ages against their deadlines, and the path of the last
+        stall-forensics artifact (utils/healthmon).  `ready` is the
+        load-balancer verdict: route away when false.  With the sentinel
+        off (`COMETBFT_TPU_HEALTH` unset) the route still answers with
+        `{"enabled": false}` so callers can use it as a liveness poll."""
+        from ..utils import healthmon
+
+        return healthmon.snapshot()
 
     def status(self) -> dict:
         """rpc/core/status.go."""
@@ -769,6 +787,7 @@ def _hdr(meta):
 
 ROUTES = {
     "health": ("", Environment.health),
+    "tpu_health": ("", Environment.tpu_health),
     "status": ("", Environment.status),
     "net_info": ("", Environment.net_info),
     "genesis": ("", Environment.genesis),

@@ -64,6 +64,43 @@ def flight_record_text() -> str:
     return json.dumps(recorder().dump(), indent=1, default=str)
 
 
+def stall_report(
+    reason: str,
+    extra_sections: list[tuple[str, str]] | None = None,
+    directory: str | None = None,
+) -> str:
+    """Write a stall-forensics bundle — reason, caller-supplied sections
+    (the health sentinel passes its snapshot, the verify-service stats
+    with in-flight batch ages, and a trace-ring drain), flight-recorder
+    dump, all-thread stacks — and return its path.  The crash_report
+    sibling for a node that is WEDGED rather than dead: called by
+    utils/healthmon on a probe deadline breach or stale heartbeat; must
+    never raise (the node is already in trouble)."""
+    import tempfile
+
+    directory = directory or tempfile.gettempdir()
+    path = os.path.join(
+        directory, f"cometbft-health-{os.getpid()}-{time.time_ns()}.txt"
+    )
+    sections = [
+        f"=== stall forensics ===\nreason: {reason}\nwall_ns: {time.time_ns()}\n"
+    ]
+    for title, body in extra_sections or []:
+        sections.append(f"=== {title} ===")
+        sections.append(body)
+    sections.extend(
+        [
+            "=== consensus flight recorder ===",
+            flight_record_text(),
+            "=== threads ===",
+            thread_dump(),
+        ]
+    )
+    with open(path, "w") as f:
+        f.write("\n".join(sections))
+    return path
+
+
 def crash_report(reason: str, directory: str | None = None) -> str:
     """Write a post-mortem bundle — reason, consensus flight-recorder
     dump, all-thread stack dump — to a file and return its path.  Called

@@ -168,6 +168,40 @@ FLIGHTREC = _declare(
     "Consensus flight-recorder ring capacity (clamped to >= 1).",
 )
 
+# health sentinel (utils/healthmon)
+HEALTH = _declare(
+    "COMETBFT_TPU_HEALTH", "bool", False,
+    "`1` starts the node health sentinel (utils/healthmon) at node "
+    "start: periodic hang-proof accelerator probes, heartbeat audits of "
+    "the long-lived loops, and automatic stall forensics.  Off = "
+    "`healthmon.beat()` stays a zero-overhead no-op.",
+)
+HEALTH_PERIOD_MS = _declare(
+    "COMETBFT_TPU_HEALTH_PERIOD_MS", "int", 60000,
+    "Sentinel probe period (ms): how often `jax.devices()` is probed in "
+    "a throwaway subprocess.",
+)
+HEALTH_PROBE_TIMEOUT_MS = _declare(
+    "COMETBFT_TPU_HEALTH_PROBE_TIMEOUT_MS", "int", 20000,
+    "Hard deadline (ms) for one sentinel probe; a probe past it is "
+    "SIGKILLed (whole process group) and counted as a failure.",
+)
+HEALTH_WEDGE_AFTER = _declare(
+    "COMETBFT_TPU_HEALTH_WEDGE_AFTER", "int", 2,
+    "Consecutive probe failures at/above which the health state is "
+    "`wedged` (below it: `degraded`); a success snaps back to `ok`.",
+)
+HEALTH_ARTIFACT_MIN_INTERVAL_MS = _declare(
+    "COMETBFT_TPU_HEALTH_ARTIFACT_MIN_INTERVAL_MS", "int", 300000,
+    "Floor (ms) between two stall-forensics artifacts: one artifact is "
+    "captured per incident, and never more often than this however the "
+    "state flaps.",
+)
+HEALTH_DIR = _declare(
+    "COMETBFT_TPU_HEALTH_DIR", "str", "",
+    "Directory for stall-forensics artifacts; empty = `$TMPDIR`.",
+)
+
 # analysis / correctness tooling
 LOCKCHECK = _declare(
     "COMETBFT_TPU_LOCKCHECK", "bool", False,

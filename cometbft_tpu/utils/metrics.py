@@ -95,6 +95,13 @@ class Gauge(_Metric):
         with self._mtx:
             return self._values.get(self._label_key(labels), 0.0)
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series (e.g. a retired loop's beat-age): a
+        gauge for an entity that no longer exists must leave the
+        exposition, not freeze at its last value forever."""
+        with self._mtx:
+            self._values.pop(self._label_key(labels), None)
+
     def expose(self) -> list[str]:
         with self._mtx:
             items = sorted(self._values.items())
@@ -372,6 +379,39 @@ class Hub:
                 0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                 0.1, 0.5,
             ),
+        )
+        # ---- health sentinel (utils/healthmon)
+        self.health_state = r.gauge(
+            "health_state",
+            "Node health state from the sentinel "
+            "(0=ok, 1=degraded, 2=wedged)",
+        )
+        self.health_probe_seconds = r.histogram(
+            "health_probe_seconds",
+            "Accelerator probe latency (subprocess jax.devices(); a "
+            "hang is clamped at the probe deadline)",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 240.0),
+        )
+        self.health_probe_total = r.counter(
+            "health_probe_total",
+            "Sentinel probe attempts (label result=ok|fail|hang)",
+        )
+        self.health_probe_consec_failures = r.gauge(
+            "health_consecutive_probe_failures",
+            "Consecutive failed sentinel probes (resets on success)",
+        )
+        self.health_beat_age = r.gauge(
+            "health_beat_age_seconds",
+            "Age of each registered loop's last heartbeat (label loop)",
+        )
+        self.health_transitions = r.counter(
+            "health_transitions_total",
+            "Health state transitions (label state = the state entered)",
+        )
+        self.health_forensics = r.counter(
+            "health_forensics_artifacts_total",
+            "Stall-forensics artifacts written by the sentinel",
         )
         self.verify_phase_seconds = r.histogram(
             "verify_phase_seconds",

@@ -234,7 +234,26 @@ def chrome_trace_events() -> list[dict]:
     metadata records), timestamp-sorted."""
     events, names = _drain_all()
     pid = os.getpid()
+    # Wall-clock anchor: every event timestamp in this export is pure
+    # perf_counter_ns, while flight-recorder entries and log lines carry
+    # wall-clock time — one (wall_ns, perf_ns) pair sampled at export
+    # time lets a consumer line all three up on one timeline:
+    #   wall_ns(event) = wall_time_ns + (event.ts * 1000 - perf_counter_ns)
+    wall_anchor_ns = time.time_ns()
+    perf_anchor_ns = time.perf_counter_ns()
     out: list[dict] = [
+        {
+            "ph": "M",
+            "name": "wall_clock_anchor",
+            "pid": pid,
+            "tid": 0,
+            "args": {
+                "wall_time_ns": wall_anchor_ns,
+                "perf_counter_ns": perf_anchor_ns,
+            },
+        }
+    ]
+    out += [
         {
             "ph": "M",
             "name": "thread_name",

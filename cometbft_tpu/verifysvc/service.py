@@ -60,7 +60,7 @@ import threading
 import time
 from enum import IntEnum
 
-from ..utils import envknobs, tracing
+from ..utils import envknobs, healthmon, tracing
 from ..utils.flightrec import recorder as _flightrec
 from ..utils.log import get_logger
 from ..utils.metrics import hub as _mhub
@@ -227,6 +227,11 @@ class VerifyService:
         # a queued consensus batch always overtakes queued mempool work
         self._hostq: queue.PriorityQueue = queue.PriorityQueue()
         self._hostseq = 0
+        # batches handed to the device/host but not yet settled, keyed by
+        # id(batch): the health sentinel's forensics read their ages to
+        # say HOW LONG a wedged dispatch has been in flight
+        self._inflight: dict[int, dict] = {}
+        self._inflight_mtx = threading.Lock()
         self._running = False
         self._threads: list[threading.Thread] = []
         self._start_once = threading.Lock()
@@ -306,6 +311,8 @@ class VerifyService:
                 break
             if payload is not None:
                 _fail_batch(payload[1])
+        with self._inflight_mtx:
+            self._inflight.clear()
 
     # ------------------------------------------------------------- submit
 
@@ -420,11 +427,27 @@ class VerifyService:
         reason = "full" if (was_full or total >= self.batch_max) else "deadline"
         return batch, reason
 
+    def _track_inflight(self, batch: list[_Request], where: str) -> None:
+        with self._inflight_mtx:
+            self._inflight[id(batch)] = {
+                "class": batch[0].klass.label,
+                "sigs": sum(len(r.items) for r in batch),
+                "requests": len(batch),
+                "where": where,
+                "since": time.monotonic(),
+            }
+
+    def _untrack_inflight(self, batch: list[_Request]) -> None:
+        with self._inflight_mtx:
+            self._inflight.pop(id(batch), None)
+
     def _sched_loop(self) -> None:
         m = _mhub()
         while True:
+            healthmon.beat("verifysvc-sched")
             with self._cond:
                 if not self._running:
+                    healthmon.retire("verifysvc-sched")
                     return
                 now = time.monotonic()
                 klass = self._pick_class_locked(now)
@@ -498,6 +521,7 @@ class VerifyService:
                     # real submit-time work: hand it to the host worker
                     # (class-priority queue) so the scheduler stays free
                     # to dispatch the next, possibly higher-class, batch
+                    self._track_inflight(batch, "host")
                     self._hostseq += 1
                     self._hostq.put(
                         (int(klass), self._hostseq, (bv, batch))
@@ -511,6 +535,7 @@ class VerifyService:
                 for r in batch:
                     r.ticket._fail(e)
                 return
+        self._track_inflight(batch, "device")
         self._collectq.put((bv, ticket, batch))
 
     def _host_loop(self) -> None:
@@ -519,8 +544,13 @@ class VerifyService:
         can't preempt an in-flight verify/compile, so the worst-case
         consensus delay is ONE lower-class task, not a whole backlog)."""
         while True:
-            _, _, payload = self._hostq.get()
+            healthmon.beat("verifysvc-host")
+            try:
+                _, _, payload = self._hostq.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if payload is None:
+                healthmon.retire("verifysvc-host")
                 return
             bv, batch = payload
             klass = batch[0].klass
@@ -535,6 +565,7 @@ class VerifyService:
                     self.logger.error(
                         f"host-route verify failed (class={klass.label}): {e!r}"
                     )
+                    self._untrack_inflight(batch)
                     for r in batch:
                         r.ticket._fail(e)
                     continue
@@ -542,21 +573,42 @@ class VerifyService:
                 self._settle(bv, ticket, batch)  # resolved already
             else:
                 # device ticket (uncached path): the collector owns the
-                # blocking result wait, freeing this worker immediately
+                # blocking result wait, freeing this worker immediately.
+                # Relabel the in-flight record (same entry, age keeps
+                # accruing) so a wedge during the collect blames the
+                # device wait, not the finished host work
+                with self._inflight_mtx:
+                    rec = self._inflight.get(id(batch))
+                    if rec is not None:
+                        rec["where"] = "device"
                 self._collectq.put((bv, ticket, batch))
 
     # ---------------------------------------------------------- collector
 
     def _collect_loop(self) -> None:
         while True:
-            item = self._collectq.get()
+            healthmon.beat("verifysvc-collect")
+            try:
+                item = self._collectq.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if item is None:
+                healthmon.retire("verifysvc-collect")
                 return
             self._settle(*item)
 
     def _settle(self, bv, ticket, batch: list[_Request]) -> None:
         """Resolve a dispatched batch's tickets from its verifier
-        ticket, splitting the result vector back per request."""
+        ticket, splitting the result vector back per request.  The batch
+        stays in the in-flight table until it resolves either way — the
+        blocking collect() below is exactly the wait whose age the
+        health forensics need to report when a device wedges mid-batch."""
+        try:
+            self._settle_inner(bv, ticket, batch)
+        finally:
+            self._untrack_inflight(batch)
+
+    def _settle_inner(self, bv, ticket, batch: list[_Request]) -> None:
         labels = (
             {"class": batch[0].klass.label,
              "requests": len(batch)}
@@ -593,19 +645,48 @@ class VerifyService:
 
     # ------------------------------------------------------------- status
 
-    def stats(self) -> dict:
-        """Snapshot for the /verify_svc_status RPC and bench reporting."""
-        with self._cond:
-            queued = {
-                k.label: {
-                    "requests": len(self._queues[k]),
-                    "sigs": self._queued_sigs[k],
+    def stats(self, lock_timeout: float | None = None) -> dict:
+        """Snapshot for the /verify_svc_status RPC, bench reporting, and
+        the health sentinel's stall forensics.  ``lock_timeout`` bounds
+        the wait for the scheduler lock (the sentinel passes a small
+        value: a diagnosis of a wedged node must not block on the wedge
+        it is diagnosing); on timeout the queue section reads
+        ``lock_busy`` and the lock-free tallies still report."""
+        now = time.monotonic()
+        with self._inflight_mtx:
+            in_flight = [
+                {
+                    "class": rec["class"],
+                    "sigs": rec["sigs"],
+                    "requests": rec["requests"],
+                    "where": rec["where"],
+                    "age_s": round(now - rec["since"], 3),
                 }
-                for k in Klass
-            }
+                for rec in self._inflight.values()
+            ]
+        if lock_timeout is None:
+            acquired = self._cond.acquire()
+        else:
+            acquired = self._cond.acquire(timeout=lock_timeout)
+        if acquired:
+            try:
+                queued = {
+                    k.label: {
+                        "requests": len(self._queues[k]),
+                        "sigs": self._queued_sigs[k],
+                    }
+                    for k in Klass
+                }
+                dispatched = dict(self._dispatched)
+                rejected = dict(self._rejected)
+            finally:
+                self._cond.release()
+        else:
+            queued = {"lock_busy": True}
             dispatched = dict(self._dispatched)
             rejected = dict(self._rejected)
         return {
+            "in_flight": in_flight,
             "running": self._running,
             "batch_max": self.batch_max,
             "queue_max": self.queue_max,

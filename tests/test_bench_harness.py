@@ -61,6 +61,16 @@ def test_unavailable_backend_yields_structured_error():
     assert "error" in out and "backend-unavailable" in out["error"]
     assert isinstance(out["phases"], dict)
     assert "kernelcheck" not in out  # BENCH_KERNELCHECK=0 honored
+    # the failed round embeds the health sentinel's STRUCTURED wedge
+    # report (utils/healthmon.ProbeResult per attempt), not a bespoke
+    # string: same probe implementation, same shape as /tpu_health
+    wr = out["wedge_report"]
+    assert wr["state"] in ("wedged", "unavailable")
+    assert len(wr["attempts"]) == 1  # BENCH_PROBE_RETRIES=1
+    att = wr["attempts"][0]
+    assert att["ok"] is False
+    assert isinstance(att["latency_s"], (int, float))
+    assert att["timed_out"] is False  # exited, didn't hang
 
 
 def test_crash_after_probe_yields_structured_error():

@@ -29,6 +29,44 @@ def _clean_tracer():
 # ------------------------------------------------------------------ tracer
 
 
+def test_export_carries_wall_clock_anchor(tmp_path):
+    """Every export carries one wall_clock_anchor metadata record — a
+    (wall_ns, perf_ns) pair sampled at one instant — so the pure
+    perf_counter trace timeline can be correlated with flight-recorder
+    wall_ns entries and log timestamps."""
+    import time as _time
+
+    tracing.set_enabled(True)
+    tracing.reset()
+    with tracing.span("anchored"):
+        pass
+    events = tracing.chrome_trace_events()
+    anchors = [e for e in events if e["name"] == "wall_clock_anchor"]
+    assert len(anchors) == 1
+    a = anchors[0]
+    assert a["ph"] == "M"  # metadata: no timeline footprint of its own
+    args = a["args"]
+    # both clocks sampled "now": each within a generous bound of a fresh
+    # reading, and the pair coherent enough to reconstruct wall time of
+    # the span to sub-second accuracy
+    assert abs(args["wall_time_ns"] - _time.time_ns()) < 5e9
+    assert abs(args["perf_counter_ns"] - _time.perf_counter_ns()) < 5e9
+    span_ev = next(e for e in events if e["name"] == "anchored")
+    wall_of_span = args["wall_time_ns"] + (
+        span_ev["ts"] * 1e3 - args["perf_counter_ns"]
+    )
+    assert abs(wall_of_span - _time.time_ns()) < 5e9
+    # metadata records stay excluded from the exported span count
+    path = str(tmp_path / "anchored.trace.json")
+    n = tracing.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert n == sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+    assert any(
+        e["name"] == "wall_clock_anchor" for e in doc["traceEvents"]
+    )
+
+
 def test_disabled_path_is_shared_noop():
     """Trace off (the default): span() must return one shared no-op
     object — no allocation, no clock read — and record nothing."""
