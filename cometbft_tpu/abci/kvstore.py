@@ -56,6 +56,16 @@ def parse_validator_tx(tx: bytes) -> tuple[str, bytes, int]:
     power = int(power_s)
     if power < 0:
         raise ValueError(f"power cannot be negative, got {power}")
+    # reject wrong-sized keys HERE, where CheckTx/ProcessProposal already
+    # reject on ValueError: a hex-encoded key is valid base64 of the
+    # wrong length, and letting it through turns into a
+    # validate_validator_updates crash INSIDE block apply — a malformed
+    # val tx halting consensus on every node (found by the chaos
+    # valset-rotation scenario)
+    if (key_type or ed25519.KEY_TYPE) == ed25519.KEY_TYPE and len(pubkey) != 32:
+        raise ValueError(
+            f"ed25519 pubkey must be 32 bytes, got {len(pubkey)}"
+        )
     # empty type means ed25519 everywhere in this app; normalizing HERE
     # keeps a "val:!<key>!5" tx from reaching consensus with a type that
     # validate_validator_updates would reject after the block is decided

@@ -333,10 +333,20 @@ class ConsensusReactor(Reactor):
                     ps.set_has_block_part(msg.height, msg.round, msg.part.index)
                 peer.try_send(DATA_STREAM, wire)
         elif isinstance(msg, VoteMessage):
-            self._broadcast_vote(msg.vote)
+            self._broadcast_vote(
+                msg.vote, bypass_dedup=msg.bypass_gossip_dedup
+            )
 
-    def _broadcast_vote(self, vote: Vote) -> None:
+    def _broadcast_vote(self, vote: Vote, bypass_dedup: bool = False) -> None:
         wire = pb.ConsensusMessage(vote=pb.VoteMsg(vote=vote.to_proto())).encode()
+        if bypass_dedup:
+            # chaos double_sign injection: push to every peer without
+            # touching has-vote state, so the honest vote that follows
+            # (same validator index) still gossips normally and every
+            # peer's vote set receives the CONFLICTING PAIR
+            for peer in self.switch.peers.list():
+                peer.try_send(VOTE_STREAM, wire)
+            return
         for peer in self.switch.peers.list():
             ps = peer.get("consensus_peer_state")
             if ps is not None and ps.has_vote(vote):

@@ -73,6 +73,13 @@ class BlockPartMessage:
 @dataclass
 class VoteMessage:
     vote: Vote
+    # chaos only (utils/fail `double_sign`): send to every peer without
+    # consulting/updating the has-vote gossip bookkeeping.  Vote gossip
+    # dedups by validator INDEX, so an equivocating pair from one node
+    # would otherwise have its second vote suppressed at the send seam
+    # and no honest vote set would ever hold both — a byzantine sender
+    # doesn't honor gossip etiquette, and neither does the injection.
+    bypass_gossip_dedup: bool = False
 
 
 @dataclass
@@ -1234,7 +1241,58 @@ class ConsensusState(Service):
     def _sign_add_vote(self, vote_type: int, block_hash: bytes, psh) -> None:
         vote = self._sign_vote(vote_type, block_hash, psh)
         if vote is not None:
+            self._maybe_double_sign(vote)
             self._internal_msg(MsgInfo(VoteMessage(vote), "", time.time_ns()))
+
+    def _maybe_double_sign(self, vote: Vote) -> None:
+        """Chaos seam (utils/fail, fault ``double_sign``): alongside a
+        signed non-nil prevote, BROADCAST a conflicting vote for a
+        fabricated block at the same height/round — byzantine
+        equivocation, injected.  Broadcast-only: the equivocator does
+        not process its own conflicting vote (its honest vote is the
+        one in its WAL); honest peers' vote sets raise
+        ErrVoteConflictingVotes, feed the evidence pool, and the
+        DuplicateVoteEvidence lands in a later block.  The conflicting
+        vote is signed by the raw key, deliberately bypassing FilePV's
+        last-sign-state guard — bypassing that guard is what makes the
+        node byzantine."""
+        from ..utils import fail
+
+        if (
+            vote.type != PREVOTE_TYPE
+            or not vote.block_id.hash
+            or self._replay_mode
+            or self.broadcast_hook is None
+        ):
+            return
+        key = getattr(self.priv_validator, "key", None)
+        if key is None:
+            return  # remote signers can't be coaxed into equivocating
+        if fail.consume("double_sign") is None:
+            return
+        conflicting = Vote(
+            type=vote.type,
+            height=vote.height,
+            round=vote.round,
+            block_id=BlockID(
+                hash=bytes(b ^ 0xFF for b in vote.block_id.hash),
+                part_set_header=vote.block_id.part_set_header,
+            ),
+            timestamp=vote.timestamp,
+            validator_address=vote.validator_address,
+            validator_index=vote.validator_index,
+        )
+        conflicting.signature = key.priv_key.sign(
+            conflicting.sign_bytes(self.state.chain_id)
+        )
+        self.logger.error(
+            "CHAOS: broadcasting conflicting prevote (injected "
+            f"double_sign) at {vote.height}/{vote.round}"
+        )
+        _flightrec().record(
+            "chaos_double_sign", height=vote.height, round=vote.round
+        )
+        self.broadcast_hook(VoteMessage(conflicting, bypass_gossip_dedup=True))
 
     def _internal_msg(self, mi: MsgInfo) -> None:
         """Own proposals/votes/parts: WAL-log (fsync for votes) then

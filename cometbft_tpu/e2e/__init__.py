@@ -3,6 +3,7 @@ conformance grammar (reference: test/e2e/)."""
 
 from .grammar import GrammarError, RecordingApp, check_execution
 from .runner import E2ENode, Manifest, NodeSpec, Runner
+from .scenarios import SCENARIOS, ScenarioResult, run_scenario
 
 __all__ = [
     "Runner",
@@ -12,4 +13,7 @@ __all__ = [
     "RecordingApp",
     "check_execution",
     "GrammarError",
+    "SCENARIOS",
+    "ScenarioResult",
+    "run_scenario",
 ]

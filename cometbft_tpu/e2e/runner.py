@@ -28,9 +28,15 @@ class NodeSpec:
 
     name: str
     start_at: int = 0  # height to join at (0 = genesis)
-    # kill|pause|restart|disconnect (disconnect = network partition via
-    # SIGUSR1 toggle, the runner/perturb.go docker-disconnect analogue)
+    # kill|pause|restart|disconnect|wedge|double_sign (disconnect =
+    # network partition via SIGUSR1 toggle, the runner/perturb.go
+    # docker-disconnect analogue; wedge/double_sign arm the fault
+    # registry over RPC — utils/fail.py — and require the node to run
+    # with COMETBFT_TPU_FAULT_RPC=1 in its env)
     perturbations: list[str] = field(default_factory=list)
+    # extra environment for the node process (chaos scenarios set
+    # COMETBFT_TPU_FAULT_RPC / COMETBFT_TPU_HEALTH / failover knobs here)
+    env: dict[str, str] = field(default_factory=dict)
     # per-link shaping (runner/latency_emulation.go analogue): outbound
     # delay +- jitter applied at this node's sockets (utils/netutil)
     latency_ms: float = 0.0
@@ -59,7 +65,8 @@ class Manifest:
 class E2ENode:
     def __init__(self, name: str, home: str, rpc_port: int,
                  latency_ms: float = 0.0, latency_jitter_ms: float = 0.0,
-                 abci_port: int = 0, abci_scheme: str = "tcp"):
+                 abci_port: int = 0, abci_scheme: str = "tcp",
+                 extra_env: dict[str, str] | None = None):
         self.name = name
         self.home = home
         self.rpc_port = rpc_port
@@ -67,6 +74,7 @@ class E2ENode:
         self.latency_jitter_ms = latency_jitter_ms
         self.abci_port = abci_port  # non-zero: external app process
         self.abci_scheme = abci_scheme  # "tcp" (socket) | "grpc"
+        self.extra_env = dict(extra_env or {})
         self.proc: subprocess.Popen | None = None
         self.app_proc: subprocess.Popen | None = None
 
@@ -90,6 +98,7 @@ class E2ENode:
             env["COMETBFT_TPU_TEST_LATENCY_MS"] = (
                 f"{self.latency_ms}:{self.latency_jitter_ms}"
             )
+        env.update(self.extra_env)
         if self.abci_port and self.app_proc is None:
             # external app rides the ABCI socket or gRPC transport (the
             # generator's abci axis); it outlives node restarts the way
@@ -154,6 +163,18 @@ class E2ENode:
                 return True
             time.sleep(0.25)
         return False
+
+    def arm_fault(self, name: str, value: float = 1.0) -> dict:
+        """Arm a fault in the running node via the fault registry's RPC
+        endpoint (utils/fail.py; needs COMETBFT_TPU_FAULT_RPC=1 in the
+        node's env — NodeSpec.env)."""
+        return self.rpc("arm_fault", name=name, value=value)
+
+    def clear_fault(self, name: str | None = None) -> dict:
+        return self.rpc("clear_fault", **({"name": name} if name else {}))
+
+    def verify_svc(self) -> dict:
+        return self.rpc("verify_svc_status")
 
     def kill(self) -> None:
         """kill -9: the crash-recovery perturbation (runner/perturb.go)."""
@@ -253,6 +274,7 @@ class Runner:
                     latency_jitter_ms=spec.latency_jitter_ms,
                     abci_port=abci_port,
                     abci_scheme="grpc" if spec.abci == "grpc" else "tcp",
+                    extra_env=spec.env,
                 )
             )
 
@@ -359,6 +381,31 @@ class Runner:
                     node.partition_toggle()
                     time.sleep(4.0)
                     node.partition_toggle()
+                elif p == "wedge":
+                    # inject a device wedge via the fault registry's RPC
+                    # arm endpoint: the verify plane must trip to CPU
+                    # fallback and keep the node committing, then
+                    # restore via probation once healed
+                    try:
+                        node.arm_fault("wedge_device")
+                        time.sleep(6.0)  # wedged window under test
+                        node.clear_fault("wedge_device")
+                    except Exception as e:  # noqa: BLE001 — fault RPC may be disabled
+                        _log.warning(
+                            f"wedge perturbation of {node.name} failed "
+                            f"(is COMETBFT_TPU_FAULT_RPC=1 set?): {e!r}"
+                        )
+                elif p == "double_sign":
+                    # one byzantine equivocation: the next signed
+                    # non-nil prevote is accompanied by a conflicting
+                    # broadcast, feeding the evidence pool
+                    try:
+                        node.arm_fault("double_sign", 1)
+                    except Exception as e:  # noqa: BLE001 — fault RPC may be disabled
+                        _log.warning(
+                            f"double_sign perturbation of {node.name} "
+                            f"failed (is COMETBFT_TPU_FAULT_RPC=1 set?): {e!r}"
+                        )
 
     def wait_for_height(self, h: int, timeout: float = 240.0) -> bool:
         deadline = time.monotonic() + timeout

@@ -1,0 +1,692 @@
+"""Named chaos scenarios: multi-process localnets under load with
+injected faults, each asserting LIVENESS (heights keep advancing) and
+SAFETY (no conflicting commits) and leaving a diagnosable artifact trail
+(per-node flight-recorder dumps, health snapshots, verify-service stats,
+node logs) — so a failed run is debuggable from the artifact directory
+alone, without a rerun.
+
+The scenarios extend the e2e :class:`~cometbft_tpu.e2e.runner.Runner`
+(real node processes, real sockets) with the PR-8 fault registry
+(utils/fail.py, armed over RPC via ``COMETBFT_TPU_FAULT_RPC=1``):
+
+========================== ==============================================
+``wedge_smoke``            1 node, fast (tier-1): injected device wedge
+                           mid-run trips the verify service to CPU
+                           fallback, commits continue, clearing the
+                           fault restores TPU mode via probation.
+``wedge``                  3 nodes under load: same trip/restore cycle
+                           on one node while the network keeps
+                           committing and stays fork-free.
+``crash_replay``           kill -9 a node mid-run; WAL + handshake
+                           replay must recover it past the crash height.
+``partition_heal``         sever one node's p2p sockets (SIGUSR1), heal,
+                           assert it catches up with no fork.
+``double_sign``            a byzantine node broadcasts one conflicting
+                           prevote; honest nodes form
+                           DuplicateVoteEvidence, commit it, and the
+                           kvstore app docks the equivocator's power.
+``valset_rotation_blocksync``  rotate a validator's power while a late
+                           joiner is blocksyncing through the rotation
+                           heights; the joiner must converge.
+========================== ==============================================
+
+Driven by ``scripts/chaos.py`` (``--json`` emits a machine-readable
+pass/fail artifact per scenario); the fast ``wedge_smoke`` also runs in
+tier-1 (tests/test_chaos_scenarios.py), the multi-node scenarios in the
+slow tier.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..utils.log import get_logger
+from .runner import Manifest, NodeSpec, Runner
+
+_log = get_logger("e2e.chaos")
+
+# Env for a node that will have faults injected: fault RPC on, the
+# health sentinel probing fast (so an armed wedge is judged `wedged`
+# within seconds, not the production minute), and the verify-service
+# failover plane on a tight leash.  Values are strings (subprocess env).
+CHAOS_FAULT_ENV = {
+    "COMETBFT_TPU_FAULT_RPC": "1",
+    "COMETBFT_TPU_HEALTH": "1",
+    "COMETBFT_TPU_HEALTH_PERIOD_MS": "2000",
+    "COMETBFT_TPU_HEALTH_PROBE_TIMEOUT_MS": "8000",
+    "COMETBFT_TPU_HEALTH_WEDGE_AFTER": "2",
+    "COMETBFT_TPU_FAILOVER_BATCH_DEADLINE_MS": "4000",
+    "COMETBFT_TPU_FAILOVER_PROBE_PERIOD_MS": "1000",
+    "COMETBFT_TPU_FAILOVER_PROBE_TIMEOUT_MS": "8000",
+    "COMETBFT_TPU_FAILOVER_PROBATION_OK": "2",
+}
+
+
+@dataclass
+class ScenarioResult:
+    """Machine-readable verdict for one scenario (the per-scenario
+    artifact ``scripts/chaos.py --json`` emits)."""
+
+    name: str
+    ok: bool = False
+    liveness: bool = False
+    safety: bool = False
+    problems: list[str] = field(default_factory=list)
+    details: dict = field(default_factory=dict)
+    artifact_dir: str = ""
+    elapsed_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "liveness": self.liveness,
+            "safety": self.safety,
+            "problems": list(self.problems),
+            "details": dict(self.details),
+            "artifact_dir": self.artifact_dir,
+            "elapsed_s": round(self.elapsed_s, 1),
+        }
+
+
+def _wait_for(pred, timeout: float, poll: float = 0.5, desc: str = ""):
+    """Poll pred() until truthy; returns the value or None on timeout.
+    pred exceptions are treated as not-yet (nodes restart mid-scenario)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception as e:  # noqa: BLE001 — mid-scenario RPC gaps are expected
+            _log.debug(f"waiting for {desc or 'condition'}: {e!r}")
+            v = None
+        if v:
+            return v
+        time.sleep(poll)
+    return None
+
+
+def _drive_load_until(
+    runner: Runner, pred, timeout: float, desc: str = "", extra=None
+):
+    """Like :func:`_wait_for` but keeps transaction load flowing — the
+    scenarios assert liveness UNDER LOAD, not on an idle chain.
+    ``extra`` (optional) runs once per round for scenario-specific
+    traffic (signed CheckTx envelopes, valset txs)."""
+    deadline = time.monotonic() + timeout
+    round_id = int(time.monotonic() * 10) % 100000
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception as e:  # noqa: BLE001 — mid-scenario RPC gaps are expected
+            _log.debug(f"load-waiting for {desc or 'condition'}: {e!r}")
+            v = None
+        if v:
+            return v
+        runner.load(round_id)
+        if extra is not None:
+            try:
+                extra(round_id)
+            except Exception as e:  # noqa: BLE001 — extra load rides out node restarts
+                _log.debug(f"extra load round {round_id}: {e!r}")
+        round_id += 1
+        runner.start_late_nodes()
+        time.sleep(0.7)
+    return None
+
+
+def _signed_tx_sender(node, tag: str):
+    """Per-round signed-envelope CheckTx traffic (verifysvc/checktx):
+    exercises the verify service's MEMPOOL class on a live node — with
+    the wedge armed, these must keep being admitted through the CPU
+    fallback path."""
+    from ..crypto import ed25519 as host
+    from ..verifysvc import checktx
+
+    keys = [host.PrivKey.from_seed(bytes([41 + i]) * 32) for i in range(3)]
+
+    def send(round_id: int) -> None:
+        for i, key in enumerate(keys):
+            tx = checktx.make_signed_tx(
+                key, f"{tag}-{round_id}-{i}".encode()
+            )
+            node.rpc("broadcast_tx_sync", tx=base64.b64encode(tx).decode())
+
+    return send
+
+
+def _min_height(runner: Runner) -> int:
+    hs = runner._heights(only_running=True)
+    return min(hs) if hs else 0
+
+
+def _collect_artifacts(runner: Runner, out_dir: str) -> dict:
+    """Pull every node's diagnosis surfaces into the artifact dir: the
+    flight-recorder dump (where the failover/health/chaos events live),
+    /tpu_health, /verify_svc_status, /faults — a failed scenario is
+    diagnosed from these files plus the node logs already in each home."""
+    os.makedirs(out_dir, exist_ok=True)
+    index = {}
+    for node in runner.nodes:
+        if node.proc is None:
+            index[node.name] = "not running"
+            continue
+        dumps = {}
+        for route in ("tpu_health", "verify_svc_status",
+                      "dump_consensus_trace", "faults", "status"):
+            try:
+                dumps[route] = node.rpc(route)
+            except Exception as e:  # noqa: BLE001 — partial artifacts beat none
+                dumps[route] = {"error": repr(e)}
+        path = os.path.join(out_dir, f"{node.name}.json")
+        with open(path, "w") as f:
+            json.dump(dumps, f, indent=1, default=str)
+        index[node.name] = path
+    return index
+
+
+def _finish(
+    res: ScenarioResult, runner: Runner, t0: float, upto: int
+) -> ScenarioResult:
+    """Shared epilogue: safety invariants + watchdog parity + artifacts."""
+    problems = runner.check_invariants(upto=upto)
+    if any("divergence" in p for p in problems):
+        # the latest-app-hash check polls heights and hashes in separate
+        # RPC rounds, so a node committing between them reads as a
+        # same-height divergence; a REAL divergence persists (it forks
+        # the next header), a race clears on a re-check
+        time.sleep(1.5)
+        problems = runner.check_invariants(upto=upto)
+    res.safety = not [p for p in problems if "fork" in p or "divergence" in p]
+    res.problems.extend(problems)
+    fires = runner.check_watchdog_fires()
+    if fires:
+        res.problems.extend(fires)
+    res.details["heights"] = runner._heights(only_running=True)
+    res.details["artifacts"] = _collect_artifacts(runner, res.artifact_dir)
+    res.ok = res.liveness and res.safety and not res.problems
+    res.elapsed_s = time.monotonic() - t0
+    return res
+
+
+def _failover_events(node) -> list[dict]:
+    entries = node.rpc("dump_consensus_trace").get("entries", [])
+    return [e for e in entries if e.get("kind") == "verifysvc_failover"]
+
+
+# ------------------------------------------------------------- scenarios
+
+
+def scenario_wedge_smoke(out_dir: str, base_port: int = 26000) -> ScenarioResult:
+    """Single-node wedge/trip/probation round trip — the fast (tier-1)
+    smoke of the whole failover plane against a REAL node process."""
+    res = ScenarioResult("wedge_smoke", artifact_dir=os.path.join(out_dir, "wedge_smoke"))
+    t0 = time.monotonic()
+    m = Manifest(
+        chain_id="chaos-wedge-smoke",
+        nodes=[NodeSpec("solo", env=dict(CHAOS_FAULT_ENV))],
+        target_height=2,
+        load_tx_per_round=1,
+    )
+    r = Runner(m, os.path.join(out_dir, "wedge_smoke", "net"), base_port=base_port)
+    r.setup()
+    r.start()
+    node = r.nodes[0]
+    signed_load = _signed_tx_sender(node, "smoke")
+    try:
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= 2, 90, "baseline height",
+            extra=signed_load,
+        ):
+            res.problems.append("node never reached height 2 (pre-fault)")
+            return _finish(res, r, t0, upto=2)
+
+        node.arm_fault("wedge_device")
+        trip = _drive_load_until(
+            r, lambda: node.verify_svc()["backend_mode"] == "cpu_fallback",
+            45, desc="failover trip", extra=signed_load,
+        )
+        if not trip:
+            res.problems.append("verify service never tripped to cpu_fallback")
+            return _finish(res, r, t0, upto=2)
+        res.details["tripped"] = True
+
+        # liveness IN degraded mode: the wedged node keeps committing
+        # under mixed load (plain txs + signed CheckTx envelopes)
+        h0 = _min_height(r)
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= h0 + 2, 90, "degraded-mode commits",
+            extra=signed_load,
+        ):
+            res.problems.append(
+                f"no commits while wedged (stuck at {_min_height(r)})"
+            )
+            return _finish(res, r, t0, upto=h0)
+        res.liveness = True
+
+        st = node.verify_svc()
+        fo = st.get("failover", {})
+        res.details["trip_reason"] = fo.get("last_trip_reason")
+        res.details["forensics_artifact"] = fo.get("last_artifact")
+        res.details["trips"] = fo.get("trips")
+        if not fo.get("last_artifact"):
+            res.problems.append("trip emitted no forensics artifact")
+        events = _failover_events(node)
+        res.details["failover_events"] = events
+        if len([e for e in events
+                if e.get("detail", {}).get("direction") == "to_cpu"]) != 1:
+            res.problems.append(
+                f"expected exactly one to_cpu flightrec event, got {events}"
+            )
+
+        # heal: clearing the fault must restore TPU mode via probation
+        node.clear_fault("wedge_device")
+        restored = _wait_for(
+            lambda: node.verify_svc()["backend_mode"] == "tpu",
+            60, desc="probation restore",
+        )
+        if not restored:
+            res.problems.append("probation never restored TPU mode")
+        res.details["restored"] = bool(restored)
+        h1 = _min_height(r)
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= h1 + 1, 60, "post-restore commit"
+        ):
+            res.problems.append("no commits after restore")
+            res.liveness = False
+        return _finish(res, r, t0, upto=max(2, h1))
+    finally:
+        r.stop_all()
+
+
+def scenario_wedge(out_dir: str, base_port: int = 26200) -> ScenarioResult:
+    """3-node net under load; one node's device wedges mid-run.  The
+    network must keep committing (the wedged node trips to CPU fallback
+    and keeps its validator seat live), stay fork-free, and the wedged
+    node must restore TPU mode after the heal."""
+    res = ScenarioResult("wedge", artifact_dir=os.path.join(out_dir, "wedge"))
+    t0 = time.monotonic()
+    m = Manifest(
+        chain_id="chaos-wedge",
+        nodes=[
+            NodeSpec("wedged", env=dict(CHAOS_FAULT_ENV)),
+            NodeSpec("b"),
+            NodeSpec("c"),
+        ],
+        target_height=8,
+        load_tx_per_round=2,
+    )
+    r = Runner(m, os.path.join(out_dir, "wedge", "net"), base_port=base_port)
+    r.setup()
+    r.start()
+    node = r.nodes[0]
+    try:
+        if not _drive_load_until(r, lambda: _min_height(r) >= 3, 180, "baseline"):
+            res.problems.append("net never reached height 3 (pre-fault)")
+            return _finish(res, r, t0, upto=3)
+
+        node.arm_fault("wedge_device")
+        if not _wait_for(
+            lambda: node.verify_svc()["backend_mode"] == "cpu_fallback",
+            60, desc="failover trip",
+        ):
+            res.problems.append("wedged node never tripped to cpu_fallback")
+            return _finish(res, r, t0, upto=3)
+        h0 = _min_height(r)
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= h0 + 3, 180, "degraded commits"
+        ):
+            res.problems.append(f"net stalled while wedged ({_min_height(r)})")
+            return _finish(res, r, t0, upto=h0)
+        res.liveness = True
+        fo = node.verify_svc().get("failover", {})
+        res.details["trip_reason"] = fo.get("last_trip_reason")
+        res.details["forensics_artifact"] = fo.get("last_artifact")
+        node.clear_fault("wedge_device")
+        restored = _wait_for(
+            lambda: node.verify_svc()["backend_mode"] == "tpu",
+            90, desc="probation restore",
+        )
+        if not restored:
+            res.problems.append("probation never restored TPU mode")
+        res.details["restored"] = bool(restored)
+        _drive_load_until(
+            r, lambda: _min_height(r) >= m.target_height, 120, "target height"
+        )
+        return _finish(res, r, t0, upto=max(3, _min_height(r)))
+    finally:
+        r.stop_all()
+
+
+def scenario_crash_replay(out_dir: str, base_port: int = 26400) -> ScenarioResult:
+    """kill -9 one node mid-run, restart it, and require WAL + handshake
+    replay to bring it back past the crash height (validated once in
+    PR 3; now a standing scenario)."""
+    res = ScenarioResult(
+        "crash_replay", artifact_dir=os.path.join(out_dir, "crash_replay")
+    )
+    t0 = time.monotonic()
+    m = Manifest(
+        chain_id="chaos-crash",
+        nodes=[
+            NodeSpec("a"),
+            NodeSpec("victim", perturbations=["kill"]),
+            NodeSpec("c"),
+        ],
+        target_height=7,
+        load_tx_per_round=2,
+    )
+    r = Runner(m, os.path.join(out_dir, "crash_replay", "net"), base_port=base_port)
+    r.setup()
+    r.start()
+    try:
+        if not _drive_load_until(r, lambda: _min_height(r) >= 3, 180, "baseline"):
+            res.problems.append("net never reached height 3 (pre-crash)")
+            return _finish(res, r, t0, upto=3)
+        crash_h = _min_height(r)
+        r.perturb()  # kill -9 + restart + wait_ready
+        res.details["crash_height"] = crash_h
+        if not _drive_load_until(
+            r,
+            lambda: _min_height(r) >= crash_h + 3
+            and len(r._heights(only_running=True)) == 3,
+            240, "post-crash convergence",
+        ):
+            res.problems.append(
+                f"victim never recovered past crash height {crash_h} "
+                f"({r._heights(only_running=True)})"
+            )
+            return _finish(res, r, t0, upto=crash_h)
+        res.liveness = True
+        return _finish(res, r, t0, upto=crash_h + 2)
+    finally:
+        r.stop_all()
+
+
+def scenario_partition_heal(out_dir: str, base_port: int = 26600) -> ScenarioResult:
+    """Sever one node's p2p sockets (SIGUSR1 toggle), heal after a few
+    seconds, assert it catches back up and nobody forked."""
+    res = ScenarioResult(
+        "partition_heal", artifact_dir=os.path.join(out_dir, "partition_heal")
+    )
+    t0 = time.monotonic()
+    # FOUR validators: severing one leaves 3/4 = 75% > 2/3, so the
+    # majority side keeps committing through the partition (a 3-node
+    # net would sit at exactly 2/3 and legitimately halt — quorum needs
+    # strictly more)
+    m = Manifest(
+        chain_id="chaos-partition",
+        nodes=[
+            NodeSpec("a"),
+            NodeSpec("b"),
+            NodeSpec("c"),
+            NodeSpec("isolated", perturbations=["disconnect"]),
+        ],
+        target_height=7,
+        load_tx_per_round=2,
+    )
+    r = Runner(
+        m, os.path.join(out_dir, "partition_heal", "net"), base_port=base_port
+    )
+    r.setup()
+    r.start()
+    try:
+        if not _drive_load_until(r, lambda: _min_height(r) >= 3, 180, "baseline"):
+            res.problems.append("net never reached height 3 (pre-partition)")
+            return _finish(res, r, t0, upto=3)
+        h0 = _min_height(r)
+        r.perturb()  # partition + heal (blocks ~4s inside)
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= h0 + 3, 240, "post-heal convergence"
+        ):
+            res.problems.append(
+                f"isolated node never caught up ({r._heights(only_running=True)})"
+            )
+            return _finish(res, r, t0, upto=h0)
+        res.liveness = True
+        return _finish(res, r, t0, upto=h0 + 2)
+    finally:
+        r.stop_all()
+
+
+def scenario_double_sign(out_dir: str, base_port: int = 26800) -> ScenarioResult:
+    """One byzantine equivocation: a 4-validator net where one node
+    broadcasts a conflicting prevote.  Honest nodes must capture the
+    conflict as DuplicateVoteEvidence, commit it in a block, and the
+    kvstore app docks the equivocator's power (kvstore.go:316-334
+    parity) — asserted via /validators, which every node must agree on."""
+    res = ScenarioResult(
+        "double_sign", artifact_dir=os.path.join(out_dir, "double_sign")
+    )
+    t0 = time.monotonic()
+    m = Manifest(
+        chain_id="chaos-equivocation",
+        nodes=[
+            NodeSpec("a"),
+            NodeSpec("b"),
+            NodeSpec("c"),
+            NodeSpec("byz", env={"COMETBFT_TPU_FAULT_RPC": "1"}),
+        ],
+        target_height=8,
+        load_tx_per_round=2,
+    )
+    r = Runner(m, os.path.join(out_dir, "double_sign", "net"), base_port=base_port)
+    r.setup()
+    r.start()
+    byz = r.nodes[3]
+    try:
+        if not _drive_load_until(r, lambda: _min_height(r) >= 2, 180, "baseline"):
+            res.problems.append("net never reached height 2 (pre-fault)")
+            return _finish(res, r, t0, upto=2)
+
+        # the byzantine validator's address, to watch its power
+        byz_val = byz.rpc("status")["validator_info"]
+        byz.arm_fault("double_sign", 1)
+        res.details["byz_address"] = byz_val["address"]
+
+        def _docked():
+            # evidence committed -> FinalizeBlock misbehavior -> kvstore
+            # docks one power; visible in the ACTIVE validator set.
+            # Returns the height the punished set is live at (truthy).
+            h = r.nodes[0].height()
+            vals = r.nodes[0].rpc("validators", height=h)["validators"]
+            for v in vals:
+                if v["address"] == byz_val["address"]:
+                    if int(v["voting_power"]) < int(byz_val["voting_power"]):
+                        return h
+            return 0
+
+        h_docked = _drive_load_until(r, _docked, 240, "evidence committed")
+        if not h_docked:
+            res.problems.append(
+                "equivocator's power was never docked (evidence not "
+                "formed/committed?)"
+            )
+            return _finish(res, r, t0, upto=_min_height(r))
+        res.details["power_docked_at"] = h_docked
+        res.liveness = True
+
+        # all honest nodes agree on the punished set — compared AT ONE
+        # height (validator sets are height-indexed; latest-height
+        # queries race block application across nodes)
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= h_docked, 120, "height convergence"
+        ):
+            res.problems.append(
+                f"nodes never converged to height {h_docked}"
+            )
+            return _finish(res, r, t0, upto=_min_height(r))
+        powers = set()
+        for node in r.nodes[:3]:
+            vals = node.rpc("validators", height=h_docked)["validators"]
+            powers.add(
+                tuple(sorted((v["address"], v["voting_power"]) for v in vals))
+            )
+        if len(powers) != 1:
+            res.problems.append(
+                f"validator sets diverge at height {h_docked}: {powers}"
+            )
+        return _finish(res, r, t0, upto=_min_height(r))
+    finally:
+        r.stop_all()
+
+
+def scenario_valset_rotation_blocksync(
+    out_dir: str, base_port: int = 27000
+) -> ScenarioResult:
+    """Rotate a validator's power (kvstore `val=` txs) while a late
+    joiner is blocksyncing through exactly those heights: the joiner
+    must track the validator-set changes block by block and converge."""
+    res = ScenarioResult(
+        "valset_rotation_blocksync",
+        artifact_dir=os.path.join(out_dir, "valset_rotation_blocksync"),
+    )
+    t0 = time.monotonic()
+    m = Manifest(
+        chain_id="chaos-valset",
+        nodes=[
+            NodeSpec("a"),
+            NodeSpec("b"),
+            NodeSpec("c"),
+            NodeSpec("joiner", start_at=4),
+        ],
+        target_height=10,
+        load_tx_per_round=2,
+    )
+    r = Runner(
+        m,
+        os.path.join(out_dir, "valset_rotation_blocksync", "net"),
+        base_port=base_port,
+    )
+    r.setup()
+    r.start()
+    try:
+        # the rotated validator: node c's key, read from the shared
+        # genesis (which stores pubkeys HEX-encoded; the kvstore val tx
+        # wants base64 — a raw copy is valid base64 of the WRONG bytes,
+        # the poison pill parse_validator_tx now rejects)
+        with open(os.path.join(r.out, "node0", "config", "genesis.json")) as f:
+            genesis = json.load(f)
+        target_val = genesis["validators"][2]
+        pub_b64 = base64.b64encode(
+            bytes.fromhex(target_val["pub_key"]["value"])
+        ).decode()
+        res.details["rotated_pubkey"] = pub_b64
+
+        def _val_tx(power: int) -> str:
+            tx = f"val=ed25519!{pub_b64}!{power}".encode()
+            return base64.b64encode(tx).decode()
+
+        if not _drive_load_until(r, lambda: _min_height(r) >= 2, 180, "baseline"):
+            res.problems.append("net never reached height 2")
+            return _finish(res, r, t0, upto=2)
+
+        # first rotation BEFORE the joiner starts (so it blocksyncs
+        # through a valset change), second while it is syncing
+        r.nodes[0].rpc("broadcast_tx_sync", tx=_val_tx(7))
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= 5, 180, "joiner start window"
+        ):
+            res.problems.append("net never reached height 5")
+            return _finish(res, r, t0, upto=2)
+        r.nodes[0].rpc("broadcast_tx_sync", tx=_val_tx(12))
+
+        def _converged():
+            hs = r._heights(only_running=True)
+            return (
+                len(hs) == 4
+                and min(hs) >= m.target_height
+                and all(n.proc is not None for n in r.nodes)
+            )
+
+        if not _drive_load_until(r, _converged, 300, "joiner convergence"):
+            res.problems.append(
+                "joiner never converged through the rotation "
+                f"({r._heights(only_running=True)})"
+            )
+            return _finish(res, r, t0, upto=_min_height(r))
+        res.liveness = True
+
+        # every node (joiner included) agrees the second rotation landed.
+        # Validator updates take effect at commit height + 2, which can
+        # postdate the convergence check — keep the chain moving until
+        # the rotated power is live everywhere.
+        def _rotated_power(node):
+            for v in node.rpc("validators")["validators"]:
+                if v["pub_key"]["value"] == pub_b64:
+                    return v["voting_power"]
+            return None
+
+        def _rotation_live():
+            return all(_rotated_power(n) == "12" for n in r.nodes)
+
+        if not _drive_load_until(r, _rotation_live, 120, "rotation visible"):
+            final = sorted({str(_rotated_power(n)) for n in r.nodes})
+            res.problems.append(f"rotation not applied everywhere: {final}")
+            res.details["final_rotated_power"] = final
+        else:
+            res.details["final_rotated_power"] = "12"
+        return _finish(res, r, t0, upto=m.target_height)
+    finally:
+        r.stop_all()
+
+
+# ------------------------------------------------------------- registry
+
+SCENARIOS = {
+    "wedge_smoke": scenario_wedge_smoke,
+    "wedge": scenario_wedge,
+    "crash_replay": scenario_crash_replay,
+    "partition_heal": scenario_partition_heal,
+    "double_sign": scenario_double_sign,
+    "valset_rotation_blocksync": scenario_valset_rotation_blocksync,
+}
+
+# the five "full" scenarios scripts/chaos.py runs by default (the smoke
+# is tier-1's fast stand-in, subsumed by `wedge`)
+DEFAULT_SCENARIOS = [
+    "wedge",
+    "crash_replay",
+    "partition_heal",
+    "double_sign",
+    "valset_rotation_blocksync",
+]
+
+
+def run_scenario(name: str, out_dir: str, base_port: int | None = None) -> ScenarioResult:
+    fn = SCENARIOS.get(name)
+    if fn is None:
+        raise ValueError(
+            f"unknown scenario {name!r} (known: {', '.join(SCENARIOS)})"
+        )
+    _log.info(f"chaos scenario {name} starting (artifacts under {out_dir})")
+    try:
+        res = fn(out_dir) if base_port is None else fn(out_dir, base_port)
+    except Exception as e:  # noqa: BLE001 — a crashed scenario is a failed scenario
+        import traceback
+
+        res = ScenarioResult(
+            name,
+            ok=False,
+            problems=[f"scenario raised {type(e).__name__}: {e}"],
+            details={
+                # the RPC artifact sweep needs live nodes, which a crash
+                # may have taken down — preserve what a triager needs:
+                # the traceback here, and the node logs that survive
+                # under <artifact_dir>/net/node*/node.log
+                "traceback": traceback.format_exc(),
+                "note": (
+                    "scenario crashed before RPC artifact collection; "
+                    "node logs remain under artifact_dir/net/"
+                ),
+            },
+            artifact_dir=os.path.join(out_dir, name),
+        )
+    _log.info(
+        f"chaos scenario {name}: {'PASS' if res.ok else 'FAIL'} "
+        f"({res.elapsed_s:.1f}s, problems={res.problems})"
+    )
+    return res

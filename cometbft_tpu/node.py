@@ -489,6 +489,17 @@ class Node:
                 "health sentinel on: probe every "
                 f"{self._healthmon.probe_period_s:g}s, /tpu_health serving"
             )
+        # verify service: start the scheduler (and with it the failover
+        # watchdog) NOW, not lazily on first submit — a device that
+        # wedges while the node is verify-idle must already be tripped
+        # to CPU fallback when the first commit/CheckTx batch arrives,
+        # not strand it and only then notice
+        from .crypto import batch as _crypto_batch
+
+        if _crypto_batch.device_capable():
+            from .verifysvc.service import global_service
+
+            global_service()._ensure_started()
         self.logger.info(
             f"node {self.node_key.id()[:8]} started: p2p {self.listen_addr}"
         )

@@ -157,6 +157,8 @@ class MConnection(Service):
         st = self.streams.get(stream_id)
         if st is None or not self.is_running():
             return False
+        if self._fault_drop():
+            return True  # injected loss: swallowed, reported delivered
         try:
             st.send_queue.put(msg, timeout=timeout)
         except queue.Full:
@@ -168,12 +170,25 @@ class MConnection(Service):
         st = self.streams.get(stream_id)
         if st is None or not self.is_running():
             return False
+        if self._fault_drop():
+            return True  # injected loss: swallowed, reported delivered
         try:
             st.send_queue.put_nowait(msg)
         except queue.Full:
             return False
         self._send_signal.set()
         return True
+
+    @staticmethod
+    def _fault_drop() -> bool:
+        """Chaos seam (utils/fail, fault ``drop_p2p_pct``): silently
+        drop a percentage of outbound messages — a lossy link without
+        tc/netem, exercising the gossip retransmission paths.  One
+        module-bool check when unarmed."""
+        from ...utils import fail
+
+        pct = fail.armed("drop_p2p_pct")
+        return pct is not None and fail.should_drop(pct)
 
     def _pick_stream(self) -> _Stream | None:
         """Lowest sent/priority ratio wins (connection.go sendPacketMsg)."""

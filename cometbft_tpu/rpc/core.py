@@ -710,6 +710,62 @@ class Environment:
 
         return recorder().dump()
 
+    # --------------------------------------------- fault injection (chaos)
+
+    def _require_fault_rpc(self) -> None:
+        """The fault routes exist for the chaos harness (e2e/scenarios,
+        scripts/chaos.py); they are live only when the node was started
+        with COMETBFT_TPU_FAULT_RPC=1 — a production node rejects them
+        the way unsafe p2p controls reject without rpc.unsafe."""
+        from ..utils import envknobs
+
+        if not envknobs.get_bool(envknobs.FAULT_RPC):
+            raise RPCError(
+                -32601,
+                "fault-injection RPC is disabled: set COMETBFT_TPU_FAULT_RPC=1",
+            )
+
+    def arm_fault(self, name=None, value=None) -> dict:
+        """Arm a named fault in the registry (utils/fail.py): the chaos
+        harness's live injection entry — a backend wedge, a lossy link,
+        a byzantine double-sign — into a running node, deterministically
+        and without touching its process."""
+        self._require_fault_rpc()
+        from ..utils import fail
+
+        if not name:
+            raise RPCError(-32602, "missing fault name")
+        try:
+            fail.arm(str(name), float(value) if value is not None else 1.0)
+        except ValueError as e:
+            raise RPCError(-32602, str(e)) from e
+        _log.warning(f"fault armed via RPC: {name}={value if value is not None else 1}")
+        return {"armed": fail.active()}
+
+    def clear_fault(self, name=None) -> dict:
+        """Clear one fault (or all, with no name): the heal half of
+        every chaos scenario."""
+        self._require_fault_rpc()
+        from ..utils import fail
+
+        if name:
+            fail.clear(str(name))
+        else:
+            fail.clear_all()
+        _log.warning(f"fault cleared via RPC: {name or 'ALL'}")
+        return {"armed": fail.active()}
+
+    def faults(self) -> dict:
+        """Armed-fault snapshot + per-fault fire tallies (readable with
+        the arm/clear routes disabled — observing is never unsafe)."""
+        from ..utils import envknobs, fail
+
+        return {
+            "rpc_enabled": envknobs.get_bool(envknobs.FAULT_RPC),
+            "armed": fail.active(),
+            "fired": fail.fired(),
+        }
+
     def verify_svc_status(self) -> dict:
         """Verify-service scheduler snapshot (ours, no reference
         analogue): per-class queue depths, dispatched/rejected batch
@@ -822,5 +878,9 @@ ROUTES = {
     "dump_consensus_state": ("", Environment.dump_consensus_state),
     "dump_consensus_trace": ("", Environment.dump_consensus_trace),
     "verify_svc_status": ("", Environment.verify_svc_status),
+    # fault injection (chaos harness; live only with COMETBFT_TPU_FAULT_RPC=1)
+    "arm_fault": ("name,value", Environment.arm_fault),
+    "clear_fault": ("name", Environment.clear_fault),
+    "faults": ("", Environment.faults),
     "consensus_params": ("height", Environment.consensus_params),
 }

@@ -141,6 +141,71 @@ VERIFYSVC_CHECKTX = _declare(
     "(verifysvc/checktx); unsigned txs always pass through untouched.",
 )
 
+# verify-service degraded-mode failover (verifysvc/service.py)
+FAILOVER = _declare(
+    "COMETBFT_TPU_FAILOVER", "bool", True,
+    "`0` disables automatic TPU->CPU verify-plane failover: a wedged "
+    "device then strands in-flight batches instead of tripping the "
+    "service to host verification.",
+)
+FAILOVER_BATCH_DEADLINE_MS = _declare(
+    "COMETBFT_TPU_FAILOVER_BATCH_DEADLINE_MS", "int", 30000,
+    "An in-flight batch older than this while dispatched to (or "
+    "awaiting results from) the device trips the verify service to CPU "
+    "mode; host-side submit work (cold compiles) is exempt.",
+)
+FAILOVER_PROBATION_OK = _declare(
+    "COMETBFT_TPU_FAILOVER_PROBATION_OK", "int", 2,
+    "Consecutive successful probation probes required before a tripped "
+    "verify service restores TPU mode.",
+)
+FAILOVER_PROBE_PERIOD_MS = _declare(
+    "COMETBFT_TPU_FAILOVER_PROBE_PERIOD_MS", "int", 15000,
+    "Probation probe period (ms) while the verify service is in CPU "
+    "fallback mode.",
+)
+FAILOVER_PROBE_TIMEOUT_MS = _declare(
+    "COMETBFT_TPU_FAILOVER_PROBE_TIMEOUT_MS", "int", 10000,
+    "Hard deadline (ms) for one probation probe (the hang-proof "
+    "subprocess probe, utils/healthmon.probe_devices).",
+)
+
+# fault injection registry (utils/fail.py; chaos harness only — never
+# set in production)
+FAULT_WEDGE_DEVICE = _declare(
+    "COMETBFT_TPU_FAULT_WEDGE_DEVICE", "str", "",
+    "Non-empty arms the `wedge_device` fault at process start: device "
+    "result waits block and the accelerator probe reports a hang until "
+    "the fault is cleared.",
+)
+FAULT_SLOW_COLLECT = _declare(
+    "COMETBFT_TPU_FAULT_SLOW_COLLECT", "str", "",
+    "Arms the `slow_collect` fault: device result waits take an extra "
+    "<value> seconds.",
+)
+FAULT_FAIL_DISPATCH = _declare(
+    "COMETBFT_TPU_FAULT_FAIL_DISPATCH", "str", "",
+    "Arms the `fail_dispatch` fault: verify-service dispatches raise "
+    "InjectedFault (failover re-verifies the batch on host).",
+)
+FAULT_DROP_P2P_PCT = _declare(
+    "COMETBFT_TPU_FAULT_DROP_P2P_PCT", "str", "",
+    "Arms the `drop_p2p_pct` fault: <value> percent of outbound p2p "
+    "messages are silently dropped at the MConnection send seam.",
+)
+FAULT_DOUBLE_SIGN = _declare(
+    "COMETBFT_TPU_FAULT_DOUBLE_SIGN", "str", "",
+    "Arms the `double_sign` fault: the next <value> signed non-nil "
+    "prevotes are accompanied by a conflicting broadcast-only vote "
+    "(byzantine equivocation feeding the evidence pool).",
+)
+FAULT_RPC = _declare(
+    "COMETBFT_TPU_FAULT_RPC", "bool", False,
+    "`1` exposes the `arm_fault` / `clear_fault` RPC routes so the "
+    "chaos harness can inject faults into a live node; off (the "
+    "default) those routes reject.",
+)
+
 # blocksync
 VERIFY_AHEAD = _declare(
     "COMETBFT_TPU_VERIFY_AHEAD", "int?", None,

@@ -82,6 +82,9 @@ DEFAULT_LOOPS: dict[str, float | None] = {
     "verifysvc-sched": 10.0,
     "verifysvc-collect": 60.0,
     "verifysvc-host": 300.0,
+    # informational: the failover watchdog legitimately blocks for a
+    # whole probation probe (subprocess, its own hard deadline)
+    "verifysvc-failover": None,
     "blocksync-events": 15.0,
     "blocksync-pool": 60.0,
     "blockpool": 15.0,
@@ -130,6 +133,21 @@ def probe_devices(timeout_s: float) -> ProbeResult:
     blocks on the child's pipes after a kill.
     """
     import signal
+
+    from . import fail
+
+    if fail.armed("wedge_device") is not None:
+        # injected wedge (utils/fail): report the hang the real tunnel
+        # would produce, immediately and deterministically — the chaos
+        # harness's in-process stand-in for a >timeout_s jax.devices()
+        # block, honored here so the sentinel and the failover
+        # probation loop both see the same wedged world
+        return ProbeResult(
+            False,
+            "injected fault: wedge_device (probe reported as hung)",
+            float(timeout_s),
+            timed_out=True,
+        )
 
     code = "import jax; print(jax.devices()[0].platform)"
     t0 = time.monotonic()
@@ -250,6 +268,21 @@ class HealthMonitor:
         self._next_probe = 0.0  # fire immediately on start
 
     # ---------------------------------------------------------- lifecycle
+
+    @property
+    def state(self) -> str:
+        """Current tri-state health (atomic str read, no lock: the
+        verify service's failover watchdog polls this every tick)."""
+        return self._state
+
+    @property
+    def last_probe_at(self) -> float | None:
+        """Monotonic time of the last ingested probe result (atomic
+        read).  The failover watchdog compares this against its own
+        last restore so a sentinel verdict that predates the restore —
+        the sentinel probes far less often than probation — can't
+        immediately re-trip a just-restored service."""
+        return self._last_result_at
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():

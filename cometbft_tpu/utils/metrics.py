@@ -380,6 +380,23 @@ class Hub:
                 0.1, 0.5,
             ),
         )
+        # ---- verify-service degraded-mode failover (verifysvc/service.py)
+        self.verify_svc_backend_mode = r.gauge(
+            "verify_svc_backend_mode",
+            "Verify-service backend mode (0=tpu, 1=cpu_fallback); flips "
+            "on every failover trip/restore",
+        )
+        self.verify_svc_failover = r.counter(
+            "verify_svc_failover_total",
+            "Verify-service failover transitions (label direction="
+            "to_cpu|to_tpu)",
+        )
+        self.verify_svc_host_reverify = r.counter(
+            "verify_svc_host_reverify_total",
+            "Batches re-verified on the host path by the failover plane "
+            "(label cause=wedge|dispatch_error|submit_error|"
+            "collect_error)",
+        )
         # ---- health sentinel (utils/healthmon)
         self.health_state = r.gauge(
             "health_state",

@@ -39,6 +39,15 @@ def resolve_mode(pubkeys: list[bytes] | None):
     kernel."""
     if pubkeys is None:
         return MODE_PLAIN
+    from .service import _GLOBAL
+
+    if _GLOBAL is not None and _GLOBAL.backend_mode != "tpu":
+        # degraded mode: comb table binds are bypassed entirely — an
+        # ensure()/ensure_async() is DEVICE work (table build + H2D),
+        # exactly the hang the failover trip escaped.  Peek the module
+        # global, never global_service(): resolving a mode must not
+        # construct and install a fresh scheduler.
+        return MODE_PLAIN
     from ..crypto import batch as crypto_batch
 
     if len(pubkeys) < crypto_batch.comb_min():

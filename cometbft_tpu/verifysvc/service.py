@@ -51,19 +51,58 @@ the scheduler thread would delay a consensus dispatch behind a mempool
 batch, the inversion the class system exists to prevent, and the
 priority queue bounds a queued consensus batch's extra wait to at most
 one in-flight lower-class task.
+
+**Degraded-mode failover** (ROADMAP item 5: BENCH r03-r05 lost three
+perf rounds to a wedged device tunnel, and PR 7's health sentinel only
+*detects* that state): the service runs in one of two backend modes,
+``tpu`` or ``cpu_fallback``.  A dedicated failover watchdog thread —
+never the scheduler, which must stay free to dispatch — trips the
+service to CPU mode when an in-flight batch has been dispatched to (or
+awaiting results from) the device longer than
+``COMETBFT_TPU_FAILOVER_BATCH_DEADLINE_MS``, or when the health
+sentinel (utils/healthmon) reports the accelerator ``wedged``.  A trip:
+
+  * re-verifies every stranded in-flight batch on the host path, each
+    request's per-signature blame in its OWN add() order (ticket
+    resolution is first-wins, so the wedged device wait completing
+    later — or never — cannot double-resolve or overwrite verdicts);
+  * respawns the collector/host workers under a new generation (the old
+    ones may be parked inside a wedged device wait forever; stale
+    generations exit as soon as they unblock instead of double-draining);
+  * routes every subsequent batch host-side — comb table binds are
+    bypassed in ``_make_verifier`` here and in ``client.resolve_mode``
+    (a table build is device work: it would hang with the tunnel);
+  * emits a flight-recorder ``verifysvc_failover`` event, flips the
+    ``verify_svc_backend_mode`` gauge, and writes ONE forensics
+    artifact (utils/debugdump.stall_report) per trip.
+
+While tripped, the watchdog runs a **probation loop**: the hang-proof
+subprocess probe (utils/healthmon.probe_devices — it can never hang
+this process, and it honors the ``wedge_device`` injected fault) every
+``COMETBFT_TPU_FAILOVER_PROBE_PERIOD_MS``; after
+``COMETBFT_TPU_FAILOVER_PROBATION_OK`` consecutive successes the
+service restores TPU mode.  Dispatch/collect *errors* (as opposed to
+hangs) don't flip the mode: the failed batch is re-verified on host
+with identical verdicts and the service keeps serving — the
+``fail_dispatch`` injected fault exercises exactly that path.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from enum import IntEnum
 
-from ..utils import envknobs, healthmon, tracing
+from ..utils import envknobs, fail, healthmon, tracing
 from ..utils.flightrec import recorder as _flightrec
 from ..utils.log import get_logger
 from ..utils.metrics import hub as _mhub
+
+MODE_TPU = "tpu"
+MODE_CPU_FALLBACK = "cpu_fallback"
+_MODE_CODE = {MODE_TPU: 0, MODE_CPU_FALLBACK: 1}
 
 
 class Klass(IntEnum):
@@ -119,24 +158,37 @@ class Ticket:
     (all_ok, per_signature) in the request's own add() order, or raises
     whatever the dispatch/collect path raised."""
 
-    __slots__ = ("_ev", "_result", "_exc", "nsigs", "timings")
+    __slots__ = ("_ev", "_mtx", "_result", "_exc", "nsigs", "timings")
 
     def __init__(self, nsigs: int):
         self._ev = threading.Event()
+        self._mtx = threading.Lock()
         self._result: tuple[bool, list[bool]] | None = None
         self._exc: BaseException | None = None
         self.nsigs = nsigs
         self.timings: dict[str, float] = {}
 
-    def _resolve(self, result, timings=None) -> None:
-        self._result = result
-        if timings:
-            self.timings = dict(timings)
-        self._ev.set()
+    def _resolve(self, result, timings=None) -> bool:
+        """First resolution wins: a failover host re-verify races the
+        wedged device collect it replaced, and whichever settles a
+        ticket first is authoritative — the loser's late answer is
+        discarded, never overwritten onto an already-read result."""
+        with self._mtx:
+            if self._ev.is_set():
+                return False
+            self._result = result
+            if timings:
+                self.timings = dict(timings)
+            self._ev.set()
+            return True
 
-    def _fail(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._ev.set()
+    def _fail(self, exc: BaseException) -> bool:
+        with self._mtx:
+            if self._ev.is_set():
+                return False
+            self._exc = exc
+            self._ev.set()
+            return True
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -180,6 +232,46 @@ def _parse_weights(spec: str) -> dict[Klass, int]:
     return out
 
 
+class _HostBatchVerifier:
+    """The degraded-mode data plane: the exact BatchVerifier seam shape
+    the device verifiers expose, wrapping CpuEd25519BatchVerifier (ONE
+    source of the host-verdict semantics — ZIP-215, bit-identical to
+    the kernels) behind a sync-ticket submit().  ``_entry = None``
+    routes its submit() through the class-priority host worker
+    (``_submit_is_offloaded``), so a mempool batch's host verification
+    still cannot delay a queued consensus dispatch while the service is
+    tripped."""
+
+    _entry = None
+    _fallback = None
+
+    def __init__(self):
+        from ..models.verifier import CpuEd25519BatchVerifier
+
+        self._cpu = CpuEd25519BatchVerifier()
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        self._cpu.add(pub_key, msg, sig)
+
+    def submit(self):
+        return ("sync", self._cpu.verify())
+
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        return ticket[1]
+
+
+def _host_verify_items(items) -> tuple[bool, list[bool]]:
+    """The one host-path verdict every fallback resolves to — delegates
+    to CpuEd25519BatchVerifier so the semantics cannot drift from the
+    cpu backend (the blame-order tests pin service results against
+    exactly this)."""
+    from ..models.verifier import CpuEd25519BatchVerifier
+
+    cpu = CpuEd25519BatchVerifier()
+    cpu._items = list(items)
+    return cpu.verify()
+
+
 class VerifyService:
     """Priority-scheduled batching front of the device verify pipeline.
 
@@ -195,6 +287,14 @@ class VerifyService:
         queue_max: int | None = None,
         deadlines_ms: dict[Klass, float] | None = None,
         weights: dict[Klass, int] | None = None,
+        failover: bool | None = None,
+        batch_deadline_s: float | None = None,
+        probation_ok: int | None = None,
+        probe_fn=None,
+        probe_period_s: float | None = None,
+        probe_timeout_s: float | None = None,
+        failover_tick_s: float = 0.25,
+        artifact_dir: str | None = None,
     ):
         self.batch_max = max(
             1, batch_max if batch_max is not None
@@ -226,7 +326,10 @@ class VerifyService:
         # entries (klass_value, seq, (bv, batch)); lower tuples first so
         # a queued consensus batch always overtakes queued mempool work
         self._hostq: queue.PriorityQueue = queue.PriorityQueue()
-        self._hostseq = 0
+        # thread-safe sequence (scheduler, collector, AND the failover
+        # error path all enqueue): equal (prio, seq) tuples would make
+        # PriorityQueue compare the unorderable payloads
+        self._hostseq = itertools.count(1)
         # batches handed to the device/host but not yet settled, keyed by
         # id(batch): the health sentinel's forensics read their ages to
         # say HOW LONG a wedged dispatch has been in flight
@@ -241,6 +344,51 @@ class VerifyService:
         self._dispatched: dict[str, int] = {k.label: 0 for k in Klass}
         self._rejected: dict[str, int] = {k.label: 0 for k in Klass}
 
+        # ---- degraded-mode failover (module docstring, "failover")
+        self.failover_enabled = (
+            envknobs.get_bool(envknobs.FAILOVER) if failover is None
+            else failover
+        )
+        self.batch_deadline_s = (
+            batch_deadline_s if batch_deadline_s is not None
+            else max(1, envknobs.get_int(envknobs.FAILOVER_BATCH_DEADLINE_MS))
+            / 1e3
+        )
+        self.probation_ok = max(
+            1, probation_ok if probation_ok is not None
+            else envknobs.get_int(envknobs.FAILOVER_PROBATION_OK)
+        )
+        self.probe_period_s = (
+            probe_period_s if probe_period_s is not None
+            else max(1, envknobs.get_int(envknobs.FAILOVER_PROBE_PERIOD_MS))
+            / 1e3
+        )
+        self.probe_timeout_s = (
+            probe_timeout_s if probe_timeout_s is not None
+            else max(1, envknobs.get_int(envknobs.FAILOVER_PROBE_TIMEOUT_MS))
+            / 1e3
+        )
+        self._probe_fn = (
+            probe_fn if probe_fn is not None else healthmon.probe_devices
+        )
+        self.failover_tick_s = max(0.01, failover_tick_s)
+        self.artifact_dir = artifact_dir
+        # mode state, guarded by _failover_mtx (never held across
+        # blocking work); _gen tags worker threads so a trip can respawn
+        # the collector/host workers while the wedged old generation is
+        # still parked inside a device wait
+        self._failover_mtx = threading.Lock()
+        self._backend_mode = MODE_TPU
+        self._gen = 0
+        self._trips = 0
+        self._restores = 0
+        self._probation_consec_ok = 0
+        self._next_probation_probe = 0.0
+        self._last_restore_at: float | None = None
+        self._last_trip_reason: str | None = None
+        self._last_artifact: str | None = None
+        self._stop_ev = threading.Event()
+
     # ------------------------------------------------------------ lifecycle
 
     def _ensure_started(self) -> None:
@@ -250,22 +398,47 @@ class VerifyService:
             if self._running:
                 return
             self._running = True
+            # restart path (stop() then a later submit): a stale stop
+            # signal would make every bounded wait in the failover loop
+            # return immediately — a busy spin firing back-to-back
+            # subprocess probes
+            self._stop_ev.clear()
             self._threads = [
                 threading.Thread(
                     target=self._sched_loop, name="verifysvc-sched",
                     daemon=True,
                 ),
-                threading.Thread(
-                    target=self._collect_loop, name="verifysvc-collect",
-                    daemon=True,
-                ),
-                threading.Thread(
-                    target=self._host_loop, name="verifysvc-host",
-                    daemon=True,
-                ),
             ]
+            if self.failover_enabled:
+                self._threads.append(
+                    threading.Thread(
+                        target=self._failover_loop,
+                        name="verifysvc-failover", daemon=True,
+                    )
+                )
             for t in self._threads:
                 t.start()
+            self._threads += self._spawn_workers(self._gen)
+
+    def _spawn_workers(self, gen: int) -> list[threading.Thread]:
+        """Start a collector + host worker tagged with ``gen``.  A
+        failover trip bumps the generation and calls this again: the
+        old workers may be parked forever inside a wedged device wait,
+        and a stale generation exits (without retiring its heartbeat —
+        the fresh worker owns the name now) as soon as it unblocks."""
+        ts = [
+            threading.Thread(
+                target=self._collect_loop, args=(gen,),
+                name="verifysvc-collect", daemon=True,
+            ),
+            threading.Thread(
+                target=self._host_loop, args=(gen,),
+                name="verifysvc-host", daemon=True,
+            ),
+        ]
+        for t in ts:
+            t.start()
+        return ts
 
     def stop(self) -> None:
         """Tear down the scheduler/collector (tests).  Queued requests
@@ -279,6 +452,7 @@ class VerifyService:
                 self._queues[k] = []
                 self._queued_sigs[k] = 0
             self._cond.notify_all()
+        self._stop_ev.set()
         self._collectq.put(None)
         self._hostq.put((_HOST_SENTINEL_PRIO, 0, None))
         for r in stranded:
@@ -428,14 +602,33 @@ class VerifyService:
         return batch, reason
 
     def _track_inflight(self, batch: list[_Request], where: str) -> None:
+        now = time.monotonic()
         with self._inflight_mtx:
             self._inflight[id(batch)] = {
                 "class": batch[0].klass.label,
                 "sigs": sum(len(r.items) for r in batch),
                 "requests": len(batch),
                 "where": where,
-                "since": time.monotonic(),
+                "since": now,
+                # when the batch ENTERED the device-bound phase — the
+                # clock the failover deadline runs on.  A host-tracked
+                # batch starts it only at the host->device relabel:
+                # host-worker time (a cold XLA compile is legitimate
+                # minutes-long work) must never count toward the trip
+                "device_since": now if where == "device" else None,
+                # the requests themselves, so a failover trip can
+                # re-verify stranded work on host (never serialized:
+                # stats() copies the display fields only)
+                "batch": batch,
             }
+
+    def _relabel_inflight(self, batch: list[_Request], where: str) -> None:
+        with self._inflight_mtx:
+            rec = self._inflight.get(id(batch))
+            if rec is not None:
+                rec["where"] = where
+                if where in ("device", "collect") and rec.get("device_since") is None:
+                    rec["device_since"] = time.monotonic()
 
     def _untrack_inflight(self, batch: list[_Request]) -> None:
         with self._inflight_mtx:
@@ -468,7 +661,12 @@ class VerifyService:
     def _make_verifier(self, mode):
         """Bind a batch to a device verifier.  The ONLY constructor seam
         for the data plane — tests monkeypatch this to observe dispatch
-        order without touching a real kernel."""
+        order without touching a real kernel.  In CPU fallback mode
+        EVERY batch — comb-bound or not — gets the host verifier: a
+        comb entry is device-resident state, and touching it while the
+        tunnel is wedged is exactly the hang the trip escaped."""
+        if self._backend_mode == MODE_CPU_FALLBACK:
+            return _HostBatchVerifier()
         if mode[0] == "comb":
             from ..models.comb_verifier import CombBatchVerifier
 
@@ -511,8 +709,11 @@ class VerifyService:
              "sigs": nsigs, "requests": len(batch)}
             if tracing.enabled() else None
         )
+        bv = None
         with tracing.span("verify.sched.dispatch", labels):
             try:
+                if fail.armed("fail_dispatch") is not None:
+                    raise fail.InjectedFault("injected fault: fail_dispatch")
                 bv = self._make_verifier(batch[0].mode)
                 for r in batch:
                     for pub, msg, sig in r.items:
@@ -522,28 +723,37 @@ class VerifyService:
                     # (class-priority queue) so the scheduler stays free
                     # to dispatch the next, possibly higher-class, batch
                     self._track_inflight(batch, "host")
-                    self._hostseq += 1
                     self._hostq.put(
-                        (int(klass), self._hostseq, (bv, batch))
+                        (int(klass), next(self._hostseq), (bv, batch))
                     )
                     return
                 ticket = bv.submit()  # comb staging seam: cheap dispatch
-            except BaseException as e:  # noqa: BLE001 — fail the tickets, keep scheduling
+            except BaseException as e:  # noqa: BLE001 — settle the tickets, keep scheduling
                 self.logger.error(
                     f"dispatch failed (class={klass.label}, sigs={nsigs}): {e!r}"
                 )
-                for r in batch:
-                    r.ticket._fail(e)
+                self._fail_or_reverify(
+                    batch, e, cause="dispatch_error", bv=bv
+                )
                 return
         self._track_inflight(batch, "device")
         self._collectq.put((bv, ticket, batch))
 
-    def _host_loop(self) -> None:
+    def _host_loop(self, gen: int = 0) -> None:
         """Drain submit-time work in class-priority order: queued
         consensus batches overtake queued lower-class ones (the worker
         can't preempt an in-flight verify/compile, so the worst-case
-        consensus delay is ONE lower-class task, not a whole backlog)."""
+        consensus delay is ONE lower-class task, not a whole backlog).
+        ``gen`` retires this worker after a failover trip respawned a
+        fresh one (a stale worker processes at most the item it already
+        held — harmless, settlement is first-wins — then exits without
+        retiring the heartbeat the fresh worker now owns)."""
         while True:
+            if gen != self._gen:
+                return
+            if not self._running:
+                healthmon.retire("verifysvc-host")
+                return
             healthmon.beat("verifysvc-host")
             try:
                 _, _, payload = self._hostq.get(timeout=0.5)
@@ -553,6 +763,25 @@ class VerifyService:
                 healthmon.retire("verifysvc-host")
                 return
             bv, batch = payload
+            if all(r.ticket.done() for r in batch):
+                # a failover trip already host-re-verified this batch
+                # while it sat queued: submitting its stale device-bound
+                # verifier now could park THIS worker in the same wedge
+                self._untrack_inflight(batch)
+                continue
+            if (
+                self._backend_mode == MODE_CPU_FALLBACK
+                and not isinstance(bv, _HostBatchVerifier)
+            ):
+                # pending batch whose payload was bound to a DEVICE
+                # verifier pre-trip (raced the mode flip): its submit()
+                # would dispatch to the wedged tunnel — rebuild it on
+                # the host path instead
+                hbv = _HostBatchVerifier()
+                for r in batch:
+                    for pub, msg, sig in r.items:
+                        hbv.add(pub, msg, sig)
+                bv = hbv
             klass = batch[0].klass
             labels = (
                 {"class": klass.label, "requests": len(batch)}
@@ -561,13 +790,14 @@ class VerifyService:
             with tracing.span("verify.sched.hostwork", labels):
                 try:
                     ticket = bv.submit()  # the inline work happens here
-                except BaseException as e:  # noqa: BLE001 — fail the tickets, keep serving
+                except BaseException as e:  # noqa: BLE001 — settle the tickets, keep serving
                     self.logger.error(
                         f"host-route verify failed (class={klass.label}): {e!r}"
                     )
                     self._untrack_inflight(batch)
-                    for r in batch:
-                        r.ticket._fail(e)
+                    self._fail_or_reverify(
+                        batch, e, cause="submit_error", bv=bv
+                    )
                     continue
             if ticket[0] == "sync":
                 self._settle(bv, ticket, batch)  # resolved already
@@ -577,16 +807,18 @@ class VerifyService:
                 # Relabel the in-flight record (same entry, age keeps
                 # accruing) so a wedge during the collect blames the
                 # device wait, not the finished host work
-                with self._inflight_mtx:
-                    rec = self._inflight.get(id(batch))
-                    if rec is not None:
-                        rec["where"] = "device"
+                self._relabel_inflight(batch, "device")
                 self._collectq.put((bv, ticket, batch))
 
     # ---------------------------------------------------------- collector
 
-    def _collect_loop(self) -> None:
+    def _collect_loop(self, gen: int = 0) -> None:
         while True:
+            if gen != self._gen:
+                return  # superseded by a failover trip's fresh worker
+            if not self._running:
+                healthmon.retire("verifysvc-collect")
+                return
             healthmon.beat("verifysvc-collect")
             try:
                 item = self._collectq.get(timeout=0.5)
@@ -602,7 +834,15 @@ class VerifyService:
         ticket, splitting the result vector back per request.  The batch
         stays in the in-flight table until it resolves either way — the
         blocking collect() below is exactly the wait whose age the
-        health forensics need to report when a device wedges mid-batch."""
+        failover watchdog and the health forensics read when a device
+        wedges mid-batch."""
+        if all(r.ticket.done() for r in batch):
+            # a failover trip already host-re-verified this batch while
+            # it sat queued behind a wedged collect: touching the device
+            # ticket now would park THIS worker in the same wedge
+            self._untrack_inflight(batch)
+            return
+        self._relabel_inflight(batch, "collect")
         try:
             self._settle_inner(bv, ticket, batch)
         finally:
@@ -616,13 +856,25 @@ class VerifyService:
         )
         with tracing.span("verify.sched.collect", labels):
             try:
+                if not (isinstance(ticket, tuple) and ticket and ticket[0] == "sync"):
+                    # injected-fault seams, in the same place a real
+                    # device wedge/stall bites: the blocking DEVICE
+                    # result wait.  Sync tickets are host-verified
+                    # results — a wedged device never blocks them, so
+                    # neither do the faults (post-trip CPU-mode batches
+                    # must keep settling while the wedge is armed)
+                    slow = fail.armed("slow_collect")
+                    if slow is not None:
+                        time.sleep(slow)
+                    fail.wedge_wait("wedge_device")
                 _, res = bv.collect(ticket)
-            except BaseException as e:  # noqa: BLE001 — fail the tickets, keep draining
+            except BaseException as e:  # noqa: BLE001 — settle the tickets, keep draining
                 self.logger.error(
                     f"collect failed (class={batch[0].klass.label}): {e!r}"
                 )
-                for r in batch:
-                    r.ticket._fail(e)
+                self._fail_or_reverify(
+                    batch, e, cause="collect_error", bv=bv
+                )
                 return
         total = sum(len(r.items) for r in batch)
         if len(res) != total:
@@ -643,6 +895,314 @@ class VerifyService:
             # (matches the verifiers' own all(res) and bool(res))
             r.ticket._resolve((all(part) and bool(part), part), timings)
 
+    # ----------------------------------------------------------- failover
+
+    @property
+    def backend_mode(self) -> str:
+        """``tpu`` | ``cpu_fallback`` (atomic str read; clients check
+        this before binding comb tables)."""
+        return self._backend_mode
+
+    def _fail_or_reverify(
+        self, batch: list[_Request], exc, cause: str, bv=None
+    ) -> None:
+        """A dispatch/submit/collect ERROR (not a hang): with failover
+        enabled the batch re-verifies on host — identical verdicts, no
+        mode change, the service keeps serving — instead of failing the
+        callers' tickets and pushing every one of them onto their own
+        inline fallback.  The re-verification is requeued onto the
+        class-priority host worker, NEVER run on the caller: a big
+        lower-class batch erroring at dispatch must not park the
+        scheduler (or the collector's FIFO) behind seconds of
+        sequential host verifies, and the single worker bounds
+        concurrency while keeping consensus re-verifies ahead of
+        mempool ones.  If the HOST path itself errored (``bv`` already
+        a :class:`_HostBatchVerifier`) the tickets fail — requeueing
+        would loop."""
+        if not self.failover_enabled or isinstance(bv, _HostBatchVerifier):
+            for r in batch:
+                r.ticket._fail(exc)
+            return
+        _mhub().verify_svc_host_reverify.inc(cause=cause)
+        hbv = _HostBatchVerifier()
+        for r in batch:
+            for pub, msg, sig in r.items:
+                hbv.add(pub, msg, sig)
+        # (re-)track as host work; on the collect_error path the outer
+        # _settle finally pops this entry while the requeue is pending —
+        # a brief stats gap, settlement itself is unaffected
+        self._track_inflight(batch, "host")
+        self._hostq.put(
+            (int(batch[0].klass), next(self._hostseq), (hbv, batch))
+        )
+
+    def _reverify_batches(self, batches: list[list[_Request]]) -> None:
+        """Host-verify every request of every batch, per-signature blame
+        in each request's OWN add() order, resolving tickets first-wins
+        (a wedged device wait completing later is discarded)."""
+        for batch in batches:
+            for r in batch:
+                if r.ticket.done():
+                    continue
+                with tracing.span(
+                    "verify.failover.reverify",
+                    {"class": r.klass.label, "sigs": len(r.items)}
+                    if tracing.enabled() else None,
+                ):
+                    r.ticket._resolve(_host_verify_items(r.items))
+
+    def _failover_loop(self) -> None:
+        """The failover watchdog: a dedicated thread — NEVER the
+        scheduler — so a wedged scheduler/collector can't take the trip
+        decision down with it, and the probation probe (a subprocess
+        with a hard deadline) has somewhere safe to block."""
+        while self._running:
+            if self._backend_mode == MODE_TPU:
+                healthmon.beat("verifysvc-failover")
+                reason = self._trip_reason()
+                if reason is not None:
+                    self._trip_to_cpu(reason)
+                else:
+                    self._stop_ev.wait(self.failover_tick_s)
+                continue
+            # ---- CPU mode: sweep stranded work every tick, probe
+            # toward restoration every probe period
+            self._stop_ev.wait(self.failover_tick_s)
+            if not self._running:
+                return
+            healthmon.beat("verifysvc-failover")
+            if self._backend_mode != MODE_CPU_FALLBACK:
+                continue
+            self._sweep_stranded()
+            now = time.monotonic()
+            if now < self._next_probation_probe:
+                continue
+            self._next_probation_probe = now + self.probe_period_s
+            try:
+                res = self._probe_fn(self.probe_timeout_s)
+                ok = bool(res.ok)
+                detail = res.detail
+            except BaseException as e:  # noqa: BLE001 — a probe bug is a failed probe
+                ok, detail = False, f"probe raised {type(e).__name__}: {e}"
+            with self._failover_mtx:
+                self._probation_consec_ok = (
+                    self._probation_consec_ok + 1 if ok else 0
+                )
+                consec = self._probation_consec_ok
+            self.logger.info(
+                f"failover probation probe: ok={ok} ({detail}) "
+                f"[{consec}/{self.probation_ok}]"
+            )
+            if consec >= self.probation_ok:
+                self._restore_tpu()
+
+    def _sweep_stranded(self) -> None:
+        """Close the trip/dispatch race: the scheduler reads the mode
+        (tpu) in _make_verifier BEFORE tracking the batch, so a batch
+        bound to a device verifier concurrently with the trip can miss
+        the stranded-batch snapshot and park the fresh collector in the
+        same wedge.  In CPU mode, any tracked batch overdue on the
+        device deadline is host-re-verified — first-wins settlement
+        makes repeats no-ops, and its callers unblock no matter how the
+        race interleaved."""
+        now = time.monotonic()
+        with self._inflight_mtx:
+            overdue = [
+                rec["batch"] for rec in self._inflight.values()
+                if rec.get("device_since") is not None
+                and now - rec["device_since"] > self.batch_deadline_s
+            ]
+        overdue = [
+            b for b in overdue if not all(r.ticket.done() for r in b)
+        ]
+        if not overdue:
+            return
+        _mhub().verify_svc_host_reverify.inc(len(overdue), cause="wedge")
+        self.logger.warning(
+            f"cpu-fallback sweep: {len(overdue)} batch(es) stranded past "
+            "the device deadline after the trip; re-verifying on host"
+        )
+        # untrack BEFORE the off-thread re-verify: the parked worker's
+        # own finally may never run (that is the wedge), a stale
+        # ever-aging entry would re-trip the service the moment
+        # probation restores, and the next tick must not re-select the
+        # work this spawn is already doing.  Off-thread like the trip's
+        # _recover: the watchdog must go straight back to watching (and
+        # to probation probes), not serialize behind a big host verify.
+        for b in overdue:
+            self._untrack_inflight(b)
+        threading.Thread(
+            target=self._reverify_batches, args=(overdue,),
+            name="verifysvc-reverify", daemon=True,
+        ).start()
+
+    def _trip_reason(self) -> str | None:
+        """Why the service should trip NOW, or None.  Two signals:
+        an in-flight batch stuck dispatched-to/awaiting the device past
+        the batch deadline (``where`` device/collect; ``host`` is exempt
+        — a cold-bucket XLA compile on the host worker is legitimate
+        minutes-long work), or the health sentinel judging the
+        accelerator wedged."""
+        now = time.monotonic()
+        with self._inflight_mtx:
+            worst = max(
+                (
+                    now - rec["device_since"]
+                    for rec in self._inflight.values()
+                    if rec.get("device_since") is not None
+                ),
+                default=0.0,
+            )
+        if worst > self.batch_deadline_s:
+            return (
+                f"in-flight batch {worst:.1f}s past the "
+                f"{self.batch_deadline_s:g}s device deadline"
+            )
+        mon = healthmon.monitor()
+        if mon is not None and mon.state == healthmon.STATE_WEDGED:
+            # ignore a wedged verdict the sentinel formed BEFORE our
+            # probation restored: the sentinel probes far less often
+            # (60s default vs probation's 15s), and its stale state
+            # would re-trip a just-restored service every watchdog tick
+            # until its next probe — duplicate artifacts and to_cpu
+            # events for one incident.  Once it re-probes and still
+            # says wedged, the trip is legitimate.
+            probe_at = getattr(mon, "last_probe_at", None)
+            if (
+                self._last_restore_at is None
+                or probe_at is None
+                or probe_at > self._last_restore_at
+            ):
+                return "health sentinel reports accelerator wedged"
+        return None
+
+    def trip_to_cpu(self, reason: str) -> bool:
+        """Public trip entry (bench degraded rounds; operators via
+        tests).  Returns False when already tripped."""
+        return self._trip_to_cpu(reason)
+
+    def _trip_to_cpu(self, reason: str) -> bool:
+        with self._failover_mtx:
+            if self._backend_mode == MODE_CPU_FALLBACK:
+                return False
+            self._backend_mode = MODE_CPU_FALLBACK
+            self._trips += 1
+            self._probation_consec_ok = 0
+            self._last_trip_reason = reason
+            self._next_probation_probe = time.monotonic() + self.probe_period_s
+            self._gen += 1
+            gen = self._gen
+        with self._inflight_mtx:
+            stranded = [rec["batch"] for rec in self._inflight.values()]
+        stranded_sigs = sum(
+            len(r.items) for batch in stranded for r in batch
+        )
+        m = _mhub()
+        m.verify_svc_backend_mode.set(_MODE_CODE[MODE_CPU_FALLBACK])
+        m.verify_svc_failover.inc(direction="to_cpu")
+        m.verify_svc_host_reverify.inc(len(stranded), cause="wedge")
+        _flightrec().record(
+            "verifysvc_failover",
+            direction="to_cpu",
+            reason=reason,
+            stranded_batches=len(stranded),
+            stranded_sigs=stranded_sigs,
+        )
+        tracing.instant(
+            "verify.failover",
+            {"direction": "to_cpu", "stranded": len(stranded)}
+            if tracing.enabled() else None,
+        )
+        self.logger.error(
+            f"verify service TRIPPED to CPU fallback: {reason} "
+            f"({len(stranded)} in-flight batches / {stranded_sigs} sigs "
+            "re-verifying on host)"
+        )
+        # a pre-trip stats snapshot (in-flight ages still visible) for
+        # the forensics artifact, taken before re-verification resolves
+        # and untracks the stranded entries
+        snapshot = self.stats(lock_timeout=0.5)
+        # fresh workers: the old generation may be parked inside the
+        # wedged wait forever (that is the failure being survived)
+        workers = self._spawn_workers(gen)
+        self._threads = [
+            t for t in self._threads
+            if t.name not in ("verifysvc-collect", "verifysvc-host")
+        ] + workers
+        # re-verify stranded work off-thread: the watchdog must go
+        # straight back to watching, and forensics IO must not delay
+        # the re-verification that restores consensus liveness
+        def _recover():
+            # untrack FIRST: the stranded batches are already past the
+            # device deadline, and leaving them in the table would make
+            # the watchdog's very next sweep re-select them — double
+            # counting and re-verifying work this thread is about to do
+            # (the forensics snapshot above already preserved them)
+            for batch in stranded:
+                self._untrack_inflight(batch)
+            self._reverify_batches(stranded)
+            path = self._capture_failover_forensics(reason, snapshot)
+            with self._failover_mtx:
+                self._last_artifact = path
+
+        threading.Thread(
+            target=_recover, name="verifysvc-reverify", daemon=True
+        ).start()
+        return True
+
+    def _restore_tpu(self) -> None:
+        with self._failover_mtx:
+            if self._backend_mode != MODE_CPU_FALLBACK:
+                return
+            self._backend_mode = MODE_TPU
+            self._restores += 1
+            self._probation_consec_ok = 0
+            self._last_restore_at = time.monotonic()
+        m = _mhub()
+        m.verify_svc_backend_mode.set(_MODE_CODE[MODE_TPU])
+        m.verify_svc_failover.inc(direction="to_tpu")
+        _flightrec().record("verifysvc_failover", direction="to_tpu")
+        tracing.instant(
+            "verify.failover",
+            {"direction": "to_tpu"} if tracing.enabled() else None,
+        )
+        self.logger.warning(
+            "verify service restored to TPU mode "
+            f"({self.probation_ok} consecutive probation probes ok)"
+        )
+
+    def _capture_failover_forensics(self, reason: str, snapshot: dict) -> str | None:
+        """ONE diagnosis artifact per trip (debugdump.stall_report:
+        verifysvc stats with the stranded in-flight ages, flight
+        recorder, all-thread stacks).  Must never raise — it runs while
+        the node is already degraded."""
+        import json as _json
+
+        from ..utils import debugdump
+
+        try:
+            sections = [
+                ("verify service (at trip)",
+                 _json.dumps(snapshot, indent=1, default=str)),
+            ]
+            if tracing.enabled():
+                events = tracing.chrome_trace_events()[-256:]
+                sections.append(
+                    ("trace ring (newest 256)",
+                     _json.dumps(events, default=str))
+                )
+            path = debugdump.stall_report(
+                f"verify-service failover to cpu_fallback: {reason}",
+                sections,
+                directory=self.artifact_dir,
+            )
+            _mhub().health_forensics.inc()
+            self.logger.warning(f"failover forensics written to {path}")
+            return path
+        except Exception as e:  # noqa: BLE001 — forensics must never hurt the node
+            self.logger.warning(f"failover forensics capture failed: {e!r}")
+            return None
+
     # ------------------------------------------------------------- status
 
     def stats(self, lock_timeout: float | None = None) -> dict:
@@ -661,6 +1221,13 @@ class VerifyService:
                     "requests": rec["requests"],
                     "where": rec["where"],
                     "age_s": round(now - rec["since"], 3),
+                    # the failover deadline's clock (None while still in
+                    # host-worker submit: compiles don't count)
+                    "device_age_s": (
+                        round(now - rec["device_since"], 3)
+                        if rec.get("device_since") is not None
+                        else None
+                    ),
                 }
                 for rec in self._inflight.values()
             ]
@@ -685,9 +1252,23 @@ class VerifyService:
             queued = {"lock_busy": True}
             dispatched = dict(self._dispatched)
             rejected = dict(self._rejected)
+        with self._failover_mtx:
+            failover = {
+                "enabled": self.failover_enabled,
+                "backend_mode": self._backend_mode,
+                "trips": self._trips,
+                "restores": self._restores,
+                "probation_consec_ok": self._probation_consec_ok,
+                "probation_ok_needed": self.probation_ok,
+                "batch_deadline_ms": self.batch_deadline_s * 1e3,
+                "last_trip_reason": self._last_trip_reason,
+                "last_artifact": self._last_artifact,
+            }
         return {
             "in_flight": in_flight,
             "running": self._running,
+            "backend_mode": failover["backend_mode"],
+            "failover": failover,
             "batch_max": self.batch_max,
             "queue_max": self.queue_max,
             "deadline_ms": {

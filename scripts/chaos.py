@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Chaos scenario driver: run the named e2e fault scenarios
+(cometbft_tpu/e2e/scenarios.py) and emit a machine-readable pass/fail
+artifact per scenario.
+
+    python scripts/chaos.py                      # the 5 full scenarios
+    python scripts/chaos.py --scenario wedge --scenario double_sign
+    python scripts/chaos.py --smoke              # fast single-node smoke
+    python scripts/chaos.py --json out/chaos.json --out out/artifacts
+    python scripts/chaos.py --list
+
+Exit status: 0 iff every selected scenario passed.  ``--json`` writes
+``{"ok": bool, "scenarios": [ScenarioResult...]}``; each scenario also
+leaves a per-node artifact directory (flight-recorder dump, health
+snapshot, verify-service stats, node logs) under ``--out`` so a failed
+run is diagnosable without a rerun.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    from cometbft_tpu.e2e import scenarios as sc
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--scenario", action="append", default=[],
+        help="scenario name (repeatable); default: the 5 full scenarios",
+    )
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="run only the fast single-node wedge_smoke",
+    )
+    p.add_argument("--list", action="store_true", help="list scenarios and exit")
+    p.add_argument("--json", default="", help="write the machine-readable verdict here")
+    p.add_argument("--out", default="", help="artifact directory (default: a tmp dir)")
+    p.add_argument(
+        "--base-port", type=int, default=0,
+        help="override the per-scenario default port ranges",
+    )
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in sc.SCENARIOS:
+            print(name)
+        return 0
+
+    names = args.scenario or (
+        ["wedge_smoke"] if args.smoke else list(sc.DEFAULT_SCENARIOS)
+    )
+    unknown = [n for n in names if n not in sc.SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {', '.join(unknown)}", file=sys.stderr)
+        print(f"known: {', '.join(sc.SCENARIOS)}", file=sys.stderr)
+        return 2
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="cometbft-chaos-")
+    os.makedirs(out_dir, exist_ok=True)
+
+    results = []
+    t0 = time.monotonic()
+    for i, name in enumerate(names):
+        base_port = (args.base_port + i * 200) if args.base_port else None
+        res = sc.run_scenario(name, out_dir, base_port=base_port)
+        results.append(res)
+        print(json.dumps(res.to_dict()), flush=True)  # one line per scenario
+
+    verdict = {
+        "ok": all(r.ok for r in results),
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "artifact_dir": out_dir,
+        "scenarios": [r.to_dict() for r in results],
+    }
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)), exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(verdict, f, indent=1)
+    print(
+        f"chaos: {sum(r.ok for r in results)}/{len(results)} scenarios passed "
+        f"in {verdict['elapsed_s']}s (artifacts: {out_dir})",
+        file=sys.stderr,
+    )
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,10 @@ def test_unavailable_backend_yields_structured_error():
         {
             "JAX_PLATFORMS": "no_such_platform",
             "BENCH_PROBE_TIMEOUT": "60",
+            # failover OFF: this test pins the PRE-failover fail-fast
+            # contract (structured error, no value); the failover-armed
+            # degraded round is test_unavailable_backend_degrades_to_cpu
+            "COMETBFT_TPU_FAILOVER": "0",
             # one attempt, no retry sleep: the retry ladder (default 2 x
             # 90 s, for wedged-tunnel recovery) would outlive the 120 s
             # subprocess timeout and break the emit-one-line contract
@@ -71,6 +75,38 @@ def test_unavailable_backend_yields_structured_error():
     assert att["ok"] is False
     assert isinstance(att["latency_s"], (int, float))
     assert att["timed_out"] is False  # exited, didn't hang
+
+
+def test_unavailable_backend_degrades_to_cpu():
+    """With failover armed (the default), a dead backend no longer
+    costs the round: bench falls back to the verify service's tripped
+    CPU path and emits a REAL degraded p50 labeled
+    ``backend_mode: cpu_fallback`` — plus the wedge evidence — instead
+    of only an error object (the BENCH r03-r05 failure mode)."""
+    out = _run(
+        {
+            "JAX_PLATFORMS": "no_such_platform",
+            "BENCH_PROBE_TIMEOUT": "60",
+            "BENCH_PROBE_RETRIES": "1",
+            "BENCH_PROBE_RETRY_DELAY": "0",
+            "BENCH_KERNELCHECK": "0",
+            "BENCH_SHARDCHECK": "0",
+            # small degraded scale: host path is ~4 ms/sig pure-Python
+            "BENCH_DEGRADED_N": "64",
+            "BENCH_DEGRADED_ITERS": "2",
+        }
+    )
+    assert out["backend_mode"] == "cpu_fallback"
+    assert out["metric"] == "verify_commit_p50_64_ms"
+    assert isinstance(out["value"], (int, float)) and out["value"] > 0
+    assert "error" not in out  # the round carries a value, not a loss
+    assert "backend-unavailable" in out["backend_error"]
+    assert out["wedge_report"]["state"] in ("wedged", "unavailable")
+    assert out["verifier"] == "cpu-fallback"
+    sched = out["scheduler"]
+    assert sched["backend_mode"] == "cpu_fallback"
+    assert sched["failover_trips"] == 1
+    assert sched["dispatched_batches"]["consensus"] >= 2
 
 
 def test_crash_after_probe_yields_structured_error():

@@ -1,0 +1,134 @@
+"""Chaos scenario harness (e2e/scenarios.py + scripts/chaos.py).
+
+Tier-1 runs the single-node ``wedge_smoke`` (the whole failover plane —
+trip, degraded-mode liveness, forensics, probation restore — against a
+real node process, ~15-40 s) plus the driver's contract on stub
+scenarios.  The five multi-node scenarios run in the slow tier, one test
+each so a failure isolates."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from cometbft_tpu.e2e import scenarios as sc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_chaos_mod():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_driver", os.path.join(REPO, "scripts", "chaos.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ fast tier
+
+
+def test_chaos_smoke_wedge_single_node(tmp_path):
+    """The tier-1 smoke: a real single-node net wedges, trips to CPU
+    fallback, keeps committing, emits forensics + the flightrec event,
+    and restores TPU mode after the heal."""
+    res = sc.run_scenario("wedge_smoke", str(tmp_path), base_port=25500)
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+    assert res.liveness and res.safety
+    assert res.details.get("tripped") and res.details.get("restored")
+    assert res.details.get("forensics_artifact")
+    # the per-node artifact bundle landed (diagnosability contract)
+    arts = res.details.get("artifacts", {})
+    assert arts and all(os.path.exists(p) for p in arts.values())
+
+
+def test_chaos_driver_json_artifact(tmp_path, monkeypatch, capsys):
+    """scripts/chaos.py --json emits one machine-readable verdict per
+    scenario and exits non-zero iff any failed (driver contract, proven
+    on stub scenarios so it stays fast)."""
+    mod = _load_chaos_mod()
+
+    def fake_pass(out_dir, base_port=0):
+        return sc.ScenarioResult("fake_pass", ok=True, liveness=True, safety=True)
+
+    def fake_fail(out_dir, base_port=0):
+        return sc.ScenarioResult("fake_fail", problems=["injected failure"])
+
+    monkeypatch.setitem(sc.SCENARIOS, "fake_pass", fake_pass)
+    monkeypatch.setitem(sc.SCENARIOS, "fake_fail", fake_fail)
+
+    out = tmp_path / "verdict.json"
+    rc = mod.main([
+        "--scenario", "fake_pass", "--json", str(out),
+        "--out", str(tmp_path / "art"),
+    ])
+    assert rc == 0
+    verdict = json.loads(out.read_text())
+    assert verdict["ok"] is True
+    assert [s["name"] for s in verdict["scenarios"]] == ["fake_pass"]
+    assert {"name", "ok", "liveness", "safety", "problems", "details",
+            "artifact_dir", "elapsed_s"} <= set(verdict["scenarios"][0])
+    # stdout carried one JSON line per scenario (the streaming artifact)
+    lines = [
+        json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("{")
+    ]
+    assert [ln["name"] for ln in lines] == ["fake_pass"]
+
+    rc = mod.main([
+        "--scenario", "fake_pass", "--scenario", "fake_fail",
+        "--json", str(out), "--out", str(tmp_path / "art2"),
+    ])
+    assert rc == 1
+    verdict = json.loads(out.read_text())
+    assert verdict["ok"] is False
+    assert [s["ok"] for s in verdict["scenarios"]] == [True, False]
+
+
+def test_chaos_driver_rejects_unknown_scenario(tmp_path):
+    mod = _load_chaos_mod()
+    assert mod.main(["--scenario", "nope"]) == 2
+    with pytest.raises(ValueError, match="unknown scenario"):
+        sc.run_scenario("nope", str(tmp_path))
+
+
+def test_registry_names_the_five_full_scenarios():
+    assert set(sc.DEFAULT_SCENARIOS) == {
+        "wedge", "crash_replay", "partition_heal", "double_sign",
+        "valset_rotation_blocksync",
+    }
+    assert set(sc.DEFAULT_SCENARIOS) | {"wedge_smoke"} == set(sc.SCENARIOS)
+
+
+# ------------------------------------------------------------ slow tier
+
+
+@pytest.mark.slow
+def test_scenario_wedge(tmp_path):
+    res = sc.run_scenario("wedge", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+
+
+@pytest.mark.slow
+def test_scenario_crash_replay(tmp_path):
+    res = sc.run_scenario("crash_replay", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+
+
+@pytest.mark.slow
+def test_scenario_partition_heal(tmp_path):
+    res = sc.run_scenario("partition_heal", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+
+
+@pytest.mark.slow
+def test_scenario_double_sign(tmp_path):
+    res = sc.run_scenario("double_sign", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+
+
+@pytest.mark.slow
+def test_scenario_valset_rotation_blocksync(tmp_path):
+    res = sc.run_scenario("valset_rotation_blocksync", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)

@@ -287,7 +287,11 @@ def test_empty_submit_resolves_immediately(svc):
 
 
 def test_dispatch_error_fails_tickets_not_service(svc):
-    s = svc(deadlines_ms={k: 0 for k in Klass})
+    """With failover OFF (the pre-failover contract), a dispatch error
+    fails the tickets; the scheduler itself survives.  The failover-ON
+    behavior (host re-verify, identical verdicts) is pinned in
+    tests/test_failover.py."""
+    s = svc(deadlines_ms={k: 0 for k in Klass}, failover=False)
 
     def boom(mode):
         raise RuntimeError("no backend")
