@@ -80,6 +80,16 @@ class Kernel:
                     with needs_mesh they are bound onto the factory call.
     needs_mesh    : build a 1-device CPU mesh and call fn as a factory.
     mesh_static   : extra factory positionals after the mesh.
+    max_eqns      : compile-cost budget — hard ceiling on the traced
+                    jaxpr's total equation count (nested bodies
+                    included).  EVERY production kernel must declare a
+                    positive budget (kernelcheck fails the manifest
+                    otherwise): the old ``comb_build_a_tables`` rode
+                    unbudgeted past the PR-6 gate straight into a 2m34s
+                    XLA compile (MULTICHIP_r05); that grandfather clause
+                    is gone.  Budgets are measured counts plus ~30%
+                    headroom — an unrolled-loop blowup fails in
+                    milliseconds, an innocuous +1 eqn does not.
     """
 
     name: str
@@ -89,6 +99,7 @@ class Kernel:
     static_kwargs: tuple[tuple[str, object], ...] = ()
     needs_mesh: bool = False
     mesh_static: tuple = ()
+    max_eqns: int = 0  # fixture rows may omit; production rows may not
 
 
 _TABLES = i32(64, 9, 3, 22, V)  # ops/comb.py layout: validator axis minor
@@ -98,10 +109,14 @@ _B_TABLES = f32(22, 66, 4096)  # shared radix-4096 base-point comb
 KERNELS: tuple[Kernel, ...] = (
     # ---- ops/comb.py — the validator-set fast path
     Kernel(
+        # scan-rolled since PR 11 (measured 25,359 eqns; the unrolled
+        # pre-rework build was ~84k and compiled for 2m34s) — this budget
+        # is the deleted grandfather clause
         name="comb_build_a_tables",
         fn="cometbft_tpu.ops.comb:build_a_tables",
         args=(u8(V, 32),),
         out=(_TABLES, boolean(V)),
+        max_eqns=32_000,
     ),
     Kernel(
         name="comb_verify_cached_tree",
@@ -109,6 +124,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(_TABLES, boolean(V), u8(V, 32), u8(V, 32), u8(V, 64), _B_TABLES),
         out=(boolean(V),),
         static_kwargs=(("tree", True),),
+        max_eqns=50_000,  # measured 38,618
     ),
     Kernel(
         # the sequential cross-check path must stay pinned too: it is the
@@ -118,6 +134,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(_TABLES, boolean(V), u8(V, 32), u8(V, 32), u8(V, 64), _B_TABLES),
         out=(boolean(V),),
         static_kwargs=(("tree", False),),
+        max_eqns=36_000,  # measured 27,633
     ),
     # ---- ops/ed25519.py — the uncached Straus kernel
     Kernel(
@@ -125,6 +142,7 @@ KERNELS: tuple[Kernel, ...] = (
         fn="cometbft_tpu.ops.ed25519:verify_batch",
         args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
         out=(boolean(N),),
+        max_eqns=100_000,  # measured 76,880
     ),
     # ---- ops/sha2.py — challenge hashing + device payload assembly
     Kernel(
@@ -132,18 +150,21 @@ KERNELS: tuple[Kernel, ...] = (
         fn="cometbft_tpu.ops.sha2:sha256_blocks",
         args=(u8(N, 2, 64), i32(N)),
         out=(u8(N, 32),),
+        max_eqns=1_000,  # measured 153
     ),
     Kernel(
         name="sha512_blocks",
         fn="cometbft_tpu.ops.sha2:sha512_blocks",
         args=(u8(N, 2, 128), i32(N)),
         out=(u8(N, 64),),
+        max_eqns=1_000,  # measured 376
     ),
     Kernel(
         name="sha2_parse_verify_payload",
         fn="cometbft_tpu.ops.sha2:parse_verify_payload",
         args=(u8(N, PAYLOAD_W), u8(N, 32)),
         out=(u8(N, 32), u8(N, 32), u8(N, 1, 128), i32(N), boolean(N)),
+        max_eqns=500,  # measured 79
     ),
     # ---- ops/merkle.py — the block-hash pass
     Kernel(
@@ -151,6 +172,7 @@ KERNELS: tuple[Kernel, ...] = (
         fn="cometbft_tpu.ops.merkle:root_from_leaves",
         args=(u8(N, 1, 64), i32(N)),
         out=(u8(32),),
+        max_eqns=2_000,  # measured 628
     ),
     # ---- ops/bls381.py — G1 aggregation (FastAggregateVerify data plane)
     Kernel(
@@ -158,6 +180,7 @@ KERNELS: tuple[Kernel, ...] = (
         fn="cometbft_tpu.ops.bls381:aggregate_g1",
         args=(i32(N, 32), i32(N, 32), i32(N, 32)),
         out=(i32(32), i32(32), i32(32)),
+        max_eqns=18_000,  # measured 12,966
     ),
     # ---- models/comb_verifier.py — cache assembly + the device program
     Kernel(
@@ -170,12 +193,14 @@ KERNELS: tuple[Kernel, ...] = (
         ),
         out=(_TABLES, boolean(V)),
         static_kwargs=(("V", V),),
+        max_eqns=500,  # measured 32
     ),
     Kernel(
         name="comb_device_verify",
         fn="cometbft_tpu.models.comb_verifier:_device_verify",
         args=(_TABLES, boolean(V), u8(V, 32), u8(V, PAYLOAD_W)),
         out=(u8(2),),  # packbits(V=4 lanes) -> 1 byte, + the all-ok byte
+        max_eqns=50_000,  # measured 39,068
     ),
     # ---- parallel/verify.py — the mesh-sharded programs (1-device CPU
     # mesh for the trace; the collective mix is what the fingerprint pins)
@@ -185,6 +210,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
         out=(boolean(), boolean(N)),
         needs_mesh=True,
+        max_eqns=100_000,  # measured 76,888
     ),
     Kernel(
         name="sharded_verify_cached",
@@ -193,6 +219,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(u8(2),),
         needs_mesh=True,
         mesh_static=(True,),  # tree=True, part of the jit cache key
+        max_eqns=50_000,  # measured 39,075
     ),
     Kernel(
         name="sharded_merkle_root",
@@ -200,6 +227,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 1, 64), i32(N)),
         out=(u8(32),),
         needs_mesh=True,
+        max_eqns=2_000,  # measured 633
     ),
 )
 
@@ -239,6 +267,11 @@ JIT_SITES: dict[str, str] = {
 # the hot path a sync is a finding.
 
 COLLECT_BOUNDARIES: dict[str, str] = {
+    "cometbft_tpu/ops/comb.py::build_a_tables_host": (
+        "the host-precomputed A-table build: pure host bigint/numpy by "
+        "design (the compile-free cold-start path); its np.asarray "
+        "normalizes the caller's host pubkey array, never a device fetch"
+    ),
     "cometbft_tpu/ops/bls381.py::aggregate_pubkeys_device": (
         "the BLS host bridge: one blocking fetch of the aggregated point"
     ),
@@ -387,9 +420,16 @@ SHARDED_KERNELS: tuple[ShardedKernel, ...] = (
         # measured 76,888 eqns / loop depth 1 / ~11 KB per device at the
         # 8-lane trace; budgets leave headroom for kernel evolution but
         # fail an unrolled-table-build-class blowup immediately
-        max_eqns=110_000,
+        max_eqns=100_000,
         max_loop_depth=4,
         max_device_bytes=8 << 20,
+        # every argument is a per-call staging transfer, dead after
+        # dispatch — all five donated (PR-11: "finish the set")
+        donate_argnums=(0, 1, 2, 3, 4),
+        entry_donated_params=(
+            ("a_enc", 1), ("r_enc", 2), ("s_bytes", 3),
+            ("msg_blocks", 4), ("msg_active", 5),
+        ),
     ),
     ShardedKernel(
         name="sharded_verify_cached",
@@ -404,13 +444,14 @@ SHARDED_KERNELS: tuple[ShardedKernel, ...] = (
         ),
         out_specs=((),),
         collectives=(("all_gather", 1), ("psum", 1)),
-        # measured 39,074 eqns / loop depth 1 / ~24.9 MB per device at
+        # measured 39,075 eqns / loop depth 1 / ~24.9 MB per device at
         # the 8-lane trace (the replicated radix-4096 basepoint comb is
         # ~23.8 MB on EVERY device — the estimate is dominated by it)
-        max_eqns=60_000,
+        max_eqns=50_000,
         max_loop_depth=4,
         max_device_bytes=48 << 20,
-        # the per-call staging payload is consumed by the dispatch
+        # the per-call staging payload is consumed by the dispatch;
+        # tables/valid/pubs persist in the cache entry — never donated
         donate_argnums=(3,),
         entry_donated_params=(("payload", 4),),  # wrapper: (mesh, t, v, p, payload)
     ),
@@ -426,6 +467,9 @@ SHARDED_KERNELS: tuple[ShardedKernel, ...] = (
         max_eqns=2_000,
         max_loop_depth=4,
         max_device_bytes=1 << 20,
+        # per-call leaf staging transfers, dead after dispatch
+        donate_argnums=(0, 1),
+        entry_donated_params=(("leaf_blocks", 1), ("leaf_active", 2)),
     ),
 )
 

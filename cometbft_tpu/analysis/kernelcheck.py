@@ -117,6 +117,7 @@ class Trace:
     signature: str  # "(in avals) -> (out avals)"
     primitives: dict[str, int]
     findings: list[Finding] = field(default_factory=list)
+    eqns: int = 0  # total jaxpr equations, nested bodies included
 
     def fingerprint(self) -> dict:
         payload = {
@@ -126,7 +127,10 @@ class Trace:
         digest = hashlib.sha256(
             json.dumps(payload, sort_keys=True).encode()
         ).hexdigest()
-        return {**payload, "digest": digest}
+        # eqns ride along for operators reading the golden but stay out
+        # of the digest: they are budget-gated (manifest max_eqns hard
+        # ceilings), not drift-gated — the shardcheck "costs" policy
+        return {**payload, "digest": digest, "costs": {"eqns": self.eqns}}
 
 
 def _resolve(kernel: manifest.Kernel):
@@ -233,8 +237,10 @@ def trace_kernel(kernel: manifest.Kernel) -> Trace:
         )
 
     prims: dict[str, int] = {}
+    total_eqns = 0
     for jaxpr in _walk_jaxprs(closed.jaxpr):
         for eqn in jaxpr.eqns:
+            total_eqns += 1
             name = eqn.primitive.name
             prims[name] = prims.get(name, 0) + 1
 
@@ -277,7 +283,22 @@ def trace_kernel(kernel: manifest.Kernel) -> Trace:
                         "(np.float32(...)/jnp.float32(...)) so promotion "
                         "cannot drift"
                     )
-    return Trace(kernel, signature, prims, findings)
+
+    # compile-cost budget: the static face of a minutes-long XLA compile
+    # (the pre-PR-11 comb table build hit 2m34s at ~84k eqns).  A kernel
+    # with no declared budget skips the gate here but fails the manifest
+    # consistency pass below — no production kernel rides unbudgeted.
+    if kernel.max_eqns > 0 and total_eqns > kernel.max_eqns:
+        add(
+            f"compile-cost budget: {total_eqns} jaxpr equations exceeds "
+            f"the budget of {kernel.max_eqns} "
+            f"({total_eqns - kernel.max_eqns:+d}) — an unrolled loop or "
+            "table build lands here in milliseconds instead of as a "
+            "minutes-long XLA compile; restructure the kernel (roll the "
+            "loop with lax.scan / precompute host-side) or raise the "
+            "budget with justification"
+        )
+    return Trace(kernel, signature, prims, findings, total_eqns)
 
 
 # -------------------------------------------------------------- drift gate
@@ -358,7 +379,10 @@ def compare_fingerprints(
 
 
 def _manifest_findings() -> list[Finding]:
-    """Internal consistency: every JIT_SITES value must name a kernel."""
+    """Internal consistency: every JIT_SITES value must name a kernel,
+    and every kernel must carry a positive compile-cost budget — the
+    grandfather clause that let ``comb_build_a_tables`` ride unbudgeted
+    into a 2m34s XLA compile is deleted."""
     findings: list[Finding] = []
     names = manifest.by_name()
     for site, kernel in manifest.JIT_SITES.items():
@@ -367,6 +391,15 @@ def _manifest_findings() -> list[Finding]:
                 "kernel-manifest",
                 "cometbft_tpu/analysis/kernel_manifest.py", 1, 0,
                 f"JIT_SITES[{site!r}] names unknown kernel {kernel!r}",
+            ))
+    for k in manifest.KERNELS:
+        if k.max_eqns <= 0:
+            findings.append(Finding(
+                "kernel-manifest",
+                "cometbft_tpu/analysis/kernel_manifest.py", 1, 0,
+                f"kernel {k.name!r} declares no compile-cost budget "
+                "(max_eqns) — unbudgeted kernels are how multi-minute "
+                "XLA compiles land; declare a measured ceiling",
             ))
     return findings
 
@@ -431,6 +464,13 @@ def summary(findings: list[Finding], traces: list[Trace]) -> dict:
         "primitive_total": sum(
             sum(t.primitives.values()) for t in traces
         ),
+        # per-kernel eqn counts next to their budgets: the acceptance
+        # surface for "the table path fits the budget" on backend-less
+        # rounds (bench.py embeds this summary)
+        "eqns": {
+            t.kernel.name: {"eqns": t.eqns, "max_eqns": t.kernel.max_eqns}
+            for t in traces
+        },
         "findings": [
             {"check": f.check, "path": f.path, "message": f.message}
             for f in findings

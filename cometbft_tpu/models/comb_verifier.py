@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time as _time
 from collections import OrderedDict
 
 import numpy as np
@@ -258,10 +259,7 @@ class ValsetCombCache:
     def _build(
         pubkeys: list[bytes], base: _CacheEntry | None = None
     ) -> _CacheEntry:
-        import jax
         import jax.numpy as jnp
-
-        from ..ops import comb
 
         mesh = active_mesh()
         index = {pk: i for i, pk in enumerate(pubkeys)}
@@ -283,7 +281,7 @@ class ValsetCombCache:
                     reuse.append((i, j))
         pub_arr = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(-1, 32)
         if base is None or not reuse:
-            tables, valid = comb.build_a_tables_jit(jnp.asarray(pub_arr))
+            tables, valid = _build_tables(pub_arr)
             return _finish_entry(tables, valid, pub_arr, index, mesh)
 
         # Incremental churn: gather unchanged rows from the previous set's
@@ -301,7 +299,8 @@ class ValsetCombCache:
             padded = [pubkeys[i] for i in fresh]
             padded += [padded[0]] * (bucket - len(fresh))
             a = np.frombuffer(b"".join(padded), dtype=np.uint8).reshape(-1, 32)
-            t_new, v_new = comb.build_a_tables_jit(jnp.asarray(a))
+            t_new, v_new = _build_tables(a)
+            t_new, v_new = jnp.asarray(t_new), jnp.asarray(v_new)
         else:
             t_new = base.tables[..., :0]
             v_new = base.valid[:0]
@@ -318,9 +317,58 @@ class ValsetCombCache:
         return _finish_entry(tables, valid, pub_arr, index, mesh)
 
 
+def _build_tables(pub_arr: np.ndarray):
+    """One table build, routed: sets up to COMETBFT_TPU_COMB_HOST_BUILD_MAX
+    validators (or churn buckets that size) are precomputed on HOST
+    (ops/comb.build_a_tables_host — exact bigint, bit-identical, ~10 ms
+    per validator, NO XLA program, so a cold pod never pays the
+    table-build compile); bigger builds run the scan-rolled jitted
+    kernel (ops/comb.build_a_tables_jit), whose compile the persistent
+    XLA cache amortizes and whose arithmetic the device wins at scale.
+    Returns (tables, valid) — host numpy or device arrays; callers
+    device_put with their placement (_finish_entry).
+
+    The default threshold (2048) matches COMETBFT_TPU_COMB_ASYNC_MIN:
+    foreground builds stay host/compile-free, while the giant sets that
+    would be slow on host already build in the background behind the
+    uncached fallback (ensure_async)."""
+    from ..ops import comb
+    from ..utils import envknobs
+
+    lim = envknobs.get_int(envknobs.COMB_HOST_BUILD_MAX)
+    t0 = _time.perf_counter()
+    if 0 < pub_arr.shape[0] <= lim:
+        with tracing.span(
+            "verify.table_build", {"backend": "host"} if tracing.enabled() else None
+        ):
+            out = comb.build_a_tables_host(pub_arr)
+        _mhub().verify_phase_seconds.observe(
+            _time.perf_counter() - t0, phase="table_build_host"
+        )
+        return out
+    import jax.numpy as jnp
+
+    with tracing.span(
+        "verify.table_build", {"backend": "device"} if tracing.enabled() else None
+    ):
+        out = comb.build_a_tables_jit(jnp.asarray(pub_arr))
+        # the jit dispatch is async: wait for the arithmetic so the
+        # phase is the COMPLETED build (the host counterpart measures
+        # completed work; comparing the two is this split's purpose)
+        out[0].block_until_ready()
+    _mhub().verify_phase_seconds.observe(
+        _time.perf_counter() - t0, phase="table_build_device"
+    )
+    return out
+
+
 def _finish_entry(tables, valid, pub_arr, index, mesh) -> _CacheEntry:
     """Place the built tables: sharded over the mesh's lane axis when the
-    multi-chip path is active, resident on the default device otherwise."""
+    multi-chip path is active, resident on the default device otherwise.
+    ``tables``/``valid`` may be host numpy (the precomputed path) or
+    device arrays (the jitted build) — ``device_put`` with the explicit
+    ``NamedSharding`` covers both, landing host tables directly in their
+    sharded layout with no resharding copy."""
     import jax
 
     if mesh is not None:
@@ -333,6 +381,8 @@ def _finish_entry(tables, valid, pub_arr, index, mesh) -> _CacheEntry:
         valid = jax.device_put(valid, NamedSharding(mesh, P(axis)))
         pubs = jax.device_put(pub_arr, NamedSharding(mesh, P(axis, None)))
     else:
+        tables = jax.device_put(tables)
+        valid = jax.device_put(valid)
         pubs = jax.device_put(pub_arr)
     tables.block_until_ready()
     return _CacheEntry(tables, valid, pubs, index, mesh)

@@ -32,6 +32,8 @@ both the uncached kernel and the host verifier.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -60,34 +62,53 @@ def build_a_tables(a_enc):
     Runs once per validator set.  Signed-digit comb: only entries
     j = 0..8 are stored (the lookup negates for digits < 0), halving
     both the HBM footprint and the per-position build work vs a 0..15
-    table.  Entries come from a double/add chain (4 doubles + 3 adds
-    per position; 16*P for the next position is one more double of the
+    table.  Entries come from a scanned sequential-add chain
+    (j*P = (j-1)*P + P; 16*P for the next position is one double of the
     8*P entry).  Entries are normalized to affine with a two-level
     Montgomery batch inversion (3 muls/entry amortized instead of a
     ~265-mul chain each), so the per-verify additions are the cheap
     7-multiply add_niels.
 
     Manifest kernel ``comb_build_a_tables``: shape/dtype/jaxpr contract
-    enforced by analysis/kernelcheck.
+    enforced by analysis/kernelcheck, INCLUDING the compile-cost budget
+    (``max_eqns``) every kernel now carries — the normalize pass is
+    scan-rolled so the jaxpr stays thousands of equations, not the
+    ~85k-equation unrolled build whose XLA compile ran 2m34s
+    (MULTICHIP_r05).  Output limbs are FROZEN canonical, bit-identical
+    to :func:`build_a_tables_host` (the compile-free production path).
     """
     pt, valid = E.decompress(a_enc)
+    # Invalid encodings are sanitized to the identity BEFORE the chain.
+    # Their table rows are never consulted (``a_valid`` masks the
+    # verdict), but the garbage off-curve coordinates used to flow into
+    # the shared Montgomery batch inversion below — where an
+    # attacker-chosen encoding whose chain hits Z ≡ 0 (mod p) would
+    # corrupt every VALID validator's inverse through the shared prefix
+    # product.  Identity rows keep every Z nonzero (complete formulas on
+    # curve points) and make the host build trivially bit-identical on
+    # invalid rows too.
+    pt = E.select(valid, pt, E.identity((a_enc.shape[0],)))
     p0 = E.neg(pt)  # tables hold multiples of -A
     V = a_enc.shape[0]
 
     def position_entries(p):
         """[0..8]*p as stacked extended coords (9, 22, V) per coord,
-        plus 16*p for the next position."""
-        e2 = E.double(p)
-        e3 = E.add(e2, p)
-        e4 = E.double(e2)
-        e5 = E.add(e4, p)
-        e6 = E.double(e3)
-        e7 = E.add(e6, p)
-        e8 = E.double(e4)
-        entries = [E.identity((V,)), p, e2, e3, e4, e5, e6, e7, e8]
-        p16 = E.double(e8)
-        stack = lambda c: jnp.stack([getattr(e, c) for e in entries])
-        return stack("x"), stack("y"), stack("z"), stack("t"), p16
+        plus 16*p for the next position.  The entry chain is a scanned
+        sequential add (j*p = (j-1)*p + p) — one rolled add body instead
+        of an unrolled double/add ladder, for the compile-cost budget;
+        affine output is identical (representatives differ, the final
+        canonical freeze does not)."""
+
+        def astep(acc, _):
+            nxt = E.add(acc, p)
+            return nxt, nxt
+
+        e8, rest = lax.scan(astep, p, None, length=NENT_A - 2)  # 2p..8p
+        ident = E.identity((V,))
+        stack = lambda c: jnp.concatenate(
+            [getattr(ident, c)[None], getattr(p, c)[None], getattr(rest, c)]
+        )
+        return stack("x"), stack("y"), stack("z"), stack("t"), E.double(e8)
 
     def body(i, carry):
         p, tx, ty, tz, tt = carry
@@ -109,67 +130,216 @@ def build_a_tables(a_enc):
 
 
 _BUILD_A_JIT = None
+_BUILD_A_MTX = threading.Lock()
 
 
 def build_a_tables_jit(a_enc):
     """Process-wide jitted build_a_tables so every call site (cache build,
-    incremental churn, benches) shares one compiled program per shape."""
+    incremental churn, benches) shares one compiled program per shape.
+
+    Publication is lock-guarded (the parallel/verify._publish_program
+    discipline): two threads racing the first verify used to each
+    install their OWN ``jax.jit`` wrapper here, guaranteeing two traces
+    (and two multi-minute XLA compiles before the scan-rolled rework) of
+    the same table build.  The dispatch itself runs outside the lock."""
     global _BUILD_A_JIT
-    if _BUILD_A_JIT is None:
-        _BUILD_A_JIT = jax.jit(build_a_tables)
-    return _BUILD_A_JIT(a_enc)
+    fn = _BUILD_A_JIT
+    if fn is None:
+        with _BUILD_A_MTX:
+            if _BUILD_A_JIT is None:
+                _BUILD_A_JIT = jax.jit(build_a_tables)
+            fn = _BUILD_A_JIT
+    return fn(a_enc)
 
 
 def _normalize_to_niels(tx, ty, tz):
     """Extended (pos, ent, 22, V) coords -> stacked affine Niels
-    (3, pos, ent, 22, V): (y+x, y-x, 2dxy).
+    (3, pos, ent, 22, V): (y+x, y-x, 2dxy), limbs FROZEN canonical.
 
     Batch inversion: Montgomery's trick over the entry axis, then over the
-    position axis, so only (V,) values go through the full inversion chain.
-    Zero Z never occurs (Z=2 after add, Z>0 always on this curve's
-    complete formulas), except entry 0 (identity, Z=1) — safe.
+    position axis, so only (22, V) values go through the full inversion
+    chain.  Zero Z never occurs (Z=2 after add, Z>0 always on this
+    curve's complete formulas; invalid rows are sanitized to identity
+    chains before this runs), except entry 0 (identity, Z=1) — safe.
+
+    Every prefix/unwind pass is a ``lax.scan`` — the pre-PR-11 Python
+    loops unrolled ~460 field multiplies into ~85k flat jaxpr equations,
+    the direct cause of the 2m34s ``jit_build_a_tables`` XLA compile.
+    The scans compute the SAME products in the same order; the final
+    :func:`ops.field.freeze` canonicalizes the limb representation, so
+    the restructure is invisible downstream and the device tables agree
+    bit-for-bit with the host-precomputed ones
+    (:func:`build_a_tables_host`).
     """
-    # level 1: prefix products over the 16-entry axis (batched over pos)
-    prefix1 = [tz[:, 0]]
-    for j in range(1, NENT_A):
-        prefix1.append(F.mul(prefix1[-1], tz[:, j]))
+
+    def mul_carry(c, z):
+        p = F.mul(c, z)
+        return p, p
+
+    def unwind(running, xs):
+        pref_prev, z = xs
+        return F.mul(running, z), F.mul(running, pref_prev)
+
+    # level 1: prefix products over the entry axis (batched over pos)
+    zs = jnp.moveaxis(tz, 1, 0)  # (ent, pos, 22, V)
+    _, pref1_rest = lax.scan(mul_carry, zs[0], zs[1:])
+    prefix1 = jnp.concatenate([zs[:1], pref1_rest], axis=0)
     tot1 = prefix1[-1]  # (pos, 22, V)
 
-    # level 2: prefix products over the 64-position axis
-    prefix2 = [tot1[0]]
-    for i in range(1, NPOS_A):
-        prefix2.append(F.mul(prefix2[-1], tot1[i]))
-    tot2 = prefix2[-1]  # (22, V)
+    # level 2: prefix products over the position axis
+    _, pref2_rest = lax.scan(mul_carry, tot1[0], tot1[1:])
+    prefix2 = jnp.concatenate([tot1[:1], pref2_rest], axis=0)
 
-    inv_tot2 = F.invert(tot2)
+    inv_tot2 = F.invert(prefix2[-1])  # (22, V)
 
-    # unwind level 2: inv_tot1[i] = inverse of tot1[i]
-    inv_tot1 = [None] * NPOS_A
-    running = inv_tot2
-    for i in range(NPOS_A - 1, 0, -1):
-        inv_tot1[i] = F.mul(running, prefix2[i - 1])
-        running = F.mul(running, tot1[i])
-    inv_tot1[0] = running
+    # unwind level 2: inv_tot1[i] = inverse of tot1[i] (reverse scan over
+    # positions NPOS_A-1 .. 1; outputs land at their original indices)
+    running, inv1_rest = lax.scan(
+        unwind, inv_tot2, (prefix2[:-1], tot1[1:]), reverse=True
+    )
+    inv_tot1 = jnp.concatenate([running[None], inv1_rest], axis=0)
 
     # unwind level 1: entry-axis inverses, batched over all positions
-    run = jnp.stack(inv_tot1)  # (pos, 22, V)
-    inv_z = jnp.zeros_like(tz)
-    for j in range(NENT_A - 1, 0, -1):
-        inv_z = inv_z.at[:, j].set(F.mul(run, prefix1[j - 1]))
-        run = F.mul(run, tz[:, j])
-    inv_z = inv_z.at[:, 0].set(run)
+    run, invz_rest = lax.scan(
+        unwind, inv_tot1, (prefix1[:-1], zs[1:]), reverse=True
+    )
+    inv_z = jnp.moveaxis(
+        jnp.concatenate([run[None], invz_rest], axis=0), 0, 1
+    )  # (pos, ent, 22, V)
 
     x = F.mul(tx, inv_z)
     y = F.mul(ty, inv_z)
     xy = F.mul(x, y)
-    return jnp.stack(
-        [F.add(y, x), F.sub(y, x), F.mul(xy, jnp.asarray(_D2_C))]
+    return F.freeze(
+        jnp.stack([F.add(y, x), F.sub(y, x), F.mul(xy, jnp.asarray(_D2_C))])
     )
+
+
+# ------------------------------------------- host A-table precomputation
+
+
+def _host_decompress_zip215(pk: bytes):
+    """ZIP-215 decompression on host ints with EXACTLY the device
+    kernel's semantics (ops/ed25519.decompress): non-canonical y
+    accepted, x = 0 with sign 1 accepted, validity = the on-curve check.
+    Returns ((x, y, 1, x*y) extended coords, ok)."""
+    P = ref.P
+    enc = int.from_bytes(pk, "little")
+    sign = (enc >> 255) & 1
+    y = (enc & ((1 << 255) - 1)) % P
+    u = (y * y - 1) % P
+    v = (ref.D * y % P * y + 1) % P
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vxx = v * x % P * x % P
+    flipped = vxx == (P - u) % P
+    ok = vxx == u or flipped
+    if flipped:
+        x = x * ref.SQRT_M1 % P
+    if (x & 1) != sign:
+        x = (P - x) % P
+    return (x, y, 1, x * y % P), ok
+
+
+def build_a_tables_host(a_enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-precomputed A-tables: exact-bigint build of the same
+    (64, 9, 3, 22, V) int32 tables + (V,) valid that
+    :func:`build_a_tables` produces — bit-identical (the device path
+    freezes its output to canonical limbs; affine coordinates are
+    projective invariants, so both paths land on the same canonical
+    field elements), with NO XLA program anywhere.
+
+    This is the cold-start fix of ROADMAP item 1: the jitted build's
+    XLA compile ran 2m34s (MULTICHIP_r05) before the scan-rolled
+    rework, and even compile-cached it costs a device round trip per
+    new shape.  The host build is pure Python/NumPy — a few ms per
+    validator — and its output is ``device_put`` with the entry's
+    ``NamedSharding`` (models/comb_verifier._finish_entry), so the
+    tables land already sharded over the mesh without tracing anything.
+    models/comb_verifier routes builds of up to
+    ``COMETBFT_TPU_COMB_HOST_BUILD_MAX`` validators here; the jitted
+    kernel remains for bigger sets and as the bit-exactness witness
+    (tests/test_comb_hostbuild.py).
+
+    Invalid pubkey rows build from the identity, mirroring the device
+    kernel's sanitization (their rows are masked by ``valid``
+    downstream).
+    """
+    P = ref.P
+    a_enc = np.ascontiguousarray(np.asarray(a_enc, dtype=np.uint8))
+    V = int(a_enc.shape[0])
+    valid = np.zeros((V,), dtype=bool)
+    p0: list[tuple] = []
+    for vrow in range(V):
+        pt, ok = _host_decompress_zip215(a_enc[vrow].tobytes())
+        valid[vrow] = ok
+        p0.append(ref.pt_neg(pt) if ok else ref.IDENT)
+
+    # entries[i][j][v] = j * 16^i * (-A_v) in extended coords
+    ext: list[list[list[tuple]]] = [
+        [[None] * V for _ in range(NENT_A)] for _ in range(NPOS_A)
+    ]
+    for vrow in range(V):
+        base = p0[vrow]
+        for i in range(NPOS_A):
+            row = ext[i]
+            row[0][vrow] = ref.IDENT
+            acc = base
+            row[1][vrow] = acc
+            for j in range(2, NENT_A):
+                acc = ref.pt_add(acc, base)
+                row[j][vrow] = acc
+            for _ in range(4):
+                base = ref.pt_add(base, base)
+
+    # one flat Montgomery batch inversion over every Z (all nonzero:
+    # identity Z=1, on-curve chains Z != 0 by completeness)
+    flat = [p for row in ext for col in row for p in col]
+    prefix = [1]
+    for p in flat:
+        prefix.append(prefix[-1] * p[2] % P)
+    inv = pow(prefix[-1], P - 2, P)
+    inv_z = [0] * len(flat)
+    for k in range(len(flat) - 1, -1, -1):
+        inv_z[k] = inv * prefix[k] % P
+        inv = inv * flat[k][2] % P
+
+    # canonical Niels values, serialized LE then decoded to limbs in one
+    # vectorized pass (33 bytes cover the 22x12-bit limb span)
+    buf = bytearray()
+    k = 0
+    for i in range(NPOS_A):
+        for j in range(NENT_A):
+            vals = [bytearray(), bytearray(), bytearray()]
+            for vrow in range(V):
+                X, Y, _, _ = ext[i][j][vrow]
+                iz = inv_z[k]
+                k += 1
+                x = X * iz % P
+                y = Y * iz % P
+                vals[0] += ((y + x) % P).to_bytes(33, "little")
+                vals[1] += ((y - x) % P).to_bytes(33, "little")
+                vals[2] += (x * y % P * ref.D2 % P).to_bytes(33, "little")
+            for c in vals:
+                buf += c
+    raw = np.frombuffer(bytes(buf), dtype=np.uint8).reshape(
+        NPOS_A, NENT_A, 3, V, 33
+    )
+    bits = np.unpackbits(raw, axis=-1, bitorder="little")  # (..., V, 264)
+    limbs = bits.reshape(NPOS_A, NENT_A, 3, V, F.NLIMBS, F.BITS).astype(
+        np.int32
+    )
+    limbs = (limbs * (1 << np.arange(F.BITS, dtype=np.int32))).sum(axis=-1)
+    # (pos, ent, 3, V, 22) -> (pos, ent, 3, 22, V)
+    tables = np.ascontiguousarray(
+        limbs.transpose(0, 1, 2, 4, 3), dtype=np.int32
+    )
+    return tables, valid
 
 
 # --------------------------------------------------- B-table construction
 
 _B_TABLES = None  # device (NPOS_B, 66, NENT_B) f32, built lazily
+_B_TABLES_MTX = threading.Lock()
 
 
 def build_b_tables() -> np.ndarray:
@@ -227,12 +397,16 @@ def build_b_tables() -> np.ndarray:
 def get_b_tables():
     global _B_TABLES
     if _B_TABLES is None:
-        # the device constant is cached process-wide, so it must never be
-        # born inside somebody's jit trace (a stored tracer poisons every
-        # later program); force eager creation even when first called
-        # under tracing
-        with jax.ensure_compile_time_eval():
-            _B_TABLES = jnp.asarray(_b_tables_cached())
+        # publish under a lock (same discipline as build_a_tables_jit):
+        # two first-verify threads would otherwise both run the ~2s host
+        # build and the 24 MB transfer.  The device constant is cached
+        # process-wide, so it must never be born inside somebody's jit
+        # trace (a stored tracer poisons every later program); force
+        # eager creation even when first called under tracing.
+        with _B_TABLES_MTX:
+            if _B_TABLES is None:
+                with jax.ensure_compile_time_eval():
+                    _B_TABLES = jnp.asarray(_b_tables_cached())
     return _B_TABLES
 
 

@@ -21,7 +21,7 @@ import threading
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.7 promotes shard_map out of experimental
     from jax import shard_map as _shard_map
@@ -74,11 +74,23 @@ def _verify_fn(mesh: Mesh):
     persistent compile cache (this made the un-jitted path effectively
     un-runnable on the CPU backend).
 
+    The jit carries EXPLICIT ``in_shardings``/``out_shardings`` matching
+    the shard_map specs: a host batch lands directly in its sharded
+    layout (one scatter-free transfer per device), an already-sharded
+    device buffer is consumed in place, and a mislaid input can never
+    silently reshard at the pjit boundary — the stage-handoff contract
+    of docs/sharding_contracts.md.  Every argument is a per-call staging
+    transfer, dead after dispatch, so ALL FIVE are donated (the device
+    may reuse their HBM for outputs); callers must pass fresh arrays and
+    never read them after the call (``donated-read-after-dispatch``
+    enforces this statically at declared entrypoints).
+
     Manifest kernel ``sharded_verify_batch``: the contract checker calls
     this factory with a 1-device CPU mesh and pins the traced program
     (the collective mix — psum/all_gather — is part of the fingerprint);
     analysis/shardcheck.py re-traces it under a real 8-way CPU mesh and
-    holds it to the declared shardings/collective census/budgets.
+    holds it to the declared shardings/collective census/budgets,
+    including the donation vector.
     """
     key = ("verify_batch", mesh_cache_key(mesh))
     cached = _cached_program(key)
@@ -93,13 +105,18 @@ def _verify_fn(mesh: Mesh):
         all_ok = jax.lax.all_gather(ok, axis, tiled=True)
         return total_bad == 0, all_ok
 
+    row = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
     fn = jax.jit(
         shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
             out_specs=(P(), P()),
-        )
+        ),
+        in_shardings=(row, row, row, row, row),
+        out_shardings=(repl, repl),
+        donate_argnums=(0, 1, 2, 3, 4),
     )
     return _publish_program(key, fn)
 
@@ -109,6 +126,11 @@ def sharded_verify_batch(mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_acti
 
     Returns (all_valid: bool scalar, valid: (N,) bool fully replicated).
     N must be divisible by the mesh size (callers pad to bucket sizes).
+
+    ALL FIVE arrays are DONATED to the device program (each is a fresh
+    per-call staging transfer): pass fresh arrays and never read them
+    after this returns — the ``donated-read-after-dispatch`` check
+    enforces it statically at call sites of this entrypoint.
     """
     with tracing.span(
         "verify.shard_dispatch",
@@ -180,6 +202,18 @@ def _comb_verify_fn(mesh: Mesh, tree: bool):
             ),
             out_specs=P(),
         ),
+        # explicit shardings = the stage-handoff contract: the cache
+        # entry's device-resident tables/valid/pubs (placed by
+        # _finish_entry with these exact NamedShardings) are consumed in
+        # place — no resharding copy at the pjit boundary — and the
+        # host-staged payload transfers straight into its row layout
+        in_shardings=(
+            NamedSharding(mesh, P(None, None, None, None, axis)),
+            NamedSharding(mesh, P(axis)),
+            NamedSharding(mesh, P(axis, None)),
+            NamedSharding(mesh, P(axis, None)),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
         # the payload is a per-call staging transfer, dead after dispatch
         donate_argnums=(3,),
     )
@@ -216,7 +250,9 @@ def sharded_verify_cached(mesh: Mesh, tables, valid, pubs, payload):
 
 
 def _merkle_fn(mesh: Mesh):
-    # Manifest kernel ``sharded_merkle_root``.
+    # Manifest kernel ``sharded_merkle_root``.  Explicit shardings +
+    # donation like the verify stages: the leaf blocks are a per-call
+    # staging transfer, dead after dispatch.
     key = ("merkle_root", mesh_cache_key(mesh))
     cached = _cached_program(key)
     if cached is not None:
@@ -228,13 +264,17 @@ def _merkle_fn(mesh: Mesh):
         roots = jax.lax.all_gather(sub, axis)  # (D, 32)
         return M.root_from_leaf_hashes(roots)
 
+    row = NamedSharding(mesh, P(axis))
     fn = jax.jit(
         shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=P(),
-        )
+        ),
+        in_shardings=(row, row),
+        out_shardings=NamedSharding(mesh, P()),
+        donate_argnums=(0, 1),
     )
     return _publish_program(key, fn)
 
@@ -246,6 +286,9 @@ def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):
     subtree roots are all_gathered and folded on every device (replicated
     result).  Exactly the reference's power-of-two split (tree.go:101)
     when n/D is a power of two — which callers guarantee by padding.
+
+    Both arrays are DONATED (per-call staging transfers): pass fresh
+    arrays and never read them after this returns.
     """
     return _merkle_fn(mesh)(leaf_blocks, leaf_active)
 

@@ -64,6 +64,15 @@ COMB_ASYNC_MIN = _declare(
     "Set size at/above which a missing comb table builds in the background "
     "while verification proceeds through the uncached kernel.",
 )
+COMB_HOST_BUILD_MAX = _declare(
+    "COMETBFT_TPU_COMB_HOST_BUILD_MAX", "int", 2048,
+    "Largest validator-set (or churn-bucket) size whose comb A-tables "
+    "are precomputed on HOST (exact bigint, bit-identical to the jitted "
+    "kernel, ~10 ms/validator, NO XLA program) and `device_put` straight "
+    "into their sharded layout — a cold pod never pays the table-build "
+    "compile.  Bigger builds use the scan-rolled jitted kernel (persistent "
+    "compile cache amortizes it).  0 = always the device kernel.",
+)
 COMB_TREE = _declare(
     "COMETBFT_TPU_COMB_TREE", "bool", True,
     "`0` selects the sequential fori_loop comb accumulation (the bit-exact "

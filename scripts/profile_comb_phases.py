@@ -27,16 +27,47 @@ rng = np.random.default_rng(7)
 keys = [host.PrivKey.from_seed(rng.bytes(32)) for _ in range(V)]
 pubs = [k.pub_key().data for k in keys]
 
+# ---- table_build phase: the cold-start cost (PR-11), attributable per
+# sub-phase.  COMBPROF_TABLE_BUILD=host|device|both|skip (default: host
+# at small V, device at large V — the models/comb_verifier routing).
+# host  = build_a_tables_host (bigint precompute) + device_put H2D
+# device = build_a_tables_jit (compile + arithmetic; the compile half
+#          vanishes with a warm COMETBFT_TPU_COMPILE_CACHE)
+_tb_mode = os.environ.get("COMBPROF_TABLE_BUILD", "")
+if not _tb_mode:
+    _tb_mode = "host" if V <= 2048 else "device"
+a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(-1, 32)
+tables = valid = None
+if _tb_mode in ("host", "both"):
+    t0 = time.time()
+    th, vh = comb.build_a_tables_host(a)
+    t1 = time.time()
+    tables = jax.device_put(th); valid = jax.device_put(vh)
+    tables.block_until_ready(); valid.block_until_ready()
+    t2 = time.time()
+    print(
+        f"table_build (host): precompute {t1-t0:.1f} s + device_put H2D "
+        f"{t2-t1:.1f} s = {t2-t0:.1f} s  ({(t2-t0)/max(V,1)*1e3:.1f} ms/validator)",
+        flush=True,
+    )
+if _tb_mode in ("device", "both"):
+    t0 = time.time()
+    tables, valid = comb.build_a_tables_jit(jnp.asarray(a))
+    tables.block_until_ready()
+    print(
+        f"table_build (device, compile+run): {time.time()-t0:.1f} s "
+        "(warm COMETBFT_TPU_COMPILE_CACHE removes the compile half)",
+        flush=True,
+    )
 tp, vp = os.path.join(TDIR, f"tablesT{V}.npy"), os.path.join(TDIR, f"validT{V}.npy")
-if os.path.exists(tp) and os.path.exists(vp):
+if tables is None and os.path.exists(tp) and os.path.exists(vp):
     t0=time.time()
     tables = jnp.asarray(np.load(tp, mmap_mode="r"))
     valid = jnp.asarray(np.load(vp))
     tables.block_until_ready()
     print("tables loaded from disk", round(time.time()-t0,1), "s", flush=True)
-else:
+elif tables is None:
     t0=time.time()
-    a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(-1,32)
     tables, valid = comb.build_a_tables_jit(jnp.asarray(a))
     tables.block_until_ready()
     print("tables built", round(time.time()-t0,1), "s", flush=True)

@@ -60,11 +60,17 @@ else:
     _lockwitness = None
 
 # Persistent compilation cache: the Ed25519 kernel takes minutes to compile
-# on the CPU backend; cache compiled executables across test runs.
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# on the CPU backend; cache compiled executables across test runs.  Routed
+# through the production knob helper (utils/compilecache) so
+# COMETBFT_TPU_COMPILE_CACHE still wins — an operator can redirect or
+# isolate the suite's cache without editing this file; the repo-local
+# tests/.jax_cache is only the default.  (Imported after the lockwitness
+# install above, so the helper's module-level locks are witnessed.)
+from cometbft_tpu.utils import compilecache as _compilecache  # noqa: E402
+
+_compilecache.maybe_enable(
+    default_dir=os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
 
 
 import pytest  # noqa: E402

@@ -131,6 +131,35 @@ def make_respec(mesh):
     return _jit_shard(local, mesh, ((),), ((),))
 
 
+def make_pipelined_reshard(mesh):
+    """Two pipelined shard_map stages whose handoff inserts a
+    resharding ``with_sharding_constraint`` — the inter-stage reshard
+    the census must catch (PR-11 regression: the production stage
+    handoff is reshard-free by contract)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cometbft_tpu.parallel.verify import shard_map
+
+    def stage1(x):
+        return x * 2
+
+    def stage2(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    s1 = shard_map(stage1, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS))
+    s2 = shard_map(stage2, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())
+
+    def prog(x):
+        y = s1(x)
+        # the handoff bug under test: the buffer is re-laid-out between
+        # stages instead of staying device-resident in its shard layout
+        y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P()))
+        return s2(y)
+
+    return jax.jit(prog)
+
+
 def make_untraceable(mesh):
     raise RuntimeError("untraceable by design")
 
@@ -172,6 +201,7 @@ BAD_DEPTH = _sk("shardfix_depth")
 BAD_DONATION = _sk("shardfix_donate", donate_argnums=(0,))
 SNEAKY_DONATION = _sk("shardfix_sneaky")
 BAD_SPEC = _sk("shardfix_respec")
+BAD_PIPELINE = _sk("shardfix_pipeline", max_eqns=256)
 UNTRACEABLE = _sk("shardfix_boom")
 
 KERNEL_ROWS: dict[str, manifest.Kernel] = {
@@ -182,6 +212,7 @@ KERNEL_ROWS: dict[str, manifest.Kernel] = {
     "shardfix_donate": _row("shardfix_donate", "make_broken_donation"),
     "shardfix_sneaky": _row("shardfix_sneaky", "make_sneaky_donation"),
     "shardfix_respec": _row("shardfix_respec", "make_respec"),
+    "shardfix_pipeline": _row("shardfix_pipeline", "make_pipelined_reshard"),
     "shardfix_boom": _row("shardfix_boom", "make_untraceable"),
 }
 
@@ -193,4 +224,5 @@ SHARDED_KERNELS: tuple[manifest.ShardedKernel, ...] = (
     BAD_DONATION,
     SNEAKY_DONATION,
     BAD_SPEC,
+    BAD_PIPELINE,
 )

@@ -132,13 +132,13 @@ def test_incremental_churn_builds_only_changed_rows(monkeypatch):
     from cometbft_tpu.models import comb_verifier as cv
 
     built_rows = []
-    real_build = comb.build_a_tables_jit
+    real_build = cv._build_tables  # the host/device routing seam (PR 11)
 
     def spy(a):
         built_rows.append(int(a.shape[0]))
         return real_build(a)
 
-    monkeypatch.setattr(comb, "build_a_tables_jit", spy)
+    monkeypatch.setattr(cv, "_build_tables", spy)
 
     V = 64
     keys = [host.PrivKey.from_seed(bytes([i]) * 32) for i in range(V + V)]

@@ -375,8 +375,12 @@ def _fixture_module():
 
 
 def _kernel(fn, out, name="fix"):
+    # budgeted like every production kernel: tests that swap the
+    # manifest (regenerate/run_check end-to-end) must not trip the
+    # unbudgeted-kernel manifest finding
     return manifest.Kernel(
-        name=name, fn=f"_kc_fixtures:{fn}", args=(manifest.i32(4),), out=out
+        name=name, fn=f"_kc_fixtures:{fn}", args=(manifest.i32(4),), out=out,
+        max_eqns=1_000_000,
     )
 
 

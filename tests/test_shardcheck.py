@@ -60,9 +60,16 @@ def test_sharding_manifest_is_internally_consistent():
     assert set(manifest.sharded_by_name()) == {
         "sharded_verify_batch", "sharded_verify_cached", "sharded_merkle_root",
     }
-    # the donated-entrypoint worklist the AST check consumes
+    # the donated-entrypoint worklist the AST check consumes: since
+    # PR 11 every per-call staging slab of every sharded program is
+    # donated ("finish the set"), not just the comb payload
     assert manifest.donated_entrypoints() == {
+        "sharded_verify_batch": (
+            ("a_enc", 1), ("r_enc", 2), ("s_bytes", 3),
+            ("msg_blocks", 4), ("msg_active", 5),
+        ),
         "sharded_verify_cached": (("payload", 4),),
+        "sharded_merkle_root": (("leaf_blocks", 1), ("leaf_active", 2)),
     }
 
 
@@ -145,6 +152,36 @@ def test_spec_mismatch_is_a_finding():
     msg = findings[0].message
     assert "sharding closure" in msg
     assert "replicated" in msg and "{0:sig}" in msg
+
+
+def test_inter_stage_reshard_trips_census():
+    """PR-11 regression: a pipelined stage handoff that inserts a
+    resharding sharding_constraint is a census finding — the
+    no-reshard stage-handoff contract of docs/sharding_contracts.md."""
+    findings, t = _trace_one(fx.BAD_PIPELINE)
+    msgs = " | ".join(f.message for f in findings)
+    assert "undeclared collective 'sharding_constraint'" in msgs
+    assert t.collectives.get("sharding_constraint", 0) >= 1
+    # the two-stage shape also violates the one-mesh-entry contract
+    assert "shard_map applications in one program" in msgs
+
+
+def test_real_sharded_programs_census_is_reshard_free():
+    """The checked-in goldens carry the production censuses: zero
+    sharding_constraint anywhere — pipelined stages hand off
+    device-resident buffers without a resharding copy — and the
+    donation vectors match the manifest's finished set (PR 11: every
+    per-call staging slab donated).  The slow golden gate proves these
+    goldens match a fresh 8-way trace."""
+    golden = shardcheck.load_fingerprints()
+    by_name = manifest.sharded_by_name()
+    assert set(golden) == set(by_name)
+    for name, fp in golden.items():
+        assert "sharding_constraint" not in fp["collectives"], name
+        assert fp["donated"] == sorted(by_name[name].donate_argnums), name
+    assert golden["sharded_verify_batch"]["donated"] == [0, 1, 2, 3, 4]
+    assert golden["sharded_merkle_root"]["donated"] == [0, 1]
+    assert golden["sharded_verify_cached"]["donated"] == [3]
 
 
 def test_untraceable_fixture_reports_trace_failure_only(tmp_path):
