@@ -64,17 +64,20 @@ def device_capable() -> bool:
 
 
 def create_batch_verifier(
-    key_type: str, pubkeys: list[bytes] | None = None, klass=None
+    key_type: str, pubkeys: list[bytes] | None = None, klass=None,
+    tenant: str | None = None,
 ) -> BatchVerifier:
     """(crypto/batch/batch.go:10)  Device-capable backends return a
     verify-service client (verifysvc.ServiceBatchVerifier) bound to the
-    caller's priority class (default: consensus) — the service owns all
-    batching, scheduling, and device dispatch.  When the caller knows
-    the validator set (pubkeys, in set order), large sets bind to the
-    comb-cached program here, in the caller's thread: tables stay
-    device-resident across calls, keyed by the set (the reference's
-    expanded-key LRU, ed25519.go:43,68, writ large), and a first-sight
-    table build never runs on the shared scheduler thread."""
+    caller's priority class (default: consensus) and tenant (default:
+    this process's COMETBFT_TPU_VERIFYSVC_TENANT — single-chain callers
+    never pass one) — the service owns all batching, scheduling, and
+    device dispatch.  When the caller knows the validator set (pubkeys,
+    in set order), large sets bind to the comb-cached program here, in
+    the caller's thread: tables stay device-resident across calls,
+    keyed by the set (the reference's expanded-key LRU, ed25519.go:43,68,
+    writ large), and a first-sight table build never runs on the shared
+    scheduler thread."""
     if not supports_batch_verifier(key_type):
         raise ValueError(f"no batch verifier for key type {key_type!r}")
     if not device_capable():
@@ -83,5 +86,6 @@ def create_batch_verifier(
     from ..verifysvc.service import Klass
 
     return ServiceBatchVerifier(
-        Klass.CONSENSUS if klass is None else klass, resolve_mode(pubkeys)
+        Klass.CONSENSUS if klass is None else klass, resolve_mode(pubkeys),
+        tenant=tenant,
     )

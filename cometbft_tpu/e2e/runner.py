@@ -37,6 +37,10 @@ class NodeSpec:
     # extra environment for the node process (chaos scenarios set
     # COMETBFT_TPU_FAULT_RPC / COMETBFT_TPU_HEALTH / failover knobs here)
     env: dict[str, str] = field(default_factory=dict)
+    # verify-plane tenant this chain's node claims
+    # (COMETBFT_TPU_VERIFYSVC_TENANT): how process-level chains share a
+    # multi-tenant verify plane; "" keeps the default tenant
+    tenant: str = ""
     # per-link shaping (runner/latency_emulation.go analogue): outbound
     # delay +- jitter applied at this node's sockets (utils/netutil)
     latency_ms: float = 0.0
@@ -265,6 +269,10 @@ class Runner:
             if spec.db_backend:
                 cfg.base.db_backend = spec.db_backend
             save_config(cfg)
+            if spec.tenant:
+                spec.env.setdefault(
+                    "COMETBFT_TPU_VERIFYSVC_TENANT", spec.tenant
+                )
             self.nodes.append(
                 E2ENode(
                     spec.name,

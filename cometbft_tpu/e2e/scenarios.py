@@ -75,6 +75,10 @@ class ScenarioResult:
     ok: bool = False
     liveness: bool = False
     safety: bool = False
+    # a scenario that RAISED (harness bug / environment breakage) is a
+    # different verdict from one that ran and failed its assertions —
+    # scripts/chaos.py exits 3 for crashes vs 1 for failures
+    crashed: bool = False
     problems: list[str] = field(default_factory=list)
     details: dict = field(default_factory=dict)
     artifact_dir: str = ""
@@ -86,6 +90,7 @@ class ScenarioResult:
             "ok": self.ok,
             "liveness": self.liveness,
             "safety": self.safety,
+            "crashed": self.crashed,
             "problems": list(self.problems),
             "details": dict(self.details),
             "artifact_dir": self.artifact_dir,
@@ -109,6 +114,18 @@ def _wait_for(pred, timeout: float, poll: float = 0.5, desc: str = ""):
     return None
 
 
+# deterministic load-round numbering: run_scenario(seed=...) pins the
+# starting round id so repeated runs (scripts/chaos.py --repeat --seed,
+# the soak's mid-run injections) submit identical tx streams
+_SEED: int | None = None
+
+
+def _round_id_base() -> int:
+    if _SEED is not None:
+        return (_SEED * 1009) % 100000
+    return int(time.monotonic() * 10) % 100000
+
+
 def _drive_load_until(
     runner: Runner, pred, timeout: float, desc: str = "", extra=None
 ):
@@ -117,7 +134,7 @@ def _drive_load_until(
     ``extra`` (optional) runs once per round for scenario-specific
     traffic (signed CheckTx envelopes, valset txs)."""
     deadline = time.monotonic() + timeout
-    round_id = int(time.monotonic() * 10) % 100000
+    round_id = _round_id_base()
     while time.monotonic() < deadline:
         try:
             v = pred()
@@ -656,13 +673,23 @@ DEFAULT_SCENARIOS = [
 ]
 
 
-def run_scenario(name: str, out_dir: str, base_port: int | None = None) -> ScenarioResult:
+def run_scenario(
+    name: str,
+    out_dir: str,
+    base_port: int | None = None,
+    seed: int | None = None,
+) -> ScenarioResult:
+    global _SEED
     fn = SCENARIOS.get(name)
     if fn is None:
         raise ValueError(
             f"unknown scenario {name!r} (known: {', '.join(SCENARIOS)})"
         )
-    _log.info(f"chaos scenario {name} starting (artifacts under {out_dir})")
+    _SEED = seed
+    _log.info(
+        f"chaos scenario {name} starting (artifacts under {out_dir}"
+        + (f", seed={seed}" if seed is not None else "") + ")"
+    )
     try:
         res = fn(out_dir) if base_port is None else fn(out_dir, base_port)
     except Exception as e:  # noqa: BLE001 — a crashed scenario is a failed scenario
@@ -671,6 +698,7 @@ def run_scenario(name: str, out_dir: str, base_port: int | None = None) -> Scena
         res = ScenarioResult(
             name,
             ok=False,
+            crashed=True,
             problems=[f"scenario raised {type(e).__name__}: {e}"],
             details={
                 # the RPC artifact sweep needs live nodes, which a crash

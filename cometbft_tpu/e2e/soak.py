@@ -1,0 +1,714 @@
+"""Sustained-load multi-tenant SLO soak over one shared verify plane.
+
+The endurance proof of ROADMAP item 5: M in-process chains
+(e2e/tenants.py) share ONE VerifyService for minutes-to-hours of mixed
+load — per-tenant consensus commit verification plus signed-envelope
+CheckTx traffic — while a rogue tenant floods the mempool class and
+PR-8 faults fire mid-soak (device wedge → failover trip → probation →
+restore; optionally a full chaos scenario — node crash + WAL replay —
+running as a concurrent subprocess via scripts/chaos.py).  The run
+emits one machine-readable SLO artifact whose assertions are the
+multi-tenant contract:
+
+  * **no starvation** — the rogue tenant's mempool flood degrades no
+    other tenant's consensus verify p99 by more than a bounded factor
+    (default 2x baseline), and every tenant's consensus batches keep
+    dispatching throughout;
+  * **quota isolation** — backpressure rejects land on the flooding
+    tenant only (per-tenant reject tallies: rogue > 0, victims == 0);
+  * **no leak** — RSS / thread-count / queue-depth watermarks stay flat
+    across the run (utils/leaktest.ResourceWatermarks);
+  * **no drift** — every verdict bitmap is bit-identical to its
+    construction-time expectation, across every failover trip/restore
+    cycle (degraded-mode host re-verification included);
+  * **fault endurance** — every scheduled wedge cycle actually tripped
+    the service to cpu_fallback AND restored via probation.
+
+Phases (fractions of the configured duration): warmup (discarded) →
+baseline (normal load) → flood (rogue mempool flood; wedge cycles fire
+in both baseline and flood) → recovery (flood stops; queues must
+drain).  Consensus latency samples are tagged with phase and wedge
+windows so the starvation comparison only uses clean (un-wedged)
+baseline vs clean flood samples.
+
+Driven by ``scripts/soak.py``; the fast two-tenant smoke configuration
+runs in tier-1 (tests/test_soak.py), the real >=5-minute soak in the
+slow tier and standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+from ..crypto import ed25519 as host
+from ..utils import fail, leaktest
+from ..utils.healthmon import ProbeResult
+from ..utils.log import get_logger
+from ..verifysvc import checktx
+from ..verifysvc.service import (
+    MODE_CPU_FALLBACK,
+    MODE_TPU,
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+)
+from .tenants import TenantChain, build_chains
+
+_log = get_logger("e2e.soak")
+
+
+@dataclass
+class SoakConfig:
+    """Knobs of one soak run.  The defaults are the fast smoke shape;
+    scripts/soak.py overrides them for the real >=5-minute run."""
+
+    tenants: int = 3
+    validators_per_chain: int = 16
+    duration_s: float = 60.0
+    seed: int = 7
+    rogue: str = ""  # "" = the last chain floods
+    flood_senders: int = 2
+    # flood batch width: a lower-class batch is the scheduler's
+    # preemption granularity — one in-flight batch is the bounded
+    # head-of-line delay a queued consensus batch can see, so the
+    # starvation SLO's headroom scales inversely with this
+    flood_batch_sigs: int = 8
+    flood_burst: int = 24  # submits per sender burst before collecting
+    commit_pause_s: float = 0.01
+    checktx_period_s: float = 0.08
+    wedge_cycles: int = 2
+    wedge_hold_s: float = 2.0
+    tenant_quota: int = 128
+    queue_max: int = 1 << 20  # class bound way above quota: quota binds first
+    tenant_weights: dict = field(default_factory=dict)
+    batch_max: int = 16
+    data_plane: str = "fake"  # "fake" (CPU-only, deterministic) | "real"
+    collect_timeout_s: float = 30.0
+    batch_deadline_s: float = 1.0
+    probation_ok: int = 2
+    probe_period_s: float = 0.2
+    starvation_factor: float = 2.0
+    starvation_floor_ms: float = 0.0  # extra slack for sub-second smokes
+    leak_check: bool = True
+    chaos_scenarios: tuple = ()  # e.g. ("crash_replay",): subprocess mid-soak
+    chaos_base_port: int = 29400
+    artifact_dir: str = ""
+    json_path: str = ""
+
+    def phase_plan(self) -> dict[str, tuple[float, float]]:
+        """Phase windows as (start, end) offsets from t0."""
+        d = self.duration_s
+        warm = min(2.0, 0.06 * d)
+        base_end = warm + 0.35 * d
+        flood_end = base_end + 0.45 * d
+        return {
+            "warmup": (0.0, warm),
+            "baseline": (warm, base_end),
+            "flood": (base_end, flood_end),
+            "recovery": (flood_end, d),
+        }
+
+
+def _host_verdicts(items) -> tuple[bool, list[bool]]:
+    res = [host.verify_signature(p, m, s) for (p, m, s) in items]
+    return all(res) and bool(res), res
+
+
+class _FakeDeviceBV:
+    """The soak's deterministic CPU 'device': real host crypto, but
+    shaped exactly like the production sub-threshold path — ``_entry =
+    None`` routes submit() through the service's class-priority host
+    worker (so the contention under test is the production contention),
+    while the returned ticket is NON-sync, so the collector's device
+    wait — where the wedge fault bites and the failover deadline runs —
+    stays on the code path a real device exercises."""
+
+    _entry = None
+    _fallback = None
+
+    def __init__(self):
+        self._items = []
+
+    def add(self, pub, msg, sig):
+        self._items.append((pub, msg, sig))
+
+    def submit(self):
+        # the "device compute" runs here, on the host worker, governed
+        # by the class-priority queue exactly like production host work
+        return ("fakedev", _host_verdicts(self._items))
+
+    def collect(self, ticket):
+        return ticket[1]
+
+
+def _percentile(vals: list[float], q: float):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class SoakRun:
+    """One soak execution; :func:`run_soak` is the entry point."""
+
+    def __init__(self, cfg: SoakConfig):
+        self.cfg = cfg
+        self.chains: list[TenantChain] = build_chains(
+            cfg.tenants, n_validators=cfg.validators_per_chain, seed=cfg.seed
+        )
+        self.rogue = cfg.rogue or self.chains[-1].name
+        self.svc = VerifyService(
+            batch_max=cfg.batch_max,
+            queue_max=cfg.queue_max,
+            tenant_quota=cfg.tenant_quota,
+            tenant_weights=dict(cfg.tenant_weights),
+            deadlines_ms={
+                Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+                Klass.MEMPOOL: 5, Klass.BACKGROUND: 25,
+            },
+            batch_deadline_s=cfg.batch_deadline_s,
+            probation_ok=cfg.probation_ok,
+            probe_period_s=cfg.probe_period_s,
+            probe_fn=self._probe,
+            failover_tick_s=0.05,
+            artifact_dir=cfg.artifact_dir or None,
+        )
+        if cfg.data_plane == "fake":
+            real = VerifyService._make_verifier.__get__(self.svc)
+            # fake device for TPU mode only: cpu_fallback must exercise
+            # the PRODUCTION _HostBatchVerifier routing
+            self.svc._make_verifier = (
+                lambda mode: _FakeDeviceBV()
+                if self.svc.backend_mode == MODE_TPU else real(mode)
+            )
+        self.t0 = 0.0
+        self.stop_ev = threading.Event()
+        self.flood_on = threading.Event()
+        self._mtx = threading.Lock()
+        # consensus latency samples: (t_offset, latency_s, tenant)
+        self.cs_samples: dict[str, list[tuple[float, float]]] = {
+            c.name: [] for c in self.chains
+        }
+        self.cs_timeouts: dict[str, int] = {c.name: 0 for c in self.chains}
+        self.checktx_stats: dict[str, dict[str, int]] = {
+            c.name: {"attempts": 0, "mismatches": 0} for c in self.chains
+        }
+        self.flood_stats = {
+            "submitted": 0, "rejected": 0, "timeouts": 0, "slow_collects": 0,
+        }
+        self.drift = {"checked": 0, "mismatches": 0}
+        self.wedge_windows: list[dict] = []  # {armed, tripped, cleared, restored}
+        self.chaos_results: list[dict] = []
+        self._chaos_threads: list[threading.Thread] = []
+        self.watermarks = leaktest.ResourceWatermarks(
+            gauges={
+                "inflight": lambda: len(self.svc._inflight),
+                "queued_sigs": lambda: sum(
+                    self.svc._class_sigs[k] for k in Klass
+                ),
+            }
+        )
+        self.errors: list[str] = []
+
+    # --------------------------------------------------------- plumbing
+
+    @staticmethod
+    def _probe(_timeout_s: float) -> ProbeResult:
+        """Probation probe stub: healthy iff the wedge fault is not
+        armed — deterministic, no subprocess, honest about the injected
+        incident (healthmon.probe_devices behaves the same way when the
+        fault is armed, minus the subprocess)."""
+        wedged = fail.armed("wedge_device") is not None
+        return ProbeResult(not wedged, "soak-probe", 0.0, timed_out=wedged)
+
+    def _now(self) -> float:
+        return time.monotonic() - self.t0
+
+    def _record_drift(self, per, expected, where: str) -> None:
+        with self._mtx:
+            self.drift["checked"] += 1
+            if list(per) != list(expected):
+                self.drift["mismatches"] += 1
+                if len(self.errors) < 32:
+                    self.errors.append(
+                        f"verdict drift at {where}: got {per} want {expected}"
+                    )
+
+    # ------------------------------------------------------- load loops
+
+    def _consensus_loop(self, chain: TenantChain) -> None:
+        i = 0
+        while not self.stop_ev.is_set():
+            tpl = chain.commit(i)
+            i += 1
+            t_submit = self._now()
+            t0 = time.monotonic()
+            try:
+                ticket = self.svc.submit(
+                    tpl.items, Klass.CONSENSUS, tenant=chain.name
+                )
+                _ok, per = ticket.collect(self.cfg.collect_timeout_s)
+            except VerifyServiceBackpressure:
+                # counted by the service's tenant tallies; the quota-
+                # isolation assertion fails the run if a victim sees this
+                continue
+            except TimeoutError:
+                with self._mtx:
+                    self.cs_timeouts[chain.name] += 1
+                continue
+            lat = time.monotonic() - t0
+            self._record_drift(
+                per, tpl.expected, f"{chain.name}/consensus/{tpl.height}"
+            )
+            with self._mtx:
+                self.cs_samples[chain.name].append((t_submit, lat))
+            if self.cfg.commit_pause_s:
+                self.stop_ev.wait(self.cfg.commit_pause_s)
+
+    def _checktx_loop(self, chain: TenantChain) -> None:
+        j = 0
+        while not self.stop_ev.is_set():
+            tx, expect_good = chain.tx(j)
+            j += 1
+            got = checktx.verify_tx_signature(
+                tx, service=self.svc, tenant=chain.name
+            )
+            with self._mtx:
+                st = self.checktx_stats[chain.name]
+                st["attempts"] += 1
+                if got is not bool(expect_good):
+                    st["mismatches"] += 1
+                    if len(self.errors) < 32:
+                        self.errors.append(
+                            f"checktx drift {chain.name}/{j}: "
+                            f"got {got} want {expect_good}"
+                        )
+            self.stop_ev.wait(self.cfg.checktx_period_s)
+
+    def _flood_loop(self, chain: TenantChain, idx: int) -> None:
+        """Rogue mempool flood: bursts of wide batches, far faster than
+        the plane drains, so the tenant quota MUST reject some — the
+        backpressure that must stay confined to this tenant.  Pending
+        tickets are swept with a SHORT wait and retried: under strict
+        class priority an over-quota flooder's batches legitimately
+        languish behind every tenant's consensus work while the plane
+        is saturated (counted as ``slow_collects``, not lost — they
+        resolve once the flood lifts, asserted by the final drain)."""
+        items, expected = chain.flood_items(self.cfg.flood_batch_sigs)
+        pending: list = []
+
+        def sweep(wait_s: float) -> None:
+            still = []
+            for t in pending:
+                try:
+                    _ok, per = t.collect(wait_s)
+                    self._record_drift(per, expected, f"{chain.name}/flood")
+                except TimeoutError:
+                    still.append(t)
+            pending[:] = still
+
+        while not self.stop_ev.is_set():
+            if not self.flood_on.wait(0.1):
+                if pending:
+                    sweep(0.2)
+                continue
+            for _ in range(self.cfg.flood_burst):
+                if self.stop_ev.is_set() or not self.flood_on.is_set():
+                    break
+                try:
+                    pending.append(
+                        self.svc.submit(items, Klass.MEMPOOL, tenant=chain.name)
+                    )
+                    with self._mtx:
+                        self.flood_stats["submitted"] += 1
+                except VerifyServiceBackpressure as e:
+                    with self._mtx:
+                        self.flood_stats["rejected"] += 1
+                    if e.tenant != chain.name and len(self.errors) < 32:
+                        self.errors.append(
+                            f"flood backpressure misattributed: {e.tenant!r}"
+                        )
+            before = len(pending)
+            sweep(0.05)
+            if pending and len(pending) == before:
+                # nothing resolved this round: the flooder's accepted
+                # backlog is languishing behind every tenant's consensus
+                # work — strict class priority doing its job (the
+                # backlog is bounded by the tenant quota, and the final
+                # drain below proves nothing is ever lost)
+                with self._mtx:
+                    self.flood_stats["slow_collects"] += 1
+        # final drain: every remaining flood ticket must resolve once
+        # the flood has lifted — an unresolved one IS a lost ticket
+        deadline = time.monotonic() + self.cfg.collect_timeout_s
+        for t in pending:
+            try:
+                _ok, per = t.collect(max(0.1, deadline - time.monotonic()))
+                self._record_drift(per, expected, f"{chain.name}/flood-drain")
+            except TimeoutError:
+                with self._mtx:
+                    self.flood_stats["timeouts"] += 1
+
+    # ------------------------------------------------------ fault plane
+
+    def _wedge_cycle(self, tag: str) -> dict:
+        """One sentinel-style device-wedge incident: arm → the failover
+        watchdog trips the service to cpu_fallback (in-flight batch past
+        the device deadline; the probation probe honors the fault) →
+        hold while degraded traffic keeps flowing → clear → probation
+        restores TPU mode."""
+        ev = {"tag": tag, "armed_at": self._now(), "tripped": False,
+              "restored": False}
+        fail.arm("wedge_device")
+        deadline = time.monotonic() + max(20.0, 4 * self.cfg.batch_deadline_s)
+        while time.monotonic() < deadline and not self.stop_ev.is_set():
+            if self.svc.backend_mode == MODE_CPU_FALLBACK:
+                ev["tripped"] = True
+                ev["tripped_at"] = self._now()
+                break
+            time.sleep(0.02)
+        self.stop_ev.wait(self.cfg.wedge_hold_s)
+        fail.clear("wedge_device")
+        ev["cleared_at"] = self._now()
+        deadline = time.monotonic() + max(
+            20.0, 10 * self.cfg.probe_period_s * self.cfg.probation_ok
+        )
+        while time.monotonic() < deadline and not self.stop_ev.is_set():
+            if self.svc.backend_mode == MODE_TPU:
+                ev["restored"] = True
+                ev["restored_at"] = self._now()
+                break
+            time.sleep(0.02)
+        with self._mtx:
+            self.wedge_windows.append(ev)
+        _log.info(f"soak wedge cycle {tag}: {ev}")
+        return ev
+
+    def _chaos_subprocess(self, scenario: str, slot: int = 0) -> None:
+        """Run a full chaos scenario (real node processes — this is the
+        node-crash + WAL-replay fault of the soak) concurrently with the
+        in-process load, via the scripts/chaos.py driver."""
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        out = os.path.join(
+            self.cfg.artifact_dir or os.getcwd(), f"soak-chaos-{scenario}"
+        )
+        os.makedirs(out, exist_ok=True)
+        verdict_path = os.path.join(out, "verdict.json")
+        # concurrent scenarios each get a disjoint port range (chaos.py
+        # scenarios span < 200 ports)
+        cmd = [
+            sys.executable, os.path.join(repo, "scripts", "chaos.py"),
+            "--scenario", scenario, "--seed", str(self.cfg.seed),
+            "--json", verdict_path, "--out", out,
+            "--base-port", str(self.cfg.chaos_base_port + slot * 200),
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, timeout=max(600, self.cfg.duration_s)
+            )
+            with open(verdict_path) as f:
+                verdict = json.load(f)
+            verdict["exit_code"] = proc.returncode
+        except Exception as e:  # noqa: BLE001 — a dead chaos child is a finding, not a crash
+            _log.warning(f"soak chaos subprocess {scenario} failed: {e!r}")
+            verdict = {"ok": False, "error": repr(e), "scenario": scenario}
+        with self._mtx:
+            self.chaos_results.append(verdict)
+
+    def _fault_schedule_loop(self) -> None:
+        """Fire the wedge cycles at planned offsets: half in baseline,
+        half mid-flood, so drift is checked across failover under both
+        calm and contended load.  The chaos subprocess (real node
+        processes — heavy CPU neighbors) is kicked at RECOVERY start
+        instead: the run then extends, load still flowing, until it
+        completes, so its host-level contention never pollutes the
+        baseline-vs-flood starvation comparison."""
+        plan = self.cfg.phase_plan()
+        b0, b1 = plan["baseline"]
+        f0, f1 = plan["flood"]
+        r0 = plan["recovery"][0]
+        cycles = max(0, self.cfg.wedge_cycles)
+        times = []
+        n_base = cycles // 2
+        n_flood = cycles - n_base
+        for i in range(n_base):
+            times.append(b0 + (b1 - b0) * (i + 1) / (n_base + 1))
+        for i in range(n_flood):
+            times.append(f0 + (f1 - f0) * (i + 1) / (n_flood + 1))
+        chaos_started = False
+        for i, at in enumerate(sorted(times)):
+            while self._now() < at and not self.stop_ev.is_set():
+                self.stop_ev.wait(0.1)
+            if self.stop_ev.is_set():
+                return
+            self._wedge_cycle(f"cycle{i}")
+        while not self.stop_ev.is_set():
+            if not chaos_started and self._now() >= r0:
+                chaos_started = self._start_chaos()
+            self.stop_ev.wait(0.2)
+
+    def _start_chaos(self) -> bool:
+        for slot, scenario in enumerate(self.cfg.chaos_scenarios):
+            t = threading.Thread(
+                target=self._chaos_subprocess, args=(scenario, slot),
+                name=f"soak-chaos-{scenario}", daemon=True,
+            )
+            t.start()
+            self._chaos_threads.append(t)
+        return True
+
+    def _sampler_loop(self) -> None:
+        period = max(0.5, self.cfg.duration_s / 120.0)
+        while not self.stop_ev.is_set():
+            self.watermarks.sample()
+            self.stop_ev.wait(period)
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        plan = cfg.phase_plan()
+        self.t0 = time.monotonic()
+        started_unix = time.time()
+        threads = [
+            threading.Thread(
+                target=self._consensus_loop, args=(c,),
+                name=f"soak-cs-{c.name}", daemon=True,
+            )
+            for c in self.chains
+        ] + [
+            threading.Thread(
+                target=self._checktx_loop, args=(c,),
+                name=f"soak-tx-{c.name}", daemon=True,
+            )
+            for c in self.chains
+        ]
+        rogue_chain = next(c for c in self.chains if c.name == self.rogue)
+        threads += [
+            threading.Thread(
+                target=self._flood_loop, args=(rogue_chain, i),
+                name=f"soak-flood-{i}", daemon=True,
+            )
+            for i in range(cfg.flood_senders)
+        ]
+        threads.append(
+            threading.Thread(
+                target=self._fault_schedule_loop, name="soak-faults",
+                daemon=True,
+            )
+        )
+        threads.append(
+            threading.Thread(
+                target=self._sampler_loop, name="soak-sampler", daemon=True
+            )
+        )
+        for t in threads:
+            t.start()
+        _log.info(
+            f"soak started: {cfg.tenants} tenants x "
+            f"{cfg.validators_per_chain} validators, {cfg.duration_s:.0f}s, "
+            f"rogue={self.rogue}, plane={cfg.data_plane}"
+        )
+        try:
+            f0, f1 = plan["flood"]
+            while self._now() < cfg.duration_s:
+                now = self._now()
+                if f0 <= now < f1:
+                    self.flood_on.set()
+                else:
+                    self.flood_on.clear()
+                time.sleep(0.05)
+            # extended window: the chaos subprocess (node crash + WAL
+            # replay under real processes) may still be running — keep
+            # the tenant load flowing until it completes so the fault
+            # fires against a BUSY plane, without its host-level CPU
+            # contention polluting the baseline/flood SLO windows above
+            for t in self._chaos_threads:
+                while t.is_alive():
+                    t.join(timeout=2.0)
+                    if self._now() > cfg.duration_s + 900:
+                        _log.warning("chaos subprocess overran; stopping soak")
+                        break
+        finally:
+            self.flood_on.clear()
+            self.stop_ev.set()
+            fail.clear_all()  # un-wedge parked workers before joining
+            for t in threads:
+                t.join(timeout=max(30.0, cfg.collect_timeout_s + 5))
+        # drain: queues/in-flight must return to zero (part of no-leak)
+        drained = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            with self.svc._cond:
+                queued = sum(self.svc._class_sigs[k] for k in Klass)
+            if queued == 0 and not self.svc._inflight:
+                drained = True
+                break
+            time.sleep(0.1)
+        self.watermarks.sample()
+        report = self._report(plan, started_unix, drained)
+        self.svc.stop()
+        if cfg.json_path:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(cfg.json_path)), exist_ok=True
+            )
+            with open(cfg.json_path, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+            _log.info(f"soak SLO artifact written to {cfg.json_path}")
+        return report
+
+    # --------------------------------------------------------- verdict
+
+    def _clean_window_samples(
+        self, tenant: str, window: tuple[float, float]
+    ) -> list[float]:
+        """Latency samples submitted inside ``window`` but OUTSIDE any
+        wedge incident (arm -> restore + margin): the starvation SLO
+        compares flood vs baseline under the same (healthy) backend."""
+        margin = 0.5
+        spans = [
+            (w["armed_at"] - margin,
+             w.get("restored_at", w.get("cleared_at", w["armed_at"]))
+             + margin)
+            for w in self.wedge_windows
+        ]
+        lo, hi = window
+        out = []
+        for t, lat in self.cs_samples[tenant]:
+            if not (lo <= t < hi):
+                continue
+            if any(a <= t <= b for a, b in spans):
+                continue
+            out.append(lat)
+        return out
+
+    def _report(self, plan, started_unix: float, drained: bool) -> dict:
+        cfg = self.cfg
+        svc_stats = self.svc.stats(lock_timeout=2.0)
+        tenants_report = {}
+        victims_ok = True
+        starvation_detail = {}
+        for c in self.chains:
+            base = self._clean_window_samples(c.name, plan["baseline"])
+            flood = self._clean_window_samples(c.name, plan["flood"])
+            allsamp = [lat for _t, lat in self.cs_samples[c.name]]
+            base_p99 = _percentile(base, 0.99)
+            flood_p99 = _percentile(flood, 0.99)
+            entry = {
+                "consensus": {
+                    "samples": len(allsamp),
+                    "p50_ms": _r(_percentile(allsamp, 0.5)),
+                    "p99_ms": _r(_percentile(allsamp, 0.99)),
+                    "baseline_p99_ms": _r(base_p99),
+                    "flood_p99_ms": _r(flood_p99),
+                    "baseline_samples": len(base),
+                    "flood_samples": len(flood),
+                    "collect_timeouts": self.cs_timeouts[c.name],
+                },
+                "checktx": dict(self.checktx_stats[c.name]),
+                "service_tallies": svc_stats.get("tenants", {}).get(
+                    c.name, {}
+                ),
+                "rogue": c.name == self.rogue,
+            }
+            if c.name != self.rogue:
+                if base_p99 is None or flood_p99 is None:
+                    ok = False
+                    why = "insufficient clean samples"
+                else:
+                    allowed = max(
+                        cfg.starvation_factor * base_p99,
+                        base_p99 + cfg.starvation_floor_ms / 1e3,
+                    )
+                    ok = flood_p99 <= allowed
+                    why = (
+                        f"flood p99 {flood_p99 * 1e3:.1f}ms vs allowed "
+                        f"{allowed * 1e3:.1f}ms "
+                        f"(baseline {base_p99 * 1e3:.1f}ms)"
+                    )
+                starvation_detail[c.name] = {"ok": ok, "detail": why}
+                victims_ok = victims_ok and ok
+            tenants_report[c.name] = entry
+
+        # quota isolation from the service's own per-tenant tallies
+        tallies = svc_stats.get("tenants", {})
+        rogue_rejected = tallies.get(self.rogue, {}).get("rejected", 0)
+        victim_rejected = {
+            c.name: tallies.get(c.name, {}).get("rejected", 0)
+            for c in self.chains if c.name != self.rogue
+        }
+        quota_ok = rogue_rejected > 0 and not any(victim_rejected.values())
+
+        leak = (
+            self.watermarks.flat() if cfg.leak_check
+            else {"ok": True, "skipped": True}
+        )
+        leak["drained"] = drained
+        leak_ok = bool(leak["ok"]) and drained
+
+        drift_ok = (
+            self.drift["mismatches"] == 0 and self.drift["checked"] > 0
+            and not any(
+                st["mismatches"] for st in self.checktx_stats.values()
+            )
+        )
+        cycles = list(self.wedge_windows)
+        faults_ok = (
+            len(cycles) >= cfg.wedge_cycles
+            and all(w["tripped"] and w["restored"] for w in cycles)
+        )
+        chaos_ok = all(r.get("ok") for r in self.chaos_results)
+        lost = sum(self.cs_timeouts.values()) + self.flood_stats["timeouts"]
+
+        assertions = {
+            "no_starvation": {"ok": victims_ok, "per_tenant": starvation_detail},
+            "quota_isolation": {
+                "ok": quota_ok,
+                "rogue_rejected": rogue_rejected,
+                "victim_rejected": victim_rejected,
+                "flood": dict(self.flood_stats),
+            },
+            "no_leak": {"ok": leak_ok, **leak},
+            "no_drift": {"ok": drift_ok, **self.drift},
+            "fault_endurance": {
+                "ok": faults_ok and chaos_ok,
+                "wedge_cycles": cycles,
+                "trips": svc_stats["failover"]["trips"],
+                "restores": svc_stats["failover"]["restores"],
+                "chaos": self.chaos_results,
+            },
+            "zero_lost_tickets": {"ok": lost == 0, "lost": lost},
+        }
+        ok = all(a["ok"] for a in assertions.values()) and not self.errors
+        return {
+            "ok": ok,
+            "started_unix": started_unix,
+            "duration_s": round(self._now(), 1),
+            "config": asdict(cfg),
+            "rogue": self.rogue,
+            "phases": {k: [round(a, 1), round(b, 1)] for k, (a, b) in plan.items()},
+            "tenants": tenants_report,
+            "assertions": assertions,
+            "errors": list(self.errors),
+            "service": svc_stats,
+            "watermark_samples": len(self.watermarks.samples),
+        }
+
+
+def _r(v, scale: float = 1e3, nd: int = 2):
+    """Seconds -> rounded ms (None-safe)."""
+    return None if v is None else round(v * scale, nd)
+
+
+def run_soak(cfg: SoakConfig) -> dict:
+    """Build and execute one soak; returns the SLO report dict (also
+    written to cfg.json_path when set)."""
+    return SoakRun(cfg).run()

@@ -1,0 +1,133 @@
+"""In-process multi-tenant chains sharing ONE verify plane.
+
+The production consolidation shape (ROADMAP item 5) is N independent
+appchains sharing a single accelerator verify plane.  This module builds
+the in-process version of that testnet: each :class:`TenantChain` is a
+small chain's verification workload — a validator set, pre-signed commit
+batches (with known-tampered rows so blame order is checkable), and
+signed CheckTx envelopes — submitted through the SHARED
+:class:`~cometbft_tpu.verifysvc.service.VerifyService` under the chain's
+own tenant id.  Every template carries its expected per-signature
+verdict bitmap from construction, so a soak can assert bit-exact
+verdicts (no drift) without re-running host crypto in the hot loop.
+
+Used by the soak harness (e2e/soak.py, scripts/soak.py) and the
+multi-tenant fairness tests; process-level chains claim a tenant the
+same way via ``NodeSpec.tenant`` (e2e/runner.py), which sets
+``COMETBFT_TPU_VERIFYSVC_TENANT`` in the node's environment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..crypto import ed25519 as host
+from ..verifysvc import checktx
+
+
+def _seed_bytes(*parts) -> bytes:
+    return hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
+
+
+@dataclass
+class CommitTemplate:
+    """One pre-signed commit's verification payload: (pub, msg, sig)
+    triples in validator order plus the expected per-signature verdicts
+    (False rows are deliberately tampered at construction)."""
+
+    height: int
+    items: list = field(default_factory=list)
+    expected: list = field(default_factory=list)
+
+    @property
+    def all_ok(self) -> bool:
+        return bool(self.expected) and all(self.expected)
+
+
+class TenantChain:
+    """One small chain's verify workload, bound to a tenant id.
+
+    Templates are pre-signed at construction (pure-python signing is
+    ~0.6 ms/sig — fine at setup, too slow for a hot loop) and cycled by
+    index, so the load loops do zero crypto: submit, collect, compare
+    against the expected bitmap.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        n_validators: int = 8,
+        seed: int = 0,
+        commit_pool: int = 16,
+        tx_pool: int = 24,
+        tamper_every: int = 5,
+        tx_tamper_every: int = 8,
+    ):
+        self.name = name
+        self.n_validators = n_validators
+        self._keys = [
+            host.PrivKey.from_seed(_seed_bytes("val", name, seed, i))
+            for i in range(n_validators)
+        ]
+        self.pubkeys = [k.pub_key().data for k in self._keys]
+
+        # pre-signed commit templates; every tamper_every'th has one
+        # corrupted signature row so blame-order plumbing stays honest
+        self.commits: list[CommitTemplate] = []
+        for h in range(commit_pool):
+            tpl = CommitTemplate(height=h + 1)
+            bad = (h % n_validators) if (
+                tamper_every and (h + 1) % tamper_every == 0
+            ) else None
+            for i, sk in enumerate(self._keys):
+                msg = b"%s|commit|%d|val%d" % (name.encode(), h + 1, i)
+                sig = sk.sign(msg)
+                if i == bad:
+                    msg += b"!"  # tampered: must verify False
+                tpl.items.append((self.pubkeys[i], msg, sig))
+                tpl.expected.append(i != bad)
+            self.commits.append(tpl)
+
+        # signed CheckTx envelopes; every tx_tamper_every'th is corrupted
+        # (payload byte flip after signing -> must verify False)
+        self._tx_keys = [
+            host.PrivKey.from_seed(_seed_bytes("tx", name, seed, i))
+            for i in range(4)
+        ]
+        self.txs: list[tuple[bytes, bool]] = []
+        for j in range(tx_pool):
+            sk = self._tx_keys[j % len(self._tx_keys)]
+            tx = checktx.make_signed_tx(
+                sk, b"%s|tx|%d" % (name.encode(), j)
+            )
+            good = not (tx_tamper_every and (j + 1) % tx_tamper_every == 0)
+            if not good:
+                tx = tx[:-1] + bytes([tx[-1] ^ 1])
+            self.txs.append((tx, good))
+
+    def commit(self, i: int) -> CommitTemplate:
+        return self.commits[i % len(self.commits)]
+
+    def tx(self, i: int) -> tuple[bytes, bool]:
+        return self.txs[i % len(self.txs)]
+
+    def flood_items(self, n_sigs: int) -> tuple[list, list]:
+        """A reusable n_sigs-wide mempool batch (valid envelope-domain
+        signatures) for rogue-flood load, with its expected bitmap."""
+        items = []
+        for i in range(n_sigs):
+            sk = self._tx_keys[i % len(self._tx_keys)]
+            msg = b"%s|flood|%d" % (self.name.encode(), i)
+            items.append((sk.pub_key().data, msg, sk.sign(msg)))
+        return items, [True] * n_sigs
+
+
+def build_chains(
+    n: int, n_validators: int = 8, seed: int = 0, **kw
+) -> list[TenantChain]:
+    """N chains named ``chain0..chainN-1`` sharing one plane."""
+    return [
+        TenantChain(f"chain{i}", n_validators=n_validators, seed=seed, **kw)
+        for i in range(n)
+    ]

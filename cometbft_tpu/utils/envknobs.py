@@ -149,6 +149,42 @@ VERIFYSVC_CHECKTX = _declare(
     "`0` disables the mempool CheckTx ed25519 envelope gate "
     "(verifysvc/checktx); unsigned txs always pass through untouched.",
 )
+VERIFYSVC_TENANT = _declare(
+    "COMETBFT_TPU_VERIFYSVC_TENANT", "str", "default",
+    "Tenant id this process submits verify-service work under (how a "
+    "chain claims its slice of a shared multi-tenant verify plane).  "
+    "Single-chain deployments keep the `default` tenant and see no "
+    "behavior change.",
+)
+VERIFYSVC_TENANT_QUOTA = _declare(
+    "COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA", "int", 0,
+    "Per-(tenant, class) queue bound in signatures — one tenant's "
+    "mempool flood hits ITS quota and backpressures while other "
+    "tenants' queues stay admissible.  0 (default) = the class-wide "
+    "COMETBFT_TPU_VERIFYSVC_QUEUE_MAX, i.e. no extra per-tenant bound.",
+)
+VERIFYSVC_TENANT_WEIGHTS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_TENANT_WEIGHTS", "str", "",
+    "Weighted-fair interleave of READY tenants within one priority "
+    "class, e.g. `chain-a=4,chain-b=1`; unlisted tenants weigh 1.  "
+    "Classes still dispatch in strict priority (consensus first) — "
+    "weights only order tenants competing inside the same class.",
+)
+VERIFYSVC_TENANT_LABEL_MAX = _declare(
+    "COMETBFT_TPU_VERIFYSVC_TENANT_LABEL_MAX", "int", 32,
+    "Bound on distinct tenant label values the metrics hub exposes "
+    "(utils/metrics.LabelGuard); tenants beyond it aggregate under the "
+    "`__overflow__` label so an unbounded tenant-id stream cannot blow "
+    "up the /metrics exposition.",
+)
+VERIFYSVC_COLLECT_TIMEOUT_MS = _declare(
+    "COMETBFT_TPU_VERIFYSVC_COLLECT_TIMEOUT_MS", "int", 120000,
+    "Deadline (ms) a verify-service client waits in Ticket.collect() "
+    "before declaring the scheduler stuck: the wait is abandoned with "
+    "stall forensics and the client verifies its own batch inline on "
+    "the host (first-wins ticket settlement discards the late device "
+    "result).  0 = wait forever (the pre-PR-12 contract).",
+)
 
 # verify-service degraded-mode failover (verifysvc/service.py)
 FAILOVER = _declare(

@@ -48,6 +48,97 @@ def check_threads(grace_s: float = 3.0, allow: tuple[str, ...] = ()):
     raise ThreadLeakError(f"{len(leaked)} thread(s) leaked: {names}")
 
 
+def rss_bytes() -> int:
+    """This process's resident set size.  /proc when available (Linux),
+    else ru_maxrss (peak, not current — still monotone-usable for a
+    "did it keep growing" check); 0 when neither source exists."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KiB, macOS bytes; either way it's a watermark
+        return ru * 1024 if ru < 1 << 34 else ru
+    except (ImportError, OSError, ValueError):
+        return 0
+
+
+class ResourceWatermarks:
+    """Periodic RSS/thread/custom-gauge sampling for endurance runs (the
+    soak harness's no-leak assertion): sample() appends one row; flat()
+    judges whether the tail of the run grew past the head by more than
+    the allowed tolerance.  Gauges are zero-arg callables (e.g. a lambda
+    over the verify service's queue depths) sampled alongside the
+    built-ins."""
+
+    def __init__(self, gauges: dict | None = None):
+        self.gauges = dict(gauges or {})
+        self.samples: list[dict] = []
+
+    def sample(self) -> dict:
+        row = {
+            "t": time.monotonic(),
+            "rss_bytes": rss_bytes(),
+            "threads": threading.active_count(),
+        }
+        for name, fn in self.gauges.items():
+            try:
+                row[name] = fn()
+            except Exception:  # noqa: BLE001 — a dead gauge must not kill the soak
+                row[name] = None
+        self.samples.append(row)
+        return row
+
+    def _window_avg(self, key: str, rows: list[dict]) -> float | None:
+        vals = [r[key] for r in rows if isinstance(r.get(key), (int, float))]
+        return sum(vals) / len(vals) if vals else None
+
+    def flat(
+        self,
+        rss_tolerance_bytes: int = 64 << 20,
+        rss_tolerance_frac: float = 0.2,
+        thread_tolerance: int = 4,
+        window_frac: float = 0.2,
+    ) -> dict:
+        """Compare the average of the FIRST window_frac of samples to
+        the LAST: RSS may grow by at most max(tolerance_bytes,
+        frac * head) and the thread count by thread_tolerance.  Returns
+        a verdict dict ({"ok": bool, ...per-resource detail}) rather
+        than raising — the soak folds it into its SLO artifact."""
+        n = len(self.samples)
+        out: dict = {"ok": False, "samples": n}
+        if n < 4:
+            out["detail"] = "not enough samples"
+            return out
+        w = max(2, int(n * window_frac))
+        head, tail = self.samples[:w], self.samples[-w:]
+        rss0 = self._window_avg("rss_bytes", head)
+        rss1 = self._window_avg("rss_bytes", tail)
+        thr0 = self._window_avg("threads", head)
+        thr1 = self._window_avg("threads", tail)
+        rss_allow = max(rss_tolerance_bytes, (rss0 or 0) * rss_tolerance_frac)
+        rss_ok = rss0 is None or rss1 is None or (rss1 - rss0) <= rss_allow
+        thr_ok = thr0 is None or thr1 is None or (thr1 - thr0) <= thread_tolerance
+        out.update(
+            ok=bool(rss_ok and thr_ok),
+            rss_head_bytes=None if rss0 is None else int(rss0),
+            rss_tail_bytes=None if rss1 is None else int(rss1),
+            rss_grew_bytes=(
+                None if (rss0 is None or rss1 is None) else int(rss1 - rss0)
+            ),
+            rss_allowed_bytes=int(rss_allow),
+            rss_ok=bool(rss_ok),
+            threads_head=thr0, threads_tail=thr1, threads_ok=bool(thr_ok),
+        )
+        return out
+
+
 @contextlib.contextmanager
 def watchdog(timeout_s: float = 60.0):
     """Dump all thread stacks to stderr if the block exceeds timeout_s

@@ -159,6 +159,47 @@ class Histogram(_Metric):
         return out
 
 
+class LabelGuard:
+    """Bounded admission of label VALUES for one label dimension.
+
+    Prometheus label values are unbounded series: a metric labeled by a
+    caller-supplied id (the verify service's tenant) would let an
+    unbounded id stream allocate one series per id and blow up the
+    exposition.  The guard admits the first ``max_values`` distinct
+    values verbatim and maps everything after onto the single
+    ``__overflow__`` bucket, so the series count is capped no matter
+    what ids arrive.  Admission is first-come sticky: a value once
+    admitted keeps its own series for the life of the process.
+    """
+
+    OVERFLOW = "__overflow__"
+
+    def __init__(self, max_values: int = 32):
+        self.max_values = max(1, int(max_values))
+        self._seen: set[str] = set()
+        self._mtx = threading.Lock()
+        self._overflowed = 0
+
+    def bound(self, value) -> str:
+        v = str(value)
+        with self._mtx:
+            if v in self._seen:
+                return v
+            if len(self._seen) < self.max_values:
+                self._seen.add(v)
+                return v
+            self._overflowed += 1
+            return self.OVERFLOW
+
+    def overflowed(self) -> int:
+        with self._mtx:
+            return self._overflowed
+
+    def admitted(self) -> int:
+        with self._mtx:
+            return len(self._seen)
+
+
 class Registry:
     def __init__(self, namespace: str = "cometbft"):
         self.namespace = namespace
@@ -379,6 +420,40 @@ class Hub:
                 0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                 0.1, 0.5,
             ),
+        )
+        # ---- verify-service tenancy (verifysvc/service.py, (tenant,
+        # class) scheduling).  Tenant label values MUST pass through
+        # self.tenant_labels.bound() — an unbounded tenant-id stream
+        # would otherwise allocate unbounded series (beyond the bound
+        # they aggregate under "__overflow__").
+        from . import envknobs as _envknobs
+
+        self.tenant_labels = LabelGuard(
+            _envknobs.get_int(_envknobs.VERIFYSVC_TENANT_LABEL_MAX)
+        )
+        self.verify_svc_tenant_queue_depth = r.gauge(
+            "verify_svc_tenant_queue_depth",
+            "Signatures queued per (tenant, class) in the verify "
+            "service (labels tenant, class; tenant set bounded by "
+            "COMETBFT_TPU_VERIFYSVC_TENANT_LABEL_MAX, overflow bucket "
+            "__overflow__)",
+        )
+        self.verify_svc_tenant_dispatched = r.counter(
+            "verify_svc_tenant_dispatched_total",
+            "Verify-service batches dispatched per (tenant, class) "
+            "(labels tenant, class)",
+        )
+        self.verify_svc_tenant_rejected = r.counter(
+            "verify_svc_tenant_rejected_total",
+            "Verify-service submissions rejected with backpressure per "
+            "(tenant, class) (labels tenant, class, scope=tenant|class: "
+            "which bound was hit)",
+        )
+        self.verify_svc_collect_timeout = r.counter(
+            "verify_svc_collect_timeout_total",
+            "Client-side Ticket.collect() deadlines that expired "
+            "(label class); the client host-verified its batch inline "
+            "and left stall forensics",
         )
         # ---- verify-service degraded-mode failover (verifysvc/service.py)
         self.verify_svc_backend_mode = r.gauge(

@@ -29,7 +29,14 @@ way (both ends are ZIP-215; tests/test_comb_tree.py pins kernel == host).
 from __future__ import annotations
 
 from ..crypto import ed25519 as host_ed25519
-from .service import Klass, VerifyService, VerifyServiceBackpressure, global_service
+from .service import (
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+    collect_timeout_s,
+    global_service,
+    report_collect_stall,
+)
 
 MAGIC = b"\xd0sigtx1\x00"
 SIGN_DOMAIN = b"cometbft-tpu/sigtx/v1|"
@@ -52,14 +59,18 @@ def parse_signed_tx(tx: bytes) -> tuple[bytes, bytes, bytes] | None:
 
 
 def verify_tx_signature(
-    tx: bytes, service: VerifyService | None = None
+    tx: bytes,
+    service: VerifyService | None = None,
+    tenant: str | None = None,
 ) -> bool | None:
     """Verify a tx's envelope signature through the verify service.
 
     Returns None for unsigned txs (no envelope), True/False for signed
-    ones.  Device-batched through the MEMPOOL class when the accelerator
-    backend is selectable; host verification otherwise and on
-    backpressure — the caller never needs to know which path ran."""
+    ones.  Device-batched through the MEMPOOL class — under ``tenant``
+    (None = this process's default tenant) — when the accelerator
+    backend is selectable; host verification otherwise, on backpressure,
+    and on a collect-deadline stall — the caller never needs to know
+    which path ran."""
     parsed = parse_signed_tx(tx)
     if parsed is None:
         return None
@@ -72,11 +83,26 @@ def verify_tx_signature(
         if crypto_batch.device_capable():
             svc = global_service()
     if svc is not None:
+        import time as _time
+
+        t0 = _time.monotonic()
         try:
-            _, per = svc.submit([(pub, msg, sig)], Klass.MEMPOOL).collect()
+            _, per = svc.submit(
+                [(pub, msg, sig)], Klass.MEMPOOL, tenant=tenant
+            ).collect(collect_timeout_s())
             return bool(per and per[0])
         except VerifyServiceBackpressure:
             pass  # admission control said no: fall through to the host
+        except TimeoutError:
+            # live-but-stuck scheduler: leave forensics, take the host
+            # path (first-wins settlement discards the late answer)
+            from .service import default_tenant
+
+            report_collect_stall(
+                Klass.MEMPOOL,
+                tenant if tenant is not None else default_tenant(),
+                1, _time.monotonic() - t0, service=svc,
+            )
         except ValueError:
             return False  # malformed pubkey/sig lengths can't be valid
     return host_ed25519.verify_signature(pub, msg, sig)

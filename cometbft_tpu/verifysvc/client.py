@@ -17,13 +17,18 @@ the rejection is already counted/flight-recorded by the service.
 
 from __future__ import annotations
 
+import time
+
 from ..utils import tracing
 from .service import (
     MODE_PLAIN,
     Klass,
     VerifyService,
     VerifyServiceBackpressure,
+    collect_timeout_s,
+    default_tenant,
     global_service,
+    report_collect_stall,
 )
 
 
@@ -74,10 +79,12 @@ class ServiceBatchVerifier:
         klass: Klass = Klass.CONSENSUS,
         mode=MODE_PLAIN,
         service: VerifyService | None = None,
+        tenant: str | None = None,
     ):
         self._klass = klass
         self._mode = mode
         self._svc = service
+        self._tenant = tenant if tenant is not None else default_tenant()
         self._items: list[tuple[bytes, bytes, bytes]] = []
         self.last_timings: dict[str, float] = {}
 
@@ -87,6 +94,10 @@ class ServiceBatchVerifier:
     @property
     def klass(self) -> Klass:
         return self._klass
+
+    @property
+    def tenant(self) -> str:
+        return self._tenant
 
     def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
         if len(pub_key) != 32 or len(sig) != 64:
@@ -103,6 +114,21 @@ class ServiceBatchVerifier:
             self._svc = global_service()
         return self._svc
 
+    def _host_fallback(self, span_name: str) -> tuple[bool, list[bool]]:
+        """Inline host verification of OUR retained items — correct
+        verdicts in our own add() order, shared by the backpressure and
+        collect-stall paths."""
+        from ..models.verifier import CpuEd25519BatchVerifier
+
+        cpu = CpuEd25519BatchVerifier()
+        cpu._items = list(self._items)
+        with tracing.span(
+            span_name,
+            {"class": self._klass.label, "sigs": len(cpu._items)}
+            if tracing.enabled() else None,
+        ):
+            return cpu.verify()
+
     def submit(self):
         """Enqueue with the service and return an opaque ticket for
         collect().  On backpressure the batch is verified inline on the
@@ -111,25 +137,31 @@ class ServiceBatchVerifier:
             return ("sync", (False, []))
         try:
             return ("svc", self._service().submit(
-                list(self._items), self._klass, self._mode
+                list(self._items), self._klass, self._mode,
+                tenant=self._tenant,
             ))
         except VerifyServiceBackpressure:
-            from ..models.verifier import CpuEd25519BatchVerifier
-
-            cpu = CpuEd25519BatchVerifier()
-            cpu._items = list(self._items)
-            with tracing.span(
-                "verify.svc_fallback",
-                {"class": self._klass.label, "sigs": len(cpu._items)}
-                if tracing.enabled() else None,
-            ):
-                return ("sync", cpu.verify())
+            return ("sync", self._host_fallback("verify.svc_fallback"))
 
     def collect(self, ticket) -> tuple[bool, list[bool]]:
         kind, payload = ticket
         if kind == "sync":
             return payload
-        result = payload.collect()
+        # bounded wait: a live-but-stuck scheduler (accepted the submit,
+        # never resolved the ticket) must not park a consensus or
+        # blocksync caller forever.  On expiry: stall forensics, then the
+        # host fallback — first-wins ticket settlement discards the
+        # service's late answer if it ever comes.
+        timeout = collect_timeout_s()
+        t0 = time.monotonic()
+        try:
+            result = payload.collect(timeout)
+        except TimeoutError:
+            report_collect_stall(
+                self._klass, self._tenant, len(self._items),
+                time.monotonic() - t0, service=self._svc,
+            )
+            return self._host_fallback("verify.collect_stall_fallback")
         if payload.timings:
             self.last_timings.update(payload.timings)
         return result

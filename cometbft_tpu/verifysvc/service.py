@@ -39,6 +39,34 @@ one row per validator.  Per-request blame order is preserved exactly:
 each ticket's per-signature list follows its own add() order however
 batches were merged or completed.
 
+**Multi-tenant scheduling** (ROADMAP item 5: N independent chains
+consolidated onto one shared verify plane): every request carries a
+*tenant* id — ``COMETBFT_TPU_VERIFYSVC_TENANT`` names the tenant a
+process submits under, defaulting to ``default`` so every single-chain
+caller is untouched — and the scheduler keys its queues by
+**(tenant, class)**:
+
+  * classes still dispatch in strict global priority (one tenant's
+    ready consensus batch outranks every tenant's mempool work);
+  * WITHIN a class, ready tenants interleave weighted-fair
+    (``COMETBFT_TPU_VERIFYSVC_TENANT_WEIGHTS``, default weight 1 each,
+    rotating round-robin so no tenant owns the tie-break) — a rogue
+    tenant's mempool flood cannot monopolize the class's dispatch slots;
+  * each (tenant, class) queue is additionally bounded by
+    ``COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA`` signatures (0 = the
+    class-wide bound), so backpressure lands on the flooding tenant —
+    :class:`VerifyServiceBackpressure` carries ``tenant`` and ``scope``
+    (which bound was hit) — while other tenants keep admitting;
+  * batches never mix tenants: coalescing happens inside one
+    (tenant, class) queue, so per-tenant latency/flush/reject
+    accounting stays exact (the ``verify_svc_tenant_*`` metrics, with
+    the tenant label set bounded by utils/metrics.LabelGuard).
+
+The sustained-load proof of these properties is the soak harness
+(``scripts/soak.py`` driving e2e/soak.py): M in-process chains
+(e2e/tenants.py) share one service for minutes-to-hours while faults
+fire, with per-tenant SLOs asserting no starvation, no leak, no drift.
+
 The scheduler thread only *dispatches* (the underlying submit() seam is
 asynchronous — payload staging runs on the comb staging thread); a
 separate collector thread drains results in dispatch order and resolves
@@ -138,19 +166,49 @@ MODE_PLAIN = ("plain",)
 # work settles before the worker exits
 _HOST_SENTINEL_PRIO = 1 << 30
 
+# the tenant every single-chain caller lands on when none is claimed
+DEFAULT_TENANT = "default"
+
+
+def default_tenant() -> str:
+    """The tenant id this process submits under — how a chain claims
+    its slice of a shared verify plane (COMETBFT_TPU_VERIFYSVC_TENANT);
+    empty/unset = ``default``."""
+    t = envknobs.get_str(envknobs.VERIFYSVC_TENANT).strip()
+    return t or DEFAULT_TENANT
+
+
+def collect_timeout_s() -> float | None:
+    """The client-side Ticket.collect() deadline
+    (COMETBFT_TPU_VERIFYSVC_COLLECT_TIMEOUT_MS); None = wait forever."""
+    ms = envknobs.get_int(envknobs.VERIFYSVC_COLLECT_TIMEOUT_MS)
+    return None if ms <= 0 else ms / 1e3
+
 
 class VerifyServiceBackpressure(Exception):
-    """A class's queue is at its signature bound; the caller must fall
-    back to host verification (or shed the request)."""
+    """A queue is at its signature bound; the caller must fall back to
+    host verification (or shed the request).  ``scope`` says which
+    bound was hit: ``tenant`` (this tenant's per-class quota — other
+    tenants are still admissible) or ``class`` (the class-wide bound)."""
 
-    def __init__(self, klass: Klass, queued: int, limit: int):
+    def __init__(
+        self,
+        klass: Klass,
+        queued: int,
+        limit: int,
+        tenant: str = DEFAULT_TENANT,
+        scope: str = "class",
+    ):
         super().__init__(
-            f"verify service backpressure: class {klass.label} has "
-            f"{queued} signatures queued (limit {limit})"
+            f"verify service backpressure: {scope} bound, class "
+            f"{klass.label} tenant {tenant} has {queued} signatures "
+            f"queued (limit {limit})"
         )
         self.klass = klass
         self.queued = queued
         self.limit = limit
+        self.tenant = tenant
+        self.scope = scope
 
 
 class Ticket:
@@ -202,12 +260,13 @@ class Ticket:
 
 
 class _Request:
-    __slots__ = ("items", "klass", "mode", "ticket", "enq")
+    __slots__ = ("items", "klass", "mode", "ticket", "enq", "tenant")
 
-    def __init__(self, items, klass: Klass, mode):
+    def __init__(self, items, klass: Klass, mode, tenant: str = DEFAULT_TENANT):
         self.items = items
         self.klass = klass
         self.mode = mode
+        self.tenant = tenant
         self.ticket = Ticket(len(items))
         self.enq = time.monotonic()
 
@@ -229,6 +288,26 @@ def _parse_weights(spec: str) -> dict[Klass, int]:
             continue
         if w >= 1:
             out[k] = w
+    return out
+
+
+def _parse_tenant_weights(spec: str) -> dict[str, int]:
+    """``"chain-a=4,chain-b=1"`` -> per-tenant fair-share weights
+    (unlisted tenants weigh 1).  Same forgiving parse as the class
+    weights: malformed entries drop, empty = equal shares."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip()
+        try:
+            w = int(val)
+        except ValueError:
+            continue
+        if name and w >= 1:
+            out[name] = w
     return out
 
 
@@ -287,6 +366,8 @@ class VerifyService:
         queue_max: int | None = None,
         deadlines_ms: dict[Klass, float] | None = None,
         weights: dict[Klass, int] | None = None,
+        tenant_quota: int | None = None,
+        tenant_weights: dict[str, int] | None = None,
         failover: bool | None = None,
         batch_deadline_s: float | None = None,
         probation_ok: int | None = None,
@@ -317,8 +398,31 @@ class VerifyService:
             else _parse_weights(envknobs.get_str(envknobs.VERIFYSVC_WEIGHTS))
         )
         self._credits: dict[Klass, int] = {}
-        self._queues: dict[Klass, list[_Request]] = {k: [] for k in Klass}
-        self._queued_sigs: dict[Klass, int] = {k: 0 for k in Klass}
+        # ---- (tenant, class) scheduling state.  Queues are keyed
+        # class-first (strict global priority), then by tenant (the
+        # weighted-fair interleave within the class).  Tenant sub-dicts
+        # are created on first submit and REMOVED when drained, so an
+        # unbounded tenant-id stream never grows the scheduler state.
+        q = tenant_quota if tenant_quota is not None else envknobs.get_int(
+            envknobs.VERIFYSVC_TENANT_QUOTA
+        )
+        self.tenant_quota = q if q and q > 0 else self.queue_max
+        self._tenant_weights = (
+            dict(tenant_weights) if tenant_weights is not None
+            else _parse_tenant_weights(
+                envknobs.get_str(envknobs.VERIFYSVC_TENANT_WEIGHTS)
+            )
+        )
+        self._queues: dict[Klass, dict[str, list[_Request]]] = {
+            k: {} for k in Klass
+        }
+        self._queued_sigs: dict[Klass, dict[str, int]] = {k: {} for k in Klass}
+        self._class_sigs: dict[Klass, int] = {k: 0 for k in Klass}
+        # weighted round-robin position + credits per class; credits are
+        # rebuilt from the READY tenant set at each replenish, so tenants
+        # that drained and left the queue dict are pruned for free
+        self._tenant_credits: dict[Klass, dict[str, int]] = {k: {} for k in Klass}
+        self._last_tenant: dict[Klass, str | None] = {k: None for k in Klass}
         self._cond = threading.Condition()
         self._collectq: queue.Queue = queue.Queue()
         # class-priority queue for batches whose submit() runs real work
@@ -343,6 +447,11 @@ class VerifyService:
         # endpoint reads these without scraping /metrics
         self._dispatched: dict[str, int] = {k.label: 0 for k in Klass}
         self._rejected: dict[str, int] = {k.label: 0 for k in Klass}
+        # per-tenant tallies for stats()/soak SLOs, keyed by the hub's
+        # BOUNDED tenant label (LabelGuard) so a tenant-id flood can't
+        # grow this dict without bound either
+        self._tenant_tallies: dict[str, dict[str, int]] = {}
+        self._tally_mtx = threading.Lock()
 
         # ---- degraded-mode failover (module docstring, "failover")
         self.failover_enabled = (
@@ -447,10 +556,16 @@ class VerifyService:
             if not self._running:
                 return
             self._running = False
-            stranded = [r for q in self._queues.values() for r in q]
+            stranded = [
+                r
+                for tenant_queues in self._queues.values()
+                for q in tenant_queues.values()
+                for r in q
+            ]
             for k in Klass:
-                self._queues[k] = []
-                self._queued_sigs[k] = 0
+                self._queues[k] = {}
+                self._queued_sigs[k] = {}
+                self._class_sigs[k] = 0
             self._cond.notify_all()
         self._stop_ev.set()
         self._collectq.put(None)
@@ -490,12 +605,17 @@ class VerifyService:
 
     # ------------------------------------------------------------- submit
 
-    def submit(self, items, klass: Klass, mode=MODE_PLAIN) -> Ticket:
+    def submit(
+        self, items, klass: Klass, mode=MODE_PLAIN, tenant: str | None = None
+    ) -> Ticket:
         """Enqueue one verification request (a list of
-        (pubkey, msg, sig) triples, verified as a unit) and return its
-        ticket.  Raises :class:`VerifyServiceBackpressure` when the
-        class's queue is at its signature bound."""
+        (pubkey, msg, sig) triples, verified as a unit) under
+        ``tenant`` (None = this process's default tenant) and return
+        its ticket.  Raises :class:`VerifyServiceBackpressure` when the
+        tenant's per-class quota or the class-wide queue bound is hit."""
         items = list(items)
+        if tenant is None:
+            tenant = default_tenant()
         if not items:
             t = Ticket(0)
             t._resolve((False, []))  # empty-batch contract of the verifiers
@@ -503,65 +623,117 @@ class VerifyService:
         self._ensure_started()
         n = len(items)
         m = _mhub()
+        tlabel = m.tenant_labels.bound(tenant)
         with self._cond:
             if not self._running:
                 # stop() won the race after _ensure_started: enqueueing
                 # onto a dead scheduler would park the caller forever —
                 # reject so they take their host fallback instead
-                raise VerifyServiceBackpressure(klass, 0, self.queue_max)
-            queued = self._queued_sigs[klass]
-            if queued + n > self.queue_max:
-                self._rejected[klass.label] += 1
-                rejected = self._rejected[klass.label]
+                raise VerifyServiceBackpressure(
+                    klass, 0, self.queue_max, tenant=tenant
+                )
+            class_q = self._class_sigs[klass]
+            ten_q = self._queued_sigs[klass].get(tenant, 0)
+            if ten_q + n > self.tenant_quota < self.queue_max:
+                # the flooding tenant's OWN quota: backpressure confined
+                # to the offender, the class stays admissible for others.
+                # With no extra per-tenant bound configured (quota ==
+                # queue_max) the class bound below owns the attribution:
+                # scope="tenant" must only ever point an operator at a
+                # quota knob that is actually the binding constraint.
+                queued, limit, scope = ten_q, self.tenant_quota, "tenant"
+            elif class_q + n > self.queue_max:
+                queued, limit, scope = class_q, self.queue_max, "class"
             else:
-                req = _Request(items, klass, mode)
-                self._queues[klass].append(req)
-                self._queued_sigs[klass] = queued + n
-                depth = queued + n
+                queued = limit = 0
+                scope = None
+            if scope is not None:
+                self._rejected[klass.label] += 1
+            else:
+                req = _Request(items, klass, mode, tenant=tenant)
+                self._queues[klass].setdefault(tenant, []).append(req)
+                self._queued_sigs[klass][tenant] = ten_q + n
+                self._class_sigs[klass] = class_q + n
+                depth = class_q + n
+                tdepth = ten_q + n
                 self._cond.notify()
-                rejected = None
-        if rejected is not None:
+        if scope is not None:
             # admission control: count it, flight-record it, and push the
             # decision back to the caller (host fallback / shed)
+            self._tally_tenant(tlabel, "rejected")
             m.verify_svc_rejected.inc(**{"class": klass.label})
+            m.verify_svc_tenant_rejected.inc(
+                **{"tenant": tlabel, "class": klass.label, "scope": scope}
+            )
             _flightrec().record(
                 "verifysvc_backpressure",
-                klass=klass.label, queued=queued, sigs=n, limit=self.queue_max,
+                klass=klass.label, tenant=tenant, scope=scope,
+                queued=queued, sigs=n, limit=limit,
             )
             tracing.instant(
                 "verify.sched.reject",
-                {"class": klass.label, "queued": queued, "sigs": n}
+                {"class": klass.label, "tenant": tenant, "scope": scope,
+                 "queued": queued, "sigs": n}
                 if tracing.enabled() else None,
             )
-            raise VerifyServiceBackpressure(klass, queued, self.queue_max)
+            raise VerifyServiceBackpressure(
+                klass, queued, limit, tenant=tenant, scope=scope
+            )
         m.verify_svc_queue_depth.set(depth, **{"class": klass.label})
+        m.verify_svc_tenant_queue_depth.set(
+            tdepth, **{"tenant": tlabel, "class": klass.label}
+        )
         return req.ticket
 
-    def verify(self, items, klass: Klass, mode=MODE_PLAIN) -> tuple[bool, list[bool]]:
+    def _tally_tenant(self, tlabel: str, key: str, n: int = 1) -> None:
+        """Bump a per-tenant tally (keyed by the BOUNDED label).  Its
+        own small lock: the reject path holds the scheduler cond, the
+        dispatch path holds nothing — order is always cond -> tally."""
+        with self._tally_mtx:
+            t = self._tenant_tallies.get(tlabel)
+            if t is None:
+                t = self._tenant_tallies[tlabel] = {
+                    "dispatched_batches": 0, "dispatched_sigs": 0,
+                    "rejected": 0,
+                }
+            t[key] = t.get(key, 0) + n
+
+    def verify(
+        self, items, klass: Klass, mode=MODE_PLAIN, tenant: str | None = None
+    ) -> tuple[bool, list[bool]]:
         """submit() + collect() in one call (synchronous callers)."""
-        return self.submit(items, klass, mode).collect()
+        return self.submit(items, klass, mode, tenant=tenant).collect()
 
     # ---------------------------------------------------------- scheduler
 
-    def _ready_locked(self, klass: Klass, now: float) -> bool:
-        q = self._queues[klass]
+    def _tenant_ready_locked(self, klass: Klass, tenant: str, now: float) -> bool:
+        q = self._queues[klass].get(tenant)
         if not q:
             return False
-        if self._queued_sigs[klass] >= self.batch_max:
+        if self._queued_sigs[klass].get(tenant, 0) >= self.batch_max:
             return True
         return (now - q[0].enq) >= self._deadline_s[klass]
 
+    def _ready_locked(self, klass: Klass, now: float) -> bool:
+        """A class is ready when ANY of its tenants is ready (width or
+        deadline) — strict class priority is decided first, the tenant
+        interleave second."""
+        return any(
+            self._tenant_ready_locked(klass, t, now)
+            for t in self._queues[klass]
+        )
+
     def _next_deadline_locked(self, now: float) -> float | None:
-        """Seconds until the earliest not-yet-ready class flushes, or
-        None when every queue is empty."""
+        """Seconds until the earliest not-yet-ready (class, tenant)
+        queue flushes, or None when every queue is empty."""
         best = None
         for k in Klass:
-            q = self._queues[k]
-            if not q:
-                continue
-            remain = self._deadline_s[k] - (now - q[0].enq)
-            if best is None or remain < best:
-                best = remain
+            for q in self._queues[k].values():
+                if not q:
+                    continue
+                remain = self._deadline_s[k] - (now - q[0].enq)
+                if best is None or remain < best:
+                    best = remain
         return best
 
     def _pick_class_locked(self, now: float) -> Klass | None:
@@ -581,14 +753,55 @@ class VerifyService:
         self._credits[ready[0]] -= 1
         return ready[0]
 
-    def _form_batch_locked(self, klass: Klass) -> tuple[list[_Request], str]:
-        """Pop the head batch of a ready class.  Comb-bound requests go
-        solo; plain requests coalesce up to the batch width."""
-        q = self._queues[klass]
-        # the flush reason is what made the CLASS ready, decided before
+    def _pick_tenant_locked(self, klass: Klass, now: float) -> str:
+        """Weighted-fair interleave of the class's READY tenants: spend
+        per-tenant credits in rotating round-robin order (starting after
+        the last dispatched tenant, so no tenant owns the tie-break);
+        when every ready tenant is out of credits, replenish each to its
+        configured weight.  A tenant with weight w gets w dispatch slots
+        per round — a flooding tenant's surplus queue depth buys it
+        nothing beyond its share."""
+        ready = sorted(
+            t for t in self._queues[klass]
+            if self._tenant_ready_locked(klass, t, now)
+        )
+        if len(ready) == 1:
+            self._last_tenant[klass] = ready[0]
+            return ready[0]
+        last = self._last_tenant[klass]
+        if last in ready:
+            i = ready.index(last)
+            order = ready[i + 1 :] + ready[: i + 1]
+        else:
+            order = ready
+        creds = self._tenant_credits[klass]
+        for t in order:
+            if creds.get(t, 0) > 0:
+                creds[t] -= 1
+                self._last_tenant[klass] = t
+                return t
+        # replenish — rebuilt from the ready set, which prunes tenants
+        # that drained and left the queue dict since the last round
+        self._tenant_credits[klass] = creds = {
+            t: self._tenant_weights.get(t, 1) for t in ready
+        }
+        t = order[0]
+        creds[t] -= 1
+        self._last_tenant[klass] = t
+        return t
+
+    def _form_batch_locked(
+        self, klass: Klass, tenant: str
+    ) -> tuple[list[_Request], str]:
+        """Pop the head batch of a ready (class, tenant) queue.  Comb-
+        bound requests go solo; plain requests coalesce up to the batch
+        width.  Batches never mix tenants — per-tenant latency and
+        blame accounting stay exact."""
+        q = self._queues[klass][tenant]
+        # the flush reason is what made the queue ready, decided before
         # popping: a width-triggered flush whose head dispatches solo
         # (comb) must not read as a deadline expiry on the dashboards
-        was_full = self._queued_sigs[klass] >= self.batch_max
+        was_full = self._queued_sigs[klass].get(tenant, 0) >= self.batch_max
         head = q.pop(0)
         batch = [head]
         total = len(head.items)
@@ -597,7 +810,15 @@ class VerifyService:
                 nxt = q.pop(0)
                 batch.append(nxt)
                 total += len(nxt.items)
-        self._queued_sigs[klass] -= total
+        remaining = self._queued_sigs[klass].get(tenant, 0) - total
+        if q:
+            self._queued_sigs[klass][tenant] = remaining
+        else:
+            # drained: drop the tenant's entries so scheduler state stays
+            # bounded however many tenant ids ever appeared
+            del self._queues[klass][tenant]
+            self._queued_sigs[klass].pop(tenant, None)
+        self._class_sigs[klass] -= total
         reason = "full" if (was_full or total >= self.batch_max) else "deadline"
         return batch, reason
 
@@ -606,6 +827,7 @@ class VerifyService:
         with self._inflight_mtx:
             self._inflight[id(batch)] = {
                 "class": batch[0].klass.label,
+                "tenant": batch[0].tenant,
                 "sigs": sum(len(r.items) for r in batch),
                 "requests": len(batch),
                 "where": where,
@@ -653,9 +875,16 @@ class VerifyService:
                         0.5 if remain is None else max(0.0, min(remain, 0.5))
                     )
                     continue
-                batch, reason = self._form_batch_locked(klass)
-                depth = self._queued_sigs[klass]
+                tenant = self._pick_tenant_locked(klass, now)
+                batch, reason = self._form_batch_locked(klass, tenant)
+                depth = self._class_sigs[klass]
+                tdepth = self._queued_sigs[klass].get(tenant, 0)
             m.verify_svc_queue_depth.set(depth, **{"class": klass.label})
+            m.verify_svc_tenant_queue_depth.set(
+                tdepth,
+                **{"tenant": m.tenant_labels.bound(tenant),
+                   "class": klass.label},
+            )
             self._dispatch(klass, batch, reason)
 
     def _make_verifier(self, mode):
@@ -697,16 +926,22 @@ class VerifyService:
     def _dispatch(self, klass: Klass, batch: list[_Request], reason: str) -> None:
         m = _mhub()
         nsigs = sum(len(r.items) for r in batch)
+        tlabel = m.tenant_labels.bound(batch[0].tenant)
         now = time.monotonic()
         for r in batch:
             m.verify_svc_queue_wait.observe(
                 now - r.enq, **{"class": klass.label}
             )
         m.verify_svc_flush.inc(**{"class": klass.label, "reason": reason})
+        m.verify_svc_tenant_dispatched.inc(
+            **{"tenant": tlabel, "class": klass.label}
+        )
         self._dispatched[klass.label] += 1
+        self._tally_tenant(tlabel, "dispatched_batches")
+        self._tally_tenant(tlabel, "dispatched_sigs", nsigs)
         labels = (
-            {"class": klass.label, "reason": reason,
-             "sigs": nsigs, "requests": len(batch)}
+            {"class": klass.label, "tenant": batch[0].tenant,
+             "reason": reason, "sigs": nsigs, "requests": len(batch)}
             if tracing.enabled() else None
         )
         bv = None
@@ -1217,6 +1452,7 @@ class VerifyService:
             in_flight = [
                 {
                     "class": rec["class"],
+                    "tenant": rec.get("tenant", DEFAULT_TENANT),
                     "sigs": rec["sigs"],
                     "requests": rec["requests"],
                     "where": rec["where"],
@@ -1239,8 +1475,13 @@ class VerifyService:
             try:
                 queued = {
                     k.label: {
-                        "requests": len(self._queues[k]),
-                        "sigs": self._queued_sigs[k],
+                        "requests": sum(
+                            len(q) for q in self._queues[k].values()
+                        ),
+                        "sigs": self._class_sigs[k],
+                        "by_tenant": {
+                            t: n for t, n in self._queued_sigs[k].items()
+                        },
                     }
                     for k in Klass
                 }
@@ -1252,6 +1493,8 @@ class VerifyService:
             queued = {"lock_busy": True}
             dispatched = dict(self._dispatched)
             rejected = dict(self._rejected)
+        with self._tally_mtx:
+            tenants = {t: dict(v) for t, v in self._tenant_tallies.items()}
         with self._failover_mtx:
             failover = {
                 "enabled": self.failover_enabled,
@@ -1271,6 +1514,8 @@ class VerifyService:
             "failover": failover,
             "batch_max": self.batch_max,
             "queue_max": self.queue_max,
+            "tenant_quota": self.tenant_quota,
+            "tenant_weights": dict(self._tenant_weights),
             "deadline_ms": {
                 k.label: self._deadline_s[k] * 1e3 for k in Klass
             },
@@ -1278,7 +1523,89 @@ class VerifyService:
             "queued": queued,
             "dispatched_batches": dispatched,
             "rejected": rejected,
+            "tenants": tenants,
         }
+
+
+# ---- client-side collect-stall forensics (the bounded Ticket.collect
+# contract): rate-limit the heavyweight artifact so a storm of timed-out
+# callers produces ONE report per window, not one per caller
+_STALL_MTX = threading.Lock()
+_LAST_STALL_REPORT = 0.0
+_STALL_REPORT_MIN_INTERVAL_S = 60.0
+
+
+def _reset_stall_gate() -> None:
+    """Tests only: re-arm the stall-report rate limiter."""
+    global _LAST_STALL_REPORT
+    with _STALL_MTX:
+        _LAST_STALL_REPORT = 0.0
+
+
+def report_collect_stall(
+    klass: Klass,
+    tenant: str,
+    nsigs: int,
+    waited_s: float,
+    service: "VerifyService | None" = None,
+    artifact_dir: str | None = None,
+) -> str | None:
+    """A client's bounded Ticket.collect() expired: the scheduler is
+    alive enough to accept submits but did not resolve this ticket in
+    time.  Count it, flight-record it, and (rate-limited) write a stall
+    forensics artifact naming the stuck class/tenant with the service's
+    own view of its queues and in-flight ages — the caller then degrades
+    to an inline host verification instead of parking forever.  Returns
+    the artifact path, or None when rate-limited/failed."""
+    m = _mhub()
+    m.verify_svc_collect_timeout.inc(**{"class": klass.label})
+    _flightrec().record(
+        "verifysvc_collect_stall",
+        klass=klass.label, tenant=tenant, sigs=nsigs,
+        waited_s=round(waited_s, 3),
+    )
+    tracing.instant(
+        "verify.collect_stall",
+        {"class": klass.label, "tenant": tenant, "sigs": nsigs}
+        if tracing.enabled() else None,
+    )
+    global _LAST_STALL_REPORT
+    now = time.monotonic()
+    with _STALL_MTX:
+        if now - _LAST_STALL_REPORT < _STALL_REPORT_MIN_INTERVAL_S:
+            return None
+        _LAST_STALL_REPORT = now
+    import json as _json
+
+    from ..utils import debugdump
+
+    svc = service if service is not None else _GLOBAL
+    sections = []
+    if svc is not None:
+        # bounded lock wait: the stats of a stuck scheduler must not
+        # park the very diagnosis of its stall
+        sections.append(
+            ("verify service (at stall)",
+             _json.dumps(svc.stats(lock_timeout=0.5), indent=1, default=str))
+        )
+    try:
+        path = debugdump.stall_report(
+            f"verify-service collect() deadline expired: class="
+            f"{klass.label} tenant={tenant} sigs={nsigs} after "
+            f"{waited_s:.1f}s (caller degrading to inline host verify)",
+            sections,
+            directory=artifact_dir,
+        )
+        m.health_forensics.inc()
+        get_logger("verifysvc").error(
+            f"collect stall forensics written to {path}"
+        )
+        return path
+    except Exception as e:  # noqa: BLE001 — forensics must never hurt the caller
+        get_logger("verifysvc").warning(
+            f"collect stall forensics capture failed: {e!r}"
+        )
+        return None
 
 
 _GLOBAL: VerifyService | None = None

@@ -7,9 +7,19 @@ artifact per scenario.
     python scripts/chaos.py --scenario wedge --scenario double_sign
     python scripts/chaos.py --smoke              # fast single-node smoke
     python scripts/chaos.py --json out/chaos.json --out out/artifacts
+    python scripts/chaos.py --repeat 3 --seed 42 # deterministic cycling
     python scripts/chaos.py --list
 
-Exit status: 0 iff every selected scenario passed.  ``--json`` writes
+Exit status: 0 iff every selected scenario passed; 1 when one or more
+scenarios ran and FAILED their assertions; 3 when one or more scenarios
+CRASHED (raised — a harness/environment breakage, not a chaos verdict).
+The distinction lets a driver (the soak harness, CI retry logic) treat
+"the network forked" differently from "the runner threw".
+
+``--repeat N`` runs the selected scenario list N times (ports offset
+per iteration so iterations never collide) and ``--seed`` pins the
+deterministic load-round numbering — together they make scenarios
+reusable as repeated mid-soak fault injections.  ``--json`` writes
 ``{"ok": bool, "scenarios": [ScenarioResult...]}``; each scenario also
 leaves a per-node artifact directory (flight-recorder dump, health
 snapshot, verify-service stats, node logs) under ``--out`` so a failed
@@ -63,6 +73,16 @@ def main(argv: list[str] | None = None) -> int:
         "--base-port", type=int, default=0,
         help="override the per-scenario default port ranges",
     )
+    p.add_argument(
+        "--repeat", type=int, default=1,
+        help="run the selected scenario list N times (ports offset per "
+             "iteration); the mid-soak fault-injection shape",
+    )
+    p.add_argument(
+        "--seed", type=int, default=None,
+        help="deterministic load-round numbering (repeat runs submit "
+             "identical tx streams)",
+    )
     args = p.parse_args(argv)
 
     if args.list:
@@ -78,20 +98,42 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unknown scenario(s): {', '.join(unknown)}", file=sys.stderr)
         print(f"known: {', '.join(sc.SCENARIOS)}", file=sys.stderr)
         return 2
+    if args.repeat < 1:
+        print(f"--repeat must be >= 1, got {args.repeat}", file=sys.stderr)
+        return 2
 
     out_dir = args.out or tempfile.mkdtemp(prefix="cometbft-chaos-")
     os.makedirs(out_dir, exist_ok=True)
 
     results = []
     t0 = time.monotonic()
-    for i, name in enumerate(names):
-        base_port = (args.base_port + i * 200) if args.base_port else None
-        res = sc.run_scenario(name, out_dir, base_port=base_port)
-        results.append(res)
-        print(json.dumps(res.to_dict()), flush=True)  # one line per scenario
+    for rep in range(args.repeat):
+        rep_dir = (
+            out_dir if args.repeat == 1
+            else os.path.join(out_dir, f"rep{rep}")
+        )
+        for i, name in enumerate(names):
+            # each (iteration, scenario) slot gets its own port range so
+            # a lingering listener from a previous run never collides.
+            # Without --base-port the scenarios' built-in defaults are
+            # already disjoint within one rep, but reps would reuse
+            # them — so repeats anchor above the built-in ranges.
+            slot = rep * len(names) + i
+            anchor = args.base_port or (27400 if args.repeat > 1 else None)
+            base_port = (anchor + slot * 200) if anchor else None
+            res = sc.run_scenario(
+                name, rep_dir, base_port=base_port, seed=args.seed
+            )
+            if args.repeat > 1:
+                res.details["repeat"] = rep
+            results.append(res)
+            print(json.dumps(res.to_dict()), flush=True)  # one line each
 
     verdict = {
         "ok": all(r.ok for r in results),
+        "crashed": any(r.crashed for r in results),
+        "repeat": args.repeat,
+        "seed": args.seed,
         "elapsed_s": round(time.monotonic() - t0, 1),
         "artifact_dir": out_dir,
         "scenarios": [r.to_dict() for r in results],
@@ -105,7 +147,11 @@ def main(argv: list[str] | None = None) -> int:
         f"in {verdict['elapsed_s']}s (artifacts: {out_dir})",
         file=sys.stderr,
     )
-    return 0 if verdict["ok"] else 1
+    if verdict["ok"]:
+        return 0
+    # crash (scenario raised) vs failure (assertions failed): distinct
+    # exit codes so drivers can tell a broken harness from a bad verdict
+    return 3 if verdict["crashed"] else 1
 
 
 if __name__ == "__main__":

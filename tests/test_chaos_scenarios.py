@@ -89,8 +89,88 @@ def test_chaos_driver_json_artifact(tmp_path, monkeypatch, capsys):
 def test_chaos_driver_rejects_unknown_scenario(tmp_path):
     mod = _load_chaos_mod()
     assert mod.main(["--scenario", "nope"]) == 2
+    assert mod.main(["--scenario", "wedge_smoke", "--repeat", "0"]) == 2
     with pytest.raises(ValueError, match="unknown scenario"):
         sc.run_scenario("nope", str(tmp_path))
+
+
+def test_chaos_driver_repeat_and_seed(tmp_path, monkeypatch):
+    """--repeat N re-runs the scenario list with per-iteration port
+    offsets (no collisions) and --seed pins the deterministic load-round
+    base — the shape the soak uses for mid-run fault injections."""
+    mod = _load_chaos_mod()
+    calls = []
+
+    def fake_pass(out_dir, base_port=0):
+        calls.append((out_dir, base_port))
+        return sc.ScenarioResult(
+            "fake_pass", ok=True, liveness=True, safety=True
+        )
+
+    monkeypatch.setitem(sc.SCENARIOS, "fake_pass", fake_pass)
+    out = tmp_path / "verdict.json"
+    rc = mod.main([
+        "--scenario", "fake_pass", "--repeat", "3", "--seed", "42",
+        "--json", str(out), "--out", str(tmp_path / "art"),
+        "--base-port", "31000",
+    ])
+    assert rc == 0
+    verdict = json.loads(out.read_text())
+    assert verdict["repeat"] == 3 and verdict["seed"] == 42
+    assert [s["name"] for s in verdict["scenarios"]] == ["fake_pass"] * 3
+    assert [s["details"]["repeat"] for s in verdict["scenarios"]] == [0, 1, 2]
+    # per-iteration base ports never collide; per-iteration artifact dirs
+    ports = [p for (_d, p) in calls]
+    assert len(set(ports)) == 3
+    dirs = [d for (d, _p) in calls]
+    assert len(set(dirs)) == 3
+    # the seed pinned the scenarios' deterministic round numbering
+    assert sc._SEED == 42
+    assert sc._round_id_base() == (42 * 1009) % 100000
+    # an unseeded run resets to time-derived rounds
+    rc = mod.main([
+        "--scenario", "fake_pass", "--json", str(out),
+        "--out", str(tmp_path / "art2"),
+    ])
+    assert rc == 0 and sc._SEED is None
+
+
+def test_chaos_driver_crash_exits_3_not_1(tmp_path, monkeypatch):
+    """A scenario that RAISES (harness breakage) exits 3 and is marked
+    crashed in the verdict — distinct from an assertion failure's 1."""
+    mod = _load_chaos_mod()
+
+    def fake_crash(out_dir, base_port=0):
+        raise RuntimeError("harness exploded")
+
+    def fake_fail(out_dir, base_port=0):
+        return sc.ScenarioResult("fake_fail", problems=["assertion failed"])
+
+    monkeypatch.setitem(sc.SCENARIOS, "fake_crash", fake_crash)
+    monkeypatch.setitem(sc.SCENARIOS, "fake_fail", fake_fail)
+    out = tmp_path / "verdict.json"
+    rc = mod.main([
+        "--scenario", "fake_crash", "--json", str(out),
+        "--out", str(tmp_path / "a"),
+    ])
+    assert rc == 3
+    verdict = json.loads(out.read_text())
+    assert verdict["crashed"] is True
+    assert verdict["scenarios"][0]["crashed"] is True
+    assert "traceback" in verdict["scenarios"][0]["details"]
+
+    # plain failure still exits 1; a crash anywhere in the list wins
+    rc = mod.main([
+        "--scenario", "fake_fail", "--json", str(out),
+        "--out", str(tmp_path / "b"),
+    ])
+    assert rc == 1
+    assert json.loads(out.read_text())["crashed"] is False
+    rc = mod.main([
+        "--scenario", "fake_fail", "--scenario", "fake_crash",
+        "--json", str(out), "--out", str(tmp_path / "c"),
+    ])
+    assert rc == 3
 
 
 def test_registry_names_the_five_full_scenarios():

@@ -442,6 +442,100 @@ def test_service_restarts_after_stop(svc):
     assert not s._stop_ev.is_set()
 
 
+# ------------------------------------------- repeated failover cycling
+
+
+def test_repeated_failover_cycles_multitenant(svc):
+    """Satellite (PR 12): three full trip→probation→restore cycles
+    under CONCURRENT multi-tenant load — zero lost tickets (every
+    collect resolves) and per-request blame preserved bit-identical to
+    the host path across every cycle, for every tenant and class."""
+    probe_ok = threading.Event()
+    s = svc(
+        deadlines_ms={k: 0 for k in Klass},
+        batch_deadline_s=0.25,
+        failover_tick_s=0.03,
+        probation_ok=1,
+        probe_period_s=0.03,
+        probe_fn=lambda _t: _probe(probe_ok.is_set()),
+    )
+    _fake_device(s)
+    stop = threading.Event()
+    res_mtx = threading.Lock()
+    results: list[tuple[str, list, tuple]] = []
+    errors: list[str] = []
+
+    def loader(tenant: str, klass: Klass, tag: bytes):
+        i = 0
+        while not stop.is_set():
+            items = _sigs(3, tag + b"-%d" % (i % 4), tamper=(i % 3,))
+            try:
+                got = s.submit(items, klass, tenant=tenant).collect(WAIT)
+            except Exception as e:  # noqa: BLE001 — a lost/errored ticket fails the test
+                errors.append(f"{tenant}: {type(e).__name__}: {e}")
+                return
+            with res_mtx:
+                results.append((tenant, items, got))
+            i += 1
+            time.sleep(0.005)
+
+    loaders = [
+        ("chain-a", Klass.CONSENSUS, b"la"),
+        ("chain-b", Klass.CONSENSUS, b"lb"),
+        ("chain-b", Klass.MEMPOOL, b"lm"),
+        ("chain-c", Klass.BACKGROUND, b"lc"),
+    ]
+    threads = [
+        threading.Thread(
+            target=loader, args=spec, name=f"t-cycle-loader-{i}"
+        )
+        for i, spec in enumerate(loaders)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for cycle in range(3):
+            probe_ok.clear()
+            fail.arm("wedge_device")
+            deadline = time.monotonic() + WAIT
+            while (
+                s.backend_mode != MODE_CPU_FALLBACK
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert s.backend_mode == MODE_CPU_FALLBACK, f"cycle {cycle}: no trip"
+            n0 = len(results)
+            time.sleep(0.2)  # degraded traffic must keep flowing
+            assert len(results) > n0, f"cycle {cycle}: no progress while tripped"
+            fail.clear("wedge_device")
+            probe_ok.set()
+            deadline = time.monotonic() + WAIT
+            while s.backend_mode != MODE_TPU and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert s.backend_mode == MODE_TPU, f"cycle {cycle}: no restore"
+            time.sleep(0.1)  # restored traffic flows before the next trip
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(WAIT)
+    assert not errors, errors
+    st = s.stats()
+    assert st["failover"]["trips"] == 3 and st["failover"]["restores"] == 3
+    # every resolved ticket, from every cycle/mode, bit-identical to the
+    # host path with blame in its own add() order — and all four tenant
+    # streams made progress
+    assert len(results) >= 20
+    seen_tenants = set()
+    for tenant, items, got in results:
+        seen_tenants.add(tenant)
+        assert got == _host_verdicts(items), tenant
+    assert seen_tenants == {"chain-a", "chain-b", "chain-c"}
+    # per-tenant dispatch accounting survived the worker respawns
+    tallies = st["tenants"]
+    for tenant in seen_tenants:
+        assert tallies[tenant]["dispatched_batches"] > 0
+
+
 # ---------------------------------------------------- CPU-mode routing
 
 

@@ -182,3 +182,59 @@ def test_hub_exposition_parses_clean_and_has_verify_plane():
     ):
         assert name in types, f"{name} missing from the hub exposition"
     assert ("cometbft_p2p_message_send_count", {"ch_id": "64"}, 1.0) in samples
+
+def test_label_guard_bounds_cardinality():
+    """LabelGuard: the first max_values distinct values keep their own
+    series, everything after lands in __overflow__ — and admission is
+    sticky, so an admitted value never migrates."""
+    from cometbft_tpu.utils.metrics import LabelGuard
+
+    g = LabelGuard(3)
+    assert [g.bound(f"t{i}") for i in range(3)] == ["t0", "t1", "t2"]
+    assert g.bound("t3") == "__overflow__"
+    assert g.bound("t0") == "t0"  # sticky
+    assert g.bound("t4") == "__overflow__"
+    assert g.admitted() == 3 and g.overflowed() == 2
+
+
+def test_label_guard_caps_series_in_exposition():
+    """An unbounded tenant-id stream through a guarded label produces a
+    BOUNDED series set: max_values own series plus one overflow bucket,
+    with every overflow observation aggregated there."""
+    from cometbft_tpu.utils.metrics import LabelGuard, Registry
+
+    r = Registry("guardtest")
+    c = r.counter("tenant_hits_total")
+    g = LabelGuard(2)
+    for i in range(10):
+        c.inc(tenant=g.bound(f"ten{i}"))
+    types, samples = parse_exposition(r.expose_text())
+    series = [
+        (labels, v) for (name, labels, v) in samples
+        if name == "guardtest_tenant_hits_total"
+    ]
+    assert len(series) == 3  # ten0, ten1, __overflow__ — never 10
+    by_tenant = {labels["tenant"]: v for labels, v in series}
+    assert by_tenant["ten0"] == 1.0 and by_tenant["ten1"] == 1.0
+    assert by_tenant["__overflow__"] == 8.0
+
+
+def test_hub_tenant_metrics_registered():
+    """The verify-service tenancy series exist on the hub and the
+    tenant guard is wired (bounded by the knob's default)."""
+    from cometbft_tpu.utils.metrics import LabelGuard, hub
+
+    h = hub()
+    assert isinstance(h.tenant_labels, LabelGuard)
+    h.verify_svc_tenant_queue_depth.set(
+        1, tenant=h.tenant_labels.bound("metrics-test-tenant"),
+        **{"class": "mempool"},
+    )
+    types, _samples = parse_exposition(h.registry.expose_text())
+    for name in (
+        "cometbft_verify_svc_tenant_queue_depth",
+        "cometbft_verify_svc_tenant_dispatched_total",
+        "cometbft_verify_svc_tenant_rejected_total",
+        "cometbft_verify_svc_collect_timeout_total",
+    ):
+        assert name in types, f"{name} missing from the hub exposition"

@@ -318,6 +318,353 @@ def test_stop_fails_stranded_tickets(svc):
         t.collect(WAIT)
 
 
+# --------------------------------------------- (tenant, class) scheduling
+
+
+def test_tenant_quota_confines_backpressure(svc):
+    """One tenant at its per-class quota rejects with scope=tenant while
+    other tenants (and the class as a whole) keep admitting — the
+    rogue-flood isolation property."""
+    s = svc(
+        queue_max=1000, tenant_quota=4,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    s.submit(_sigs(4, b"qa"), Klass.MEMPOOL, tenant="quota-a")  # at quota
+    with pytest.raises(VerifyServiceBackpressure) as ei:
+        s.submit(_sigs(1, b"qa2"), Klass.MEMPOOL, tenant="quota-a")
+    assert ei.value.tenant == "quota-a" and ei.value.scope == "tenant"
+    assert ei.value.limit == 4
+
+    # the offender's quota does not starve the neighbor tenant
+    t_b = s.submit(_sigs(2, b"qb"), Klass.MEMPOOL, tenant="quota-b")
+    assert t_b is not None
+
+    # nor the offender's OTHER classes (quota is per (tenant, class))
+    ok, per = s.submit(
+        _sigs(2, b"qa-cs"), Klass.CONSENSUS, tenant="quota-a"
+    ).collect(WAIT)
+    assert ok and per == [True, True]
+
+    # flight-recorder event carries tenant + scope
+    from cometbft_tpu.utils.flightrec import recorder
+
+    ev = [
+        e for e in recorder().dump()["entries"]
+        if e["kind"] == "verifysvc_backpressure"
+        and e.get("detail", {}).get("tenant") == "quota-a"
+    ]
+    assert ev and ev[-1]["detail"]["scope"] == "tenant"
+
+    # per-tenant tallies: the reject landed on the offender only
+    st = s.stats()
+    assert st["tenants"]["quota-a"]["rejected"] == 1
+    assert st["tenants"].get("quota-b", {}).get("rejected", 0) == 0
+
+
+def test_class_bound_still_caps_across_tenants(svc):
+    """The class-wide queue bound is a second ceiling over the sum of
+    tenants: many tenants can't overcommit the class by each staying
+    under their own quota."""
+    s = svc(
+        queue_max=4, tenant_quota=1000,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    s.submit(_sigs(3, b"ca"), Klass.MEMPOOL, tenant="cls-a")
+    with pytest.raises(VerifyServiceBackpressure) as ei:
+        s.submit(_sigs(2, b"cb"), Klass.MEMPOOL, tenant="cls-b")
+    assert ei.value.scope == "class" and ei.value.tenant == "cls-b"
+
+
+def test_tenant_weighted_fair_interleave(svc):
+    """Within one class, ready tenants interleave by weight: with
+    a=2/b=1 and a backlog of four requests each, tenant a gets two
+    dispatch slots for b's one while both are ready — a deeper queue
+    buys no extra share."""
+    import threading as _threading
+
+    s = svc(
+        batch_max=1,  # 1-sig requests never coalesce: one dispatch each
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+        tenant_weights={"wa": 2, "wb": 1},
+    )
+
+    class SyncBV:
+        _entry = object()  # not offloaded: dispatch settles inline
+        _fallback = None
+
+        def __init__(self):
+            self.items = []
+
+        def add(self, *item):
+            self.items.append(item)
+
+        def submit(self):
+            return ("sync", (True, [True] * len(self.items)))
+
+        def collect(self, ticket):
+            return ticket[1]
+
+    s._make_verifier = lambda mode: SyncBV()
+    gate = _threading.Event()
+    order = []
+    real_dispatch = s._dispatch
+
+    def recording_dispatch(klass, batch, reason):
+        if not order:
+            gate.wait(WAIT)  # park the scheduler on the primer dispatch
+        order.append(batch[0].tenant)
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = recording_dispatch
+    tickets = [s.submit(_sigs(1, b"primer"), Klass.MEMPOOL, tenant="wx")]
+    time.sleep(0.15)  # scheduler is now parked inside the primer dispatch
+    for i in range(4):
+        tickets.append(s.submit(_sigs(1, b"wa%d" % i), Klass.MEMPOOL, tenant="wa"))
+    for i in range(4):
+        tickets.append(s.submit(_sigs(1, b"wb%d" % i), Klass.MEMPOOL, tenant="wb"))
+    gate.set()
+    for t in tickets:
+        assert t.collect(WAIT) == (True, [True])
+    assert order[0] == "wx" and sorted(order[1:]) == ["wa"] * 4 + ["wb"] * 4
+    # while BOTH tenants were ready (first 6 picks), shares follow the
+    # 2:1 weights; the tail drains whoever remains
+    contended = order[1:7]
+    assert contended.count("wa") == 4 and contended.count("wb") == 2
+    # and the interleave really alternates (b is never starved to the end)
+    assert "wb" in contended[:2] or "wb" in contended[:3]
+
+
+def test_tenant_round_robin_equal_weights(svc):
+    """No weights configured: ready tenants alternate strictly."""
+    import threading as _threading
+
+    s = svc(
+        batch_max=1,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+
+    class SyncBV:
+        _entry = object()
+        _fallback = None
+
+        def __init__(self):
+            self.items = []
+
+        def add(self, *item):
+            self.items.append(item)
+
+        def submit(self):
+            return ("sync", (True, [True] * len(self.items)))
+
+        def collect(self, ticket):
+            return ticket[1]
+
+    s._make_verifier = lambda mode: SyncBV()
+    gate = _threading.Event()
+    order = []
+    real_dispatch = s._dispatch
+
+    def recording_dispatch(klass, batch, reason):
+        if not order:
+            gate.wait(WAIT)
+        order.append(batch[0].tenant)
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = recording_dispatch
+    tickets = [s.submit(_sigs(1, b"p"), Klass.MEMPOOL, tenant="rx")]
+    time.sleep(0.15)
+    for i in range(3):
+        tickets.append(s.submit(_sigs(1, b"ra%d" % i), Klass.MEMPOOL, tenant="ra"))
+        tickets.append(s.submit(_sigs(1, b"rb%d" % i), Klass.MEMPOOL, tenant="rb"))
+    gate.set()
+    for t in tickets:
+        assert t.collect(WAIT) == (True, [True])
+    assert order[1:] in (
+        ["ra", "rb", "ra", "rb", "ra", "rb"],
+        ["rb", "ra", "rb", "ra", "rb", "ra"],
+    )
+
+
+def test_consensus_outranks_other_tenants_mempool(svc):
+    """Strict class priority is GLOBAL across tenants: tenant A's
+    consensus batch dispatches before tenant B's ready mempool backlog
+    however the tenant interleave stands."""
+    s = svc(
+        batch_max=64,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
+            Klass.MEMPOOL: 60_000, Klass.BACKGROUND: 60_000,
+        },
+    )
+    order = []
+    real_dispatch = s._dispatch
+
+    def record(klass, batch, reason):
+        order.append((klass, batch[0].tenant))
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = record
+    for i in range(3):
+        s.submit(_sigs(2, b"mpx%d" % i), Klass.MEMPOOL, tenant="chainB")
+    ok, per = s.submit(
+        _sigs(2, b"csx"), Klass.CONSENSUS, tenant="chainA"
+    ).collect(WAIT)
+    assert ok and per == [True, True]
+    assert order and order[0] == (Klass.CONSENSUS, "chainA")
+
+
+def test_default_tenant_from_knob(svc, monkeypatch):
+    """A process claims its tenant via COMETBFT_TPU_VERIFYSVC_TENANT;
+    submits without an explicit tenant land there, so the whole node
+    becomes that tenant with zero call-site changes."""
+    from cometbft_tpu.verifysvc.service import default_tenant
+
+    assert default_tenant() == "default"
+    monkeypatch.setenv("COMETBFT_TPU_VERIFYSVC_TENANT", "my-chain")
+    assert default_tenant() == "my-chain"
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    ok, per = s.submit(_sigs(1, b"dt"), Klass.CONSENSUS).collect(WAIT)
+    assert ok
+    assert s.stats()["tenants"]["my-chain"]["dispatched_batches"] == 1
+
+
+def test_tenant_state_is_pruned_when_drained(svc):
+    """Scheduler state stays bounded under a churning tenant-id stream:
+    a drained tenant leaves the queue dicts entirely."""
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    for i in range(8):
+        ok, per = s.submit(
+            _sigs(1, b"churn%d" % i), Klass.CONSENSUS, tenant=f"churn-{i}"
+        ).collect(WAIT)
+        assert ok
+    with s._cond:
+        assert s._queues[Klass.CONSENSUS] == {}
+        assert s._queued_sigs[Klass.CONSENSUS] == {}
+
+
+def test_client_collect_timeout_degrades_to_host(svc, monkeypatch):
+    """Satellite: a live-but-stuck scheduler (ticket accepted, never
+    resolved) no longer parks a consensus caller forever — the bounded
+    collect expires, stall forensics land, and the caller gets correct
+    host verdicts in its own add() order."""
+    import threading as _threading
+
+    from cometbft_tpu.utils.flightrec import recorder
+    from cometbft_tpu.verifysvc import service as service_mod
+
+    monkeypatch.setenv("COMETBFT_TPU_VERIFYSVC_COLLECT_TIMEOUT_MS", "300")
+    s = svc(deadlines_ms={k: 0 for k in Klass}, failover=False)
+    release = _threading.Event()
+
+    class StuckBV:
+        _entry = object()
+        _fallback = None
+
+        def __init__(self):
+            self.items = []
+
+        def add(self, *item):
+            self.items.append(item)
+
+        def submit(self):
+            return ("stuck", list(self.items))
+
+        def collect(self, ticket):
+            release.wait(WAIT)  # the collector parks here "forever"
+            return (True, [True] * len(ticket[1]))
+
+    s._make_verifier = lambda mode: StuckBV()
+    before = mhub().verify_svc_collect_timeout.value(**{"class": "consensus"})
+    items = _sigs(3, b"stall", tamper=(1,))
+    bv = ServiceBatchVerifier(Klass.CONSENSUS, service=s, tenant="stall-t")
+    for pub, msg, sig in items:
+        bv.add(pub, msg, sig)
+    t0 = time.monotonic()
+    ok, per = bv.verify()
+    waited = time.monotonic() - t0
+    assert 0.25 <= waited < 5.0  # bounded, not forever
+    assert (not ok) and per == [True, False, True]  # host verdicts, own order
+    assert (
+        mhub().verify_svc_collect_timeout.value(**{"class": "consensus"})
+        == before + 1
+    )
+    stalls = [
+        e for e in recorder().dump()["entries"]
+        if e["kind"] == "verifysvc_collect_stall"
+        and e.get("detail", {}).get("tenant") == "stall-t"
+    ]
+    assert stalls and stalls[-1]["detail"]["sigs"] == 3
+    release.set()  # unpark the collector so teardown joins cleanly
+    service_mod._reset_stall_gate()
+
+
+def test_collect_stall_forensics_artifact(tmp_path):
+    """report_collect_stall writes ONE rate-limited artifact naming the
+    stuck class/tenant (and never raises)."""
+    from cometbft_tpu.verifysvc import service as service_mod
+
+    service_mod._reset_stall_gate()
+    p1 = service_mod.report_collect_stall(
+        Klass.CONSENSUS, "tenant-x", 5, 12.3, artifact_dir=str(tmp_path)
+    )
+    assert p1 and (tmp_path / p1.split("/")[-1]).exists()
+    with open(p1) as f:
+        body = f.read()
+    assert "collect() deadline expired" in body and "tenant-x" in body
+    # second report inside the rate window is suppressed (storm control)
+    p2 = service_mod.report_collect_stall(
+        Klass.CONSENSUS, "tenant-x", 5, 12.3, artifact_dir=str(tmp_path)
+    )
+    assert p2 is None
+    service_mod._reset_stall_gate()
+
+
+def test_checktx_collect_timeout_falls_back_to_host(svc, monkeypatch):
+    import threading as _threading
+
+    from cometbft_tpu.verifysvc import service as service_mod
+
+    monkeypatch.setenv("COMETBFT_TPU_VERIFYSVC_COLLECT_TIMEOUT_MS", "200")
+    s = svc(deadlines_ms={k: 0 for k in Klass}, failover=False)
+    release = _threading.Event()
+
+    class StuckBV:
+        _entry = object()
+        _fallback = None
+
+        def __init__(self):
+            self.items = []
+
+        def add(self, *item):
+            self.items.append(item)
+
+        def submit(self):
+            return ("stuck", list(self.items))
+
+        def collect(self, ticket):
+            release.wait(WAIT)
+            return (True, [True] * len(ticket[1]))
+
+    s._make_verifier = lambda mode: StuckBV()
+    sk = host.PrivKey.from_seed(b"z" * 32)
+    tx = checktx.make_signed_tx(sk, b"stuck-but-served")
+    assert checktx.verify_tx_signature(tx, service=s) is True  # host path
+    release.set()
+    service_mod._reset_stall_gate()
+
+
 # ------------------------------------------------------- CheckTx client
 
 
