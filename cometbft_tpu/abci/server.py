@@ -61,6 +61,10 @@ class SocketServer(Service):
             ).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
+        # deliberately blocking: an ABCI connection serves until EOF and
+        # is woken at teardown by close_socket()'s shutdown — declared
+        # here so the socket-without-timeout check reads the intent
+        conn.settimeout(None)
         buf = b""
         out = bytearray()
         try:

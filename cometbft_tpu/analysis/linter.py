@@ -137,6 +137,7 @@ def all_checks() -> dict[str, object]:
         lock_blocking,
         metrics_registry,
         raw_env,
+        socket_timeout,
         swallowed_exc,
         thread_names,
         untracked_jit,
@@ -154,6 +155,7 @@ def all_checks() -> dict[str, object]:
         host_sync,
         weak_type_literal,
         donated_read,
+        socket_timeout,
     )
     return {m.CHECK_ID: m for m in mods}
 

@@ -80,7 +80,9 @@ def create_batch_verifier(
     scheduler thread."""
     if not supports_batch_verifier(key_type):
         raise ValueError(f"no batch verifier for key type {key_type!r}")
-    if not device_capable():
+    from ..verifysvc.service import remote_plane_configured
+
+    if not device_capable() and not remote_plane_configured():
         return CpuEd25519BatchVerifier()
     from ..verifysvc.client import ServiceBatchVerifier, resolve_mode
     from ..verifysvc.service import Klass

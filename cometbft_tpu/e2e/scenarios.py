@@ -28,6 +28,13 @@ The scenarios extend the e2e :class:`~cometbft_tpu.e2e.runner.Runner`
 ``valset_rotation_blocksync``  rotate a validator's power while a late
                            joiner is blocksyncing through the rotation
                            heights; the joiner must converge.
+``plane_crash``            3 nodes consume ONE shared out-of-process
+                           verify plane (verifyd); kill -9 it
+                           mid-height with traffic flowing — every
+                           node's breaker must trip to the in-process
+                           host path (heights keep advancing), and
+                           restarting the plane must probation-restore
+                           the remote path on every node.
 ========================== ==============================================
 
 Driven by ``scripts/chaos.py`` (``--json`` emits a machine-readable
@@ -651,6 +658,144 @@ def scenario_valset_rotation_blocksync(
         r.stop_all()
 
 
+def scenario_plane_crash(out_dir: str, base_port: int = 27200) -> ScenarioResult:
+    """Shared out-of-process verify plane, killed and revived: 3 nodes
+    all point COMETBFT_TPU_VERIFYRPC_ADDR at ONE verifyd; the harness
+    kill -9s it mid-height under load.  Liveness must resume via every
+    node's circuit breaker (remote -> in-process host fallback, heights
+    keep advancing), and restarting the plane must probation-restore
+    the remote path — asserted from each node's /verify_svc_status
+    `remote` section plus the plane's own served-request tallies."""
+    from ..verifysvc import remote as vremote
+    from ..verifysvc import server as vserver
+
+    res = ScenarioResult(
+        "plane_crash", artifact_dir=os.path.join(out_dir, "plane_crash")
+    )
+    t0 = time.monotonic()
+    plane_addr = f"127.0.0.1:{base_port + 900}"
+    os.makedirs(res.artifact_dir, exist_ok=True)
+    plane_log = os.path.join(res.artifact_dir, "verifyd.log")
+    plane, plane_addr = vserver.spawn_verifyd(plane_addr, log_path=plane_log)
+    res.details["plane_addr"] = plane_addr
+    # a tight breaker leash so the scenario's windows stay short: small
+    # request budget, a couple of connection failures to trip, fast
+    # probation probing back
+    remote_env = {
+        "COMETBFT_TPU_VERIFYRPC_ADDR": plane_addr,
+        "COMETBFT_TPU_VERIFYRPC_BUDGET_MS": "4000",
+        "COMETBFT_TPU_VERIFYRPC_BREAKER_FAILS": "2",
+        "COMETBFT_TPU_VERIFYRPC_PROBE_PERIOD_MS": "500",
+        "COMETBFT_TPU_VERIFYRPC_PROBATION_OK": "2",
+    }
+    m = Manifest(
+        chain_id="chaos-plane-crash",
+        nodes=[
+            NodeSpec("a", env=dict(remote_env)),
+            NodeSpec("b", env=dict(remote_env)),
+            NodeSpec("c", env=dict(remote_env)),
+        ],
+        target_height=8,
+        load_tx_per_round=2,
+    )
+    r = Runner(m, os.path.join(out_dir, "plane_crash", "net"), base_port=base_port)
+    r.setup()
+    r.start()
+    signed_load = _signed_tx_sender(r.nodes[0], "plane")
+
+    def _breakers() -> list[str]:
+        out = []
+        for n in r.nodes:
+            try:
+                out.append(
+                    (n.verify_svc().get("remote") or {}).get("breaker", "?")
+                )
+            except Exception as e:  # noqa: BLE001 — mid-scenario RPC gaps
+                _log.debug(f"breaker probe of {n.name}: {e!r}")
+                out.append("?")
+        return out
+
+    try:
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= 3, 180, "baseline height",
+            extra=signed_load,
+        ):
+            res.problems.append("net never reached height 3 (plane alive)")
+            return _finish(res, r, t0, upto=3)
+        st = vremote.plane_status(plane_addr)
+        served = (st or {}).get("server", {}).get("requests", 0)
+        res.details["plane_requests_before_crash"] = served
+        if not served:
+            res.problems.append(
+                "plane served zero requests pre-crash: nodes never "
+                "actually consumed the remote plane"
+            )
+            return _finish(res, r, t0, upto=3)
+
+        # ---- kill -9 the plane mid-height, load still flowing
+        crash_h = _min_height(r)
+        plane.kill()
+        plane.wait(timeout=20)
+        res.details["crash_height"] = crash_h
+
+        # liveness THROUGH the outage: the breakers trip and commits
+        # continue on the in-process host path
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= crash_h + 2, 180,
+            "commits with the plane dead", extra=signed_load,
+        ):
+            res.problems.append(
+                f"net stalled after plane kill (stuck at {_min_height(r)}, "
+                f"breakers {_breakers()})"
+            )
+            return _finish(res, r, t0, upto=crash_h)
+        res.liveness = True
+        tripped = _wait_for(
+            lambda: all(b == "open" for b in _breakers()), 30,
+            desc="all breakers open",
+        )
+        res.details["breakers_after_crash"] = _breakers()
+        if not tripped:
+            res.problems.append(
+                f"not every node tripped its breaker: {_breakers()}"
+            )
+
+        # ---- revive the plane at the same address; probation restores
+        plane, _ = vserver.spawn_verifyd(plane_addr, log_path=plane_log)
+        restored = _drive_load_until(
+            r, lambda: all(b == "closed" for b in _breakers()), 120,
+            "breakers closed after restart", extra=signed_load,
+        )
+        res.details["breakers_after_restart"] = _breakers()
+        if not restored:
+            res.problems.append(
+                f"breakers never restored after plane restart: {_breakers()}"
+            )
+            return _finish(res, r, t0, upto=crash_h + 2)
+        h1 = _min_height(r)
+        if not _drive_load_until(
+            r,
+            lambda: _min_height(r) >= h1 + 2
+            and (vremote.plane_status(plane_addr) or {})
+            .get("server", {}).get("requests", 0) > 0,
+            120, "remote-served commits after restart", extra=signed_load,
+        ):
+            res.problems.append(
+                "no remote-served progress after plane restart"
+            )
+            return _finish(res, r, t0, upto=h1)
+        res.details["plane_requests_after_restart"] = (
+            vremote.plane_status(plane_addr) or {}
+        ).get("server", {}).get("requests")
+        return _finish(res, r, t0, upto=h1)
+    finally:
+        r.stop_all()
+        try:
+            plane.kill()
+        except OSError as e:
+            _log.debug(f"plane teardown kill: {e!r}")
+
+
 # ------------------------------------------------------------- registry
 
 SCENARIOS = {
@@ -660,9 +805,10 @@ SCENARIOS = {
     "partition_heal": scenario_partition_heal,
     "double_sign": scenario_double_sign,
     "valset_rotation_blocksync": scenario_valset_rotation_blocksync,
+    "plane_crash": scenario_plane_crash,
 }
 
-# the five "full" scenarios scripts/chaos.py runs by default (the smoke
+# the six "full" scenarios scripts/chaos.py runs by default (the smoke
 # is tier-1's fast stand-in, subsumed by `wedge`)
 DEFAULT_SCENARIOS = [
     "wedge",
@@ -670,6 +816,7 @@ DEFAULT_SCENARIOS = [
     "partition_heal",
     "double_sign",
     "valset_rotation_blocksync",
+    "plane_crash",
 ]
 
 

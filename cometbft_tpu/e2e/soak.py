@@ -31,6 +31,22 @@ drain).  Consensus latency samples are tagged with phase and wedge
 windows so the starvation comparison only uses clean (un-wedged)
 baseline vs clean flood samples.
 
+**Remote-plane mode** (``remote_plane=True`` / ``scripts/soak.py
+--remote-plane``): the tenants' shared service routes every batch to a
+spawned **verifyd subprocess** (verifysvc/server.py) over the RPC
+surface, so the whole soak crosses a real process boundary — quotas
+are enforced SERVER-side (the client service's own quota is opened to
+the class bound; rejections ride the wire back as backpressure with
+tenant/scope intact), and the mid-soak fault becomes the real thing:
+each cycle **kill -9s the verifyd** with batches in flight, waits for
+the circuit breaker to trip (host fallback keeps every ticket
+settling, bit-identical), restarts the plane at the same address, and
+waits for probation to restore the remote path.  The SLO artifact then
+additionally asserts the plane actually served traffic and that quota
+isolation held in the PLANE's own tallies.  Pair with
+``chaos_scenarios=("plane_crash",)`` for the real-node-process version
+of the same fault running concurrently.
+
 Driven by ``scripts/soak.py``; the fast two-tenant smoke configuration
 runs in tier-1 (tests/test_soak.py), the real >=5-minute soak in the
 slow tier and standalone.
@@ -100,6 +116,12 @@ class SoakConfig:
     chaos_base_port: int = 29400
     artifact_dir: str = ""
     json_path: str = ""
+    # ---- out-of-process plane mode (module docstring, "Remote-plane")
+    remote_plane: bool = False
+    remote_budget_s: float = 3.0  # per-request wire budget
+    remote_breaker_fails: int = 2
+    remote_probe_period_s: float = 0.25
+    verifyd_port: int = 29900  # 0 = ephemeral
 
     def phase_plan(self) -> dict[str, tuple[float, float]]:
         """Phase windows as (start, end) offsets from t0."""
@@ -163,10 +185,24 @@ class SoakRun:
             cfg.tenants, n_validators=cfg.validators_per_chain, seed=cfg.seed
         )
         self.rogue = cfg.rogue or self.chains[-1].name
+        self._verifyd = None
+        self.plane_addr: str | None = None
+        if cfg.remote_plane:
+            # the PLANE owns admission control: its env carries the real
+            # quota/batch shape, while the client-side service's quota is
+            # opened to the class bound so every rejection is genuinely
+            # server-side and rides the wire back with tenant/scope
+            self._verifyd_env = {
+                "COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA": str(cfg.tenant_quota),
+                "COMETBFT_TPU_VERIFYSVC_QUEUE_MAX": str(cfg.queue_max),
+                "COMETBFT_TPU_VERIFYSVC_BATCH_MAX": str(cfg.batch_max),
+            }
+            self._spawn_plane()
+        client_quota = cfg.queue_max if cfg.remote_plane else cfg.tenant_quota
         self.svc = VerifyService(
             batch_max=cfg.batch_max,
             queue_max=cfg.queue_max,
-            tenant_quota=cfg.tenant_quota,
+            tenant_quota=client_quota,
             tenant_weights=dict(cfg.tenant_weights),
             deadlines_ms={
                 Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2,
@@ -178,11 +214,24 @@ class SoakRun:
             probe_fn=self._probe,
             failover_tick_s=0.05,
             artifact_dir=cfg.artifact_dir or None,
+            remote_addr=self.plane_addr or "",
+            remote_opts=(
+                dict(
+                    budget_s=cfg.remote_budget_s,
+                    breaker_fails=cfg.remote_breaker_fails,
+                    probe_period_s=cfg.remote_probe_period_s,
+                    probation_ok=cfg.probation_ok,
+                    backoff_s=0.05,
+                )
+                if cfg.remote_plane else None
+            ),
         )
-        if cfg.data_plane == "fake":
+        if cfg.data_plane == "fake" and not cfg.remote_plane:
             real = VerifyService._make_verifier.__get__(self.svc)
             # fake device for TPU mode only: cpu_fallback must exercise
-            # the PRODUCTION _HostBatchVerifier routing
+            # the PRODUCTION _HostBatchVerifier routing.  (Remote mode
+            # never fakes: the data plane under test IS the wire +
+            # verifyd host path + breaker fallback.)
             self.svc._make_verifier = (
                 lambda mode: _FakeDeviceBV()
                 if self.svc.backend_mode == MODE_TPU else real(mode)
@@ -196,6 +245,9 @@ class SoakRun:
             c.name: [] for c in self.chains
         }
         self.cs_timeouts: dict[str, int] = {c.name: 0 for c in self.chains}
+        # per-tenant consensus backpressure observations: in remote mode
+        # a victim seeing ANY is a server-side quota isolation failure
+        self.cs_backpressure: dict[str, int] = {c.name: 0 for c in self.chains}
         self.checktx_stats: dict[str, dict[str, int]] = {
             c.name: {"attempts": 0, "mismatches": 0} for c in self.chains
         }
@@ -217,6 +269,27 @@ class SoakRun:
         self.errors: list[str] = []
 
     # --------------------------------------------------------- plumbing
+
+    def _spawn_plane(self) -> None:
+        from ..verifysvc import server as vserver
+
+        addr = self.plane_addr or f"127.0.0.1:{self.cfg.verifyd_port}"
+        log = os.path.join(
+            self.cfg.artifact_dir or os.getcwd(), "soak-verifyd.log"
+        ) if self.cfg.artifact_dir else None
+        self._verifyd, self.plane_addr = vserver.spawn_verifyd(
+            addr, extra_env=dict(self._verifyd_env), log_path=log,
+        )
+        _log.info(
+            f"soak verifyd at {self.plane_addr} (pid {self._verifyd.pid})"
+        )
+
+    def _plane_stats(self) -> dict | None:
+        from ..verifysvc import remote as vremote
+
+        if self.plane_addr is None:
+            return None
+        return vremote.plane_status(self.plane_addr)
 
     @staticmethod
     def _probe(_timeout_s: float) -> ProbeResult:
@@ -255,8 +328,11 @@ class SoakRun:
                 )
                 _ok, per = ticket.collect(self.cfg.collect_timeout_s)
             except VerifyServiceBackpressure:
-                # counted by the service's tenant tallies; the quota-
-                # isolation assertion fails the run if a victim sees this
+                # counted here AND by the (local or plane-side) tenant
+                # tallies; the quota-isolation assertion fails the run
+                # if a victim sees this
+                with self._mtx:
+                    self.cs_backpressure[chain.name] += 1
                 continue
             except TimeoutError:
                 with self._mtx:
@@ -311,6 +387,11 @@ class SoakRun:
                     self._record_drift(per, expected, f"{chain.name}/flood")
                 except TimeoutError:
                     still.append(t)
+                except VerifyServiceBackpressure as e:
+                    # remote mode: the PLANE's quota rejected the batch
+                    # after local admission — a settled (not lost)
+                    # ticket, attributed to this tenant
+                    self._count_flood_reject(chain, e)
             pending[:] = still
 
         while not self.stop_ev.is_set():
@@ -328,12 +409,7 @@ class SoakRun:
                     with self._mtx:
                         self.flood_stats["submitted"] += 1
                 except VerifyServiceBackpressure as e:
-                    with self._mtx:
-                        self.flood_stats["rejected"] += 1
-                    if e.tenant != chain.name and len(self.errors) < 32:
-                        self.errors.append(
-                            f"flood backpressure misattributed: {e.tenant!r}"
-                        )
+                    self._count_flood_reject(chain, e)
             before = len(pending)
             sweep(0.05)
             if pending and len(pending) == before:
@@ -354,6 +430,17 @@ class SoakRun:
             except TimeoutError:
                 with self._mtx:
                     self.flood_stats["timeouts"] += 1
+            except VerifyServiceBackpressure as e:
+                self._count_flood_reject(chain, e)  # settled, not lost
+
+    def _count_flood_reject(self, chain: TenantChain, e) -> None:
+        with self._mtx:
+            self.flood_stats["rejected"] += 1
+        if e.tenant != chain.name and len(self.errors) < 32:
+            with self._mtx:
+                self.errors.append(
+                    f"flood backpressure misattributed: {e.tenant!r}"
+                )
 
     # ------------------------------------------------------ fault plane
 
@@ -389,6 +476,77 @@ class SoakRun:
             self.wedge_windows.append(ev)
         _log.info(f"soak wedge cycle {tag}: {ev}")
         return ev
+
+    def _plane_crash_cycle(self, tag: str) -> dict:
+        """Remote mode's fault cycle: kill -9 the verifyd with batches
+        in flight → the client breaker must trip (host fallback keeps
+        every ticket settling bit-identically) → hold degraded → restart
+        the plane at the same address → probation must restore the
+        remote path.  Recorded in wedge_windows so the starvation SLO's
+        clean-window filter excludes the crash windows the same way."""
+        ev = {"tag": tag, "kind": "plane_crash", "armed_at": self._now(),
+              "tripped": False, "restored": False}
+        # accumulate rejected-by-tenant tallies BEFORE the kill wipes
+        # the plane's counters (quota isolation is asserted server-side)
+        self._accumulate_plane_tallies()
+        self._verifyd.kill()
+        try:
+            self._verifyd.wait(timeout=20)
+        except Exception as e:  # noqa: BLE001 — a zombie is the OS's problem now
+            _log.warning(f"soak verifyd wait after kill: {e!r}")
+        deadline = time.monotonic() + max(20.0, 4 * self.cfg.remote_budget_s)
+        while time.monotonic() < deadline and not self.stop_ev.is_set():
+            st = self.svc.stats().get("remote") or {}
+            if st.get("breaker") == "open":
+                ev["tripped"] = True
+                ev["tripped_at"] = self._now()
+                break
+            time.sleep(0.02)
+        self.stop_ev.wait(self.cfg.wedge_hold_s)
+        self._spawn_plane()
+        ev["cleared_at"] = self._now()
+        deadline = time.monotonic() + max(
+            20.0, 20 * self.cfg.remote_probe_period_s * self.cfg.probation_ok
+        )
+        while time.monotonic() < deadline and not self.stop_ev.is_set():
+            st = self.svc.stats().get("remote") or {}
+            if st.get("breaker") == "closed":
+                ev["restored"] = True
+                ev["restored_at"] = self._now()
+                break
+            time.sleep(0.02)
+        with self._mtx:
+            self.wedge_windows.append(ev)
+        _log.info(f"soak plane-crash cycle {tag}: {ev}")
+        return ev
+
+    def _accumulate_plane_tallies(self) -> None:
+        """Fold the current plane's per-tenant reject/dispatch tallies
+        into a run-wide accumulator — each kill -9 resets the plane's
+        own counters, and quota isolation must be judged over the WHOLE
+        run, not just the last incarnation."""
+        st = self._plane_stats()
+        if not st:
+            return
+        with self._mtx:
+            acc = getattr(self, "_plane_tally_acc", None)
+            if acc is None:
+                acc = self._plane_tally_acc = {
+                    "requests": 0, "rejected": 0, "deduped": 0,
+                    "tenants": {},
+                }
+            srv = st.get("server", {})
+            acc["requests"] += srv.get("requests", 0)
+            acc["rejected"] += srv.get("rejected", 0)
+            acc["deduped"] += srv.get("deduped", 0)
+            for tenant, tallies in (
+                st.get("service", {}).get("tenants", {}) or {}
+            ).items():
+                t = acc["tenants"].setdefault(
+                    tenant, {"dispatched_batches": 0, "rejected": 0}
+                )
+                t["dispatched_batches"] += tallies.get("dispatched_batches", 0)
+                t["rejected"] += tallies.get("rejected", 0)
 
     def _chaos_subprocess(self, scenario: str, slot: int = 0) -> None:
         """Run a full chaos scenario (real node processes — this is the
@@ -444,12 +602,16 @@ class SoakRun:
         for i in range(n_flood):
             times.append(f0 + (f1 - f0) * (i + 1) / (n_flood + 1))
         chaos_started = False
+        cycle = (
+            self._plane_crash_cycle if self.cfg.remote_plane
+            else self._wedge_cycle
+        )
         for i, at in enumerate(sorted(times)):
             while self._now() < at and not self.stop_ev.is_set():
                 self.stop_ev.wait(0.1)
             if self.stop_ev.is_set():
                 return
-            self._wedge_cycle(f"cycle{i}")
+            cycle(f"cycle{i}")
         while not self.stop_ev.is_set():
             if not chaos_started and self._now() >= r0:
                 chaos_started = self._start_chaos()
@@ -554,8 +716,18 @@ class SoakRun:
                 break
             time.sleep(0.1)
         self.watermarks.sample()
+        # fold the final plane incarnation's tallies in BEFORE teardown
+        # (the report reads the run-wide accumulator)
+        if self.cfg.remote_plane:
+            self._accumulate_plane_tallies()
         report = self._report(plan, started_unix, drained)
         self.svc.stop()
+        if self._verifyd is not None:
+            try:
+                self._verifyd.kill()
+                self._verifyd.wait(timeout=10)
+            except Exception as e:  # noqa: BLE001 — teardown of a maybe-dead child
+                _log.warning(f"soak verifyd teardown: {e!r}")
         if cfg.json_path:
             os.makedirs(
                 os.path.dirname(os.path.abspath(cfg.json_path)), exist_ok=True
@@ -638,14 +810,32 @@ class SoakRun:
                 victims_ok = victims_ok and ok
             tenants_report[c.name] = entry
 
-        # quota isolation from the service's own per-tenant tallies
-        tallies = svc_stats.get("tenants", {})
+        # quota isolation from the admission controller's own per-tenant
+        # tallies: the local service in-process, the PLANE (run-wide
+        # accumulator across kill -9 incarnations) in remote mode —
+        # plus, in remote mode, the client-side observation that no
+        # victim consensus loop ever saw a backpressure
+        if cfg.remote_plane:
+            plane_acc = getattr(self, "_plane_tally_acc", None) or {
+                "tenants": {}
+            }
+            tallies = plane_acc["tenants"]
+        else:
+            tallies = svc_stats.get("tenants", {})
         rogue_rejected = tallies.get(self.rogue, {}).get("rejected", 0)
         victim_rejected = {
             c.name: tallies.get(c.name, {}).get("rejected", 0)
             for c in self.chains if c.name != self.rogue
         }
-        quota_ok = rogue_rejected > 0 and not any(victim_rejected.values())
+        victim_bp = {
+            c.name: self.cs_backpressure[c.name]
+            for c in self.chains if c.name != self.rogue
+        }
+        quota_ok = (
+            rogue_rejected > 0
+            and not any(victim_rejected.values())
+            and not any(victim_bp.values())
+        )
 
         leak = (
             self.watermarks.flat() if cfg.leak_check
@@ -667,6 +857,19 @@ class SoakRun:
         )
         chaos_ok = all(r.get("ok") for r in self.chaos_results)
         lost = sum(self.cs_timeouts.values()) + self.flood_stats["timeouts"]
+        if cfg.remote_plane:
+            # the trip/restore tallies live in the remote breaker, and
+            # the plane must genuinely have served wire traffic
+            remote_stats = svc_stats.get("remote") or {}
+            trips = remote_stats.get("trips", 0)
+            restores = remote_stats.get("restores", 0)
+            plane_acc = getattr(self, "_plane_tally_acc", None) or {}
+            plane_served = plane_acc.get("requests", 0)
+            faults_ok = faults_ok and plane_served > 0
+        else:
+            trips = svc_stats["failover"]["trips"]
+            restores = svc_stats["failover"]["restores"]
+            plane_acc = None
 
         assertions = {
             "no_starvation": {"ok": victims_ok, "per_tenant": starvation_detail},
@@ -674,6 +877,8 @@ class SoakRun:
                 "ok": quota_ok,
                 "rogue_rejected": rogue_rejected,
                 "victim_rejected": victim_rejected,
+                "victim_backpressure": victim_bp,
+                "enforced": "server-side" if cfg.remote_plane else "in-process",
                 "flood": dict(self.flood_stats),
             },
             "no_leak": {"ok": leak_ok, **leak},
@@ -681,8 +886,8 @@ class SoakRun:
             "fault_endurance": {
                 "ok": faults_ok and chaos_ok,
                 "wedge_cycles": cycles,
-                "trips": svc_stats["failover"]["trips"],
-                "restores": svc_stats["failover"]["restores"],
+                "trips": trips,
+                "restores": restores,
                 "chaos": self.chaos_results,
             },
             "zero_lost_tickets": {"ok": lost == 0, "lost": lost},
@@ -693,6 +898,10 @@ class SoakRun:
             "started_unix": started_unix,
             "duration_s": round(self._now(), 1),
             "config": asdict(cfg),
+            "remote_plane": (
+                {"addr": self.plane_addr, "tallies": plane_acc}
+                if cfg.remote_plane else None
+            ),
             "rogue": self.rogue,
             "phases": {k: [round(a, 1), round(b, 1)] for k, (a, b) in plan.items()},
             "tenants": tenants_report,

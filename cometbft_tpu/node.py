@@ -493,10 +493,13 @@ class Node:
         # watchdog) NOW, not lazily on first submit — a device that
         # wedges while the node is verify-idle must already be tripped
         # to CPU fallback when the first commit/CheckTx batch arrives,
-        # not strand it and only then notice
+        # not strand it and only then notice.  Same for a configured
+        # remote plane: the breaker's dial/probe loop should already
+        # know whether the plane is reachable before the first batch.
         from .crypto import batch as _crypto_batch
+        from .verifysvc.service import remote_plane_configured
 
-        if _crypto_batch.device_capable():
+        if _crypto_batch.device_capable() or remote_plane_configured():
             from .verifysvc.service import global_service
 
             global_service()._ensure_started()

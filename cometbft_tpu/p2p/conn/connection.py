@@ -190,6 +190,21 @@ class MConnection(Service):
         pct = fail.armed("drop_p2p_pct")
         return pct is not None and fail.should_drop(pct)
 
+    @staticmethod
+    def _fault_delay() -> None:
+        """Chaos seam (utils/fail, fault ``delay_p2p_ms``): delay the
+        wire write by the armed milliseconds ±50% jitter — a laggy link
+        next to the drop seam's lossy one, so network-flaky soaks can
+        shape latency as well as loss.  Runs on the send ROUTINE (the
+        dedicated writer thread), never a caller: reactors keep queueing
+        at full speed while the link itself lags, exactly like real
+        latency.  One module-bool check when unarmed."""
+        from ...utils import fail
+
+        ms = fail.armed("delay_p2p_ms")
+        if ms:
+            fail.jittered_sleep(ms)
+
     def _pick_stream(self) -> _Stream | None:
         """Lowest sent/priority ratio wins (connection.go sendPacketMsg)."""
         best = None
@@ -232,6 +247,7 @@ class MConnection(Service):
                         m.p2p_send_count.inc(ch_id=str(pkt.channel_id))
                     out += frame
                 if out:
+                    self._fault_delay()
                     self.send_monitor.throttle(len(out))
                     self.conn.write(bytes(out))
                     del out[:]

@@ -769,9 +769,13 @@ class Environment:
     def verify_svc_status(self) -> dict:
         """Verify-service scheduler snapshot (ours, no reference
         analogue): per-class queue depths, dispatched/rejected batch
-        tallies, and the effective batch/deadline/weight configuration
-        (verifysvc/service.py).  Complements the `verify_svc_*` series
-        on /metrics with an on-demand structured view."""
+        tallies, the effective batch/deadline/weight configuration, and
+        — when COMETBFT_TPU_VERIFYRPC_ADDR points this node at a shared
+        out-of-process plane — the remote client's breaker state,
+        trip/restore tallies, and pending/resend counts under `remote`
+        (verifysvc/service.py + remote.py).  Complements the
+        `verify_svc_*`/`verify_rpc_*` series on /metrics with an
+        on-demand structured view."""
         from ..verifysvc.service import global_service
 
         return global_service().stats()

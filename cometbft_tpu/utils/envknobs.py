@@ -158,9 +158,12 @@ VERIFYSVC_TENANT = _declare(
 )
 VERIFYSVC_TENANT_QUOTA = _declare(
     "COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA", "int", 0,
-    "Per-(tenant, class) queue bound in signatures — one tenant's "
-    "mempool flood hits ITS quota and backpressures while other "
-    "tenants' queues stay admissible.  0 (default) = the class-wide "
+    "Per-(tenant, class) bound on OUTSTANDING signatures (queued + "
+    "dispatched-but-unsettled, released when each request's ticket "
+    "settles) — one tenant's mempool flood hits ITS quota and "
+    "backpressures while other tenants stay admissible, no matter how "
+    "fast the scheduler drains the queue into the device or wire "
+    "pipeline.  0 (default) = the class-wide "
     "COMETBFT_TPU_VERIFYSVC_QUEUE_MAX, i.e. no extra per-tenant bound.",
 )
 VERIFYSVC_TENANT_WEIGHTS = _declare(
@@ -184,6 +187,64 @@ VERIFYSVC_COLLECT_TIMEOUT_MS = _declare(
     "stall forensics and the client verifies its own batch inline on "
     "the host (first-wins ticket settlement discards the late device "
     "result).  0 = wait forever (the pre-PR-12 contract).",
+)
+
+# out-of-process verify plane (verifysvc/server.py + remote.py + verifyd)
+VERIFYRPC_ADDR = _declare(
+    "COMETBFT_TPU_VERIFYRPC_ADDR", "str", "",
+    "host:port of a shared out-of-process verify plane (verifyd, "
+    "`scripts/verifyd.py`).  When set, the local verify service routes "
+    "every batch over the wire instead of to a local device verifier "
+    "(comb binds are bypassed — device-resident state is the plane's), "
+    "falling back to the in-process host path whenever the circuit "
+    "breaker is open.  Empty (default) = the in-process plane.",
+)
+VERIFYRPC_BUDGET_MS = _declare(
+    "COMETBFT_TPU_VERIFYRPC_BUDGET_MS", "int", 10000,
+    "Per-request deadline budget (ms) for remote verify RPCs.  The "
+    "REMAINING budget — never a wall-clock deadline — crosses the wire "
+    "on every send and idempotent resend; a request that exhausts its "
+    "budget is a deadline breach, which trips the circuit breaker.",
+)
+VERIFYRPC_CONNECT_TIMEOUT_MS = _declare(
+    "COMETBFT_TPU_VERIFYRPC_CONNECT_TIMEOUT_MS", "int", 2000,
+    "TCP connect timeout (ms) for the remote verify plane (dials and "
+    "probation probes).",
+)
+VERIFYRPC_RETRY_MAX = _declare(
+    "COMETBFT_TPU_VERIFYRPC_RETRY_MAX", "int", 4,
+    "Max send attempts per remote verify request (first send + "
+    "idempotent resends after reconnects); beyond it the request fails "
+    "and the batch is re-verified on the host path.",
+)
+VERIFYRPC_BREAKER_FAILS = _declare(
+    "COMETBFT_TPU_VERIFYRPC_BREAKER_FAILS", "int", 3,
+    "Consecutive connection-level failures (connect/send/recv) that "
+    "trip the remote-plane circuit breaker to the in-process host "
+    "path.  A request deadline breach trips it immediately.",
+)
+VERIFYRPC_BACKOFF_MS = _declare(
+    "COMETBFT_TPU_VERIFYRPC_BACKOFF_MS", "int", 50,
+    "Initial reconnect backoff (ms) toward the remote verify plane; "
+    "jittered exponential, capped at 40x.",
+)
+VERIFYRPC_PROBE_PERIOD_MS = _declare(
+    "COMETBFT_TPU_VERIFYRPC_PROBE_PERIOD_MS", "int", 1000,
+    "Probation probe period (ms) while the remote-plane breaker is "
+    "open: one ping round-trip per period.",
+)
+VERIFYRPC_PROBATION_OK = _declare(
+    "COMETBFT_TPU_VERIFYRPC_PROBATION_OK", "int", 2,
+    "Consecutive successful probation pings required before the "
+    "remote-plane breaker closes and batches route remotely again.",
+)
+VERIFYRPC_DEDUP_WINDOW_S = _declare(
+    "COMETBFT_TPU_VERIFYRPC_DEDUP_WINDOW_S", "int", 120,
+    "Server-side idempotency window (seconds): verifyd remembers "
+    "(request_id, digest) -> response this long, so a retried batch is "
+    "answered from cache — never re-verified into a different blame "
+    "order — and a retry racing the original attaches to the in-flight "
+    "verification instead of duplicating it.",
 )
 
 # verify-service degraded-mode failover (verifysvc/service.py)
@@ -238,11 +299,41 @@ FAULT_DROP_P2P_PCT = _declare(
     "Arms the `drop_p2p_pct` fault: <value> percent of outbound p2p "
     "messages are silently dropped at the MConnection send seam.",
 )
+FAULT_DELAY_P2P_MS = _declare(
+    "COMETBFT_TPU_FAULT_DELAY_P2P_MS", "str", "",
+    "Arms the `delay_p2p_ms` fault: outbound p2p writes are delayed "
+    "<value> ms (±50% jitter) at the MConnection send routine — a "
+    "laggy link, composable with `drop_p2p_pct` for flaky-network "
+    "soaks.",
+)
 FAULT_DOUBLE_SIGN = _declare(
     "COMETBFT_TPU_FAULT_DOUBLE_SIGN", "str", "",
     "Arms the `double_sign` fault: the next <value> signed non-nil "
     "prevotes are accompanied by a conflicting broadcast-only vote "
     "(byzantine equivocation feeding the evidence pool).",
+)
+FAULT_PLANE_CRASH = _declare(
+    "COMETBFT_TPU_FAULT_PLANE_CRASH", "str", "",
+    "Arms the `plane_crash` fault in a verifyd process: the <value>'th "
+    "verify request SIGKILLs the plane mid-batch (no response, no "
+    "cleanup) — the deterministic kill -9-with-batches-in-flight.",
+)
+FAULT_PLANE_STALL = _declare(
+    "COMETBFT_TPU_FAULT_PLANE_STALL", "str", "",
+    "Arms the `plane_stall` fault in a verifyd process: the <value>'th "
+    "verify request SIGSTOPs the plane mid-batch (connections stay "
+    "open, nothing answers) until an external SIGCONT.",
+)
+FAULT_RPC_DELAY_MS = _declare(
+    "COMETBFT_TPU_FAULT_RPC_DELAY_MS", "str", "",
+    "Arms the `rpc_delay_ms` fault: verifyd delays every response "
+    "<value> ms (±50% jitter) before the socket write.",
+)
+FAULT_RPC_DROP_PCT = _declare(
+    "COMETBFT_TPU_FAULT_RPC_DROP_PCT", "str", "",
+    "Arms the `rpc_drop_pct` fault: verifyd silently drops <value> "
+    "percent of responses (the batch WAS verified; the client's "
+    "deadline machinery must recover).",
 )
 FAULT_RPC = _declare(
     "COMETBFT_TPU_FAULT_RPC", "bool", False,

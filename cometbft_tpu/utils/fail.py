@@ -31,9 +31,27 @@ Two generations of failure tooling share this module:
   ``fail_dispatch``     Verify-service dispatch raises InjectedFault.
   ``drop_p2p_pct``      <value> percent of outbound p2p messages are
                         silently dropped at the MConnection send seam.
+  ``delay_p2p_ms``      Outbound p2p writes are delayed <value> ms ±50%
+                        jitter at the MConnection send routine (the wire
+                        write, never a caller thread) — a laggy link
+                        without tc/netem, composable with the drop fault
+                        for genuinely flaky-network soaks.
   ``double_sign``       The next <value> signed non-nil prevotes are
                         accompanied by a conflicting broadcast-only vote
                         (byzantine equivocation feeding evidence/).
+  ``plane_crash``       Armed in a verifyd process (verifysvc/server):
+                        the <value>'th verify request kill -9s the plane
+                        mid-batch (os._exit semantics via SIGKILL — no
+                        response, no cleanup).  Deterministic "the plane
+                        died with THIS batch in flight".
+  ``plane_stall``       Like ``plane_crash`` but SIGSTOP: the plane
+                        freezes mid-batch (connections stay open, nothing
+                        answers) until an external SIGCONT.
+  ``rpc_delay_ms``      verifyd responses are delayed <value> ms ±50%
+                        jitter before hitting the socket.
+  ``rpc_drop_pct``      <value> percent of verifyd responses are silently
+                        dropped (the request WAS verified; the client's
+                        deadline/retry machinery must recover).
   ====================  ====================================================
 
 Zero cost when nothing is armed: every seam's first check is one
@@ -78,7 +96,12 @@ FAULTS = (
     "slow_collect",
     "fail_dispatch",
     "drop_p2p_pct",
+    "delay_p2p_ms",
     "double_sign",
+    "plane_crash",
+    "plane_stall",
+    "rpc_delay_ms",
+    "rpc_drop_pct",
 )
 
 _ANY_ARMED = False  # fast-path bool: every seam checks this first
@@ -196,12 +219,23 @@ def wedge_wait(name: str = "wedge_device", poll_s: float = 0.05) -> float:
 
 
 def should_drop(pct: float) -> bool:
-    """One Bernoulli roll for ``drop_p2p_pct`` (clamped to [0, 100])."""
+    """One Bernoulli roll for the percentage faults (``drop_p2p_pct``,
+    ``rpc_drop_pct``; clamped to [0, 100])."""
     if pct <= 0:
         return False
     if pct >= 100:
         return True
     return _RAND.random() * 100.0 < pct
+
+
+def jittered_sleep(ms: float) -> float:
+    """Sleep ``ms`` milliseconds ±50% uniform jitter (the latency faults
+    ``delay_p2p_ms`` / ``rpc_delay_ms``); returns the seconds slept."""
+    if ms <= 0:
+        return 0.0
+    d = (ms / 1e3) * (0.5 + _RAND.random())
+    time.sleep(d)
+    return d
 
 
 def _arm_from_env() -> None:
@@ -217,7 +251,12 @@ def _arm_from_env() -> None:
         ("slow_collect", envknobs.FAULT_SLOW_COLLECT),
         ("fail_dispatch", envknobs.FAULT_FAIL_DISPATCH),
         ("drop_p2p_pct", envknobs.FAULT_DROP_P2P_PCT),
+        ("delay_p2p_ms", envknobs.FAULT_DELAY_P2P_MS),
         ("double_sign", envknobs.FAULT_DOUBLE_SIGN),
+        ("plane_crash", envknobs.FAULT_PLANE_CRASH),
+        ("plane_stall", envknobs.FAULT_PLANE_STALL),
+        ("rpc_delay_ms", envknobs.FAULT_RPC_DELAY_MS),
+        ("rpc_drop_pct", envknobs.FAULT_RPC_DROP_PCT),
     ):
         spec = envknobs.get_str(knob).strip()
         if not spec:

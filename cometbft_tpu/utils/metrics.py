@@ -472,6 +472,34 @@ class Hub:
             "(label cause=wedge|dispatch_error|submit_error|"
             "collect_error)",
         )
+        # ---- out-of-process verify plane client (verifysvc/remote.py)
+        self.verify_rpc_requests = r.counter(
+            "verify_rpc_requests_total",
+            "Remote verify-plane request outcomes (label result=ok|"
+            "deduped|backpressure|timeout|error); deduped = answered "
+            "from the plane's idempotency window after a retry",
+        )
+        self.verify_rpc_resends = r.counter(
+            "verify_rpc_resends_total",
+            "Idempotent resends of in-flight remote verify requests "
+            "after a reconnect (same request_id+digest; the plane's "
+            "dedup window makes repeats safe)",
+        )
+        self.verify_rpc_reconnects = r.counter(
+            "verify_rpc_reconnects_total",
+            "Reconnects to the remote verify plane after a connection "
+            "death (jittered exponential backoff)",
+        )
+        self.verify_rpc_breaker_state = r.gauge(
+            "verify_rpc_breaker_state",
+            "Remote verify-plane circuit breaker (0=closed: batches "
+            "route remotely, 1=open: in-process host fallback, "
+            "probation probing)",
+        )
+        self.verify_rpc_breaker_transitions = r.counter(
+            "verify_rpc_breaker_transitions_total",
+            "Remote-plane breaker transitions (label state=open|closed)",
+        )
         # ---- health sentinel (utils/healthmon)
         self.health_state = r.gauge(
             "health_state",

@@ -80,7 +80,11 @@ def verify_tx_signature(
     if svc is None:
         from ..crypto import batch as crypto_batch
 
-        if crypto_batch.device_capable():
+        from .service import remote_plane_configured
+
+        if crypto_batch.device_capable() or remote_plane_configured():
+            # a node with no local accelerator still batches through a
+            # configured shared remote plane
             svc = global_service()
     if svc is not None:
         import time as _time

@@ -44,15 +44,25 @@ def resolve_mode(pubkeys: list[bytes] | None):
     kernel."""
     if pubkeys is None:
         return MODE_PLAIN
-    from .service import _GLOBAL
+    from .service import _GLOBAL, remote_plane_configured
 
-    if _GLOBAL is not None and _GLOBAL.backend_mode != "tpu":
-        # degraded mode: comb table binds are bypassed entirely — an
-        # ensure()/ensure_async() is DEVICE work (table build + H2D),
-        # exactly the hang the failover trip escaped.  Peek the module
-        # global, never global_service(): resolving a mode must not
-        # construct and install a fresh scheduler.
+    if remote_plane_configured():
+        # a remote-bound process must not build a local table it will
+        # never use — checked against the ENV, not just the installed
+        # service's binding: a service constructed before the knob was
+        # set would otherwise kick a background table build (minutes of
+        # compile) for a plane that owns its own device-resident tables
         return MODE_PLAIN
+    if _GLOBAL is not None:
+        if _GLOBAL.backend_mode != "tpu" or _GLOBAL.remote_addr:
+            # degraded mode: comb table binds are bypassed entirely — an
+            # ensure()/ensure_async() is DEVICE work (table build + H2D),
+            # exactly the hang the failover trip escaped.  Same with a
+            # remote plane configured: device-resident tables belong to
+            # the PLANE's process, not this one.  Peek the module
+            # global, never global_service(): resolving a mode must not
+            # construct and install a fresh scheduler.
+            return MODE_PLAIN
     from ..crypto import batch as crypto_batch
 
     if len(pubkeys) < crypto_batch.comb_min():
@@ -156,6 +166,12 @@ class ServiceBatchVerifier:
         t0 = time.monotonic()
         try:
             result = payload.collect(timeout)
+        except VerifyServiceBackpressure:
+            # a REMOTE plane's server-side quota rejected the batch
+            # after local admission (the reject rides the response and
+            # fails the ticket): same contract as a local reject —
+            # verify inline on host; the service never does it for us
+            return self._host_fallback("verify.svc_fallback")
         except TimeoutError:
             report_collect_stall(
                 self._klass, self._tenant, len(self._items),

@@ -1,0 +1,755 @@
+"""Crash-tolerant client for the out-of-process verify plane (verifyd).
+
+:class:`RemotePlaneClient` owns one connection to a verifyd
+(``COMETBFT_TPU_VERIFYRPC_ADDR``) and the machinery that makes losing
+that process survivable; :class:`RemoteBatchVerifier` is the thin
+BatchVerifier-shaped adapter the VerifyService dispatches through, so
+the scheduler/collector/ticket plumbing — and the PR-8 first-wins
+settlement that makes a late remote answer harmless — is reused
+unchanged across the process boundary.
+
+The survival contract, end to end:
+
+  * **Deadline propagation** — each request gets a budget
+    (``COMETBFT_TPU_VERIFYRPC_BUDGET_MS``) pinned to THIS process's
+    monotonic clock at submit; every send and idempotent resend carries
+    the REMAINING budget in ms, never a wall-clock deadline, so skew
+    between node and plane cannot stretch or strangle a request.
+  * **Idempotent retry** — a connection death is indistinguishable from
+    a plane death, so the io thread reconnects (jittered exponential
+    backoff, ``COMETBFT_TPU_VERIFYRPC_BACKOFF_MS`` base) and RESENDS
+    every pending request under its original (request_id, digest)
+    idempotency key.  The server's dedup window guarantees a batch that
+    actually got verified before the crash is answered from cache — the
+    same verdicts in the same blame order, never a second verification.
+  * **Circuit breaker** — ``COMETBFT_TPU_VERIFYRPC_BREAKER_FAILS``
+    consecutive connection-level failures, or a single request deadline
+    breach, trip the breaker OPEN: every pending request fails
+    immediately (the service host-re-verifies each batch with
+    per-signature blame in each request's OWN add() order; first-wins
+    ticket settlement discards the remote answer if it ever lands), all
+    subsequent batches route straight to the in-process host path, ONE
+    forensics artifact + flightrec event records the trip.  While open,
+    the io thread **probation-probes** the plane (one ping round trip
+    per ``COMETBFT_TPU_VERIFYRPC_PROBE_PERIOD_MS``); after
+    ``COMETBFT_TPU_VERIFYRPC_PROBATION_OK`` consecutive successes the
+    breaker closes and batches flow remotely again.
+
+Module-level helpers :func:`plane_ping` / :func:`plane_status` /
+:func:`plane_arm_fault` give harnesses one-shot access to a plane
+without standing up a client.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+
+from ..utils import envknobs, fail, tracing
+from ..utils.flightrec import recorder as _flightrec
+from ..utils.log import get_logger
+from ..utils.metrics import hub as _mhub
+from . import wire
+from .service import DEFAULT_TENANT, Klass, VerifyServiceBackpressure
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+_BREAKER_CODE = {BREAKER_CLOSED: 0, BREAKER_OPEN: 1}
+
+
+class RemotePlaneError(RuntimeError):
+    """Transport/deadline failure of a remote verify request.  The
+    service's error path (_fail_or_reverify) answers it with a host
+    re-verification — callers never see this exception."""
+
+
+class _Pending:
+    __slots__ = (
+        "rid", "digest", "items", "klass", "tenant", "deadline",
+        "event", "response", "error", "attempts", "sent_on_gen", "_done_cb",
+    )
+
+    def __init__(self, rid, digest, items, klass, tenant, deadline):
+        self.rid = rid
+        self.digest = digest
+        self.items = items
+        self.klass = klass
+        self.tenant = tenant
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.response: tuple[bool, list[bool]] | None = None
+        self.error: BaseException | None = None
+        self.attempts = 0
+        self.sent_on_gen = -1  # conn generation this was last sent on
+        self._done_cb = None
+
+    def remaining(self) -> float:
+        return self.deadline - time.monotonic()
+
+    def settle(self, response=None, error=None) -> None:
+        if self.event.is_set():
+            return
+        self.response = response
+        self.error = error
+        self.event.set()
+        cb, self._done_cb = self._done_cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a callback bug must not kill the io thread
+                get_logger("verifyrpc").exception(
+                    "remote pending done-callback raised"
+                )
+
+    def add_done_callback(self, cb) -> None:
+        """Fire ``cb`` once this pending settles (immediately if it
+        already has).  ONE callback per pending — the service's deferred
+        -collect hook; it runs on whichever thread settles (the io
+        thread for responses/expiry, the submitter on breaker-open)."""
+        self._done_cb = cb
+        if self.event.is_set():
+            cb, self._done_cb = self._done_cb, None
+            if cb is not None:
+                cb()
+
+
+class RemoteBatchVerifier:
+    """The BatchVerifier seam over the wire.  ``_entry = None`` routes
+    submit() through the service's class-priority host worker (network
+    IO must never run on the scheduler thread), and ``inflight_where =
+    "remote"`` keeps the local failover watchdog's device deadline off
+    these batches — the remote client owns its own deadline, and the
+    local watchdog tripping the whole service to cpu_fallback over a
+    slow PLANE would conflate two different failure domains."""
+
+    _entry = None
+    _fallback = None
+    inflight_where = "remote"
+
+    def __init__(self, client: "RemotePlaneClient"):
+        self._client = client
+        self._klass = Klass.CONSENSUS
+        self._tenant = DEFAULT_TENANT
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def bind_request(self, klass: Klass, tenant: str) -> None:
+        """Called by the service's dispatch right after construction —
+        _make_verifier only sees the mode, but the wire request carries
+        (tenant, class) for the plane's server-side scheduling."""
+        self._klass = klass
+        self._tenant = tenant
+
+    def add(self, pub: bytes, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub, msg, sig))
+
+    def submit(self):
+        return ("rpc", self._client.submit(
+            self._items, self._klass, self._tenant
+        ))
+
+    def defer_collect(self, ticket, cb) -> None:
+        """Out-of-order settlement hook: the service's host worker hands
+        the COLLECTOR this batch only once the response (or expiry) has
+        actually settled it, so collect() below never blocks and a
+        consensus settle can never queue behind in-flight lower-class
+        responses — the plane answers out of dispatch order by design
+        (it schedules by class priority)."""
+        _kind, pend = ticket
+        pend.add_done_callback(cb)
+
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        _kind, pend = ticket
+        return self._client.collect(pend)
+
+
+class RemotePlaneClient:
+    """One process's connection to a shared verifyd (module docstring)."""
+
+    def __init__(
+        self,
+        addr: str,
+        budget_s: float | None = None,
+        connect_timeout_s: float | None = None,
+        retry_max: int | None = None,
+        breaker_fails: int | None = None,
+        backoff_s: float | None = None,
+        probe_period_s: float | None = None,
+        probation_ok: int | None = None,
+        artifact_dir: str | None = None,
+    ):
+        self.addr = addr
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self.budget_s = (
+            budget_s if budget_s is not None
+            else max(1, envknobs.get_int(envknobs.VERIFYRPC_BUDGET_MS)) / 1e3
+        )
+        self.connect_timeout_s = (
+            connect_timeout_s if connect_timeout_s is not None
+            else max(1, envknobs.get_int(envknobs.VERIFYRPC_CONNECT_TIMEOUT_MS))
+            / 1e3
+        )
+        self.retry_max = max(
+            1, retry_max if retry_max is not None
+            else envknobs.get_int(envknobs.VERIFYRPC_RETRY_MAX)
+        )
+        self.breaker_fails = max(
+            1, breaker_fails if breaker_fails is not None
+            else envknobs.get_int(envknobs.VERIFYRPC_BREAKER_FAILS)
+        )
+        self.backoff_s = (
+            backoff_s if backoff_s is not None
+            else max(1, envknobs.get_int(envknobs.VERIFYRPC_BACKOFF_MS)) / 1e3
+        )
+        self.probe_period_s = (
+            probe_period_s if probe_period_s is not None
+            else max(1, envknobs.get_int(envknobs.VERIFYRPC_PROBE_PERIOD_MS))
+            / 1e3
+        )
+        self.probation_ok = max(
+            1, probation_ok if probation_ok is not None
+            else envknobs.get_int(envknobs.VERIFYRPC_PROBATION_OK)
+        )
+        self.artifact_dir = artifact_dir
+        self.logger = get_logger("verifyrpc")
+        self._mtx = threading.Lock()
+        self._pending: dict[bytes, _Pending] = {}
+        self._sock: socket.socket | None = None
+        # the frame reader travels WITH the connection: it buffers
+        # partial frames, so recreating it mid-stream would desync
+        self._reader: wire.FrameReader | None = None
+        self._send_mtx = threading.Lock()
+        self._conn_gen = 0
+        self._breaker = BREAKER_CLOSED
+        self._consec_fails = 0
+        self._probation_consec_ok = 0
+        self._trips = 0
+        self._restores = 0
+        self._reconnects = 0
+        self._resends = 0
+        self._connected_once = False
+        self._last_trip_reason: str | None = None
+        self._last_artifact: str | None = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._io_loop, name="verifyrpc-io", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- surface
+
+    def available(self) -> bool:
+        """Whether batches should route remotely right now (breaker
+        closed).  One str compare — safe on the dispatch path."""
+        return self._breaker == BREAKER_CLOSED and not self._stop.is_set()
+
+    @property
+    def breaker(self) -> str:
+        return self._breaker
+
+    def submit(self, items, klass: Klass, tenant: str) -> _Pending:
+        """Register + send one request; returns the pending handle for
+        :meth:`collect`.  Runs on the service's host worker (never the
+        scheduler).  Raises :class:`RemotePlaneError` when the breaker
+        is open — the service then builds the host path instead."""
+        items = list(items)
+        pend = _Pending(
+            rid=uuid.uuid4().bytes,
+            digest=wire.batch_digest(items),
+            items=items,
+            klass=klass,
+            tenant=tenant,
+            deadline=time.monotonic() + self.budget_s,
+        )
+        with self._mtx:
+            # breaker checked UNDER the lock the trip flips it under: a
+            # submit racing a trip either registers before the trip's
+            # pending sweep (and is failed by it) or sees OPEN here —
+            # never a stranded pending the open-state loop won't expire
+            if self._stop.is_set() or self._breaker == BREAKER_OPEN:
+                raise RemotePlaneError(
+                    f"remote verify plane unavailable "
+                    f"(breaker {self._breaker})"
+                )
+            self._pending[pend.rid] = pend
+        sent = self._try_send(pend)
+        if not sent:
+            # no live conn: the io thread connects and resends pending
+            self._wake.set()
+        return pend
+
+    def collect(self, pend: _Pending) -> tuple[bool, list[bool]]:
+        """Block for the response within the request's remaining budget.
+        The io thread's expiry sweep settles (and trips the breaker on)
+        any pending that breaches its deadline, so this normally returns
+        promptly — the extra grace below is only a backstop against the
+        io thread itself being gone."""
+        if not pend.event.wait(max(1.0, pend.remaining() + 2.0)):
+            with self._mtx:
+                self._pending.pop(pend.rid, None)
+            _mhub().verify_rpc_requests.inc(result="timeout")
+            self._trip(
+                f"request deadline breach (io thread unresponsive): "
+                f"class={pend.klass.label} sigs={len(pend.items)}"
+            )
+            raise RemotePlaneError(
+                f"remote verify deadline breached after {self.budget_s:g}s"
+            )
+        if pend.error is not None:
+            raise pend.error
+        return pend.response
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        with self._mtx:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for p in pending:
+            p.settle(error=RemotePlaneError("remote plane client closed"))
+        self._drop_conn()
+        self._thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._mtx:
+            return {
+                "addr": self.addr,
+                "breaker": self._breaker,
+                "trips": self._trips,
+                "restores": self._restores,
+                "consecutive_failures": self._consec_fails,
+                "probation_consec_ok": self._probation_consec_ok,
+                "probation_ok_needed": self.probation_ok,
+                "pending": len(self._pending),
+                "reconnects": self._reconnects,
+                "resends": self._resends,
+                "budget_ms": self.budget_s * 1e3,
+                "last_trip_reason": self._last_trip_reason,
+                "last_artifact": self._last_artifact,
+            }
+
+    # -------------------------------------------------------------- wire IO
+
+    def _try_send(self, pend: _Pending) -> bool:
+        """Send one request over the live conn, if any.  Serialized by
+        _send_mtx (submitters and the io thread's resend path share the
+        socket).  A send failure just reports False — the io thread owns
+        failure accounting and reconnection."""
+        with self._send_mtx:
+            sock = self._sock
+            if sock is None:
+                return False
+            gen = self._conn_gen
+            pend.attempts += 1
+            pend.sent_on_gen = gen
+            budget_ms = max(1, int(pend.remaining() * 1e3))
+            msg = wire.PlaneMessage(
+                verify_request=wire.VerifyRequest(
+                    request_id=pend.rid,
+                    digest=pend.digest,
+                    tenant=pend.tenant,
+                    klass=int(pend.klass),
+                    budget_ms=budget_ms,
+                    items=[
+                        wire.SigItem(pub=p, msg=m, sig=s)
+                        for (p, m, s) in pend.items
+                    ],
+                    attempt=pend.attempts,
+                )
+            )
+            try:
+                sock.sendall(wire.frame(msg))
+                return True
+            except OSError as e:
+                # includes socket.timeout: the 0.25s recv-poll timeout
+                # also bounds sendall, so a stalled plane with a full
+                # TCP window can tear a frame mid-write.  A torn frame
+                # desyncs the stream for every later request — the conn
+                # is unusable either way, so drop it HERE (we hold
+                # _send_mtx; close after releasing) and let the io
+                # thread reconnect + idempotently resend.
+                self._sock = None
+                self._reader = None
+                self.logger.info(f"verifyrpc send failed: {e!r}")
+        from ..utils.netutil import close_socket
+
+        close_socket(sock)
+        self._wake.set()  # io thread notices the dead conn
+        return False
+
+    def _drop_conn(self) -> None:
+        with self._send_mtx:
+            sock, self._sock = self._sock, None
+            self._reader = None
+        if sock is not None:
+            from ..utils.netutil import close_socket
+
+            close_socket(sock)
+
+    def _io_loop(self) -> None:
+        """THE connection owner: connect (backoff), resend pending,
+        drain responses; while the breaker is open, probation-probe."""
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            if self._breaker == BREAKER_OPEN:
+                self._probation_tick()
+                continue
+            self._expire_pending()
+            if self._sock is None:
+                with self._mtx:
+                    idle = not self._pending
+                if idle and self._connected_once:
+                    # nothing to send and nothing owed: don't hold a
+                    # conn open just to watch it (probes are the open
+                    # state's job) — wait for work
+                    self._wake.wait(0.25)
+                    self._wake.clear()
+                    continue
+                if not self._connect():
+                    # jittered exponential backoff between dial attempts
+                    sleep = backoff * (0.5 + _rand())
+                    self._stop.wait(min(sleep, 2.0))
+                    backoff = min(backoff * 2, self.backoff_s * 40)
+                    continue
+                backoff = self.backoff_s
+                self._resend_pending()
+            self._recv_tick()
+
+    def _connect(self) -> bool:
+        try:
+            sock = socket.create_connection(
+                (self._host, self._port), self.connect_timeout_s
+            )
+            sock.settimeout(0.25)  # recv poll: stop/resend checks stay live
+        except OSError as e:
+            self._record_failure(f"connect failed: {e!r}")
+            return False
+        with self._send_mtx:
+            self._sock = sock
+            self._reader = wire.FrameReader(sock)
+            self._conn_gen += 1
+        if self._connected_once:
+            with self._mtx:
+                self._reconnects += 1
+            _mhub().verify_rpc_reconnects.inc()
+            self.logger.info(f"verifyrpc reconnected to {self.addr}")
+        self._connected_once = True
+        return True
+
+    def _resend_pending(self) -> None:
+        """Idempotent resend of everything in flight on a fresh conn —
+        the server's dedup window makes repeats safe.  Requests out of
+        retry budget or past deadline fail here (collect()'s breach path
+        trips the breaker for the deadline case)."""
+        with self._mtx:
+            pending = sorted(
+                self._pending.values(), key=lambda p: int(p.klass)
+            )
+        gen = self._conn_gen
+        for p in pending:
+            if p.event.is_set() or p.sent_on_gen == gen:
+                continue
+            if p.remaining() <= 0:
+                continue  # collect() owns the breach verdict
+            if p.attempts >= self.retry_max:
+                with self._mtx:
+                    self._pending.pop(p.rid, None)
+                _mhub().verify_rpc_requests.inc(result="error")
+                p.settle(error=RemotePlaneError(
+                    f"retry budget exhausted ({p.attempts} attempts)"
+                ))
+                continue
+            if p.attempts > 0:
+                with self._mtx:
+                    self._resends += 1
+                _mhub().verify_rpc_resends.inc()
+            if not self._try_send(p):
+                return  # conn died again; next connect retries
+
+    def _recv_tick(self) -> None:
+        reader = self._reader
+        if reader is None:
+            return
+        try:
+            msg = reader.read()
+        except socket.timeout:
+            return
+        except (OSError, ValueError) as e:
+            self._conn_lost(f"recv failed: {e!r}")
+            return
+        if msg is None:
+            self._conn_lost("connection closed by plane")
+            return
+        which = msg.which()
+        if which == "verify_response":
+            self._on_response(msg.verify_response)
+        elif which == "ping_response":
+            self.logger.debug("verifyrpc: ping response")
+        else:
+            self.logger.warning(f"verifyrpc: unexpected message {which!r}")
+
+    def _expire_pending(self) -> None:
+        """Settle every pending past its deadline — THE breach signal.
+        One breach trips the breaker (issue contract: K connection
+        failures OR a deadline breach): an alive-but-unresponsive plane
+        (SIGSTOP, a wedged scheduler) must not strand callers the way a
+        cleanly-dead one cannot."""
+        now = time.monotonic()
+        with self._mtx:
+            expired = [
+                p for p in self._pending.values() if p.deadline <= now
+            ]
+            for p in expired:
+                self._pending.pop(p.rid, None)
+        if not expired:
+            return
+        m = _mhub()
+        for _ in expired:
+            m.verify_rpc_requests.inc(result="timeout")
+        worst = expired[0]
+        self._trip(
+            f"request deadline breach: class={worst.klass.label} "
+            f"sigs={len(worst.items)} budget={self.budget_s:g}s "
+            f"attempts={worst.attempts} ({len(expired)} breached)"
+        )
+        err = RemotePlaneError(
+            f"remote verify deadline breached after {self.budget_s:g}s"
+        )
+        for p in expired:
+            p.settle(error=err)
+
+    def _conn_lost(self, why: str) -> None:
+        self._drop_conn()
+        self._record_failure(why)
+
+    def _on_response(self, resp: wire.VerifyResponse) -> None:
+        with self._mtx:
+            pend = self._pending.pop(resp.request_id, None)
+            if pend is not None:
+                self._consec_fails = 0  # the plane is answering
+        if pend is None:
+            return  # late answer for an already-settled request: discard
+        m = _mhub()
+        status = resp.status
+        if status == wire.STATUS_OK:
+            m.verify_rpc_requests.inc(
+                result="deduped" if resp.deduped else "ok"
+            )
+            pend.settle(response=(
+                bool(resp.all_ok), [bool(v) for v in resp.verdicts]
+            ))
+        elif status == wire.STATUS_BACKPRESSURE:
+            # server-side admission control: surface the SAME exception
+            # a local reject raises, tenant/scope included, so the
+            # caller's fallback path is identical either way
+            m.verify_rpc_requests.inc(result="backpressure")
+            pend.settle(error=VerifyServiceBackpressure(
+                pend.klass, 0, 0, tenant=pend.tenant,
+                scope=resp.scope or "class",
+            ))
+        else:
+            m.verify_rpc_requests.inc(result="error")
+            pend.settle(error=RemotePlaneError(
+                f"plane answered {wire.STATUS_NAMES.get(status, status)}: "
+                f"{resp.error}"
+            ))
+
+    # ------------------------------------------------------------- breaker
+
+    def _record_failure(self, why: str) -> None:
+        with self._mtx:
+            self._consec_fails += 1
+            fails = self._consec_fails
+        self.logger.info(
+            f"verifyrpc failure [{fails}/{self.breaker_fails}]: {why}"
+        )
+        if fails >= self.breaker_fails:
+            self._trip(f"{fails} consecutive connection failures ({why})")
+
+    def _trip(self, reason: str) -> bool:
+        with self._mtx:
+            if self._breaker == BREAKER_OPEN:
+                return False
+            self._breaker = BREAKER_OPEN
+            self._trips += 1
+            self._probation_consec_ok = 0
+            self._last_trip_reason = reason
+            pending = list(self._pending.values())
+            self._pending.clear()
+        self._drop_conn()
+        m = _mhub()
+        m.verify_rpc_breaker_state.set(_BREAKER_CODE[BREAKER_OPEN])
+        m.verify_rpc_breaker_transitions.inc(state="open")
+        _flightrec().record(
+            "verifyrpc_breaker", state="open", reason=reason,
+            pending=len(pending),
+        )
+        tracing.instant(
+            "verify.rpc_breaker",
+            {"state": "open", "pending": len(pending)}
+            if tracing.enabled() else None,
+        )
+        self.logger.error(
+            f"remote verify plane breaker OPEN: {reason} "
+            f"({len(pending)} pending request(s) -> host re-verify); "
+            "probation probing toward restore"
+        )
+        # fail pending LAST: the service's collector immediately
+        # host-re-verifies each batch, and those verdicts must not race
+        # a half-torn-down client state
+        err = RemotePlaneError(f"breaker tripped: {reason}")
+        for p in pending:
+            p.settle(error=err)
+        self._last_artifact = self._capture_forensics(reason, len(pending))
+        self._wake.set()
+        return True
+
+    def _capture_forensics(self, reason: str, n_pending: int) -> str | None:
+        """ONE artifact per trip (debugdump.stall_report) — same rule as
+        the PR-8 failover trip; must never raise."""
+        from ..utils import debugdump
+
+        try:
+            path = debugdump.stall_report(
+                f"remote verify plane breaker tripped: {reason}",
+                [("verify rpc client", json.dumps(
+                    self.stats(), indent=1, default=str
+                )),
+                 ("stranded remote requests", str(n_pending))],
+                directory=self.artifact_dir,
+            )
+            _mhub().health_forensics.inc()
+            self.logger.warning(f"breaker forensics written to {path}")
+            return path
+        except Exception as e:  # noqa: BLE001 — forensics must never hurt the node
+            self.logger.warning(f"breaker forensics capture failed: {e!r}")
+            return None
+
+    def _probation_tick(self) -> None:
+        """One probe round while open: ping the plane on a fresh
+        short-lived connection; enough consecutive successes restore."""
+        self._stop.wait(self.probe_period_s)
+        if self._stop.is_set() or self._breaker != BREAKER_OPEN:
+            return
+        ok = plane_ping(
+            self.addr, timeout_s=max(self.connect_timeout_s, 0.5)
+        )
+        with self._mtx:
+            self._probation_consec_ok = (
+                self._probation_consec_ok + 1 if ok else 0
+            )
+            consec = self._probation_consec_ok
+        self.logger.info(
+            f"verifyrpc probation probe: ok={ok} "
+            f"[{consec}/{self.probation_ok}]"
+        )
+        if consec >= self.probation_ok:
+            self._restore()
+
+    def _restore(self) -> None:
+        with self._mtx:
+            if self._breaker != BREAKER_OPEN:
+                return
+            self._breaker = BREAKER_CLOSED
+            self._restores += 1
+            self._consec_fails = 0
+            self._probation_consec_ok = 0
+        m = _mhub()
+        m.verify_rpc_breaker_state.set(_BREAKER_CODE[BREAKER_CLOSED])
+        m.verify_rpc_breaker_transitions.inc(state="closed")
+        _flightrec().record("verifyrpc_breaker", state="closed")
+        tracing.instant(
+            "verify.rpc_breaker",
+            {"state": "closed"} if tracing.enabled() else None,
+        )
+        self.logger.warning(
+            f"remote verify plane breaker CLOSED "
+            f"({self.probation_ok} consecutive probes ok): batches route "
+            "remotely again"
+        )
+        self._wake.set()
+
+
+def _rand() -> float:
+    # tiny indirection so tests can pin the backoff jitter
+    import random
+
+    return random.random()
+
+
+# --------------------------------------------------- one-shot plane access
+
+def _one_shot(addr: str, msg: wire.PlaneMessage, want: str, timeout_s: float):
+    host, _, port = addr.rpartition(":")
+    sock = socket.create_connection(
+        (host or "127.0.0.1", int(port)), timeout_s
+    )
+    sock.settimeout(timeout_s)
+    try:
+        sock.sendall(wire.frame(msg))
+        reader = wire.FrameReader(sock)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            resp = reader.read()
+            if resp is None:
+                return None
+            if resp.which() == want:
+                return getattr(resp, want)
+        return None
+    finally:
+        from ..utils.netutil import close_socket
+
+        close_socket(sock)
+
+
+def plane_ping(addr: str, timeout_s: float = 2.0) -> bool:
+    """Liveness: one ping round trip (the probation probe)."""
+    try:
+        return _one_shot(
+            addr, wire.PlaneMessage(ping_request=wire.PingRequest()),
+            "ping_response", timeout_s,
+        ) is not None
+    except (OSError, ValueError):
+        return False
+
+
+def plane_status(addr: str, timeout_s: float = 5.0) -> dict | None:
+    """Readiness/diagnosis: the plane's stats() dict (server tallies +
+    its service's scheduler snapshot), or None when unreachable."""
+    try:
+        resp = _one_shot(
+            addr, wire.PlaneMessage(status_request=wire.StatusRequest()),
+            "status_response", timeout_s,
+        )
+    except (OSError, ValueError):
+        return None
+    if resp is None:
+        return None
+    try:
+        return json.loads(resp.json)
+    except ValueError:
+        return None
+
+
+def plane_arm_fault(
+    addr: str, name: str, value: float = 1.0,
+    clear: bool = False, timeout_s: float = 5.0,
+) -> bool:
+    """Chaos harness: arm/clear a fault inside a live verifyd (gated on
+    COMETBFT_TPU_FAULT_RPC in the plane's environment)."""
+    try:
+        resp = _one_shot(
+            addr,
+            wire.PlaneMessage(arm_fault_request=wire.ArmFaultRequest(
+                name=name, value=float(value), clear=clear,
+            )),
+            "arm_fault_response", timeout_s,
+        )
+    except (OSError, ValueError):
+        return False
+    return bool(resp is not None and resp.ok)

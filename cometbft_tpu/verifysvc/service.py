@@ -52,11 +52,14 @@ caller is untouched — and the scheduler keys its queues by
     (``COMETBFT_TPU_VERIFYSVC_TENANT_WEIGHTS``, default weight 1 each,
     rotating round-robin so no tenant owns the tie-break) — a rogue
     tenant's mempool flood cannot monopolize the class's dispatch slots;
-  * each (tenant, class) queue is additionally bounded by
-    ``COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA`` signatures (0 = the
-    class-wide bound), so backpressure lands on the flooding tenant —
+  * each (tenant, class) is additionally bounded by
+    ``COMETBFT_TPU_VERIFYSVC_TENANT_QUOTA`` OUTSTANDING signatures —
+    queued plus dispatched-but-unsettled, released at ticket
+    settlement, so a fast drain into the device/wire pipeline cannot
+    launder a flood past admission (0 = the class-wide bound) — and
+    backpressure lands on the flooding tenant:
     :class:`VerifyServiceBackpressure` carries ``tenant`` and ``scope``
-    (which bound was hit) — while other tenants keep admitting;
+    (which bound was hit) while other tenants keep admitting;
   * batches never mix tenants: coalescing happens inside one
     (tenant, class) queue, so per-tenant latency/flush/reject
     accounting stays exact (the ``verify_svc_tenant_*`` metrics, with
@@ -113,10 +116,29 @@ service restores TPU mode.  Dispatch/collect *errors* (as opposed to
 hangs) don't flip the mode: the failed batch is re-verified on host
 with identical verdicts and the service keeps serving — the
 ``fail_dispatch`` injected fault exercises exactly that path.
+
+**Out-of-process verify plane** (``COMETBFT_TPU_VERIFYRPC_ADDR``):
+when a remote plane is configured, the service routes every batch over
+the wire to a shared verifyd (verifysvc/server.py) through
+verifysvc/remote.py's crash-tolerant client instead of a local device
+verifier.  The scheduler/collector/ticket plumbing is unchanged — a
+RemoteBatchVerifier is just another BatchVerifier at the dispatch seam
+— which is exactly how the PR-8 guarantees extend across the process
+boundary: a plane death surfaces as a collect/submit error or deadline
+breach, the remote client's circuit breaker trips to the in-process
+HOST path (comb binds are bypassed — device-resident tables belong to
+the plane), stranded batches host-re-verify with per-signature blame
+in each request's own add() order, first-wins settlement discards any
+late remote answer, and probation pings restore the remote path once
+the plane returns.  Remote batches are tracked in flight as
+``where="remote"`` and exempt from the LOCAL failover batch deadline:
+the remote client owns its own deadline, and a slow plane must not be
+conflated with a wedged local accelerator.
 """
 
 from __future__ import annotations
 
+import functools
 import itertools
 import queue
 import threading
@@ -185,11 +207,20 @@ def collect_timeout_s() -> float | None:
     return None if ms <= 0 else ms / 1e3
 
 
+def remote_plane_configured() -> bool:
+    """Whether this process points at a shared out-of-process verify
+    plane (COMETBFT_TPU_VERIFYRPC_ADDR).  Routing gates (crypto/batch,
+    checktx, node startup) use this alongside device_capable(): a node
+    with no local accelerator still consumes the remote plane."""
+    return bool(envknobs.get_str(envknobs.VERIFYRPC_ADDR).strip())
+
+
 class VerifyServiceBackpressure(Exception):
-    """A queue is at its signature bound; the caller must fall back to
-    host verification (or shed the request).  ``scope`` says which
-    bound was hit: ``tenant`` (this tenant's per-class quota — other
-    tenants are still admissible) or ``class`` (the class-wide bound)."""
+    """A signature bound was hit; the caller must fall back to host
+    verification (or shed the request).  ``scope`` says which bound:
+    ``tenant`` (this tenant's per-class quota on OUTSTANDING sigs —
+    queued + in flight, released at settlement; other tenants are
+    still admissible) or ``class`` (the class-wide queue bound)."""
 
     def __init__(
         self,
@@ -202,7 +233,7 @@ class VerifyServiceBackpressure(Exception):
         super().__init__(
             f"verify service backpressure: {scope} bound, class "
             f"{klass.label} tenant {tenant} has {queued} signatures "
-            f"queued (limit {limit})"
+            f"outstanding (limit {limit})"
         )
         self.klass = klass
         self.queued = queued
@@ -216,7 +247,8 @@ class Ticket:
     (all_ok, per_signature) in the request's own add() order, or raises
     whatever the dispatch/collect path raised."""
 
-    __slots__ = ("_ev", "_mtx", "_result", "_exc", "nsigs", "timings")
+    __slots__ = ("_ev", "_mtx", "_result", "_exc", "nsigs", "timings",
+                 "_on_settle")
 
     def __init__(self, nsigs: int):
         self._ev = threading.Event()
@@ -225,6 +257,14 @@ class Ticket:
         self._exc: BaseException | None = None
         self.nsigs = nsigs
         self.timings: dict[str, float] = {}
+        # fired exactly once, on whichever resolution wins — the
+        # service's outstanding-quota release hook (submit() sets it)
+        self._on_settle = None
+
+    def _settled(self) -> None:
+        cb, self._on_settle = self._on_settle, None
+        if cb is not None:
+            cb()
 
     def _resolve(self, result, timings=None) -> bool:
         """First resolution wins: a failover host re-verify races the
@@ -238,7 +278,8 @@ class Ticket:
             if timings:
                 self.timings = dict(timings)
             self._ev.set()
-            return True
+        self._settled()
+        return True
 
     def _fail(self, exc: BaseException) -> bool:
         with self._mtx:
@@ -246,7 +287,8 @@ class Ticket:
                 return False
             self._exc = exc
             self._ev.set()
-            return True
+        self._settled()
+        return True
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -376,6 +418,8 @@ class VerifyService:
         probe_timeout_s: float | None = None,
         failover_tick_s: float = 0.25,
         artifact_dir: str | None = None,
+        remote_addr: str | None = None,
+        remote_opts: dict | None = None,
     ):
         self.batch_max = max(
             1, batch_max if batch_max is not None
@@ -418,6 +462,19 @@ class VerifyService:
         }
         self._queued_sigs: dict[Klass, dict[str, int]] = {k: {} for k in Klass}
         self._class_sigs: dict[Klass, int] = {k: 0 for k in Klass}
+        # per-(class, tenant) OUTSTANDING signatures — submitted and not
+        # yet settled.  This, not queue depth, is what the tenant quota
+        # admits against: the scheduler hands batches to the device's
+        # (or the wire's) async pipeline almost instantly, so a queue
+        # bound alone would let one tenant park unbounded work in
+        # flight.  Released exactly once per request via the ticket's
+        # first-wins settle hook.  Own lock, nested inside _cond on the
+        # submit path; the release path takes only this lock, so a
+        # ticket resolved under any other service lock cannot deadlock.
+        self._outstanding_sigs: dict[Klass, dict[str, int]] = {
+            k: {} for k in Klass
+        }
+        self._out_mtx = threading.Lock()
         # weighted round-robin position + credits per class; credits are
         # rebuilt from the READY tenant set at each replenish, so tenants
         # that drained and left the queue dict are pruned for free
@@ -482,6 +539,15 @@ class VerifyService:
         )
         self.failover_tick_s = max(0.01, failover_tick_s)
         self.artifact_dir = artifact_dir
+        # ---- out-of-process verify plane (module docstring, "remote").
+        # The client (and its io thread) is created at _ensure_started,
+        # so merely constructing a service never dials a plane.
+        self.remote_addr = (
+            remote_addr if remote_addr is not None
+            else envknobs.get_str(envknobs.VERIFYRPC_ADDR).strip()
+        ) or None
+        self._remote_opts = dict(remote_opts or {})
+        self._remote = None
         # mode state, guarded by _failover_mtx (never held across
         # blocking work); _gen tags worker threads so a trip can respawn
         # the collector/host workers while the wedged old generation is
@@ -512,6 +578,14 @@ class VerifyService:
             # return immediately — a busy spin firing back-to-back
             # subprocess probes
             self._stop_ev.clear()
+            if self.remote_addr and self._remote is None:
+                from . import remote
+
+                self._remote = remote.RemotePlaneClient(
+                    self.remote_addr,
+                    artifact_dir=self.artifact_dir,
+                    **self._remote_opts,
+                )
             self._threads = [
                 threading.Thread(
                     target=self._sched_loop, name="verifysvc-sched",
@@ -568,6 +642,14 @@ class VerifyService:
                 self._class_sigs[k] = 0
             self._cond.notify_all()
         self._stop_ev.set()
+        # close the remote client FIRST: its pending requests settle
+        # with errors and their deferred-collect callbacks enqueue the
+        # batches onto the collect queue, so the drain below fails those
+        # tickets too — stop() must never leave a remote-in-flight
+        # caller parked until its own collect timeout
+        if self._remote is not None:
+            self._remote.close()
+            self._remote = None
         self._collectq.put(None)
         self._hostq.put((_HOST_SENTINEL_PRIO, 0, None))
         for r in stranded:
@@ -634,23 +716,35 @@ class VerifyService:
                 )
             class_q = self._class_sigs[klass]
             ten_q = self._queued_sigs[klass].get(tenant, 0)
-            if ten_q + n > self.tenant_quota < self.queue_max:
-                # the flooding tenant's OWN quota: backpressure confined
-                # to the offender, the class stays admissible for others.
-                # With no extra per-tenant bound configured (quota ==
-                # queue_max) the class bound below owns the attribution:
-                # scope="tenant" must only ever point an operator at a
-                # quota knob that is actually the binding constraint.
-                queued, limit, scope = ten_q, self.tenant_quota, "tenant"
-            elif class_q + n > self.queue_max:
-                queued, limit, scope = class_q, self.queue_max, "class"
-            else:
-                queued = limit = 0
-                scope = None
+            with self._out_mtx:
+                ten_out = self._outstanding_sigs[klass].get(tenant, 0)
+                if ten_out + n > self.tenant_quota < self.queue_max:
+                    # the flooding tenant's OWN quota — on OUTSTANDING
+                    # sigs (queued + dispatched-unsettled), so a fast
+                    # drain into the device/wire pipeline can't launder
+                    # a flood past admission: backpressure confined to
+                    # the offender, the class stays admissible for
+                    # others.  With no extra per-tenant bound configured
+                    # (quota == queue_max) the class bound below owns
+                    # the attribution: scope="tenant" must only ever
+                    # point an operator at a quota knob that is
+                    # actually the binding constraint.
+                    queued, limit, scope = (
+                        ten_out, self.tenant_quota, "tenant"
+                    )
+                elif class_q + n > self.queue_max:
+                    queued, limit, scope = class_q, self.queue_max, "class"
+                else:
+                    queued = limit = 0
+                    scope = None
+                    self._outstanding_sigs[klass][tenant] = ten_out + n
             if scope is not None:
                 self._rejected[klass.label] += 1
             else:
                 req = _Request(items, klass, mode, tenant=tenant)
+                req.ticket._on_settle = functools.partial(
+                    self._release_outstanding, klass, tenant, n
+                )
                 self._queues[klass].setdefault(tenant, []).append(req)
                 self._queued_sigs[klass][tenant] = ten_q + n
                 self._class_sigs[klass] = class_q + n
@@ -684,6 +778,18 @@ class VerifyService:
             tdepth, **{"tenant": tlabel, "class": klass.label}
         )
         return req.ticket
+
+    def _release_outstanding(self, klass: Klass, tenant: str, n: int) -> None:
+        """Return ``n`` signatures of ``tenant``'s quota — the ticket's
+        settle hook, fired exactly once per admitted request no matter
+        which path (collect, host re-verify, failure, stop) wins."""
+        with self._out_mtx:
+            d = self._outstanding_sigs[klass]
+            left = d.get(tenant, 0) - n
+            if left > 0:
+                d[tenant] = left
+            else:
+                d.pop(tenant, None)
 
     def _tally_tenant(self, tlabel: str, key: str, n: int = 1) -> None:
         """Bump a per-tenant tally (keyed by the BOUNDED label).  Its
@@ -848,8 +954,18 @@ class VerifyService:
         with self._inflight_mtx:
             rec = self._inflight.get(id(batch))
             if rec is not None:
+                if where == "remote":
+                    # remote batches never start the LOCAL failover
+                    # deadline clock (device_since stays None): the
+                    # remote client owns its own deadline + breaker,
+                    # and a slow plane is not a wedged local device
+                    rec["remote"] = True
                 rec["where"] = where
-                if where in ("device", "collect") and rec.get("device_since") is None:
+                if (
+                    where in ("device", "collect")
+                    and not rec.get("remote")
+                    and rec.get("device_since") is None
+                ):
                     rec["device_since"] = time.monotonic()
 
     def _untrack_inflight(self, batch: list[_Request]) -> None:
@@ -888,12 +1004,24 @@ class VerifyService:
             self._dispatch(klass, batch, reason)
 
     def _make_verifier(self, mode):
-        """Bind a batch to a device verifier.  The ONLY constructor seam
-        for the data plane — tests monkeypatch this to observe dispatch
-        order without touching a real kernel.  In CPU fallback mode
-        EVERY batch — comb-bound or not — gets the host verifier: a
-        comb entry is device-resident state, and touching it while the
-        tunnel is wedged is exactly the hang the trip escaped."""
+        """Bind a batch to a data-plane verifier.  The ONLY constructor
+        seam — tests monkeypatch this to observe dispatch order without
+        touching a real kernel.  With a remote plane configured, every
+        batch routes over the wire while the breaker is closed and to
+        the in-process HOST path while it is open (never a local device:
+        a node consuming a shared plane may not even have one, and the
+        host path is the bit-identical verdict source either way).  In
+        CPU fallback mode EVERY batch — comb-bound or not — gets the
+        host verifier: a comb entry is device-resident state, and
+        touching it while the tunnel is wedged is exactly the hang the
+        trip escaped."""
+        rem = self._remote  # one read: stop() nulls it concurrently
+        if rem is not None:
+            if rem.available():
+                from .remote import RemoteBatchVerifier
+
+                return RemoteBatchVerifier(rem)
+            return _HostBatchVerifier()
         if self._backend_mode == MODE_CPU_FALLBACK:
             return _HostBatchVerifier()
         if mode[0] == "comb":
@@ -950,6 +1078,11 @@ class VerifyService:
                 if fail.armed("fail_dispatch") is not None:
                     raise fail.InjectedFault("injected fault: fail_dispatch")
                 bv = self._make_verifier(batch[0].mode)
+                bind = getattr(bv, "bind_request", None)
+                if bind is not None:
+                    # remote verifiers carry (tenant, class) on the wire
+                    # — the plane schedules remote submitters server-side
+                    bind(klass, batch[0].tenant)
                 for r in batch:
                     for pub, msg, sig in r.items:
                         bv.add(pub, msg, sig)
@@ -1037,13 +1170,30 @@ class VerifyService:
             if ticket[0] == "sync":
                 self._settle(bv, ticket, batch)  # resolved already
             else:
-                # device ticket (uncached path): the collector owns the
-                # blocking result wait, freeing this worker immediately.
-                # Relabel the in-flight record (same entry, age keeps
-                # accruing) so a wedge during the collect blames the
-                # device wait, not the finished host work
-                self._relabel_inflight(batch, "device")
-                self._collectq.put((bv, ticket, batch))
+                # device/remote ticket (uncached path): the collector
+                # owns the blocking result wait, freeing this worker
+                # immediately.  Relabel the in-flight record (same
+                # entry, age keeps accruing) so a wedge during the
+                # collect blames the device wait, not the finished host
+                # work — remote batches keep their own label and stay
+                # off the local failover clock
+                self._relabel_inflight(
+                    batch, getattr(bv, "inflight_where", "device")
+                )
+                defer = getattr(bv, "defer_collect", None)
+                if defer is not None:
+                    # remote batches reach the collector only once their
+                    # response/expiry has SETTLED them: the plane answers
+                    # out of dispatch order (it schedules by class), and
+                    # a FIFO blocking collect would park a consensus
+                    # settle behind every in-flight mempool response
+                    defer(
+                        ticket,
+                        lambda bv=bv, t=ticket, b=batch:
+                        self._collectq.put((bv, t, b)),
+                    )
+                else:
+                    self._collectq.put((bv, ticket, batch))
 
     # ---------------------------------------------------------- collector
 
@@ -1154,6 +1304,16 @@ class VerifyService:
         mempool ones.  If the HOST path itself errored (``bv`` already
         a :class:`_HostBatchVerifier`) the tickets fail — requeueing
         would loop."""
+        if isinstance(exc, VerifyServiceBackpressure):
+            # a REMOTE plane's server-side admission control said no:
+            # the same contract as a local reject — the tickets fail
+            # with the backpressure (tenant/scope intact) and the
+            # CALLER owns the host fallback; re-verifying here would
+            # defeat the plane's admission control by doing the work
+            # locally on its behalf
+            for r in batch:
+                r.ticket._fail(exc)
+            return
         if not self.failover_enabled or isinstance(bv, _HostBatchVerifier):
             for r in batch:
                 r.ticket._fail(exc)
@@ -1495,6 +1655,8 @@ class VerifyService:
             rejected = dict(self._rejected)
         with self._tally_mtx:
             tenants = {t: dict(v) for t, v in self._tenant_tallies.items()}
+        rem = self._remote  # one read: stop() nulls it concurrently
+        remote = rem.stats() if rem is not None else None
         with self._failover_mtx:
             failover = {
                 "enabled": self.failover_enabled,
@@ -1512,6 +1674,7 @@ class VerifyService:
             "running": self._running,
             "backend_mode": failover["backend_mode"],
             "failover": failover,
+            "remote": remote,
             "batch_max": self.batch_max,
             "queue_max": self.queue_max,
             "tenant_quota": self.tenant_quota,

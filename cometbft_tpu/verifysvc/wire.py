@@ -1,0 +1,213 @@
+"""Wire format of the out-of-process verify plane (verifyd).
+
+Varint-length-prefixed protobuf over a plain TCP stream — the exact
+framing the remote signer already speaks (privval/signer.py,
+libs/protoio semantics via wire/proto.py) — carrying a small oneof
+envelope (:class:`PlaneMessage`).  The protocol is deliberately tiny:
+
+  * :class:`VerifyRequest` — one batch of (pub, msg, sig) triples
+    verified as a unit.  Carries the **tenant** and **class** (the
+    server's VerifyService schedules remote submitters exactly like
+    local ones — quotas and weighted-fair interleave are enforced
+    server-side), an **idempotency key** (``request_id`` UUID +
+    ``digest`` over the canonical item encoding: a retried batch is
+    recognizable and is never verified into a different blame order),
+    and the **remaining deadline budget in ms** — budget, not a wall
+    -clock deadline, crosses the wire, so client/server clock skew can
+    never extend or strangle a request; every resend re-derives the
+    remaining budget from the client's own monotonic clock.
+  * :class:`VerifyResponse` — per-signature verdicts in the request's
+    own add() order, or a typed non-OK status (backpressure with the
+    tenant/scope that was hit, deadline expiry, error).  ``deduped``
+    marks a response served from the server's idempotency window.
+  * Ping/Status — liveness (the socket answers) vs readiness (the
+    status payload says the scheduler is running); the breaker's
+    probation probe uses ping.
+  * ArmFault — chaos-only (gated on COMETBFT_TPU_FAULT_RPC in the
+    verifyd process): lets a harness arm ``plane_crash``/``plane_stall``
+    /``rpc_delay_ms``/``rpc_drop_pct`` in a live plane over the wire,
+    so "kill -9 with this exact batch in flight" is deterministic.
+
+Verdicts ride as a packed repeated varint (0/1) — ``bool`` fields can't
+repeat in this codec, and packed ints are the compact proto3 idiom.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+from ..wire.proto import Field, Message, encode_varint
+
+# VerifyResponse.status values
+STATUS_OK = 0
+STATUS_BACKPRESSURE = 1
+STATUS_DEADLINE = 2
+STATUS_ERROR = 3
+STATUS_BAD_REQUEST = 4
+
+STATUS_NAMES = {
+    STATUS_OK: "ok",
+    STATUS_BACKPRESSURE: "backpressure",
+    STATUS_DEADLINE: "deadline",
+    STATUS_ERROR: "error",
+    STATUS_BAD_REQUEST: "bad_request",
+}
+
+
+class SigItem(Message):
+    FIELDS = [
+        Field(1, "pub", "bytes"),
+        Field(2, "msg", "bytes"),
+        Field(3, "sig", "bytes"),
+    ]
+
+
+class VerifyRequest(Message):
+    FIELDS = [
+        Field(1, "request_id", "bytes"),  # idempotency key half 1: UUID
+        Field(2, "digest", "bytes"),      # idempotency key half 2: batch digest
+        Field(3, "tenant", "string"),
+        Field(4, "klass", "varint"),      # service.Klass value
+        Field(5, "budget_ms", "varint"),  # REMAINING deadline budget
+        Field(6, "items", "message", SigItem, repeated=True),
+        Field(7, "attempt", "varint"),    # 1 = first send, >1 = idempotent resend
+    ]
+
+
+class VerifyResponse(Message):
+    FIELDS = [
+        Field(1, "request_id", "bytes"),
+        Field(2, "status", "varint"),
+        Field(3, "all_ok", "bool"),
+        Field(4, "verdicts", "varint", repeated=True, packed=True),
+        Field(5, "error", "string"),
+        Field(6, "deduped", "bool"),
+        Field(7, "scope", "string"),  # backpressure: which bound (tenant|class)
+    ]
+
+
+class PingRequest(Message):
+    FIELDS = []
+
+
+class PingResponse(Message):
+    FIELDS = []
+
+
+class StatusRequest(Message):
+    FIELDS = []
+
+
+class StatusResponse(Message):
+    # JSON payload: forgiving for a diagnosis surface — the schema is the
+    # server's stats() dict, which evolves with the service
+    FIELDS = [Field(1, "json", "string")]
+
+
+class ArmFaultRequest(Message):
+    FIELDS = [
+        Field(1, "name", "string"),
+        Field(2, "value", "double"),
+        Field(3, "clear", "bool"),  # clear instead of arm ("" clears all)
+    ]
+
+
+class ArmFaultResponse(Message):
+    FIELDS = [
+        Field(1, "ok", "bool"),
+        Field(2, "error", "string"),
+    ]
+
+
+class PlaneMessage(Message):
+    """The oneof envelope on the verifyd socket."""
+
+    FIELDS = [
+        Field(1, "verify_request", "message", VerifyRequest),
+        Field(2, "verify_response", "message", VerifyResponse),
+        Field(3, "ping_request", "message", PingRequest),
+        Field(4, "ping_response", "message", PingResponse),
+        Field(5, "status_request", "message", StatusRequest),
+        Field(6, "status_response", "message", StatusResponse),
+        Field(7, "arm_fault_request", "message", ArmFaultRequest),
+        Field(8, "arm_fault_response", "message", ArmFaultResponse),
+    ]
+
+    def which(self) -> str | None:
+        for f in self.FIELDS:
+            if getattr(self, f.name) is not None:
+                return f.name
+        return None
+
+
+def frame(msg: PlaneMessage) -> bytes:
+    """Varint-length-prefixed encoding, ready for sendall()."""
+    raw = msg.encode()
+    return encode_varint(len(raw)) + raw
+
+
+def batch_digest(items) -> bytes:
+    """Canonical digest over a batch's (pub, msg, sig) triples — the
+    content half of the idempotency key.  Length-prefixed fields so two
+    different batches can never collide by boundary shifting."""
+    h = hashlib.sha256()
+    for pub, msg, sig in items:
+        h.update(struct.pack("<I", len(pub)))
+        h.update(pub)
+        h.update(struct.pack("<I", len(msg)))
+        h.update(msg)
+        h.update(struct.pack("<I", len(sig)))
+        h.update(sig)
+    return h.digest()
+
+
+class FrameReader:
+    """Incremental varint-delimited PlaneMessage reader over a socket.
+
+    recv() must be called with the socket's timeout already configured
+    (the socket-without-timeout contract lives with the socket's owner);
+    returns None on clean EOF, raises socket.timeout/OSError upward.
+    A frame larger than ``max_frame`` desyncs nothing — it raises, and
+    the owner drops the connection (the privval stream-desync rule).
+    """
+
+    def __init__(self, sock, max_frame: int = 64 << 20):
+        self._sock = sock
+        self._buf = bytearray()
+        self._max = max_frame
+
+    def read(self) -> PlaneMessage | None:
+        while True:
+            msg = self._try_decode()
+            if msg is not None:
+                return msg
+            chunk = self._sock.recv(1 << 16)
+            if not chunk:
+                return None
+            self._buf += chunk
+
+    def _try_decode(self) -> PlaneMessage | None:
+        buf = self._buf
+        # decode the varint prefix by hand so a partial prefix just waits
+        n = 0
+        shift = 0
+        pos = 0
+        while True:
+            if pos >= len(buf):
+                return None
+            b = buf[pos]
+            pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ValueError("verify-plane frame: varint overflow")
+        if n > self._max:
+            raise ValueError(f"verify-plane frame too large ({n} bytes)")
+        if len(buf) - pos < n:
+            return None
+        payload = bytes(buf[pos : pos + n])
+        del buf[: pos + n]
+        return PlaneMessage.decode(payload)

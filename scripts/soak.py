@@ -11,6 +11,17 @@ and fault endurance (cometbft_tpu/e2e/soak.py).
     python scripts/soak.py --duration 3600 --tenants 8  # the long haul
     python scripts/soak.py --duration 30 --no-chaos --json out/soak.json
     python scripts/soak.py --smoke                      # tier-1 shape, ~10 s
+    python scripts/soak.py --remote-plane               # out-of-process
+                                                        # verifyd, kill -9'd
+                                                        # and revived mid-soak
+
+``--remote-plane`` spawns a verifyd subprocess and routes every
+tenant's batches over the RPC surface (verifysvc/remote.py): quotas
+are enforced server-side, each mid-soak fault cycle kill -9s the plane
+with batches in flight (breaker trip -> host fallback -> restart ->
+probation restore), and the default concurrent chaos scenario becomes
+``plane_crash`` — REAL node processes sharing their own verifyd that
+dies and returns mid-height.
 
 Exit status: 0 iff every SLO assertion held.  ``--json`` (default
 ``out/soak.json``) writes the full report; the assertions block is also
@@ -79,6 +90,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="the fast tier-1 shape: 2 tenants, ~10 s, one "
                         "wedge cycle, no chaos subprocess")
+    p.add_argument("--remote-plane", action="store_true",
+                   help="spawn a verifyd subprocess and run the soak "
+                        "over the RPC surface; fault cycles kill -9 the "
+                        "plane instead of wedging a fake device")
+    p.add_argument("--verifyd-port", type=int, default=29900,
+                   help="port the spawned verifyd listens on (0 = "
+                        "ephemeral)")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -90,10 +108,12 @@ def main(argv: list[str] | None = None) -> int:
             starvation_floor_ms=max(args.starvation_floor_ms, 250.0),
             leak_check=False, commit_pause_s=0.02, checktx_period_s=0.1,
             artifact_dir=args.out, json_path=args.json,
+            remote_plane=args.remote_plane, verifyd_port=args.verifyd_port,
         )
     else:
         chaos = tuple(args.chaos_scenario) or (
-            () if args.no_chaos else ("crash_replay",)
+            () if args.no_chaos
+            else (("plane_crash",) if args.remote_plane else ("crash_replay",))
         )
         cfg = SoakConfig(
             tenants=args.tenants,
@@ -112,6 +132,8 @@ def main(argv: list[str] | None = None) -> int:
             chaos_base_port=args.base_port,
             artifact_dir=args.out,
             json_path=args.json,
+            remote_plane=args.remote_plane,
+            verifyd_port=args.verifyd_port,
         )
 
     report = run_soak(cfg)

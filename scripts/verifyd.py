@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""verifyd: the shared out-of-process verify plane.
+
+Hosts one VerifyService (priority classes, per-tenant quotas,
+weighted-fair interleave, degraded-mode failover) behind the
+varint-delimited protobuf surface of cometbft_tpu/verifysvc/wire.py.
+Nodes point COMETBFT_TPU_VERIFYRPC_ADDR at it; the client side
+(verifysvc/remote.py) owns reconnect backoff, deadline propagation,
+idempotent retry, and the circuit breaker back to the in-process host
+path — so this process can be killed, stalled, or restarted at any
+moment without a node losing a single verification ticket.
+
+    python scripts/verifyd.py --addr 127.0.0.1:29170
+    python scripts/verifyd.py                # ephemeral port, printed as
+                                             # 'VERIFYD READY addr=...'
+
+Service shape (quotas, batch width, deadlines) comes from the usual
+COMETBFT_TPU_VERIFYSVC_* knobs in THIS process's environment — the
+plane, not its clients, owns admission control.  SIGTERM/SIGINT stop it
+cleanly; kill -9 is a supported operating condition.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.verifysvc.server import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -173,10 +173,10 @@ def test_chaos_driver_crash_exits_3_not_1(tmp_path, monkeypatch):
     assert rc == 3
 
 
-def test_registry_names_the_five_full_scenarios():
+def test_registry_names_the_six_full_scenarios():
     assert set(sc.DEFAULT_SCENARIOS) == {
         "wedge", "crash_replay", "partition_heal", "double_sign",
-        "valset_rotation_blocksync",
+        "valset_rotation_blocksync", "plane_crash",
     }
     assert set(sc.DEFAULT_SCENARIOS) | {"wedge_smoke"} == set(sc.SCENARIOS)
 
@@ -212,3 +212,18 @@ def test_scenario_double_sign(tmp_path):
 def test_scenario_valset_rotation_blocksync(tmp_path):
     res = sc.run_scenario("valset_rotation_blocksync", str(tmp_path))
     assert res.ok, json.dumps(res.to_dict(), indent=1)
+
+
+@pytest.mark.slow
+def test_scenario_plane_crash(tmp_path):
+    """3 real node processes on one shared verifyd; kill -9 it
+    mid-height, liveness resumes via every node's breaker fallback, the
+    restarted plane probation-restores and serves again (the fast
+    single-process twin is tests/test_verifyrpc.py's loopback smoke)."""
+    res = sc.run_scenario("plane_crash", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+    d = res.details
+    assert d["plane_requests_before_crash"] > 0
+    assert d["breakers_after_crash"] == ["open"] * 3
+    assert d["breakers_after_restart"] == ["closed"] * 3
+    assert d["plane_requests_after_restart"] > 0

@@ -124,6 +124,37 @@ def test_soak_smoke_two_tenants(tmp_path):
 
 
 @pytest.mark.slow
+def test_soak_remote_plane(tmp_path):
+    """The out-of-process shape of the smoke: every batch crosses the
+    wire to a spawned verifyd, quotas are enforced SERVER-side, and the
+    fault cycle kill -9s the plane with batches in flight (breaker trip
+    -> host fallback -> restart -> probation restore).  ~15 s — slow
+    tier to protect the tier-1 budget; the tier-1 loopback smoke in
+    tests/test_verifyrpc.py covers the same machinery single-process."""
+    cfg = SoakConfig(
+        artifact_dir=str(tmp_path),
+        json_path=str(tmp_path / "soak.json"),
+        remote_plane=True, verifyd_port=0, duration_s=12.0,
+        remote_budget_s=3.0,
+        **{k: v for k, v in SMOKE.items() if k != "duration_s"},
+    )
+    rep = run_soak(cfg)
+    assert rep["ok"], json.dumps(rep["assertions"], indent=1, default=str)
+    a = rep["assertions"]
+    assert a["quota_isolation"]["enforced"] == "server-side"
+    assert a["quota_isolation"]["rogue_rejected"] > 0
+    assert not any(a["quota_isolation"]["victim_backpressure"].values())
+    fe = a["fault_endurance"]
+    assert fe["trips"] >= 1 and fe["restores"] >= 1
+    assert all(
+        w["kind"] == "plane_crash" and w["tripped"] and w["restored"]
+        for w in fe["wedge_cycles"]
+    )
+    assert rep["remote_plane"]["tallies"]["requests"] > 0
+    assert a["no_drift"]["ok"] and a["zero_lost_tickets"]["ok"]
+
+
+@pytest.mark.slow
 def test_soak_real_five_minutes(tmp_path):
     """The acceptance shape (scripts/soak.py defaults, minus the chaos
     subprocess, which tests/test_chaos_scenarios.py covers one by one):

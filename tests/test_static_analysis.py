@@ -18,6 +18,7 @@ from cometbft_tpu.analysis import (
     lockwitness,
     metrics_registry,
     raw_env,
+    socket_timeout,
     swallowed_exc,
     thread_names,
 )
@@ -98,6 +99,81 @@ class C:
 '''
     (f,) = lock_blocking.check(_mod(src))
     assert "_inner_lock" in f.message
+
+
+def test_socket_timeout_trips_on_each_shape():
+    src = '''
+import socket
+
+def dial(host, port):
+    sock = socket.socket()                    # 1: no settimeout in scope
+    sock.connect((host, port))                # 2: socky receiver
+    return sock
+
+def read(sock):
+    return sock.recv(4096)                    # 3
+
+def listen(host):
+    return socket.create_server((host, 0))    # 4
+
+def dial2(host, port):
+    return socket.create_connection((host, port))  # 5: no timeout arg
+'''
+    found = socket_timeout.check(_mod(src))
+    assert len(found) == 5, [f.render() for f in found]
+    assert all(f.check == "socket-without-timeout" for f in found)
+
+
+def test_socket_timeout_cleared_by_function_or_class_scope():
+    src = '''
+import socket
+
+def dial_ok(host, port):
+    s = socket.socket()
+    s.settimeout(2.0)                          # clears the whole function
+    s.connect((host, port))
+    return s
+
+def dial_timeout_arg(host, port):
+    return socket.create_connection((host, port), 5.0)   # positional
+
+def dial_timeout_kw(host, port):
+    return socket.create_connection((host, port), timeout=5.0)
+
+def blocking_declared(sock):
+    sock.settimeout(None)                      # deliberate: declared
+    return sock.recv(10)
+
+class Client:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), 2.0)
+
+    def read(self):
+        # cleared by the CLASS scope: the constructor dialed with a
+        # timeout — the create-in-one-method, read-in-another idiom
+        return self.sock.recv(4096)
+
+def sql(path):
+    import sqlite3
+    return sqlite3.connect(path)               # not a socket: never flagged
+'''
+    assert socket_timeout.check(_mod(src)) == []
+
+
+def test_socket_timeout_one_class_does_not_launder_another():
+    src = '''
+import socket
+
+class Good:
+    def __init__(self):
+        self.sock = socket.create_connection(("h", 1), 2.0)
+
+class Bad:
+    def read(self, sock):
+        return sock.recv(10)
+'''
+    (f,) = socket_timeout.check(_mod(src))
+    assert f.check == "socket-without-timeout" and ".recv" in f.message
 
 
 def test_swallowed_exc_trips_on_bare_and_broad_pass():
