@@ -41,7 +41,10 @@ BENCH_WORKLOAD=multichip sweeps the same verify over device counts
 (default 1/2/4/8) and reports per-count p50 scaling plus
 cold-start-to-first-verify from an empty comb cache — the ROADMAP item 1
 capture (see _run_multichip); BENCH_WORKLOAD=mixed drives concurrent
-consensus + mempool CheckTx load through the verify service.
+consensus + mempool CheckTx load through the verify service;
+BENCH_WORKLOAD=bls sweeps validator-set sizes comparing ed25519-batch
+vs BLS-aggregate-commit p50 and reports the crossover set size
+(see _run_bls).
 
 Baseline: curve25519-voi batch verify ~27.5 us/sig/core on the QA CPUs
 (BASELINE.md: 50-60 us single, ~2x batch gain) -> 275 ms for 10k sigs.
@@ -432,6 +435,141 @@ def _run_mixed() -> None:
     emit_and_exit()
 
 
+def _run_bls() -> None:
+    """BENCH_WORKLOAD=bls: the ed25519-vs-BLS cost-model crossover
+    capture (ROADMAP item 2 / PAPERS.md arXiv:2302.00418).  Sweeps
+    validator-set sizes (BENCH_BLS_SIZES, default 64,256,1024,4096)
+    and measures, per size:
+
+      * ed25519-batch: N individually signed rows through the
+        production batch path (crypto/batch.create_batch_verifier,
+        comb-cached) — cost grows ~linearly in N;
+      * BLS-aggregate: ONE aggregate commit (N validators, one shared
+        message, one aggregate G2 signature replicated per row) through
+        the BLS lane (models/bls_verifier behind the verify service) —
+        one pairing-product check plus a data-parallel pubkey sum, so
+        cost is ~flat in N once the validated-pubkey cache is warm
+        (steady state: the validator set outlives the commit, exactly
+        like the resident ed25519 comb tables).
+
+    The JSON line carries per-size p50 for both schemes and
+    ``crossover_validators``: the interpolated set size where the BLS
+    aggregate becomes cheaper than the ed25519 batch (null when the
+    sweep never crosses).  Setup uses small secret scalars (pk/sig
+    scalar mults dominate setup wall clock; verification cost is
+    independent of scalar size), distinct per validator.
+    """
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import bls12381 as host_bls
+    from cometbft_tpu.crypto import ed25519 as host_ed
+    from cometbft_tpu.models import bls_verifier
+
+    sizes = [
+        int(x) for x in
+        os.environ.get("BENCH_BLS_SIZES", "64,256,1024,4096").split(",")
+        if x.strip()
+    ]
+    iters = int(os.environ.get("BENCH_BLS_ITERS", "5"))
+    REPORT["metric"] = "verify_bls_crossover_validators"
+    REPORT["workload"] = "bls"
+    REPORT["sizes"] = sizes
+    REPORT["iters"] = iters
+
+    rng = np.random.default_rng(17)
+
+    def p50(fn):
+        runs = sorted(fn() for _ in range(iters))
+        return runs[len(runs) // 2]
+
+    sweep: dict[str, dict] = {}
+    n_max = max(sizes)
+    # one key universe per scheme, sliced per size (setup dominates the
+    # sweep's wall clock; the timed regions only ever see warm caches)
+    ed_keys = [host_ed.PrivKey.from_seed(rng.bytes(32)) for _ in range(n_max)]
+    ed_pubs = [k.pub_key().data for k in ed_keys]
+    # distinct small scalars: verification cost is scalar-size-blind
+    sks = rng.choice(1 << 30, size=n_max, replace=False) + 2
+    bls_keys = [host_bls.PrivKey(int(sk)) for sk in sks]
+    bls_pubs = [k.pub_key().data for k in bls_keys]
+
+    for n in sizes:
+        row: dict = {}
+        # ---- ed25519 batch: N rows, per-validator sign bytes
+        pubs = ed_pubs[:n]
+        items = []
+        for i, sk in enumerate(ed_keys[:n]):
+            msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|chain-bls-bench"
+            items.append((pubs[i], msg, sk.sign(msg)))
+        crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)  # warm tables
+
+        def run_ed():
+            v = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
+            t0 = time.perf_counter()
+            for pub, msg, sig in items:
+                v.add(pub, msg, sig)
+            ok, per = v.verify()
+            dt = (time.perf_counter() - t0) * 1e3
+            assert ok and len(per) == n
+            return dt
+
+        run_ed()  # warmup (bucket compile / cache warm)
+        row["ed25519_p50_ms"] = round(p50(run_ed), 3)
+
+        # ---- BLS aggregate commit: one message, one aggregate sig
+        msg = b"\x08\x02\x10\x01\x18\x05|bls-agg-commit|%d" % n
+        agg_sig = host_bls.aggregate_signatures(
+            [k.sign(msg) for k in bls_keys[:n]]
+        )
+        bpubs = bls_pubs[:n]
+
+        def run_bls():
+            v = crypto_batch.create_batch_verifier("bls12_381", pubkeys=bpubs)
+            t0 = time.perf_counter()
+            for pub in bpubs:
+                v.add(pub, msg, agg_sig)
+            ok, per = v.verify()
+            dt = (time.perf_counter() - t0) * 1e3
+            assert ok and len(per) == n
+            return dt
+
+        # genuinely cold first verify per size: the key universe is
+        # sliced, so without the reset the n=256 round would find the
+        # first 64 keys already validated by the n=64 round
+        bls_verifier.reset_caches()
+        t0 = time.perf_counter()
+        run_bls()  # warmup: pays pubkey validation once (cache fill)
+        row["bls_first_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        row["bls_p50_ms"] = round(p50(run_bls), 3)
+        sweep[str(n)] = row
+
+    REPORT["sweep"] = sweep
+
+    # crossover: smallest swept size where the aggregate wins, with a
+    # log-linear interpolation between the straddling sizes
+    crossover = None
+    prev = None
+    for n in sizes:
+        row = sweep[str(n)]
+        d = row["bls_p50_ms"] - row["ed25519_p50_ms"]
+        if d <= 0:
+            if prev is None:
+                crossover = n
+            else:
+                pn, pd = prev
+                # linear interpolation of the (bls - ed) gap in log2(N)
+                import math
+
+                f = pd / (pd - d) if pd != d else 0.0
+                crossover = int(round(
+                    2 ** (math.log2(pn) + f * (math.log2(n) - math.log2(pn)))
+                ))
+            break
+        prev = (n, d)
+    REPORT["value"] = REPORT["crossover_validators"] = crossover
+    REPORT["unit"] = "validators"
+    emit_and_exit()
+
+
 def _run_multichip() -> None:
     """BENCH_WORKLOAD=multichip: the 8-device scaling capture of ROADMAP
     item 1.  Sweeps the comb-cached commit verify over device counts
@@ -653,6 +791,8 @@ def main() -> None:
         _run_mixed()
     if os.environ.get("BENCH_WORKLOAD", "") == "multichip":
         _run_multichip()
+    if os.environ.get("BENCH_WORKLOAD", "") == "bls":
+        _run_bls()
 
     N = int(os.environ.get("BENCH_N", "10000"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))

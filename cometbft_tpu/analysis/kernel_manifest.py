@@ -174,13 +174,35 @@ KERNELS: tuple[Kernel, ...] = (
         out=(u8(32),),
         max_eqns=2_000,  # measured 628
     ),
-    # ---- ops/bls381.py — G1 aggregation (FastAggregateVerify data plane)
+    # ---- ops/bls381.py — the FastAggregateVerify data plane: batched
+    # KeyValidate (on-curve + subgroup) and the tree-reduced G1 pubkey
+    # sum; Miller loop + final exponentiation stay on host
+    # (crypto/bls12381), exactly as the reference keeps them in blst
     Kernel(
         name="bls381_aggregate_g1",
         fn="cometbft_tpu.ops.bls381:aggregate_g1",
         args=(i32(N, 32), i32(N, 32), i32(N, 32)),
         out=(i32(32), i32(32), i32(32)),
         max_eqns=18_000,  # measured 12,966
+    ),
+    Kernel(
+        # subgroup check = [r]P via lax.scan over the 255 order bits: the
+        # jaxpr is O(1) in the bit count (one double+add body), so the
+        # budget is small despite the 255-step runtime chain
+        name="bls381_validate_g1",
+        fn="cometbft_tpu.ops.bls381:validate_g1",
+        args=(i32(N, 32), i32(N, 32), boolean(N)),
+        out=(boolean(N),),
+        max_eqns=8_500,  # measured 6,474
+    ),
+    Kernel(
+        # validation + tree-reduced aggregation fused into ONE dispatch —
+        # the aggregate-commit hot path (one device call per commit)
+        name="bls381_validate_aggregate_g1",
+        fn="cometbft_tpu.ops.bls381:validate_aggregate_g1",
+        args=(i32(N, 32), i32(N, 32), boolean(N)),
+        out=(boolean(N), i32(32), i32(32), i32(32)),
+        max_eqns=26_000,  # measured 19,445
     ),
     # ---- models/comb_verifier.py — cache assembly + the device program
     Kernel(
@@ -244,6 +266,10 @@ KERNELS: tuple[Kernel, ...] = (
 JIT_SITES: dict[str, str] = {
     "cometbft_tpu/ops/comb.py::build_a_tables": "comb_build_a_tables",
     "cometbft_tpu/ops/bls381.py::aggregate_g1": "bls381_aggregate_g1",
+    "cometbft_tpu/ops/bls381.py::validate_g1": "bls381_validate_g1",
+    "cometbft_tpu/ops/bls381.py::validate_aggregate_g1": (
+        "bls381_validate_aggregate_g1"
+    ),
     # models/verifier.py jits ops/ed25519.verify_batch (the uncached path)
     "cometbft_tpu/models/verifier.py::verify_batch": "ed25519_verify_batch",
     "cometbft_tpu/models/comb_verifier.py::_assemble_churn": "comb_assemble_churn",
@@ -274,6 +300,18 @@ COLLECT_BOUNDARIES: dict[str, str] = {
     ),
     "cometbft_tpu/ops/bls381.py::aggregate_pubkeys_device": (
         "the BLS host bridge: one blocking fetch of the aggregated point"
+    ),
+    "cometbft_tpu/ops/bls381.py::validate_pubkeys_device": (
+        "the BLS validation bridge: one blocking fetch of the per-row "
+        "validity bits"
+    ),
+    "cometbft_tpu/ops/bls381.py::validate_aggregate_device": (
+        "the fused FastAggregateVerify bridge: one blocking fetch of "
+        "(validity bits, aggregate point)"
+    ),
+    "cometbft_tpu/ops/bls381.py::_jac_to_affine_host": (
+        "host-side Jacobian->affine converter for an already-computed "
+        "device aggregate; its np.asarray is THE one result fetch"
     ),
     "cometbft_tpu/ops/bls381.py::from_limbs": (
         "host-side limb decoder; receives the already-fetched aggregate"

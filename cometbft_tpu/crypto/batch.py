@@ -17,6 +17,7 @@ from __future__ import annotations
 from ..models.verifier import BatchVerifier, CpuEd25519BatchVerifier
 from ..utils import envknobs
 from . import ed25519
+from .encoding import BLS_KEY_TYPE
 
 _BATCH_MIN = 2  # below this, single verification is cheaper (validation.go:15)
 
@@ -26,7 +27,12 @@ def backend() -> str:
 
 
 def supports_batch_verifier(key_type: str) -> bool:
-    return key_type == ed25519.KEY_TYPE
+    """ed25519 batches through the comb/plain kernels; bls12_381
+    through the aggregate lane (models/bls_verifier — one pairing per
+    batch).  The key type comes from the validator set's genesis pubkey
+    encoding, constrained by ConsensusParams.validator.pub_key_types —
+    that is the whole backend-selection story (docs/verify_service.md)."""
+    return key_type in (ed25519.KEY_TYPE, BLS_KEY_TYPE)
 
 
 def comb_min() -> int:
@@ -83,11 +89,16 @@ def create_batch_verifier(
     from ..verifysvc.service import remote_plane_configured
 
     if not device_capable() and not remote_plane_configured():
+        if key_type == BLS_KEY_TYPE:
+            from ..models.bls_verifier import CpuBlsBatchVerifier
+
+            return CpuBlsBatchVerifier()
         return CpuEd25519BatchVerifier()
     from ..verifysvc.client import ServiceBatchVerifier, resolve_mode
     from ..verifysvc.service import Klass
 
     return ServiceBatchVerifier(
-        Klass.CONSENSUS if klass is None else klass, resolve_mode(pubkeys),
+        Klass.CONSENSUS if klass is None else klass,
+        resolve_mode(pubkeys, key_type=key_type),
         tenant=tenant,
     )

@@ -41,6 +41,13 @@ class NodeSpec:
     # (COMETBFT_TPU_VERIFYSVC_TENANT): how process-level chains share a
     # multi-tenant verify plane; "" keeps the default tenant
     tenant: str = ""
+    # per-node validator key type ("" = the manifest-wide key_type).
+    # A mix of key types across nodes produces a MIXED validator set in
+    # genesis (e.g. ed25519 + bls12_381): commit verification then takes
+    # the sequential fallback (types/validation.should_batch_verify
+    # requires a homogeneous set), and the genesis/proto encode paths
+    # must round-trip every key type (crypto/encoding)
+    key_type: str = ""
     # per-link shaping (runner/latency_emulation.go analogue): outbound
     # delay +- jitter applied at this node's sockets (utils/netutil)
     latency_ms: float = 0.0
@@ -240,6 +247,8 @@ class Runner:
                 "--key-type", self.m.key_type,
             ]
         ) == 0
+        if any(spec.key_type for spec in self.m.nodes):
+            self._apply_node_key_types()
         for i, spec in enumerate(self.m.nodes):
             home = os.path.join(self.out, f"node{i}")
             cfg = load_config(home)
@@ -285,6 +294,55 @@ class Runner:
                     extra_env=spec.env,
                 )
             )
+
+    def _apply_node_key_types(self) -> None:
+        """Regenerate the privval key of every node with a per-spec
+        ``key_type`` override and rewrite the SHARED genesis (validator
+        list + ConsensusParams.validator.pub_key_types) across all
+        homes — a mixed-key-type validator set must round-trip through
+        the same genesis.json every node loads."""
+        from ..privval.file_pv import FilePV
+        from ..types.genesis import GenesisDoc, GenesisValidator
+
+        cfgs = [
+            load_config(os.path.join(self.out, f"node{i}"))
+            for i in range(len(self.m.nodes))
+        ]
+        pvs = []
+        for cfg, spec in zip(cfgs, self.m.nodes):
+            if spec.key_type and spec.key_type != self.m.key_type:
+                os.remove(cfg.priv_validator_key_file())
+                # the last-sign state belongs to the deleted key: a new
+                # key inheriting old height/round/signbytes would trip
+                # (or wrongly pass) the double-sign guard
+                if os.path.exists(cfg.priv_validator_state_file()):
+                    os.remove(cfg.priv_validator_state_file())
+                pv = FilePV.load_or_generate(
+                    cfg.priv_validator_key_file(),
+                    cfg.priv_validator_state_file(),
+                    key_type=spec.key_type,
+                )
+            else:
+                pv = FilePV.load_or_generate(
+                    cfg.priv_validator_key_file(),
+                    cfg.priv_validator_state_file(),
+                )
+            pvs.append(pv)
+        with open(cfgs[0].genesis_file()) as f:
+            doc = GenesisDoc.from_json(f.read())
+        doc.validators = [
+            GenesisValidator(
+                pub_key_type=pv.key.pub_key.type,
+                pub_key_bytes=pv.key.pub_key.bytes(),
+                power=10,
+            )
+            for pv in pvs
+        ]
+        doc.consensus_params.validator.pub_key_types = sorted(
+            {pv.key.pub_key.type for pv in pvs}
+        )
+        for cfg in cfgs:
+            doc.save_as(cfg.genesis_file())
 
     def start(self) -> None:
         for node, spec in zip(self.nodes, self.m.nodes):

@@ -1,0 +1,419 @@
+"""Batched BLS12-381 aggregate-commit verifiers — the BLS data plane
+behind the verify-service seam (verifysvc/service.MODE_BLS).
+
+The cost model this plane exists for (PAPERS.md arXiv:2302.00418): an
+ed25519 commit costs N independent verifies; a BLS aggregate commit
+costs ONE pairing-product check plus a data-parallel pubkey sum.  The
+BatchVerifier seam still receives per-validator (pub, msg, sig) rows, so
+the verifier groups rows into **units** keyed by exact (msg, sig) bytes:
+
+* an aggregate commit arrives as N rows sharing one message and one
+  aggregate signature -> one unit, one signature decode, one tree-
+  reduced pubkey sum (device), one pairing check;
+* individually signed rows are N singleton units -> the whole batch is
+  still ONE pairing-product check (N+1 Miller loops, one final
+  exponentiation in the native core) with exact per-row blame on
+  failure.
+
+Verdict procedure (identical on every path — this is the bit-identity
+contract the failover/remote fallbacks inherit):
+
+1. well-formedness: pubkeys decompress, are finite, on curve, and in
+   the r-subgroup (cached across calls — per-key facts); unit
+   signatures decompress, are finite, on curve, and in the r-subgroup.
+2. if every row is well-formed: ONE pairing-product check
+   ``prod e(agg_pk_u, H(m_u)) * e(-g1, sum sigs) == 1`` decides the
+   batch; pass -> every row True.  On failure (or when any row is
+   malformed) each unit is re-checked individually and every row of a
+   failing unit reads False.
+
+Like every batch verifier, a passing batch check certifies the batch,
+not each element (aggregate semantics — within a unit, blame is
+inherently unit-granular).  FastAggregateVerify over same-message rows
+is SOUND ONLY for proof-of-possession-checked keys (crypto/bls12381
+.pop_verify at key registration; the rogue-key caveat documented
+there).
+
+Split of labor: ``CpuBlsBatchVerifier`` is pure host (never imports
+jax — the PR-8 failover / PR-13 breaker fallback path);
+``BlsAggregateVerifier`` routes pubkey validation and unit aggregation
+through the ops/bls381 kernels when batch sizes clear the
+``COMETBFT_TPU_BLS_*`` thresholds.  Miller loop + final exponentiation
+stay on host (crypto/bls12381, native pairing core) exactly as the
+reference keeps them inside blst.
+
+These classes are the DATA PLANE only: production consumers reach them
+through the verify service (verifysvc/service.py routes MODE_BLS
+batches here; crypto/batch.create_batch_verifier selects the mode off
+the validator key type).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..crypto import bls12381 as host_bls
+from ..utils import envknobs, tracing
+from ..utils.metrics import hub as _mhub
+
+PUBKEY_SIZE = host_bls.PUBKEY_SIZE  # 48: compressed G1
+SIG_SIZE = host_bls.SIG_SIZE  # 96: compressed G2
+
+_NEG_G1 = (host_bls.G1_GEN[0], (-host_bls.G1_GEN[1]) % host_bls.P)
+
+# cache-miss sentinel: None is a legitimate cached value ("invalid key")
+_MISS = object()
+
+
+class _FactCache:
+    """Bounded FIFO cache of per-input FACTS (deterministic, path-
+    independent values), shared by the host and device paths — caching
+    can therefore never split their verdicts.  Thread-safe: the verify
+    service's host worker and clients' inline fallbacks both read it."""
+
+    def __init__(self, max_size: int):
+        self._d: dict = {}
+        self._max = max_size
+        self._mtx = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._mtx:
+            return self._d.get(key, default)
+
+    def put(self, key, value) -> None:
+        if self._max <= 0:
+            return
+        with self._mtx:
+            if key not in self._d and len(self._d) >= self._max:
+                self._d.pop(next(iter(self._d)))
+            self._d[key] = value
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._d)
+
+
+# pubkey bytes -> affine (x, y) int pair (fully validated: finite, on
+# curve, in subgroup) | None (invalid).  Sized by COMETBFT_TPU_BLS_PUBKEY
+# _CACHE at first use; validator sets repeat every commit, so steady
+# state never re-runs the ~4 ms/key subgroup check.
+_PK_CACHE: _FactCache | None = None
+_PK_CACHE_MTX = threading.Lock()
+
+# msg -> hash_to_g2 affine point (the ~28 ms hash-to-curve per distinct
+# message; light/verify passes re-hash the same sign-bytes)
+_H2_CACHE = _FactCache(1024)
+
+
+def _pk_cache() -> _FactCache:
+    global _PK_CACHE
+    if _PK_CACHE is None:
+        with _PK_CACHE_MTX:
+            if _PK_CACHE is None:
+                _PK_CACHE = _FactCache(
+                    max(0, envknobs.get_int(envknobs.BLS_PUBKEY_CACHE))
+                )
+    return _PK_CACHE
+
+
+def reset_caches() -> None:
+    """Tests and the bench's cold rounds: drop every cached fact (and
+    re-read the cache-size knob on next use)."""
+    global _PK_CACHE
+    with _PK_CACHE_MTX:
+        _PK_CACHE = None
+    _H2_CACHE.clear()
+
+
+def _hash_g2(msg: bytes):
+    h = _H2_CACHE.get(msg)
+    if h is None:
+        h = host_bls.hash_to_g2(msg)
+        _H2_CACHE.put(msg, h)
+    return h
+
+
+def _decode_pub(pub: bytes):
+    """Compressed G1 pubkey -> affine pair, or None for malformed /
+    infinite encodings.  Decompression guarantees on-curve; the
+    subgroup check is the batched half (device or host)."""
+    try:
+        aff = host_bls._g1_decompress(pub)
+    except ValueError:
+        return None
+    return aff  # None here = infinity: rejected like key_bls12381.go:166
+
+
+def _decode_sig(sig: bytes):
+    """Compressed G2 signature -> affine pair, or None for malformed /
+    infinite / off-curve / out-of-subgroup encodings — exactly the
+    gauntlet PubKey.verify_signature runs."""
+    try:
+        s = host_bls._g2_decompress(sig)
+    except ValueError:
+        return None
+    if (
+        s is None
+        or not host_bls._on_curve(host_bls._FP2, s)
+        or not host_bls._in_subgroup(host_bls._FP2, s)
+    ):
+        return None
+    return s
+
+
+def _validated_pubkeys(pubs, use_device: bool):
+    """-> list of affine | None (None = invalid), cache-backed.  The
+    uncached keys' subgroup checks batch on device when ``use_device``
+    and the batch clears COMETBFT_TPU_BLS_VALIDATE_DEVICE_MIN; the host
+    loop is the bit-identical fallback."""
+    cache = _pk_cache()
+    out: list = [_MISS] * len(pubs)
+    fresh: dict[bytes, list[int]] = {}
+    for i, pub in enumerate(pubs):
+        hit = cache.get(pub, _MISS)
+        if hit is not _MISS:
+            out[i] = hit
+        else:
+            fresh.setdefault(pub, []).append(i)
+    if not fresh:
+        return out
+    order = list(fresh.keys())
+    decoded = [_decode_pub(pub) for pub in order]
+    t0 = time.perf_counter()
+    candidates = [aff for aff in decoded if aff is not None]
+    if (
+        use_device
+        and len(candidates)
+        >= max(1, envknobs.get_int(envknobs.BLS_VALIDATE_DEVICE_MIN))
+    ):
+        from ..ops import bls381 as dev
+
+        with tracing.span(
+            "verify.bls_validate",
+            {"keys": len(decoded), "where": "device"}
+            if tracing.enabled() else None,
+        ):
+            ok = dev.validate_pubkeys_device(decoded)
+        checked = [aff if o else None for aff, o in zip(decoded, ok)]
+        where = "device"
+    else:
+        checked = [
+            aff
+            if aff is not None and host_bls._in_subgroup(host_bls._FP, aff)
+            else None
+            for aff in decoded
+        ]
+        where = "host"
+    _mhub().verify_phase_seconds.observe(
+        time.perf_counter() - t0, phase=f"bls_validate_{where}"
+    )
+    for pub, aff in zip(order, checked):
+        cache.put(pub, aff)
+        for i in fresh[pub]:
+            out[i] = aff
+    return out
+
+
+def _aggregate_unit(affs, use_device: bool):
+    """Sum a unit's (already validated) affine pubkeys -> affine pair or
+    None (identity).  Device tree-reduce above COMETBFT_TPU_BLS_AGG
+    _DEVICE_MIN, host Jacobian sum below — the same group element, and
+    affine coordinates are unique, so the paths cannot diverge."""
+    if len(affs) == 1:
+        # singleton unit (individually-signed row): the sum IS the
+        # point — skip the Jacobian round trip, whose _to_affine costs
+        # one ~381-bit field inversion PER ROW at batch scale
+        return affs[0]
+    if (
+        use_device
+        and len(affs) >= max(1, envknobs.get_int(envknobs.BLS_AGG_DEVICE_MIN))
+    ):
+        from ..ops import bls381 as dev
+
+        with tracing.span(
+            "verify.bls_aggregate",
+            {"keys": len(affs), "where": "device"}
+            if tracing.enabled() else None,
+        ):
+            return dev.aggregate_pubkeys_device(affs)
+    acc = (host_bls._FP.one, host_bls._FP.one, host_bls._FP.zero)
+    for aff in affs:
+        acc = host_bls._jac_add(host_bls._FP, acc, host_bls._from_affine(host_bls._FP, aff))
+    return host_bls._to_affine(host_bls._FP, acc)
+
+
+def _verify_items(items, use_device: bool) -> tuple[bool, list[bool]]:
+    """The ONE verdict procedure (module docstring) both verifier
+    classes run; ``use_device`` only moves the G1 arithmetic."""
+    n = len(items)
+    if n == 0:
+        return (False, [])
+
+    # units: rows grouped by exact (msg, sig) bytes, in first-seen order
+    units: dict[tuple[bytes, bytes], list[int]] = {}
+    for i, (_, msg, sig) in enumerate(items):
+        units.setdefault((msg, sig), []).append(i)
+
+    pubs = [pub for pub, _, _ in items]
+    agg_memo: dict[tuple[bytes, bytes], object] = {}
+    cache = _pk_cache()
+    fresh = sum(1 for p in set(pubs) if cache.get(p, _MISS) is _MISS)
+    if (
+        use_device
+        and len(units) == 1
+        and fresh >= max(1, envknobs.get_int(envknobs.BLS_VALIDATE_DEVICE_MIN))
+    ):
+        # the aggregate-commit cold path: validation + tree-reduced
+        # pubkey sum FUSED into one device dispatch
+        # (ops/bls381.validate_aggregate_g1); the fused aggregate sums
+        # exactly the valid rows, so when the batch turns out
+        # all-well-formed it IS the unit aggregate
+        from ..ops import bls381 as dev
+
+        decoded = [_decode_pub(p) for p in pubs]
+        t0 = time.perf_counter()
+        with tracing.span(
+            "verify.bls_validate",
+            {"keys": n, "where": "device", "fused": True}
+            if tracing.enabled() else None,
+        ):
+            ok, agg = dev.validate_aggregate_device(decoded)
+        _mhub().verify_phase_seconds.observe(
+            time.perf_counter() - t0, phase="bls_validate_device"
+        )
+        pub_affs = [aff if o else None for aff, o in zip(decoded, ok)]
+        for p, aff in zip(pubs, pub_affs):
+            cache.put(p, aff)
+        if all(ok):
+            (key,) = units
+            agg_memo[key] = agg
+    else:
+        pub_affs = _validated_pubkeys(pubs, use_device)
+
+    t0 = time.perf_counter()
+    sig_pts = {key: _decode_sig(key[1]) for key in units}
+    _mhub().verify_phase_seconds.observe(
+        time.perf_counter() - t0, phase="bls_sig_decode"
+    )
+
+    wellformed: dict[tuple[bytes, bytes], bool] = {
+        key: sig_pts[key] is not None
+        and all(pub_affs[i] is not None for i in rows)
+        for key, rows in units.items()
+    }
+
+    def unit_pairs(key):
+        # memoized: the blame path must reuse the hot path's (possibly
+        # device-computed) aggregations, never re-dispatch them
+        if key not in agg_memo:
+            agg_memo[key] = _aggregate_unit(
+                [pub_affs[i] for i in units[key]], use_device
+            )
+        return (agg_memo[key], _hash_g2(key[0]))
+
+    verdict: dict[tuple[bytes, bytes], bool] = {}
+    batch_ok = None
+    if all(wellformed.values()):
+        # the hot path: ONE pairing-product check for the whole batch
+        pairs = [unit_pairs(key) for key in units]
+        acc = (host_bls._FP2.one, host_bls._FP2.one, host_bls._FP2.zero)
+        for key in units:
+            acc = host_bls._jac_add(
+                host_bls._FP2, acc,
+                host_bls._from_affine(host_bls._FP2, sig_pts[key]),
+            )
+        pairs.append((_NEG_G1, host_bls._to_affine(host_bls._FP2, acc)))
+        t0 = time.perf_counter()
+        with tracing.span(
+            "verify.bls_pairing",
+            {"units": len(units)} if tracing.enabled() else None,
+        ):
+            batch_ok = host_bls._pairings_product_is_one(pairs)
+        _mhub().verify_phase_seconds.observe(
+            time.perf_counter() - t0, phase="bls_pairing"
+        )
+        if batch_ok:
+            return (True, [True] * n)
+
+    # blame: each well-formed unit re-checked individually; every row of
+    # a malformed or failing unit reads False
+    for key in units:
+        if not wellformed[key]:
+            verdict[key] = False
+        elif batch_ok is not None and len(units) == 1:
+            # a single well-formed unit's individual check IS the batch
+            # product that just failed — no second pairing needed
+            verdict[key] = batch_ok
+        else:
+            verdict[key] = host_bls._pairings_product_is_one(
+                [unit_pairs(key), (_NEG_G1, sig_pts[key])]
+            )
+    res = [False] * n
+    for key, rows in units.items():
+        for i in rows:
+            res[i] = verdict[key]
+    return (all(res) and bool(res), res)
+
+
+def _check_item(pub: bytes, msg: bytes, sig: bytes) -> None:
+    if len(pub) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
+        raise ValueError("malformed bls12-381 pubkey or signature")
+
+
+class CpuBlsBatchVerifier:
+    """Pure-host BLS verification — never imports jax; the degraded-mode
+    / breaker-open data plane, bit-identical to the device-assisted
+    verifier by construction (one shared verdict procedure)."""
+
+    def __init__(self) -> None:
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        _check_item(pub_key, msg, sig)
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        return _verify_items(self._items, use_device=False)
+
+
+class BlsAggregateVerifier:
+    """Device-assisted BLS verification: batched pubkey validation and
+    tree-reduced unit aggregation on the accelerator, pairing on host.
+
+    ``_entry = None`` routes submit() through the verify service's
+    class-priority host worker (the pairing and any cold kernel compile
+    are real submit-time work that must never run on the scheduler
+    thread).  The ticket is synchronous: a wedged device inside the G1
+    kernels parks the host worker, where the health sentinel's trip —
+    not the batch-deadline clock — re-verifies the tracked batch on
+    host (service._trip_to_cpu snapshots EVERY in-flight record)."""
+
+    _entry = None
+    _fallback = None
+
+    def __init__(self) -> None:
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        _check_item(pub_key, msg, sig)
+        self._items.append((pub_key, msg, sig))
+
+    def submit(self):
+        return ("sync", _verify_items(self._items, use_device=True))
+
+    def collect(self, ticket) -> tuple[bool, list[bool]]:
+        return ticket[1]
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        return self.collect(self.submit())

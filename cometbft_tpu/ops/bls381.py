@@ -36,6 +36,9 @@ RADIX = 1 << BITS
 MASK = RADIX - 1
 
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# the r-order of the G1/G2 subgroups (the BLS scalar field), used by the
+# batched subgroup check: P is in the subgroup iff [r]P == infinity
+R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
 R_MONT = 1 << (NLIMBS * BITS)  # 2^384
 R_INV = pow(R_MONT, P - 2, P)
 # -p^-1 mod 2^12, the per-word Montgomery multiplier
@@ -263,8 +266,10 @@ def g1_add(X1, Y1, Z1, X2, Y2, Z2):
 
 def aggregate_g1(X, Y, Z):
     """Tree-reduce a (N, 32) batch of Jacobian points to one sum — the
-    device analogue of blst P1 aggregate.  N must be a power of two
-    (callers pad with infinities).
+    device analogue of blst P1 aggregate, folded with log-depth batched
+    adds exactly like ``ops/ed25519.tree_reduce_points`` (an odd level's
+    carry row is concatenated back, so any N works; the addition law is
+    complete, so identity rows are safe anywhere in the tree).
 
     Manifest kernel ``bls381_aggregate_g1`` (analysis/kernel_manifest):
     the contract checker traces this signature and pins its jaxpr
@@ -273,17 +278,219 @@ def aggregate_g1(X, Y, Z):
     n = X.shape[0]
     while n > 1:
         half = n // 2
-        X, Y, Z = g1_add(
-            X[:half], Y[:half], Z[:half], X[half:n], Y[half:n], Z[half:n]
+        sX, sY, sZ = g1_add(
+            X[:half], Y[:half], Z[:half],
+            X[half : 2 * half], Y[half : 2 * half], Z[half : 2 * half],
         )
-        n = half
+        if n & 1:
+            sX = jnp.concatenate([sX, X[2 * half :]], axis=0)
+            sY = jnp.concatenate([sY, Y[2 * half :]], axis=0)
+            sZ = jnp.concatenate([sZ, Z[2 * half :]], axis=0)
+        X, Y, Z = sX, sY, sZ
+        n = (n + 1) // 2
     return X[0], Y[0], Z[0]
+
+
+# ---------------------------------------------------- batched validation
+# The KeyValidate half of FastAggregateVerify
+# (draft-irtf-cfrg-bls-signature §2.5: reject off-curve, out-of-subgroup,
+# and infinite pubkeys), data-parallel over the validator axis.  The
+# host keeps decompression (one Fp square root per NEW pubkey, cached by
+# models/bls_verifier); the ~4 ms/key subgroup scalar mult — the part
+# that is pure group arithmetic over all N keys at once — runs here.
+
+_ONE_M = _int_to_limbs(to_mont(1))
+_B_M = _int_to_limbs(to_mont(4))  # curve constant b = 4, Montgomery domain
+_R_BITS = np.array([b == "1" for b in bin(R_ORDER)[2:]], dtype=bool)
+
+
+def g1_on_curve(X, Y):
+    """(..., 32) affine Montgomery limbs -> (...,) bool: y^2 == x^3 + 4.
+    Canonical-limb equality is value equality (both sides in [0, p))."""
+    lhs = sqr(Y)
+    rhs = add(mul(sqr(X), X), jnp.asarray(_B_M))
+    return jnp.all(lhs == rhs, axis=-1)
+
+
+def _g1_mul_order(X, Y, Z):
+    """[r]P for a batch of Jacobian points, left-to-right double-and-add
+    over the 255 fixed bits of the group order.  lax.scan keeps the
+    jaxpr O(1) in the bit count (one body: double + conditional add) —
+    the 255-step chain is sequential by nature, but every step is
+    batched over all N validators, which is where the win lives."""
+    from jax import lax
+
+    one = jnp.broadcast_to(jnp.asarray(_ONE_M), X.shape)
+    acc0 = (one, one, jnp.zeros_like(X))
+
+    def step(acc, bit):
+        aX, aY, aZ = acc
+        dX, dY, dZ = g1_double(aX, aY, aZ)
+        sX, sY, sZ = g1_add(dX, dY, dZ, X, Y, Z)
+        return (
+            jnp.where(bit, sX, dX),
+            jnp.where(bit, sY, dY),
+            jnp.where(bit, sZ, dZ),
+        ), None
+
+    (aX, aY, aZ), _ = lax.scan(step, acc0, jnp.asarray(_R_BITS))
+    return aX, aY, aZ
+
+
+def validate_g1(X, Y, valid):
+    """Batched pubkey validation: (N, 32) affine Montgomery limbs +
+    (N,) host-decode mask -> (N,) bool (on curve AND in the r-subgroup
+    AND host-valid).  Rows the host already rejected (malformed
+    encoding, infinity, padding) are sanitized to the identity BEFORE
+    any shared arithmetic — the PR-11 lesson — and can never read True:
+    an off-curve row's [r]·identity == identity would vacuously pass the
+    subgroup test, so the on-curve bit masks it.
+
+    Manifest kernel ``bls381_validate_g1``; jit site registered in
+    JIT_SITES.
+    """
+    oncurve = valid & g1_on_curve(X, Y)
+    one = jnp.broadcast_to(jnp.asarray(_ONE_M), X.shape)
+    Z = select(oncurve, one, jnp.zeros_like(X))
+    _, _, rZ = _g1_mul_order(X, Y, Z)
+    return oncurve & is_zero(rZ)
+
+
+def validate_aggregate_g1(X, Y, valid):
+    """The fused FastAggregateVerify data plane: batched validation plus
+    the tree-reduced G1 pubkey sum in ONE device program (one dispatch
+    per aggregate-commit).  Invalid rows aggregate as the identity; the
+    caller uses the sum only when every row validated (the verdict
+    procedure in models/bls_verifier), so the sanitized rows are
+    belt-and-suspenders, not semantics.
+
+    Manifest kernel ``bls381_validate_aggregate_g1``; jit site
+    registered in JIT_SITES.
+    """
+    ok = validate_g1(X, Y, valid)
+    one = jnp.broadcast_to(jnp.asarray(_ONE_M), X.shape)
+    Z = select(ok, one, jnp.zeros_like(X))
+    Xa, Ya, Za = aggregate_g1(X, Y, Z)
+    return ok, Xa, Ya, Za
 
 
 # ------------------------------------------------------------ host bridge
 
 
 _AGG_JIT = None
+_VALIDATE_JIT = None
+_VALIDATE_AGG_JIT = None
+_JIT_MTX = None  # lazily a threading.Lock: concurrent first calls race
+
+
+def _jit_lock():
+    global _JIT_MTX
+    if _JIT_MTX is None:
+        import threading
+
+        _JIT_MTX = threading.Lock()
+    return _JIT_MTX
+
+
+def ints_to_limbs_np(vals) -> np.ndarray:
+    """Vectorized host packer: a sequence of field ints (already in the
+    Montgomery domain) -> (N, 32) int32 limb array.  The per-int Python
+    loop of to_limbs costs ~32 ops/value; at 10k validators x 2
+    coordinates per commit that is real assembly time, so the 12-bit
+    unpack is one numpy pass over the little-endian bytes (3 bytes = 2
+    limbs)."""
+    n = len(vals)
+    if n == 0:
+        return np.zeros((0, NLIMBS), dtype=np.int32)
+    raw = np.frombuffer(
+        b"".join(v.to_bytes(48, "little") for v in vals), dtype=np.uint8
+    ).reshape(n, 48)
+    trip = raw.reshape(n, NLIMBS // 2, 3).astype(np.int32)
+    out = np.empty((n, NLIMBS), dtype=np.int32)
+    out[:, 0::2] = trip[..., 0] | ((trip[..., 1] & 0xF) << 8)
+    out[:, 1::2] = (trip[..., 1] >> 4) | (trip[..., 2] << 4)
+    return out
+
+
+def _pack_affine(points, bucket: int | None = None):
+    """Affine (x, y) int pairs (None = invalid/infinity/padding) ->
+    (X, Y, valid) host arrays in the Montgomery domain, padded to
+    ``bucket`` rows (power-of-two >= 8 by default, so jit compiles a
+    handful of shapes)."""
+    n = len(points)
+    if bucket is None:
+        bucket = 8
+        while bucket < n:
+            bucket *= 2
+    xs, ys, rows = [], [], []
+    for i, aff in enumerate(points):
+        if aff is None:
+            continue
+        xs.append(to_mont(aff[0]))
+        ys.append(to_mont(aff[1]))
+        rows.append(i)
+    X = np.zeros((bucket, NLIMBS), dtype=np.int32)
+    Y = np.zeros((bucket, NLIMBS), dtype=np.int32)
+    valid = np.zeros((bucket,), dtype=bool)
+    if rows:
+        X[rows] = ints_to_limbs_np(xs)
+        Y[rows] = ints_to_limbs_np(ys)
+        valid[rows] = True
+    return X, Y, valid
+
+
+def _jac_to_affine_host(Xa, Ya, Za):
+    """One fetched (32,) Jacobian limb triple -> affine int pair or None
+    (infinity).  Exact bigint math; the single inversion runs on host."""
+    xi = int(from_limbs(np.asarray(Xa))[()])
+    yi = int(from_limbs(np.asarray(Ya))[()])
+    zi = int(from_limbs(np.asarray(Za))[()])
+    if zi == 0:
+        return None
+    z_inv = pow(zi, P - 2, P)
+    z2 = z_inv * z_inv % P
+    return (xi * z2 % P, yi * z2 % P * z_inv % P)
+
+
+def validate_pubkeys_device(points) -> list[bool]:
+    """Batched on-curve + subgroup validation of affine (x, y) int pairs
+    (None rows = host-rejected, always False).  One device dispatch;
+    the blocking result fetch is this bridge's declared collect point."""
+    import jax
+
+    global _VALIDATE_JIT
+    if _VALIDATE_JIT is None:
+        with _jit_lock():
+            if _VALIDATE_JIT is None:
+                _VALIDATE_JIT = jax.jit(validate_g1)
+    n = len(points)
+    if n == 0:
+        return []
+    X, Y, valid = _pack_affine(points)
+    ok = _VALIDATE_JIT(jnp.asarray(X), jnp.asarray(Y), jnp.asarray(valid))
+    return [bool(b) for b in np.asarray(ok)[:n]]
+
+
+def validate_aggregate_device(points):
+    """The fused FastAggregateVerify data plane in one dispatch:
+    returns (per-row ok list, aggregate affine pair or None).  The
+    aggregate sums exactly the rows that validated (invalid rows ride
+    as the identity)."""
+    import jax
+
+    global _VALIDATE_AGG_JIT
+    if _VALIDATE_AGG_JIT is None:
+        with _jit_lock():
+            if _VALIDATE_AGG_JIT is None:
+                _VALIDATE_AGG_JIT = jax.jit(validate_aggregate_g1)
+    n = len(points)
+    if n == 0:
+        return [], None
+    X, Y, valid = _pack_affine(points)
+    ok, Xa, Ya, Za = _VALIDATE_AGG_JIT(
+        jnp.asarray(X), jnp.asarray(Y), jnp.asarray(valid)
+    )
+    return [bool(b) for b in np.asarray(ok)[:n]], _jac_to_affine_host(Xa, Ya, Za)
 
 
 def aggregate_pubkeys_device(points):
@@ -295,7 +502,9 @@ def aggregate_pubkeys_device(points):
 
     global _AGG_JIT
     if _AGG_JIT is None:
-        _AGG_JIT = jax.jit(aggregate_g1)
+        with _jit_lock():
+            if _AGG_JIT is None:
+                _AGG_JIT = jax.jit(aggregate_g1)
 
     pts = []
     for pk in points:
@@ -313,17 +522,9 @@ def aggregate_pubkeys_device(points):
     X = np.zeros((n, NLIMBS), dtype=np.int32)
     Y = np.zeros((n, NLIMBS), dtype=np.int32)
     Z = np.zeros((n, NLIMBS), dtype=np.int32)
-    for i, (x, y) in enumerate(pts):
-        X[i] = to_limbs(x)
-        Y[i] = to_limbs(y)
-        Z[i] = to_limbs(1)
+    X[: len(pts)] = ints_to_limbs_np([to_mont(x) for x, _ in pts])
+    Y[: len(pts)] = ints_to_limbs_np([to_mont(y) for _, y in pts])
+    Z[: len(pts)] = np.asarray(_ONE_M, dtype=np.int32)
 
     Xa, Ya, Za = _AGG_JIT(jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
-    xi = int(from_limbs(np.asarray(Xa))[()])
-    yi = int(from_limbs(np.asarray(Ya))[()])
-    zi = int(from_limbs(np.asarray(Za))[()])
-    if zi == 0:
-        return None
-    z_inv = pow(zi, P - 2, P)
-    z2 = z_inv * z_inv % P
-    return (xi * z2 % P, yi * z2 % P * z_inv % P)
+    return _jac_to_affine_host(Xa, Ya, Za)

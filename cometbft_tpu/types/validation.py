@@ -10,6 +10,15 @@ catch-up path, background for light/evidence); on batch failure the
 per-signature validity vector assigns blame exactly like the reference
 (validation.go:384-399), and a sequential fallback covers heterogeneous
 key sets (shouldBatchVerify, validation.go:17-21).
+
+The seam routes by the validator set's KEY TYPE (the genesis pubkey
+encoding, constrained by ConsensusParams.validator.pub_key_types):
+ed25519 sets batch through the comb/plain kernels; bls12_381 sets take
+the aggregate lane (models/bls_verifier — a commit whose rows share one
+message and one aggregate signature verifies as ONE pairing-product
+check; see docs/verify_service.md "Backend selection").  Blame inside a
+BLS aggregate unit is unit-granular by nature, so the first-invalid
+report below points at the first row of the failing unit.
 """
 
 from __future__ import annotations

@@ -106,6 +106,26 @@ BLS_DEVICE = _declare(
     "`1` tree-reduces BLS pubkey aggregation on the accelerator "
     "(ops/bls381); pairings always run on host.",
 )
+BLS_VALIDATE_DEVICE_MIN = _declare(
+    "COMETBFT_TPU_BLS_VALIDATE_DEVICE_MIN", "int", 8,
+    "Minimum count of not-yet-cached BLS pubkeys for which the batched "
+    "on-curve/subgroup validation runs on the accelerator "
+    "(ops/bls381.validate_g1); below it the ~4 ms/key host check wins "
+    "over dispatch overhead.  The verdict is bit-identical either way.",
+)
+BLS_AGG_DEVICE_MIN = _declare(
+    "COMETBFT_TPU_BLS_AGG_DEVICE_MIN", "int", 256,
+    "Minimum pubkey count per aggregate unit for which the tree-reduced "
+    "G1 sum runs on the accelerator (ops/bls381.aggregate_g1); smaller "
+    "units sum on host.  The aggregate point is identical either way.",
+)
+BLS_PUBKEY_CACHE = _declare(
+    "COMETBFT_TPU_BLS_PUBKEY_CACHE", "int", 65536,
+    "Entries in the validated-BLS-pubkey cache (models/bls_verifier): "
+    "decompression + subgroup membership are per-key facts, so a "
+    "validator set pays validation once, not once per commit.  0 "
+    "disables caching.",
+)
 
 # verify service (verifysvc/ — priority-scheduled device batching)
 VERIFYSVC_BATCH_MAX = _declare(

@@ -17,6 +17,8 @@ verify_service.md has the checklist).
 
 from .client import ServiceBatchVerifier, resolve_mode
 from .service import (
+    MODE_BLS,
+    MODE_PLAIN,
     Klass,
     Ticket,
     VerifyService,
@@ -27,6 +29,8 @@ from .service import (
 
 __all__ = [
     "Klass",
+    "MODE_BLS",
+    "MODE_PLAIN",
     "ServiceBatchVerifier",
     "Ticket",
     "VerifyService",

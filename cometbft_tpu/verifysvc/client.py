@@ -21,6 +21,7 @@ import time
 
 from ..utils import tracing
 from .service import (
+    MODE_BLS,
     MODE_PLAIN,
     Klass,
     VerifyService,
@@ -32,16 +33,19 @@ from .service import (
 )
 
 
-def resolve_mode(pubkeys: list[bytes] | None):
+def resolve_mode(pubkeys: list[bytes] | None, key_type: str = "ed25519"):
     """Bind a request to its device program up front, in the CALLER's
     thread — exactly where the comb-table ensure()/ensure_async() cost
     landed before the service existed (a 10k-validator table build must
     never run on, and block, the shared scheduler thread).
 
     Mirrors the pre-service routing of crypto/batch.create_batch_verifier:
-    large known validator sets use the comb-cached program (background
-    build while warming -> uncached), everything else the uncached
-    kernel."""
+    BLS validator sets take the aggregate lane (MODE_BLS — no comb
+    tables; the BLS plane owns its own pubkey-validation cache), large
+    known ed25519 sets use the comb-cached program (background build
+    while warming -> uncached), everything else the uncached kernel."""
+    if key_type == "bls12_381":
+        return MODE_BLS
     if pubkeys is None:
         return MODE_PLAIN
     from .service import _GLOBAL, remote_plane_configured
@@ -110,6 +114,12 @@ class ServiceBatchVerifier:
         return self._tenant
 
     def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        if self._mode[0] == "bls":
+            # 48-byte compressed G1 pubkey, 96-byte compressed G2 sig
+            if len(pub_key) != 48 or len(sig) != 96:
+                raise ValueError("malformed bls12-381 pubkey or signature")
+            self._items.append((pub_key, msg, sig))
+            return
         if len(pub_key) != 32 or len(sig) != 64:
             raise ValueError("malformed ed25519 pubkey or signature")
         if len(msg) >= 1 << 24:
@@ -127,10 +137,12 @@ class ServiceBatchVerifier:
     def _host_fallback(self, span_name: str) -> tuple[bool, list[bool]]:
         """Inline host verification of OUR retained items — correct
         verdicts in our own add() order, shared by the backpressure and
-        collect-stall paths."""
-        from ..models.verifier import CpuEd25519BatchVerifier
+        collect-stall paths.  Mode-aware: a BLS batch degrades to the
+        pure-host BLS verifier (bit-identical verdict procedure), never
+        the ed25519 one."""
+        from .service import cpu_verifier_for_mode
 
-        cpu = CpuEd25519BatchVerifier()
+        cpu = cpu_verifier_for_mode(self._mode)
         cpu._items = list(self._items)
         with tracing.span(
             span_name,

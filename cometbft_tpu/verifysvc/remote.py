@@ -69,16 +69,21 @@ class RemotePlaneError(RuntimeError):
 class _Pending:
     __slots__ = (
         "rid", "digest", "items", "klass", "tenant", "deadline",
+        "key_type",
         "event", "response", "error", "attempts", "sent_on_gen", "_done_cb",
     )
 
-    def __init__(self, rid, digest, items, klass, tenant, deadline):
+    def __init__(
+        self, rid, digest, items, klass, tenant, deadline,
+        key_type: str = "ed25519",
+    ):
         self.rid = rid
         self.digest = digest
         self.items = items
         self.klass = klass
         self.tenant = tenant
         self.deadline = deadline
+        self.key_type = key_type
         self.event = threading.Event()
         self.response: tuple[bool, list[bool]] | None = None
         self.error: BaseException | None = None
@@ -129,10 +134,14 @@ class RemoteBatchVerifier:
     _fallback = None
     inflight_where = "remote"
 
-    def __init__(self, client: "RemotePlaneClient"):
+    def __init__(self, client: "RemotePlaneClient", key_type: str = "ed25519"):
         self._client = client
         self._klass = Klass.CONSENSUS
         self._tenant = DEFAULT_TENANT
+        # the batch's validator key type rides the wire so the PLANE
+        # routes it to the matching verifier lane (MODE_BLS batches must
+        # never reach an ed25519 verifier on the other side)
+        self._key_type = key_type
         self._items: list[tuple[bytes, bytes, bytes]] = []
 
     def bind_request(self, klass: Klass, tenant: str) -> None:
@@ -147,7 +156,8 @@ class RemoteBatchVerifier:
 
     def submit(self):
         return ("rpc", self._client.submit(
-            self._items, self._klass, self._tenant
+            self._items, self._klass, self._tenant,
+            key_type=self._key_type,
         ))
 
     def defer_collect(self, ticket, cb) -> None:
@@ -252,7 +262,9 @@ class RemotePlaneClient:
     def breaker(self) -> str:
         return self._breaker
 
-    def submit(self, items, klass: Klass, tenant: str) -> _Pending:
+    def submit(
+        self, items, klass: Klass, tenant: str, key_type: str = "ed25519"
+    ) -> _Pending:
         """Register + send one request; returns the pending handle for
         :meth:`collect`.  Runs on the service's host worker (never the
         scheduler).  Raises :class:`RemotePlaneError` when the breaker
@@ -265,6 +277,7 @@ class RemotePlaneClient:
             klass=klass,
             tenant=tenant,
             deadline=time.monotonic() + self.budget_s,
+            key_type=key_type,
         )
         with self._mtx:
             # breaker checked UNDER the lock the trip flips it under: a
@@ -360,6 +373,7 @@ class RemotePlaneClient:
                         for (p, m, s) in pend.items
                     ],
                     attempt=pend.attempts,
+                    key_type=pend.key_type,
                 )
             )
             try:

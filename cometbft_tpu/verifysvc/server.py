@@ -53,7 +53,12 @@ from ..utils import envknobs, fail
 from ..utils.log import get_logger
 from ..utils.netutil import close_socket
 from . import wire
-from .service import Klass, VerifyService, VerifyServiceBackpressure
+from .service import (
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+    mode_for_key_type,
+)
 
 _READY_PREFIX = "VERIFYD READY addr="
 
@@ -407,6 +412,15 @@ class VerifyServer:
                 request_id=rid, status=wire.STATUS_BAD_REQUEST,
                 error=f"unknown class {req.klass}",
             )
+        mode = mode_for_key_type(req.key_type or "")
+        if mode is None:
+            # an unknown key type must never fall through to a default
+            # verifier — the verdicts would be garbage with OK status
+            self.dedup.abort(rid)
+            return wire.VerifyResponse(
+                request_id=rid, status=wire.STATUS_BAD_REQUEST,
+                error=f"unknown key_type {req.key_type!r}",
+            )
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             self.dedup.abort(rid)  # a retry with fresh budget may run
@@ -416,7 +430,7 @@ class VerifyServer:
             )
         try:
             ticket = self.svc.submit(
-                items, klass, tenant=req.tenant or None
+                items, klass, mode, tenant=req.tenant or None
             )
         except VerifyServiceBackpressure as e:
             with self._stats_mtx:

@@ -176,13 +176,38 @@ _DEADLINE_KNOBS = {
 }
 
 # request modes: how the dispatcher binds a batch to a device program.
-# ("plain",)        -> uncached kernel (power-of-two bucket shapes);
-#                      coalescible with other plain requests of the class
+# ("plain",)        -> uncached ed25519 kernel (power-of-two bucket
+#                      shapes); coalescible with other plain requests of
+#                      the class
 # ("comb", entry)   -> comb-cached program bound to a valset cache entry
 #                      (models/comb_verifier); dispatches solo — the
 #                      scatter is one row per validator, so two commits
 #                      against the same set cannot share a program call
+# ("bls",)          -> BLS12-381 aggregate verifier (models/bls_verifier:
+#                      device pubkey validation + G1 aggregation, host
+#                      pairing); dispatches solo — a batch is an
+#                      aggregate-commit claim, and mixing it with
+#                      ed25519 rows would hand one verifier two key
+#                      types.  Selected off the validator key type by
+#                      crypto/batch.create_batch_verifier / client
+#                      .resolve_mode.
 MODE_PLAIN = ("plain",)
+MODE_BLS = ("bls",)
+
+# the wire spelling of each mode's key type (verifysvc/wire.VerifyRequest
+# .key_type); "" rides as ed25519 for back-compat with pre-BLS planes
+_MODE_KEY_TYPE = {"plain": "ed25519", "comb": "ed25519", "bls": "bls12_381"}
+_KEY_TYPE_MODE = {"": MODE_PLAIN, "ed25519": MODE_PLAIN, "bls12_381": MODE_BLS}
+
+
+def mode_key_type(mode) -> str:
+    return _MODE_KEY_TYPE.get(mode[0], "ed25519")
+
+
+def mode_for_key_type(key_type: str):
+    """Wire key_type -> dispatch mode, or None for an unknown type (the
+    server answers bad_request — never a silently-wrong verifier)."""
+    return _KEY_TYPE_MODE.get(key_type)
 
 # host-queue shutdown sentinel: sorts after every real class so queued
 # work settles before the worker exits
@@ -353,26 +378,46 @@ def _parse_tenant_weights(spec: str) -> dict[str, int]:
     return out
 
 
+def cpu_verifier_for_mode(mode):
+    """The mode's pure-host data plane (CpuEd25519BatchVerifier for the
+    ed25519 modes, CpuBlsBatchVerifier for MODE_BLS) — the ONE selection
+    point every fallback path shares, so a new key type cannot be added
+    to one fallback and missed in another."""
+    if mode[0] == "bls":
+        from ..models.bls_verifier import CpuBlsBatchVerifier
+
+        return CpuBlsBatchVerifier()
+    from ..models.verifier import CpuEd25519BatchVerifier
+
+    return CpuEd25519BatchVerifier()
+
+
 class _HostBatchVerifier:
     """The degraded-mode data plane: the exact BatchVerifier seam shape
-    the device verifiers expose, wrapping CpuEd25519BatchVerifier (ONE
-    source of the host-verdict semantics — ZIP-215, bit-identical to
-    the kernels) behind a sync-ticket submit().  ``_entry = None``
-    routes its submit() through the class-priority host worker
-    (``_submit_is_offloaded``), so a mempool batch's host verification
-    still cannot delay a queued consensus dispatch while the service is
-    tripped."""
+    the device verifiers expose, wrapping the MODE's pure-host verifier
+    (:func:`cpu_verifier_for_mode` — each the ONE source of its
+    host-verdict semantics, bit-identical to its kernels) behind a
+    sync-ticket submit().  ``_entry = None`` routes its submit() through
+    the class-priority host worker (``_submit_is_offloaded``), so a
+    mempool batch's host verification still cannot delay a queued
+    consensus dispatch while the service is tripped."""
 
     _entry = None
     _fallback = None
 
-    def __init__(self):
-        from ..models.verifier import CpuEd25519BatchVerifier
-
-        self._cpu = CpuEd25519BatchVerifier()
+    def __init__(self, mode=MODE_PLAIN):
+        self._cpu = cpu_verifier_for_mode(mode)
 
     def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
         self._cpu.add(pub_key, msg, sig)
+
+    def add_items_unchecked(self, items) -> None:
+        """Re-verify seam: take the items as-is, bypassing add()'s
+        shape validation.  The error paths re-verify batches whose
+        dispatch ALREADY failed — possibly on exactly that validation —
+        and a raise here would escape into the scheduler/host-worker
+        loop; the cpu verifiers instead judge malformed rows False."""
+        self._cpu._items = list(items)
 
     def submit(self):
         return ("sync", self._cpu.verify())
@@ -381,14 +426,12 @@ class _HostBatchVerifier:
         return ticket[1]
 
 
-def _host_verify_items(items) -> tuple[bool, list[bool]]:
+def _host_verify_items(items, mode=MODE_PLAIN) -> tuple[bool, list[bool]]:
     """The one host-path verdict every fallback resolves to — delegates
-    to CpuEd25519BatchVerifier so the semantics cannot drift from the
+    to the mode's cpu verifier so the semantics cannot drift from the
     cpu backend (the blame-order tests pin service results against
     exactly this)."""
-    from ..models.verifier import CpuEd25519BatchVerifier
-
-    cpu = CpuEd25519BatchVerifier()
+    cpu = cpu_verifier_for_mode(mode)
     cpu._items = list(items)
     return cpu.verify()
 
@@ -899,10 +942,11 @@ class VerifyService:
     def _form_batch_locked(
         self, klass: Klass, tenant: str
     ) -> tuple[list[_Request], str]:
-        """Pop the head batch of a ready (class, tenant) queue.  Comb-
-        bound requests go solo; plain requests coalesce up to the batch
-        width.  Batches never mix tenants — per-tenant latency and
-        blame accounting stay exact."""
+        """Pop the head batch of a ready (class, tenant) queue.  Only
+        plain requests coalesce (up to the batch width): comb- and bls-
+        bound requests go solo — each binds its own device program, and
+        a coalesced batch has exactly one verifier.  Batches never mix
+        tenants — per-tenant latency and blame accounting stay exact."""
         q = self._queues[klass][tenant]
         # the flush reason is what made the queue ready, decided before
         # popping: a width-triggered flush whose head dispatches solo
@@ -911,8 +955,8 @@ class VerifyService:
         head = q.pop(0)
         batch = [head]
         total = len(head.items)
-        if head.mode[0] != "comb":
-            while q and q[0].mode[0] != "comb" and total < self.batch_max:
+        if head.mode[0] == "plain":
+            while q and q[0].mode[0] == "plain" and total < self.batch_max:
                 nxt = q.pop(0)
                 batch.append(nxt)
                 total += len(nxt.items)
@@ -1020,10 +1064,14 @@ class VerifyService:
             if rem.available():
                 from .remote import RemoteBatchVerifier
 
-                return RemoteBatchVerifier(rem)
-            return _HostBatchVerifier()
+                return RemoteBatchVerifier(rem, key_type=mode_key_type(mode))
+            return _HostBatchVerifier(mode)
         if self._backend_mode == MODE_CPU_FALLBACK:
-            return _HostBatchVerifier()
+            return _HostBatchVerifier(mode)
+        if mode[0] == "bls":
+            from ..models.bls_verifier import BlsAggregateVerifier
+
+            return BlsAggregateVerifier()
         if mode[0] == "comb":
             from ..models.comb_verifier import CombBatchVerifier
 
@@ -1144,11 +1192,12 @@ class VerifyService:
                 # pending batch whose payload was bound to a DEVICE
                 # verifier pre-trip (raced the mode flip): its submit()
                 # would dispatch to the wedged tunnel — rebuild it on
-                # the host path instead
-                hbv = _HostBatchVerifier()
-                for r in batch:
-                    for pub, msg, sig in r.items:
-                        hbv.add(pub, msg, sig)
+                # the host path instead (unchecked: a malformed row must
+                # judge False, not raise out of this worker loop)
+                hbv = _HostBatchVerifier(batch[0].mode)
+                hbv.add_items_unchecked(
+                    [it for r in batch for it in r.items]
+                )
                 bv = hbv
             klass = batch[0].klass
             labels = (
@@ -1319,10 +1368,13 @@ class VerifyService:
                 r.ticket._fail(exc)
             return
         _mhub().verify_svc_host_reverify.inc(cause=cause)
-        hbv = _HostBatchVerifier()
-        for r in batch:
-            for pub, msg, sig in r.items:
-                hbv.add(pub, msg, sig)
+        # unchecked fill: the dispatch may have failed on add()'s own
+        # shape validation (e.g. a remote batch whose items don't match
+        # its key_type) — re-raising here would escape into the
+        # scheduler/worker loop and wedge the plane; the cpu verifiers
+        # judge malformed rows False instead
+        hbv = _HostBatchVerifier(batch[0].mode)
+        hbv.add_items_unchecked([it for r in batch for it in r.items])
         # (re-)track as host work; on the collect_error path the outer
         # _settle finally pops this entry while the requeue is pending —
         # a brief stats gap, settlement itself is unaffected
@@ -1344,7 +1396,7 @@ class VerifyService:
                     {"class": r.klass.label, "sigs": len(r.items)}
                     if tracing.enabled() else None,
                 ):
-                    r.ticket._resolve(_host_verify_items(r.items))
+                    r.ticket._resolve(_host_verify_items(r.items, r.mode))
 
     def _failover_loop(self) -> None:
         """The failover watchdog: a dedicated thread — NEVER the

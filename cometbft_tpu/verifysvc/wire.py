@@ -72,6 +72,10 @@ class VerifyRequest(Message):
         Field(5, "budget_ms", "varint"),  # REMAINING deadline budget
         Field(6, "items", "message", SigItem, repeated=True),
         Field(7, "attempt", "varint"),    # 1 = first send, >1 = idempotent resend
+        # validator key type of the batch ("" = ed25519 for back-compat):
+        # the server routes it to the matching verifier lane
+        # (service.mode_for_key_type); an unknown value is bad_request
+        Field(8, "key_type", "string"),
     ]
 
 

@@ -131,6 +131,78 @@ def _no_lock_order_violations():
     )
 
 
+def find_leaked_compile_threads(frames=None):
+    """Surviving background threads parked inside JAX/XLA machinery —
+    the exit-134 bug class: a daemon thread still compiling (a leaked
+    comb table build, a BLS kernel trace) races interpreter teardown and
+    aborts the whole run with ``terminate called without an active
+    exception`` and NO blame (the PR-13 ``resolve_mode`` bug died
+    exactly this way; every test had passed).  Returns
+    [(thread_name, formatted_stack)].
+
+    ``frames`` is injectable for the guard's own test; default is the
+    live ``sys._current_frames()``.  Only jax/jaxlib/xla frames flag:
+    the framework's long-lived daemons (verifysvc scheduler, tracing
+    ring, health sentinel) idle in framework code and must not trip a
+    suite-wide gate."""
+    import sys as _sys
+    import threading as _threading
+    import traceback as _traceback
+
+    if frames is None:
+        frames = _sys._current_frames()
+    offenders = []
+    for t in _threading.enumerate():
+        if t is _threading.main_thread() or t.ident is None:
+            continue
+        fr = frames.get(t.ident)
+        if fr is None:
+            continue
+        stack = _traceback.extract_stack(fr)
+        if any(
+            ("/jax/" in (f.filename or ""))
+            or ("jaxlib" in (f.filename or ""))
+            or ("/xla" in (f.filename or ""))
+            for f in stack
+        ):
+            offenders.append(
+                (t.name, "".join(_traceback.format_list(stack)))
+            )
+    return offenders
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 exit-134 guard: after the whole session, assert no
+    non-test background compile/daemon thread survives inside JAX/XLA.
+    All dots green while a background comb-table compile aborts the
+    interpreter at exit was a REAL lost round — this turns that silent
+    134 into a named thread with a stack."""
+    offenders = find_leaked_compile_threads()
+    if not offenders:
+        return
+    import sys as _sys
+
+    lines = [
+        "",
+        "=" * 70,
+        "LEAKED BACKGROUND COMPILE THREAD(S) AT SESSION END "
+        "(exit-134 guard):",
+        "a test kicked off device work (table build / kernel trace) and "
+        "exited without draining it; interpreter teardown will race the "
+        "compile and can abort the run with no blame.",
+    ]
+    for name, stack in offenders:
+        lines.append("-" * 70)
+        lines.append(f"thread: {name}")
+        lines.append(stack.rstrip())
+    lines.append("=" * 70)
+    print("\n".join(lines), file=_sys.stderr, flush=True)
+    # fail the run visibly: rc=1 with the report above beats the silent
+    # SIGABRT the leak would otherwise risk.  (wrap_session returns
+    # session.exitstatus AFTER this hook, so the assignment sticks.)
+    session.exitstatus = max(int(exitstatus or 0), 1)
+
+
 @pytest.fixture
 def cpu_crypto_backend(monkeypatch):
     """Force the sequential host verifier (storage/domain-logic tests

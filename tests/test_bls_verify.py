@@ -1,0 +1,350 @@
+"""BLS12-381 verify lane — known-answer pins and device-vs-host
+bit-identity (ISSUE 14 tentpole + KAT satellite).
+
+Fast tier: signature-scheme vectors pinned against crypto/bls12381
+(anchored by the RFC 9380 J.10.1 hash-to-curve vectors in
+tests/test_bls12381.py — the hash suite and DST are the externally
+pinned surface; the sign/aggregate/PoP hexes below are regression
+vectors computed from it and cross-checked through the pairing
+identity), wrong-subgroup / off-curve / malformed pubkey handling, and
+the unit-grouped verdict semantics of models/bls_verifier on the pure
+host path.
+
+Slow tier (kernel compiles exceed the 5 s fast budget): the batched
+validate / validate+aggregate kernels of ops/bls381 against the host
+bigint implementation over a randomized corpus that includes invalid,
+off-curve, and wrong-subgroup encodings — the PR-11
+sanitize-before-shared-state lesson, pinned.
+"""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as H
+from cometbft_tpu.models import bls_verifier as M
+
+# ------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fact_caches():
+    """Every test sees cold validated-pubkey / hash caches — cache hits
+    must never mask a divergence the test is hunting."""
+    M.reset_caches()
+    yield
+    M.reset_caches()
+
+
+def _point_mul_g1(sk: int):
+    return H._to_affine(
+        H._FP, H._jac_mul(H._FP, H._from_affine(H._FP, H.G1_GEN), sk)
+    )
+
+
+def _wrong_subgroup_g1():
+    """An on-curve G1 point OUTSIDE the r-subgroup (the cofactor is
+    ~2^125, so small-x curve points essentially never land in it),
+    plus its well-formed compressed encoding — decompression succeeds,
+    KeyValidate must still reject."""
+    x = 1
+    while True:
+        y = H._fp_sqrt((x * x * x + 4) % H.P)
+        if y is not None:
+            aff = (x, y)
+            if not H._in_subgroup(H._FP, aff):
+                return aff, H._g1_compress(aff)
+        x += 1
+
+
+def _sum_host(affs):
+    acc = (H._FP.one, H._FP.one, H._FP.zero)
+    for a in affs:
+        acc = H._jac_add(H._FP, acc, H._from_affine(H._FP, a))
+    return H._to_affine(H._FP, acc)
+
+
+# ------------------------------------------------------ pinned vectors
+
+
+def test_bls_signature_vectors_pinned():
+    """Wire-stability pin for the whole signing stack: KeyGen (HKDF per
+    the bls-signature draft), G1 pubkey compression, G2 signing under
+    the NUL ciphersuite DST, aggregation, and proof of possession.
+    Anchored externally by the RFC 9380 hash-to-curve vectors
+    (test_bls12381.py) that the sign path runs through."""
+    sk = H.PrivKey.from_secret(b"cometbft-tpu bls kat seed")
+    assert sk.bytes().hex() == (
+        "13c0a04fff6293f818b14829829a6ddc92de2646225cfd9f61cb0c15c726712c"
+    )
+    pk = sk.pub_key()
+    assert pk.data.hex() == (
+        "94e69770d0665f9b74a9f75b314f78faaef47479ed108a81544509b28b941f8a"
+        "a81ba7aebb82861da8fde700eb9d3724"
+    )
+    msg = b"cometbft-tpu bls kat message"
+    sig = sk.sign(msg)
+    assert sig.hex() == (
+        "b6504a038d8193482b2f3b5979c84f1523a28b57691003eb76899698d876515b"
+        "bc1ae6336f8078d7c4cfd3d0d580556b0028c3f3859ce834e6da97e0e3bcea76"
+        "e2e0b4360ade2ddf89584b2fa983a1556f2a20ecdc834fc8f22cc8d75653662c"
+    )
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg + b"!", sig)
+
+    sks = [H.PrivKey.from_secret(bytes([i]) * 32) for i in range(1, 5)]
+    agg = H.aggregate_signatures([k.sign(msg) for k in sks])
+    assert agg.hex() == (
+        "84993ccb78e84dc78da13019badda0cc6f86a52f732b398037762f2242b69380"
+        "1d9ab582dc6e8aed6266defc3128d9a20b42cdeae1d5ef60686cb101192032bb"
+        "b0be72b2afc73727ec4982ff0264940fea2ed93767397ae861a07ea9b70c4b3b"
+    )
+    assert H.fast_aggregate_verify([k.pub_key() for k in sks], msg, agg)
+
+    pop = H.pop_prove(sk)
+    assert pop.hex() == (
+        "8e4f4e2e7fb139ebfd641a4b6510137ef136af5e26c227f6191b826d7c7d66e9"
+        "4b04e0dcc5955dbdf30cfba85a7ae6ff062ee56dca5d53615a9c2545b37eb2f2"
+        "425bbc1d18b2bd424298472f93d4a0095991d0dafc7c85db010d1dde2b97dc96"
+    )
+    assert H.pop_verify(pk, pop)
+
+
+def test_wrong_subgroup_and_malformed_pubkeys_rejected():
+    """KeyValidate gauntlet on the host path: a wrong-subgroup key has a
+    perfectly well-formed encoding (decompression succeeds) and MUST
+    still be rejected; off-curve x and infinity are rejected at
+    decode."""
+    aff, enc = _wrong_subgroup_g1()
+    assert H._on_curve(H._FP, aff)
+    with pytest.raises(ValueError):
+        H.PubKey(enc)
+    # verifier-level: the row reads invalid (False), never a crash
+    sk = H.PrivKey(7)
+    msg = b"m"
+    sig = sk.sign(msg)
+    for bad in (
+        enc,  # wrong subgroup
+        b"\x00" * 48,  # compression flag missing
+        bytes([0xC0]) + b"\x00" * 47,  # infinity
+        bytes([0x9F]) + b"\xff" * 47,  # x >= p
+    ):
+        v = M.CpuBlsBatchVerifier()
+        v.add(sk.pub_key().data, msg, sig)
+        v.add(bad, msg, sig)
+        ok, per = v.verify()
+        assert not ok
+        assert per[1] is False
+
+
+def test_unit_grouped_verdicts_host():
+    """The unit semantics of the verdict procedure: an aggregate commit
+    is one unit (same msg+sig rows), individually signed rows are
+    singleton units with exact blame, and a malformed member poisons
+    exactly its own unit."""
+    keys = [H.PrivKey(sk) for sk in (3, 5, 7, 11, 13)]
+    pubs = [k.pub_key().data for k in keys]
+    msg = b"agg-commit"
+    agg = H.aggregate_signatures([k.sign(msg) for k in keys])
+
+    # one aggregate unit, all valid
+    v = M.CpuBlsBatchVerifier()
+    for p in pubs:
+        v.add(p, msg, agg)
+    assert v.verify() == (True, [True] * 5)
+
+    # aggregate unit + a tampered singleton: blame stays row-exact
+    v = M.CpuBlsBatchVerifier()
+    for p in pubs[:3]:
+        v.add(p, msg, H.aggregate_signatures([k.sign(msg) for k in keys[:3]]))
+    v.add(pubs[3], b"solo", keys[3].sign(b"solo"))
+    v.add(pubs[4], b"solo2", keys[3].sign(b"solo2"))  # wrong signer
+    ok, per = v.verify()
+    assert (ok, per) == (False, [True, True, True, True, False])
+
+    # an invalid pubkey inside the aggregate unit fails the WHOLE unit
+    # (an aggregate claim over a malformed set is unverifiable) while an
+    # unrelated singleton stays True
+    v = M.CpuBlsBatchVerifier()
+    agg3 = H.aggregate_signatures([k.sign(msg) for k in keys[:3]])
+    v.add(pubs[0], msg, agg3)
+    v.add(b"\x00" * 48, msg, agg3)
+    v.add(pubs[2], msg, agg3)
+    v.add(pubs[3], b"solo", keys[3].sign(b"solo"))
+    ok, per = v.verify()
+    assert (ok, per) == (False, [False, False, False, True])
+
+
+def test_pubkey_cache_is_warm_after_first_verify(monkeypatch):
+    """Steady state: the second verify of the same validator set never
+    re-runs subgroup validation (the per-key facts are cached)."""
+    keys = [H.PrivKey(sk) for sk in (3, 5, 7)]
+    pubs = [k.pub_key().data for k in keys]
+    msg = b"cache"
+    agg = H.aggregate_signatures([k.sign(msg) for k in keys])
+
+    calls = {"n": 0}
+    real = H._in_subgroup
+
+    def counting(F, aff):
+        if F is H._FP:
+            calls["n"] += 1
+        return real(F, aff)
+
+    monkeypatch.setattr(H, "_in_subgroup", counting)
+    for _ in range(2):
+        v = M.CpuBlsBatchVerifier()
+        for p in pubs:
+            v.add(p, msg, agg)
+        assert v.verify()[0] is True
+    assert calls["n"] == len(pubs)  # once per key, not once per verify
+
+
+def test_empty_and_size_validation():
+    v = M.CpuBlsBatchVerifier()
+    assert v.verify() == (False, [])
+    with pytest.raises(ValueError):
+        v.add(b"\x01" * 32, b"m", b"\x02" * 96)  # ed25519-sized pub
+    with pytest.raises(ValueError):
+        v.add(b"\x01" * 48, b"m", b"\x02" * 64)  # ed25519-sized sig
+
+
+# ------------------------------------------------- device-vs-host (slow)
+
+
+@pytest.mark.slow
+def test_validate_kernel_bit_identical_to_host():
+    """Batched device validation == the host bigint gauntlet over a
+    randomized corpus: subgroup points, wrong-subgroup on-curve points,
+    and host-rejected rows (None), in mixed order."""
+    from cometbft_tpu.ops import bls381 as D
+
+    rng = np.random.default_rng(5)
+    wrong, _ = _wrong_subgroup_g1()
+    corpus, expect = [], []
+    for i in range(21):
+        r = int(rng.integers(0, 3))
+        if r == 0:
+            aff = _point_mul_g1(int(rng.integers(2, 1 << 30)))
+            corpus.append(aff)
+            expect.append(True)
+        elif r == 1:
+            corpus.append(wrong)
+            expect.append(False)
+        else:
+            corpus.append(None)  # host decode already rejected
+            expect.append(False)
+    got = D.validate_pubkeys_device(corpus)
+    host = [
+        aff is not None and H._in_subgroup(H._FP, aff) for aff in corpus
+    ]
+    assert got == host == expect
+
+
+@pytest.mark.slow
+def test_validate_aggregate_kernel_matches_host_sum():
+    """The fused kernel: validity bits match the host gauntlet AND the
+    aggregate equals the host Jacobian sum of exactly the valid rows —
+    at odd sizes too (the tree fold's carry path)."""
+    from cometbft_tpu.ops import bls381 as D
+
+    wrong, _ = _wrong_subgroup_g1()
+    for n in (1, 3, 5, 8):
+        pts = [_point_mul_g1(sk) for sk in range(2, 2 + n)]
+        mixed = list(pts)
+        if n >= 3:
+            mixed[1] = wrong
+            mixed[2] = None
+        ok, agg = D.validate_aggregate_device(mixed)
+        host_ok = [
+            a is not None and H._in_subgroup(H._FP, a) for a in mixed
+        ]
+        assert ok == host_ok
+        ref = _sum_host([a for a, o in zip(mixed, host_ok) if o])
+        assert agg == ref
+
+    # every row invalid -> the aggregate is the identity (None)
+    ok, agg = D.validate_aggregate_device([wrong, None])
+    assert ok == [False, False] and agg is None
+
+
+@pytest.mark.slow
+def test_device_assisted_verifier_bit_identical_to_host(monkeypatch):
+    """THE tentpole contract at the verifier layer: the device-assisted
+    BlsAggregateVerifier and the pure-host CpuBlsBatchVerifier return
+    bit-identical (ok, per-row) over a corpus of aggregate units,
+    singletons, tampered rows, and malformed/wrong-subgroup encodings —
+    with the device thresholds forced to 1 so the kernels genuinely
+    run."""
+    monkeypatch.setenv("COMETBFT_TPU_BLS_VALIDATE_DEVICE_MIN", "1")
+    monkeypatch.setenv("COMETBFT_TPU_BLS_AGG_DEVICE_MIN", "1")
+    _, wrong_enc = _wrong_subgroup_g1()
+    keys = [H.PrivKey(sk) for sk in (3, 5, 7, 11, 13, 17)]
+    pubs = [k.pub_key().data for k in keys]
+    msg = b"bit-identity"
+    agg = H.aggregate_signatures([k.sign(msg) for k in keys[:4]])
+
+    def corpus():
+        v = []
+        for p in pubs[:4]:
+            v.append((p, msg, agg))  # the aggregate unit
+        v.append((pubs[4], b"s1", keys[4].sign(b"s1")))  # good singleton
+        v.append((pubs[5], b"s2", keys[4].sign(b"s2")))  # wrong signer
+        v.append((wrong_enc, b"s3", keys[5].sign(b"s3")))  # bad subgroup
+        v.append((pubs[5], b"s4", b"\x00" * 96))  # malformed sig
+        return v
+
+    results = []
+    for cls in (M.BlsAggregateVerifier, M.CpuBlsBatchVerifier):
+        M.reset_caches()  # no cross-path cache pollution
+        bv = cls()
+        for item in corpus():
+            bv.add(*item)
+        results.append(bv.verify())
+    assert results[0] == results[1]
+    ok, per = results[0]
+    assert not ok
+    assert per == [True] * 5 + [False, False, False]
+
+
+@pytest.mark.slow
+def test_fused_kernel_engages_on_single_unit_cold_batch(monkeypatch):
+    """A cold single-unit batch (the aggregate-commit shape) takes the
+    FUSED validate+aggregate dispatch — one device call — and its
+    verdicts match the pure host path; a warm repeat skips validation
+    entirely (cache) and still agrees."""
+    from cometbft_tpu.ops import bls381 as D
+
+    monkeypatch.setenv("COMETBFT_TPU_BLS_VALIDATE_DEVICE_MIN", "1")
+    calls = {"fused": 0, "validate": 0}
+    real_fused = D.validate_aggregate_device
+    real_val = D.validate_pubkeys_device
+
+    def spy_fused(pts):
+        calls["fused"] += 1
+        return real_fused(pts)
+
+    def spy_val(pts):
+        calls["validate"] += 1
+        return real_val(pts)
+
+    monkeypatch.setattr(D, "validate_aggregate_device", spy_fused)
+    monkeypatch.setattr(D, "validate_pubkeys_device", spy_val)
+
+    keys = [H.PrivKey(sk) for sk in (3, 5, 7, 11)]
+    pubs = [k.pub_key().data for k in keys]
+    msg = b"fused-unit"
+    agg = H.aggregate_signatures([k.sign(msg) for k in keys])
+
+    def run(cls):
+        bv = cls()
+        for p in pubs:
+            bv.add(p, msg, agg)
+        return bv.verify()
+
+    want = run(M.CpuBlsBatchVerifier)
+    assert want == (True, [True] * 4)
+    M.reset_caches()
+    assert run(M.BlsAggregateVerifier) == want
+    assert calls == {"fused": 1, "validate": 0}  # ONE fused dispatch
+    assert run(M.BlsAggregateVerifier) == want  # warm: cache, no device
+    assert calls == {"fused": 1, "validate": 0}
