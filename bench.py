@@ -44,7 +44,10 @@ capture (see _run_multichip); BENCH_WORKLOAD=mixed drives concurrent
 consensus + mempool CheckTx load through the verify service;
 BENCH_WORKLOAD=bls sweeps validator-set sizes comparing ed25519-batch
 vs BLS-aggregate-commit p50 and reports the crossover set size
-(see _run_bls).
+(see _run_bls); BENCH_WORKLOAD=secp sweeps batch sizes comparing the
+TPU-batched secp256k1/ECDSA lane vs the pure-host lane and drives a
+mixed ed25519+secp CheckTx ingest round with per-key-type per-class
+latency (see _run_secp).
 
 Baseline: curve25519-voi batch verify ~27.5 us/sig/core on the QA CPUs
 (BASELINE.md: 50-60 us single, ~2x batch gain) -> 275 ms for 10k sigs.
@@ -570,6 +573,213 @@ def _run_bls() -> None:
     emit_and_exit()
 
 
+def _run_secp() -> None:
+    """BENCH_WORKLOAD=secp: the batched-ECDSA capture of ROADMAP item 4
+    (PAPERS.md arXiv:2112.02229).  Two measurements in one JSON line:
+
+    * **batch-size sweep** (BENCH_SECP_SIZES, default 64,256,1024,4096):
+      per size, p50 of the TPU-batched lane (models/secp_verifier ->
+      ops/secp256k1: range checks, Montgomery batch inversion, Shamir
+      double-scalar — one fused dispatch) vs the pure-host ECDSA lane.
+      The host path is pure-Python bigint ECDSA (~tens of ms per
+      signature), so it is measured on min(n, BENCH_SECP_HOST_CAP
+      [default 64]) rows and reported per-signature plus extrapolated
+      (``host_measured_rows`` marks the cap — an extrapolated number is
+      never passed off as a measured one).
+    * **mixed ingest round** (BENCH_SECP_MIXED_SECONDS, default 10):
+      concurrent ed25519-commit consensus load plus TWO mempool CheckTx
+      sender pools — ed25519 (v1 envelopes, MODE_PLAIN) and secp256k1
+      (key-typed v2 envelopes, MODE_SECP) — through one verify service,
+      reporting per-key-type per-class latency percentiles: the
+      Ethereum-shaped ingest claim next to the scheduler's class
+      separation.
+    """
+    import threading
+
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import ed25519 as host_ed
+    from cometbft_tpu.crypto import secp256k1 as host_secp
+    from cometbft_tpu.models import secp_verifier as mv
+    from cometbft_tpu.verifysvc import checktx
+    from cometbft_tpu.verifysvc.service import global_service
+
+    sizes = [
+        int(x) for x in
+        os.environ.get("BENCH_SECP_SIZES", "64,256,1024,4096").split(",")
+        if x.strip()
+    ]
+    iters = int(os.environ.get("BENCH_SECP_ITERS", "5"))
+    host_cap = int(os.environ.get("BENCH_SECP_HOST_CAP", "64"))
+    REPORT["metric"] = "verify_secp_tpu_batch_p50_ms"
+    REPORT["workload"] = "secp"
+    REPORT["verifier"] = "secp-batched"
+    REPORT["sizes"] = sizes
+    REPORT["iters"] = iters
+
+    rng = np.random.default_rng(23)
+    n_max = max(sizes)
+    keys = [host_secp.PrivKey.from_seed(rng.bytes(32)) for _ in range(n_max)]
+    pubs = [k.pub_key().data for k in keys]
+    items = []
+    for i, sk in enumerate(keys):
+        msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|chain-secp"
+        items.append((pubs[i], msg, sk.sign(msg)))
+
+    def p50(fn):
+        runs = sorted(fn() for _ in range(iters))
+        return runs[len(runs) // 2]
+
+    sweep: dict[str, dict] = {}
+    for n in sizes:
+        row: dict = {}
+        batch = items[:n]
+
+        def run_tpu(batch=batch, n=n):
+            v = mv.TpuSecpBatchVerifier()
+            t0 = time.perf_counter()
+            for it in batch:
+                v.add(*it)
+            ok, per = v.verify()
+            dt = (time.perf_counter() - t0) * 1e3
+            assert ok and len(per) == n
+            return dt
+
+        run_tpu()  # warmup: bucket-shape compile / cache hit
+        row["tpu_p50_ms"] = round(p50(run_tpu), 3)
+
+        hn = min(n, host_cap)
+        hbatch = batch[:hn]
+
+        def run_host(hbatch=hbatch, hn=hn):
+            v = mv.CpuSecpBatchVerifier()
+            t0 = time.perf_counter()
+            for it in hbatch:
+                v.add(*it)
+            ok, per = v.verify()
+            dt = (time.perf_counter() - t0) * 1e3
+            assert ok and len(per) == hn
+            return dt
+
+        host_ms = p50(run_host)
+        row["host_measured_rows"] = hn
+        row["host_p50_ms_per_sig"] = round(host_ms / hn, 3)
+        row["host_p50_ms"] = round(
+            host_ms if hn == n else host_ms / hn * n, 3
+        )
+        row["host_extrapolated"] = hn != n
+        row["tpu_speedup_vs_host"] = round(
+            row["host_p50_ms"] / row["tpu_p50_ms"], 2
+        ) if row["tpu_p50_ms"] else None
+        sweep[str(n)] = row
+    REPORT["sweep"] = sweep
+    top = sweep[str(max(sizes))]
+    REPORT["value"] = top["tpu_p50_ms"]
+
+    # ---- mixed ed25519 + secp256k1 ingest round
+    seconds = float(os.environ.get("BENCH_SECP_MIXED_SECONDS", "10"))
+    senders = int(os.environ.get("BENCH_SECP_MIXED_SENDERS", "4"))
+    n_commit = int(os.environ.get("BENCH_SECP_COMMIT_N", "1000"))
+    ed_keys = [host_ed.PrivKey.from_seed(rng.bytes(32)) for _ in range(n_commit)]
+    ed_pubs = [k.pub_key().data for k in ed_keys]
+    commit_items = []
+    for i, sk in enumerate(ed_keys):
+        msg = b"\x08\x02\x10\x01\x18\x05" + i.to_bytes(8, "big") + b"|mixed-commit"
+        commit_items.append((ed_pubs[i], msg, sk.sign(msg)))
+    crypto_batch.create_batch_verifier("ed25519", pubkeys=ed_pubs)
+
+    ed_txs = [
+        checktx.make_signed_tx(host_ed.PrivKey.from_seed(rng.bytes(32)),
+                               b"mixed-ed-%d" % i)
+        for i in range(32)
+    ]
+    secp_txs = [
+        checktx.make_signed_tx(host_secp.PrivKey.from_seed(rng.bytes(32)),
+                               b"mixed-secp-%d" % i)
+        for i in range(32)
+    ]
+
+    stop = threading.Event()
+    lat: dict[str, list[float]] = {
+        "consensus_ed25519": [], "mempool_ed25519": [], "mempool_secp256k1": [],
+    }
+    lat_mtx = threading.Lock()
+    errors: list[str] = []
+
+    def consensus_loop():
+        try:
+            while not stop.is_set():
+                v = crypto_batch.create_batch_verifier("ed25519", pubkeys=ed_pubs)
+                t = time.perf_counter()
+                for it in commit_items:
+                    v.add(*it)
+                ok, per = v.verify()
+                dt = (time.perf_counter() - t) * 1e3
+                assert ok and len(per) == n_commit
+                with lat_mtx:
+                    lat["consensus_ed25519"].append(dt)
+        except BaseException as e:  # noqa: BLE001 — report, don't hang the bench
+            errors.append(f"consensus: {type(e).__name__}: {e}")
+            stop.set()
+
+    def mempool_loop(i: int, txs, key):
+        try:
+            j = i
+            while not stop.is_set():
+                t = time.perf_counter()
+                ok = checktx.verify_tx_signature(txs[j % len(txs)])
+                dt = (time.perf_counter() - t) * 1e3
+                assert ok is True
+                with lat_mtx:
+                    lat[key].append(dt)
+                j += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"{key}-{i}: {type(e).__name__}: {e}")
+            stop.set()
+
+    threads = [threading.Thread(target=consensus_loop, name="bench-consensus")]
+    threads += [
+        threading.Thread(target=mempool_loop, args=(i, ed_txs, "mempool_ed25519"),
+                         name=f"bench-mp-ed-{i}")
+        for i in range(senders)
+    ]
+    threads += [
+        threading.Thread(
+            target=mempool_loop, args=(i, secp_txs, "mempool_secp256k1"),
+            name=f"bench-mp-secp-{i}")
+        for i in range(senders)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 3)
+
+    stats = global_service().stats()
+    REPORT["mixed"] = {
+        "seconds": seconds,
+        "senders_per_key_type": senders,
+        "commit_n": n_commit,
+        "classes": {
+            k: {"count": len(v), "p50_ms": pct(v, 0.5), "p95_ms": pct(v, 0.95)}
+            for k, v in lat.items()
+        },
+        "scheduler": {
+            "dispatched_batches": stats["dispatched_batches"],
+            "rejected": stats["rejected"],
+        },
+    }
+    if errors:
+        REPORT["error"] = "; ".join(errors[:4])
+    emit_and_exit()
+
+
 def _run_multichip() -> None:
     """BENCH_WORKLOAD=multichip: the 8-device scaling capture of ROADMAP
     item 1.  Sweeps the comb-cached commit verify over device counts
@@ -793,6 +1003,8 @@ def main() -> None:
         _run_multichip()
     if os.environ.get("BENCH_WORKLOAD", "") == "bls":
         _run_bls()
+    if os.environ.get("BENCH_WORKLOAD", "") == "secp":
+        _run_secp()
 
     N = int(os.environ.get("BENCH_N", "10000"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))

@@ -204,6 +204,24 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N), i32(32), i32(32), i32(32)),
         max_eqns=26_000,  # measured 19,445
     ),
+    # ---- ops/secp256k1.py — the batched ECDSA lane (MODE_SECP):
+    # range/low-s validation, Montgomery batch inversion (s^-1 mod n and
+    # the affine z^-1 mod p, one Fermat chain each), Shamir double-scalar
+    # u1*G + u2*Q, and the cosmos/eth verdicts — ONE fused program.  The
+    # G window table is host-precomputed and device_put-resident (PR-11
+    # pattern: never a table-build compile), passed as the last argument.
+    Kernel(
+        name="secp256k1_verify_batch",
+        fn="cometbft_tpu.ops.secp256k1:verify_batch",
+        args=(
+            i32(N, 22), i32(N, 22), boolean(N),  # pubkey x, y, decode-ok
+            i32(N, 22), i32(N, 22), i32(N, 22),  # e, r, s (raw 256-bit)
+            boolean(N), i32(N),  # eth-row flag, recovery id
+            i32(16, 66),  # resident G window table (flat Jacobian rows)
+        ),
+        out=(boolean(N),),
+        max_eqns=18_000,  # measured 13,688
+    ),
     # ---- models/comb_verifier.py — cache assembly + the device program
     Kernel(
         name="comb_assemble_churn",
@@ -270,6 +288,7 @@ JIT_SITES: dict[str, str] = {
     "cometbft_tpu/ops/bls381.py::validate_aggregate_g1": (
         "bls381_validate_aggregate_g1"
     ),
+    "cometbft_tpu/ops/secp256k1.py::verify_batch": "secp256k1_verify_batch",
     # models/verifier.py jits ops/ed25519.verify_batch (the uncached path)
     "cometbft_tpu/models/verifier.py::verify_batch": "ed25519_verify_batch",
     "cometbft_tpu/models/comb_verifier.py::_assemble_churn": "comb_assemble_churn",
@@ -318,6 +337,14 @@ COLLECT_BOUNDARIES: dict[str, str] = {
     ),
     "cometbft_tpu/ops/field.py::from_limbs": (
         "host-side limb decoder used by tests and host bridges"
+    ),
+    "cometbft_tpu/ops/secp256k1.py::verify_batch_device": (
+        "the secp ECDSA bridge: one blocking fetch of the per-row "
+        "verdict bits"
+    ),
+    "cometbft_tpu/ops/secp256k1.py::from_limbs": (
+        "host-side limb decoder (tests); receives already-fetched "
+        "results"
     ),
 }
 # NOT boundaries: the parallel/mesh.py factories' np.array calls wrap

@@ -29,10 +29,15 @@ def backend() -> str:
 def supports_batch_verifier(key_type: str) -> bool:
     """ed25519 batches through the comb/plain kernels; bls12_381
     through the aggregate lane (models/bls_verifier — one pairing per
-    batch).  The key type comes from the validator set's genesis pubkey
-    encoding, constrained by ConsensusParams.validator.pub_key_types —
-    that is the whole backend-selection story (docs/verify_service.md)."""
-    return key_type in (ed25519.KEY_TYPE, BLS_KEY_TYPE)
+    batch); secp256k1 / secp256k1eth through the batched ECDSA lane
+    (models/secp_verifier — Shamir double-scalar kernels + Montgomery
+    batch inversion).  The key type comes from the validator set's
+    genesis pubkey encoding, constrained by
+    ConsensusParams.validator.pub_key_types — that is the whole
+    backend-selection story (docs/verify_service.md)."""
+    return key_type in (
+        ed25519.KEY_TYPE, BLS_KEY_TYPE, "secp256k1", "secp256k1eth"
+    )
 
 
 def comb_min() -> int:
@@ -93,6 +98,10 @@ def create_batch_verifier(
             from ..models.bls_verifier import CpuBlsBatchVerifier
 
             return CpuBlsBatchVerifier()
+        if key_type in ("secp256k1", "secp256k1eth"):
+            from ..models.secp_verifier import CpuSecpBatchVerifier
+
+            return CpuSecpBatchVerifier()
         return CpuEd25519BatchVerifier()
     from ..verifysvc.client import ServiceBatchVerifier, resolve_mode
     from ..verifysvc.service import Klass

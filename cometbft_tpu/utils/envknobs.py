@@ -126,6 +126,21 @@ BLS_PUBKEY_CACHE = _declare(
     "validator set pays validation once, not once per commit.  0 "
     "disables caching.",
 )
+SECP_DEVICE_MIN = _declare(
+    "COMETBFT_TPU_SECP_DEVICE_MIN", "int", 8,
+    "Minimum batch width at/above which secp256k1 ECDSA batches run on "
+    "the accelerator (ops/secp256k1.verify_batch: Shamir double-scalar "
+    "kernels + Montgomery batch inversion); below it the per-row host "
+    "verify wins over dispatch overhead.  The verdict is bit-identical "
+    "either way (models/secp_verifier).",
+)
+SECP_PUBKEY_CACHE = _declare(
+    "COMETBFT_TPU_SECP_PUBKEY_CACHE", "int", 65536,
+    "Entries in the decoded-secp256k1-pubkey cache "
+    "(models/secp_verifier): decompressing a 33-byte key costs a field "
+    "square root, and CheckTx ingest repeats senders, so decode is "
+    "paid once per key, not once per transaction.  0 disables caching.",
+)
 
 # verify service (verifysvc/ — priority-scheduled device batching)
 VERIFYSVC_BATCH_MAX = _declare(

@@ -7,55 +7,124 @@ before this module they never touched the accelerator: the reference
 delegates tx signature checking entirely to the application.
 
 This module defines a minimal *signed-tx envelope* the node itself can
-verify before the tx ever reaches the app's CheckTx:
+verify before the tx ever reaches the app's CheckTx.  Two wire
+versions:
 
-    ``MAGIC(8) | pubkey(32) | signature(64) | payload``
+    v1 (legacy): ``MAGIC_V1(8) | pubkey(32) | signature(64) | payload``
+                 — always ed25519; every pre-key-type envelope on disk
+                 or in flight keeps parsing and verifying unchanged.
+    v2:          ``MAGIC_V2(8) | key_type(1) | pubkey | signature | payload``
+                 — the key-type byte selects the signature scheme and
+                 fixes the pubkey/signature widths:
 
-with the ed25519 signature over ``SIGN_DOMAIN + payload`` (domain
-separation: a tx signature can never be replayed as a vote signature or
-vice versa).  Transactions that don't start with the magic are passed
-through untouched — the gate is opt-in per tx, so apps with their own
-signature schemes lose nothing.
+                     0x00  ed25519        pub 32   sig 64
+                     0x01  secp256k1      pub 33   sig 64  (r||s, SHA-256)
+                     0x02  secp256k1eth   pub 65   sig 65  (R||S||V, Keccak)
+
+In both versions the signature is over ``SIGN_DOMAIN + payload``
+(domain separation: a tx signature can never be replayed as a vote
+signature or vice versa).  Transactions that don't carry a well-formed
+envelope are passed through untouched — the gate is opt-in per tx, so
+apps with their own signature schemes lose nothing.
 
 Each CheckTx caller submits its single (pubkey, msg, sig) to the verify
-service's MEMPOOL class; the class's flush deadline is the coalescing
-window that merges checks from concurrent senders (p2p gossip threads,
-RPC broadcast handlers) into one device batch.  When the device backend
-isn't selectable, or the service pushes back, the check runs on the host
-(``crypto/ed25519.verify_signature``) — bit-identical semantics either
-way (both ends are ZIP-215; tests/test_comb_tree.py pins kernel == host).
+service's MEMPOOL class under the key type's dispatch mode (ed25519 ->
+MODE_PLAIN, secp types -> MODE_SECP — the key-type routing seam of
+verifysvc/service.mode_for_key_type); the class's flush deadline is the
+coalescing window that merges checks from concurrent senders into one
+device batch per mode.  When the device backend isn't selectable, or
+the service pushes back, the check runs on the host through the SAME
+per-mode cpu verifier every fallback path shares
+(``service.cpu_verifier_for_mode``) — bit-identical semantics either
+way.
 """
 
 from __future__ import annotations
 
-from ..crypto import ed25519 as host_ed25519
 from .service import (
     Klass,
     VerifyService,
     VerifyServiceBackpressure,
+    _host_verify_items,
     collect_timeout_s,
     global_service,
+    mode_for_key_type,
     report_collect_stall,
 )
 
-MAGIC = b"\xd0sigtx1\x00"
+MAGIC = b"\xd0sigtx1\x00"  # v1: implicit ed25519 (the pre-key-type wire)
+MAGIC_V2 = b"\xd0sigtx2\x00"  # v2: explicit key-type byte
 SIGN_DOMAIN = b"cometbft-tpu/sigtx/v1|"
 _HEADER_LEN = len(MAGIC) + 32 + 64
 
+# key-type byte -> (key type name, pubkey width, signature width)
+KEY_TYPE_BYTES: dict[str, int] = {
+    "ed25519": 0,
+    "secp256k1": 1,
+    "secp256k1eth": 2,
+}
+_KT_SHAPES: dict[int, tuple[str, int, int]] = {
+    0: ("ed25519", 32, 64),
+    1: ("secp256k1", 33, 64),
+    2: ("secp256k1eth", 65, 65),
+}
+
 
 def make_signed_tx(priv_key, payload: bytes) -> bytes:
-    """Wrap payload in the signed envelope (tests, loadgen, bench)."""
+    """Wrap payload in the signed envelope (tests, loadgen, bench).
+
+    ed25519 keys keep emitting the v1 wire — every deployed parser
+    (and a pre-key-type shared verify plane's host fallback)
+    understands it; secp keys emit v2 with their key-type byte."""
     sig = priv_key.sign(SIGN_DOMAIN + payload)
-    return MAGIC + priv_key.pub_key().data + sig + payload
+    kt = getattr(priv_key, "type", "ed25519")
+    if kt == "ed25519":
+        return MAGIC + priv_key.pub_key().data + sig + payload
+    ktb = KEY_TYPE_BYTES[kt]
+    return MAGIC_V2 + bytes([ktb]) + priv_key.pub_key().data + sig + payload
 
 
-def parse_signed_tx(tx: bytes) -> tuple[bytes, bytes, bytes] | None:
-    """(pubkey, signature, payload) when tx carries the envelope, else
-    None (an unsigned tx — not an error)."""
-    if len(tx) < _HEADER_LEN or not tx.startswith(MAGIC):
-        return None
-    off = len(MAGIC)
-    return tx[off : off + 32], tx[off + 32 : off + 96], tx[_HEADER_LEN:]
+def parse_signed_tx(tx: bytes) -> tuple[str, bytes, bytes, bytes] | None:
+    """(key_type, pubkey, signature, payload) when tx carries a
+    well-formed envelope, else None (an unsigned tx — not an error;
+    malformed envelopes pass through unsigned exactly like the v1
+    parser always treated short v1 headers)."""
+    if tx.startswith(MAGIC):
+        if len(tx) < _HEADER_LEN:
+            return None
+        off = len(MAGIC)
+        return (
+            "ed25519",
+            tx[off : off + 32],
+            tx[off + 32 : off + 96],
+            tx[_HEADER_LEN:],
+        )
+    if tx.startswith(MAGIC_V2):
+        off = len(MAGIC_V2)
+        if len(tx) < off + 1:
+            return None
+        shape = _KT_SHAPES.get(tx[off])
+        if shape is None:
+            return None  # unknown key type: not our envelope
+        kt, npub, nsig = shape
+        off += 1
+        if len(tx) < off + npub + nsig:
+            return None
+        return (
+            kt,
+            tx[off : off + npub],
+            tx[off + npub : off + npub + nsig],
+            tx[off + npub + nsig :],
+        )
+    return None
+
+
+def _host_verify(mode, pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Inline host verdict through the ONE shared fallback procedure
+    (service._host_verify_items -> cpu_verifier_for_mode): a malformed
+    row judges False here, never raises."""
+    _, per = _host_verify_items([(pub, msg, sig)], mode)
+    return bool(per and per[0])
 
 
 def verify_tx_signature(
@@ -67,15 +136,18 @@ def verify_tx_signature(
 
     Returns None for unsigned txs (no envelope), True/False for signed
     ones.  Device-batched through the MEMPOOL class — under ``tenant``
-    (None = this process's default tenant) — when the accelerator
-    backend is selectable; host verification otherwise, on backpressure,
-    and on a collect-deadline stall — the caller never needs to know
-    which path ran."""
+    (None = this process's default tenant), in the key type's dispatch
+    mode — when the accelerator backend is selectable; host
+    verification otherwise, on backpressure, and on a collect-deadline
+    stall — the caller never needs to know which path ran."""
     parsed = parse_signed_tx(tx)
     if parsed is None:
         return None
-    pub, sig, payload = parsed
+    key_type, pub, sig, payload = parsed
     msg = SIGN_DOMAIN + payload
+    # the ONE key-type routing seam (service._KEY_TYPE_MODE); every
+    # key type parse_signed_tx can emit has a mode there
+    mode = mode_for_key_type(key_type)
     svc = service
     if svc is None:
         from ..crypto import batch as crypto_batch
@@ -92,7 +164,7 @@ def verify_tx_signature(
         t0 = _time.monotonic()
         try:
             _, per = svc.submit(
-                [(pub, msg, sig)], Klass.MEMPOOL, tenant=tenant
+                [(pub, msg, sig)], Klass.MEMPOOL, mode, tenant=tenant
             ).collect(collect_timeout_s())
             return bool(per and per[0])
         except VerifyServiceBackpressure:
@@ -109,4 +181,4 @@ def verify_tx_signature(
             )
         except ValueError:
             return False  # malformed pubkey/sig lengths can't be valid
-    return host_ed25519.verify_signature(pub, msg, sig)
+    return _host_verify(mode, pub, msg, sig)

@@ -23,6 +23,7 @@ from ..utils import tracing
 from .service import (
     MODE_BLS,
     MODE_PLAIN,
+    MODE_SECP,
     Klass,
     VerifyService,
     VerifyServiceBackpressure,
@@ -41,11 +42,16 @@ def resolve_mode(pubkeys: list[bytes] | None, key_type: str = "ed25519"):
 
     Mirrors the pre-service routing of crypto/batch.create_batch_verifier:
     BLS validator sets take the aggregate lane (MODE_BLS — no comb
-    tables; the BLS plane owns its own pubkey-validation cache), large
-    known ed25519 sets use the comb-cached program (background build
-    while warming -> uncached), everything else the uncached kernel."""
+    tables; the BLS plane owns its own pubkey-validation cache), secp
+    sets (both the Cosmos and Ethereum wire formats) the batched ECDSA
+    lane (MODE_SECP — the Shamir G table is a process-resident
+    device_put constant, nothing to bind per set), large known ed25519
+    sets use the comb-cached program (background build while warming ->
+    uncached), everything else the uncached kernel."""
     if key_type == "bls12_381":
         return MODE_BLS
+    if key_type in ("secp256k1", "secp256k1eth"):
+        return MODE_SECP
     if pubkeys is None:
         return MODE_PLAIN
     from .service import _GLOBAL, remote_plane_configured
@@ -118,6 +124,13 @@ class ServiceBatchVerifier:
             # 48-byte compressed G1 pubkey, 96-byte compressed G2 sig
             if len(pub_key) != 48 or len(sig) != 96:
                 raise ValueError("malformed bls12-381 pubkey or signature")
+            self._items.append((pub_key, msg, sig))
+            return
+        if self._mode[0] == "secp":
+            # 33-byte compressed (cosmos, 64-byte r||s) or 65-byte
+            # uncompressed (eth, 65-byte R||S||V) wire shapes
+            if len(pub_key) not in (33, 65) or len(sig) not in (64, 65):
+                raise ValueError("malformed secp256k1 pubkey or signature")
             self._items.append((pub_key, msg, sig))
             return
         if len(pub_key) != 32 or len(sig) != 64:

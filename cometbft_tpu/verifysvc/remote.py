@@ -139,8 +139,10 @@ class RemoteBatchVerifier:
         self._klass = Klass.CONSENSUS
         self._tenant = DEFAULT_TENANT
         # the batch's validator key type rides the wire so the PLANE
-        # routes it to the matching verifier lane (MODE_BLS batches must
-        # never reach an ed25519 verifier on the other side)
+        # routes it to the matching verifier lane (MODE_BLS / MODE_SECP
+        # batches must never reach an ed25519 verifier on the other
+        # side; both secp wire shapes ride as "secp256k1" — the lane
+        # discriminates rows by pubkey length, service.mode_for_key_type)
         self._key_type = key_type
         self._items: list[tuple[bytes, bytes, bytes]] = []
 

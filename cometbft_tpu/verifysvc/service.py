@@ -191,13 +191,39 @@ _DEADLINE_KNOBS = {
 #                      types.  Selected off the validator key type by
 #                      crypto/batch.create_batch_verifier / client
 #                      .resolve_mode.
+# ("secp",)         -> batched secp256k1 ECDSA verifier
+#                      (models/secp_verifier; Cosmos 33-byte and
+#                      Ethereum 65-byte wire shapes in one lane);
+#                      rows are independent, so secp requests COALESCE
+#                      with other secp requests of the class exactly
+#                      like plain ones — but never with a different
+#                      mode, which would hand one verifier two key
+#                      types.
 MODE_PLAIN = ("plain",)
 MODE_BLS = ("bls",)
+MODE_SECP = ("secp",)
+
+# modes whose requests may merge into one batch (same mode only):
+# per-row-independent verdicts with one shared data plane
+_COALESCIBLE_MODES = frozenset({"plain", "secp"})
 
 # the wire spelling of each mode's key type (verifysvc/wire.VerifyRequest
 # .key_type); "" rides as ed25519 for back-compat with pre-BLS planes
-_MODE_KEY_TYPE = {"plain": "ed25519", "comb": "ed25519", "bls": "bls12_381"}
-_KEY_TYPE_MODE = {"": MODE_PLAIN, "ed25519": MODE_PLAIN, "bls12_381": MODE_BLS}
+_MODE_KEY_TYPE = {
+    "plain": "ed25519",
+    "comb": "ed25519",
+    "bls": "bls12_381",
+    "secp": "secp256k1",
+}
+_KEY_TYPE_MODE = {
+    "": MODE_PLAIN,
+    "ed25519": MODE_PLAIN,
+    "bls12_381": MODE_BLS,
+    # both secp wire formats share the MODE_SECP lane: the verifier
+    # tells rows apart by pubkey length, like the host crypto modules
+    "secp256k1": MODE_SECP,
+    "secp256k1eth": MODE_SECP,
+}
 
 
 def mode_key_type(mode) -> str:
@@ -380,13 +406,18 @@ def _parse_tenant_weights(spec: str) -> dict[str, int]:
 
 def cpu_verifier_for_mode(mode):
     """The mode's pure-host data plane (CpuEd25519BatchVerifier for the
-    ed25519 modes, CpuBlsBatchVerifier for MODE_BLS) — the ONE selection
-    point every fallback path shares, so a new key type cannot be added
-    to one fallback and missed in another."""
+    ed25519 modes, CpuBlsBatchVerifier for MODE_BLS,
+    CpuSecpBatchVerifier for MODE_SECP) — the ONE selection point every
+    fallback path shares, so a new key type cannot be added to one
+    fallback and missed in another."""
     if mode[0] == "bls":
         from ..models.bls_verifier import CpuBlsBatchVerifier
 
         return CpuBlsBatchVerifier()
+    if mode[0] == "secp":
+        from ..models.secp_verifier import CpuSecpBatchVerifier
+
+        return CpuSecpBatchVerifier()
     from ..models.verifier import CpuEd25519BatchVerifier
 
     return CpuEd25519BatchVerifier()
@@ -943,10 +974,12 @@ class VerifyService:
         self, klass: Klass, tenant: str
     ) -> tuple[list[_Request], str]:
         """Pop the head batch of a ready (class, tenant) queue.  Only
-        plain requests coalesce (up to the batch width): comb- and bls-
-        bound requests go solo — each binds its own device program, and
-        a coalesced batch has exactly one verifier.  Batches never mix
-        tenants — per-tenant latency and blame accounting stay exact."""
+        coalescible modes (plain ed25519, secp) merge — and only with
+        the SAME mode, up to the batch width: a coalesced batch has
+        exactly one verifier, and one verifier serves one key type.
+        Comb- and bls-bound requests go solo (each binds its own device
+        program / aggregate claim).  Batches never mix tenants —
+        per-tenant latency and blame accounting stay exact."""
         q = self._queues[klass][tenant]
         # the flush reason is what made the queue ready, decided before
         # popping: a width-triggered flush whose head dispatches solo
@@ -955,8 +988,9 @@ class VerifyService:
         head = q.pop(0)
         batch = [head]
         total = len(head.items)
-        if head.mode[0] == "plain":
-            while q and q[0].mode[0] == "plain" and total < self.batch_max:
+        kind = head.mode[0]
+        if kind in _COALESCIBLE_MODES:
+            while q and q[0].mode[0] == kind and total < self.batch_max:
                 nxt = q.pop(0)
                 batch.append(nxt)
                 total += len(nxt.items)
@@ -1072,6 +1106,10 @@ class VerifyService:
             from ..models.bls_verifier import BlsAggregateVerifier
 
             return BlsAggregateVerifier()
+        if mode[0] == "secp":
+            from ..models.secp_verifier import TpuSecpBatchVerifier
+
+            return TpuSecpBatchVerifier()
         if mode[0] == "comb":
             from ..models.comb_verifier import CombBatchVerifier
 

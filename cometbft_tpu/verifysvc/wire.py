@@ -74,7 +74,9 @@ class VerifyRequest(Message):
         Field(7, "attempt", "varint"),    # 1 = first send, >1 = idempotent resend
         # validator key type of the batch ("" = ed25519 for back-compat):
         # the server routes it to the matching verifier lane
-        # (service.mode_for_key_type); an unknown value is bad_request
+        # (service.mode_for_key_type — ed25519 -> MODE_PLAIN,
+        # bls12_381 -> MODE_BLS, secp256k1/secp256k1eth -> MODE_SECP);
+        # an unknown value is bad_request
         Field(8, "key_type", "string"),
     ]
 

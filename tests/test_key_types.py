@@ -52,13 +52,15 @@ def test_testnet_key_type_flows_into_genesis(tmp_path):
         assert v.pub_key.type == "secp256k1" and len(v.address) == 20
 
 
-def test_verify_commit_secp256k1_sequential_fallback():
+def test_verify_commit_secp256k1_batch_lane():
     """A full commit signed by secp256k1 validators verifies through
-    types/validation.verify_commit (the sequential path — batch is
-    ed25519-only per crypto/batch.supports_batch_verifier)."""
+    types/validation.verify_commit — since the MODE_SECP lane (ISSUE
+    15) secp IS batchable, so a homogeneous secp set routes through
+    crypto/batch.create_batch_verifier into the verify service's
+    batched ECDSA lane instead of the sequential fallback."""
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
-    from cometbft_tpu.types.validation import verify_commit
+    from cometbft_tpu.types.validation import should_batch_verify, verify_commit
     from cometbft_tpu.types.validators import Validator, ValidatorSet
     from cometbft_tpu.types.vote import Vote
     from cometbft_tpu.wire.canonical import PRECOMMIT_TYPE, Timestamp
@@ -66,7 +68,7 @@ def test_verify_commit_secp256k1_sequential_fallback():
     keys = [
         _generate_priv_key("secp256k1", bytes([40 + i]) * 32) for i in range(4)
     ]
-    assert not crypto_batch.supports_batch_verifier(keys[0].pub_key().type)
+    assert crypto_batch.supports_batch_verifier(keys[0].pub_key().type)
     vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
     bid = BlockID(
         hash=b"\x21" * 32,
@@ -87,9 +89,10 @@ def test_verify_commit_secp256k1_sequential_fallback():
             )
         )
     commit = Commit(height=3, round=0, block_id=bid, signatures=sigs)
+    assert should_batch_verify(vals, commit)  # the secp lane engages
     verify_commit("kt-chain", vals, bid, 3, commit)  # raises on failure
 
-    # a tampered signature still fails through the fallback
+    # a tampered signature still fails through the batch lane
     sigs[2] = CommitSig(
         block_id_flag=2,
         validator_address=sigs[2].validator_address,

@@ -1,0 +1,224 @@
+"""Known-answer + adversarial corpus for the HOST secp256k1 lanes
+(crypto/secp256k1, crypto/secp256k1eth).
+
+The host lane is the fallback verdict ORACLE of the MODE_SECP
+verify-service lane (models/secp_verifier routes failover / breaker /
+backpressure / sub-threshold batches through it, and the device kernel
+is pinned bit-identical to it) — so it needs its own adversarial
+corpus, not just round-trip tests.
+
+KAT sources: the published secp256k1 RFC 6979 deterministic-nonce
+vectors (the trezor / python-ecdsa suite — RFC 6979 itself has no
+secp256k1 profile, these are the de-facto ones every wallet pins) and
+Wycheproof-style negative cases: high-s rejection, r = 0 / s = 0,
+r/s >= n, wrong lengths, non-canonical pubkey encodings, and the
+point-at-infinity / not-on-curve edges.
+"""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import secp256k1 as c
+from cometbft_tpu.crypto import secp256k1eth as eth
+from cometbft_tpu.crypto.keccak import keccak256
+
+# (privkey scalar, message, expected r, expected s) — published
+# secp256k1 RFC 6979 vectors (low-s normalized, as the Cosmos lane
+# emits them; each independently reproduced by trezor-firmware and
+# python-ecdsa test suites)
+RFC6979_VECTORS = [
+    (
+        1,
+        b"Satoshi Nakamoto",
+        0x934B1EA10A4B3C1757E2B0C017D0B6143CE3C9A7E6A4A49860D7A6AB210EE3D8,
+        0x2442CE9D2B916064108014783E923EC36B49743E2FFA1C4496F01A512AAFD9E5,
+    ),
+    (
+        1,
+        b"All those moments will be lost in time, like tears in rain. "
+        b"Time to die...",
+        0x8600DBD41E348FE5C9465AB92D23E3DB8B98B873BEECD930736488696438CB6B,
+        0x547FE64427496DB33BF66019DACBF0039C04199ABB0122918601DB38A72CFC21,
+    ),
+    (
+        c.N - 1,
+        b"Satoshi Nakamoto",
+        0xFD567D121DB66E382991534ADA77A6BD3106F0A1098C231E47993447CD6AF2D0,
+        0x6B39CD0EB1BC8603E159EF5C20A5C8AD685A45B06CE9BEBED3F153D10D93BED5,
+    ),
+    (
+        0xF8B8AF8CE3C7CCA5E300D33939540C10D45CE001B8F252BFBC57BA0342904181,
+        b"Alan Turing",
+        0x7063AE83E7F62BBB171798131B4A0564B956930092B33B07B395615D9EC7E15C,
+        0x58DFCC1E00A35E1572F366FFE34BA0FC47DB1E7189759B9FB233C5B05AB388EA,
+    ),
+]
+
+
+@pytest.mark.parametrize("d,msg,er,es", RFC6979_VECTORS)
+def test_rfc6979_known_answers(d, msg, er, es):
+    sk = c.PrivKey(d.to_bytes(32, "big"))
+    sig = sk.sign(msg)
+    assert int.from_bytes(sig[:32], "big") == er
+    assert int.from_bytes(sig[32:], "big") == es
+    assert sk.pub_key().verify_signature(msg, sig)
+
+
+def _sig(r: int, s: int) -> bytes:
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def test_high_s_rejected():
+    """The low-s malleability rule: (r, n - s) satisfies the raw ECDSA
+    equation but MUST be rejected (Cosmos rule; eth lane identically)."""
+    sk = c.PrivKey.from_seed(b"kat-high-s")
+    pk = sk.pub_key()
+    msg = b"malleability"
+    sig = sk.sign(msg)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg, _sig(r, c.N - s))
+
+
+def test_zero_and_range_scalars_rejected():
+    sk = c.PrivKey.from_seed(b"kat-range")
+    pk = sk.pub_key()
+    msg = b"ranges"
+    sig = sk.sign(msg)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    assert not pk.verify_signature(msg, _sig(0, s))  # r = 0
+    assert not pk.verify_signature(msg, _sig(r, 0))  # s = 0
+    assert not pk.verify_signature(msg, _sig(c.N, s))  # r = n
+    assert not pk.verify_signature(msg, _sig(c.N + 1, s))  # r > n
+    assert not pk.verify_signature(msg, _sig(r, c.N))  # s = n
+
+
+def test_wrong_length_signatures_rejected():
+    sk = c.PrivKey.from_seed(b"kat-len")
+    pk = sk.pub_key()
+    msg = b"lengths"
+    sig = sk.sign(msg)
+    assert not pk.verify_signature(msg, sig[:-1])
+    assert not pk.verify_signature(msg, sig + b"\x00")
+    assert not pk.verify_signature(msg, b"")
+
+
+def test_noncanonical_pubkey_encodings_rejected():
+    """Bad prefix byte, x >= p, and x-not-on-curve compressed keys must
+    all refuse to construct (PubKey validates eagerly)."""
+    sk = c.PrivKey.from_seed(b"kat-enc")
+    good = sk.pub_key().data
+    with pytest.raises(ValueError):
+        c.PubKey(b"\x04" + good[1:])  # uncompressed prefix, 33 bytes
+    with pytest.raises(ValueError):
+        c.PubKey(b"\x05" + good[1:])  # junk prefix
+    with pytest.raises(ValueError):
+        c.PubKey(bytes([2]) + c.P.to_bytes(32, "big"))  # x = p
+    with pytest.raises(ValueError):
+        c.PubKey(good[:-1])  # truncated
+    with pytest.raises(ValueError):
+        c.PubKey(good + b"\x00")  # oversized
+    # x with no curve point: x^3 + 7 a quadratic non-residue
+    x = 5
+    while True:
+        y2 = (pow(x, 3, c.P) + c.B) % c.P
+        y = pow(y2, (c.P + 1) // 4, c.P)
+        if y * y % c.P != y2:
+            break
+        x += 1
+    with pytest.raises(ValueError):
+        c.PubKey(bytes([2]) + x.to_bytes(32, "big"))
+
+
+def test_point_at_infinity_edge():
+    """u1*G + u2*Q = infinity can be forced with crafted (r, s): pick
+    k with R = k*G, then for the verifying equation to hit infinity
+    take e = -r*d*... — simplest construction: e = 0 path is blocked
+    (e is a hash), so craft via s = e/r' ... Instead pin the direct
+    edge: a signature whose verification point WOULD be infinity is
+    rejected.  With Q = -(e/r mod n)^-1... we construct it explicitly:
+    choose u1, u2 with u1*G = -(u2*Q); then r = x(inf) is undefined —
+    the host returns False via the `pt is None` branch.  We reach that
+    branch with d = -e/r mod n so that u1*G + u2*Q = (e + r*d)/s * G
+    = 0 * G."""
+    msg = b"infinity-edge"
+    e = int.from_bytes(hashlib.sha256(msg).digest(), "big") % c.N
+    # pick any r from a real curve point, then d = -e/r mod n
+    k = 12345
+    r = c._mul(k, c.G)[0] % c.N
+    d = (-e) * c._inv(r, c.N) % c.N
+    pk = c.PrivKey(d.to_bytes(32, "big")).pub_key()
+    s = 2  # any valid low-s scalar: (e + r*d)/s = 0 regardless of s
+    assert not pk.verify_signature(msg, _sig(r, s))
+
+
+# ---------------------------------------------------------------- eth lane
+
+
+def test_eth_sign_recover_roundtrip():
+    sk = eth.PrivKey.from_seed(b"kat-eth")
+    pk = sk.pub_key()
+    msg = b"eth-roundtrip"
+    sig = sk.sign(msg)
+    assert len(sig) == 65 and sig[64] in (0, 1)
+    assert pk.verify_signature(msg, sig)
+    assert eth.recover_pubkey(keccak256(msg), sig) == pk.data
+    # low-s invariant on the eth wire too
+    assert int.from_bytes(sig[32:64], "big") <= c.N // 2
+
+
+def test_eth_adversarial_edges():
+    sk = eth.PrivKey.from_seed(b"kat-eth-adv")
+    pk = sk.pub_key()
+    msg = b"eth-edges"
+    sig = sk.sign(msg)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    v = sig[64]
+    # wrong recovery id -> different recovered key -> False
+    assert not pk.verify_signature(msg, sig[:64] + bytes([v ^ 1]))
+    # v outside {0, 1}
+    assert not pk.verify_signature(msg, sig[:64] + bytes([2]))
+    # high-s
+    assert not pk.verify_signature(
+        msg, _sig(r, c.N - s) + bytes([v ^ 1])
+    )
+    # r/s = 0 and out-of-range
+    assert not pk.verify_signature(msg, _sig(0, s) + bytes([v]))
+    assert not pk.verify_signature(msg, _sig(r, 0) + bytes([v]))
+    assert not pk.verify_signature(msg, _sig(c.N, s) + bytes([v]))
+    # wrong length
+    assert not pk.verify_signature(msg, sig[:64])
+    assert not pk.verify_signature(msg, sig + b"\x00")
+    # tampered message
+    assert not pk.verify_signature(msg + b"!", sig)
+
+
+def test_eth_pubkey_encoding_rejected():
+    sk = eth.PrivKey.from_seed(b"kat-eth-enc")
+    good = sk.pub_key().data
+    with pytest.raises(ValueError):
+        eth.PubKey(b"\x02" + good[1:33])  # compressed wire, wrong lane
+    with pytest.raises(ValueError):
+        eth.PubKey(b"\x00" + good[1:])  # bad prefix
+    with pytest.raises(ValueError):
+        eth.PubKey(good[:-1])  # truncated
+    # off-curve (x, y): flip one byte of y
+    bad = bytearray(good)
+    bad[64] ^= 1
+    with pytest.raises(ValueError):
+        eth.PubKey(bytes(bad))
+
+
+def test_cross_lane_verdicts_disagree_on_wire_shape():
+    """A cosmos key's signature is not a valid eth signature and vice
+    versa — the wire shapes (33/64 vs 65/65, SHA-256 vs Keccak) are
+    the lane discriminator models/secp_verifier keys on."""
+    cs = c.PrivKey.from_seed(b"kat-cross")
+    es = eth.PrivKey.from_seed(b"kat-cross")
+    msg = b"cross-lane"
+    assert not es.pub_key().verify_signature(msg, cs.sign(msg))
+    assert not cs.pub_key().verify_signature(msg, es.sign(msg))

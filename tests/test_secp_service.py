@@ -1,0 +1,335 @@
+"""The MODE_SECP lane behind the verify service (ISSUE 15 plumbing):
+key-type routing, same-mode coalescing (secp merges with secp, never
+with plain/bls), host-fallback bit-identity on the failover / error /
+backpressure / breaker paths, the remote plane carrying key_type, and
+the key-typed CheckTx envelope end to end.
+
+Everything here is fast-tier and pure-host on the secp side: corpus
+sizes stay below COMETBFT_TPU_SECP_DEVICE_MIN, so TpuSecpBatchVerifier
+host-routes and no XLA program compiles — kernel bit-identity is
+pinned by tests/test_secp_ops.py.
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import secp256k1 as secp
+from cometbft_tpu.crypto import secp256k1eth as seth
+from cometbft_tpu.models import secp_verifier as M
+from cometbft_tpu.utils import fail
+from cometbft_tpu.verifysvc import checktx
+from cometbft_tpu.verifysvc import server as vserver
+from cometbft_tpu.verifysvc.client import ServiceBatchVerifier, resolve_mode
+from cometbft_tpu.verifysvc.service import (
+    MODE_BLS,
+    MODE_PLAIN,
+    MODE_SECP,
+    Klass,
+    VerifyService,
+    _HostBatchVerifier,
+    _host_verify_items,
+    mode_for_key_type,
+    mode_key_type,
+    reset_global_service,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    M.reset_caches()
+    fail.clear_all()
+    yield
+    fail.clear_all()
+    reset_global_service()
+    M.reset_caches()
+
+
+def _secp_corpus(seed: bytes = b"corpus"):
+    """Cosmos + eth rows with tampered/invalid entries; returns
+    (items, expected per-row)."""
+    c1 = secp.PrivKey.from_seed(seed + b"-c1")
+    c2 = secp.PrivKey.from_seed(seed + b"-c2")
+    e1 = seth.PrivKey.from_seed(seed + b"-e1")
+    msg = b"secp-svc-" + seed
+    good_c = (c1.pub_key().data, msg, c1.sign(msg))
+    wrong_key = (c2.pub_key().data, msg, c1.sign(msg))
+    good_e = (e1.pub_key().data, msg, e1.sign(msg))
+    sig = bytearray(c1.sign(msg))
+    sig[40] ^= 1
+    tampered = (c1.pub_key().data, msg, bytes(sig))
+    items = [good_c, wrong_key, good_e, tampered]
+    return items, [True, False, True, False]
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_key_type_routing():
+    assert crypto_batch.supports_batch_verifier("secp256k1")
+    assert crypto_batch.supports_batch_verifier("secp256k1eth")
+    assert resolve_mode(None, key_type="secp256k1") == MODE_SECP
+    assert resolve_mode(None, key_type="secp256k1eth") == MODE_SECP
+    assert resolve_mode([b"x" * 33] * 4, key_type="secp256k1") == MODE_SECP
+    assert mode_key_type(MODE_SECP) == "secp256k1"
+    assert mode_for_key_type("secp256k1") == MODE_SECP
+    assert mode_for_key_type("secp256k1eth") == MODE_SECP
+    assert mode_for_key_type("ed25519") == MODE_PLAIN
+    assert mode_for_key_type("dsa") is None
+
+    v = crypto_batch.create_batch_verifier("secp256k1")
+    assert isinstance(v, ServiceBatchVerifier) and v._mode == MODE_SECP
+    v = crypto_batch.create_batch_verifier("secp256k1eth")
+    assert isinstance(v, ServiceBatchVerifier) and v._mode == MODE_SECP
+
+
+def test_cpu_backend_returns_host_secp_verifier(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+    v = crypto_batch.create_batch_verifier("secp256k1")
+    assert isinstance(v, M.CpuSecpBatchVerifier)
+    v = crypto_batch.create_batch_verifier("secp256k1eth")
+    assert isinstance(v, M.CpuSecpBatchVerifier)
+
+
+def test_client_add_validates_secp_sizes():
+    v = ServiceBatchVerifier(Klass.MEMPOOL, MODE_SECP, service=VerifyService())
+    v.add(b"\x02" + b"\x01" * 32, b"m", b"\x02" * 64)  # cosmos shapes
+    v.add(b"\x04" + b"\x01" * 64, b"m", b"\x02" * 65)  # eth shapes
+    with pytest.raises(ValueError):
+        v.add(b"\x01" * 32, b"m", b"\x02" * 64)  # ed25519-sized pub
+    with pytest.raises(ValueError):
+        v.add(b"\x02" + b"\x01" * 32, b"m", b"\x02" * 63)
+
+
+def test_secp_coalesces_with_secp_but_never_with_plain():
+    """Two queued secp requests merge into ONE dispatched batch (rows
+    are independent — the scheduler treats the mode like plain), but a
+    plain request between dispatch epochs never rides with them."""
+    svc = VerifyService(failover=False, deadlines_ms={k: 50 for k in Klass})
+    seen = []
+    real = svc._make_verifier
+
+    def spy(mode):
+        seen.append(mode[0])
+        return real(mode)
+
+    svc._make_verifier = spy
+    items, expected = _secp_corpus()
+    k = ed.PrivKey.from_seed(b"\x09" * 32)
+    ed_items = [(k.pub_key().data, b"m", k.sign(b"m"))]
+    try:
+        t1 = svc.submit(ed_items, Klass.BACKGROUND)
+        t2 = svc.submit(items[:2], Klass.BACKGROUND, MODE_SECP)
+        t3 = svc.submit(items[2:], Klass.BACKGROUND, MODE_SECP)
+        t4 = svc.submit(ed_items, Klass.BACKGROUND)
+        assert t1.collect(30) == (True, [True])
+        # per-request blame split across the coalesced batch
+        assert t2.collect(30) == (False, expected[:2])
+        assert t3.collect(30) == (False, expected[2:])
+        assert t4.collect(30) == (True, [True])
+        # the two secp requests shared ONE verifier construction
+        assert seen.count("secp") == 1
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------- host-fallback bit-identity
+
+
+def test_host_verify_items_mode_aware():
+    items, expected = _secp_corpus()
+    assert _host_verify_items(items, MODE_SECP) == (False, expected)
+    hbv = _HostBatchVerifier(MODE_SECP)
+    for it in items:
+        hbv.add(*it)
+    assert hbv.collect(hbv.submit()) == (False, expected)
+
+
+def test_secp_verdicts_identical_across_service_paths():
+    """The same corpus through (a) normal dispatch, (b) a tripped
+    (cpu_fallback) service, and (c) the dispatch-error host re-verify
+    path resolves to the SAME verdict bitmap in add() order."""
+    items, expected = _secp_corpus(b"paths")
+    want = (False, expected)
+
+    svc = VerifyService(failover=False)
+    try:
+        assert svc.verify(items, Klass.CONSENSUS, MODE_SECP) == want
+    finally:
+        svc.stop()
+
+    svc = VerifyService(
+        failover=True,
+        probe_fn=lambda _t: type(
+            "R", (), {"ok": False, "detail": "suppressed"}
+        )(),
+    )
+    try:
+        svc._ensure_started()
+        assert svc.trip_to_cpu("test: secp degraded path")
+        assert svc.backend_mode == "cpu_fallback"
+        assert svc.verify(items, Klass.CONSENSUS, MODE_SECP) == want
+    finally:
+        svc.stop()
+
+    svc = VerifyService(failover=True)
+    try:
+        fail.arm("fail_dispatch", 1.0)
+        t = svc.submit(items, Klass.CONSENSUS, MODE_SECP)
+        assert t.collect(30) == want
+    finally:
+        fail.clear_all()
+        svc.stop()
+
+
+def test_malformed_items_resolve_false_instead_of_wedging():
+    """key_type says secp, items are ed25519-sized (reachable via the
+    remote wire): dispatch-time add() raises, the host re-verify fills
+    unchecked and judges False — the plane must keep serving."""
+    svc = VerifyService(failover=True)
+    try:
+        bad = [(b"\x01" * 32, b"m", b"\x02" * 64)]
+        t = svc.submit(bad, Klass.MEMPOOL, MODE_SECP)
+        assert t.collect(30) == (False, [False])
+        items, expected = _secp_corpus(b"after")
+        assert svc.verify(items, Klass.MEMPOOL, MODE_SECP) == (False, expected)
+    finally:
+        svc.stop()
+
+
+def test_backpressure_fallback_uses_secp_host_path():
+    svc = VerifyService(queue_max=1, failover=False)
+    items, expected = _secp_corpus(b"bp")
+    try:
+        v = ServiceBatchVerifier(Klass.MEMPOOL, MODE_SECP, service=svc)
+        for it in items:
+            v.add(*it)
+        assert v.verify() == (False, expected)  # inline host fallback
+    finally:
+        svc.stop()
+
+
+def test_breaker_open_builds_secp_host_verifier():
+    svc = VerifyService(failover=False)
+
+    class _DeadRemote:
+        def available(self):
+            return False
+
+        def close(self):
+            pass
+
+        def stats(self):
+            return {}
+
+    svc._remote = _DeadRemote()
+    bv = svc._make_verifier(MODE_SECP)
+    assert isinstance(bv, _HostBatchVerifier)
+    assert isinstance(bv._cpu, M.CpuSecpBatchVerifier)
+    assert not isinstance(svc._make_verifier(MODE_PLAIN)._cpu,
+                          M.CpuSecpBatchVerifier)
+    assert not isinstance(svc._make_verifier(MODE_BLS)._cpu,
+                          M.CpuSecpBatchVerifier)
+
+
+# ------------------------------------------------------------- remote
+
+
+def _host_service() -> VerifyService:
+    svc = VerifyService(failover=False)
+    svc._make_verifier = lambda mode: _HostBatchVerifier(mode)
+    return svc
+
+
+def test_remote_plane_routes_secp_by_key_type():
+    """Remote == in-process == host for a secp corpus: the wire carries
+    key_type=secp256k1, the plane routes MODE_SECP server-side,
+    verdicts and blame order survive the round trip — for BOTH wire
+    shapes in one batch."""
+    srv = vserver.VerifyServer(
+        "127.0.0.1:0", service=_host_service(), idle_timeout_s=0.2
+    )
+    srv.start()
+    svc = VerifyService(
+        remote_addr=srv.addr,
+        remote_opts=dict(budget_s=10.0, breaker_fails=2, backoff_s=0.05,
+                         probe_period_s=0.1, probation_ok=2),
+    )
+    try:
+        items, expected = _secp_corpus(b"remote")
+        want = (False, expected)
+        assert svc.verify(items, Klass.CONSENSUS, MODE_SECP) == want
+        assert _host_verify_items(items, MODE_SECP) == want
+        assert svc.stats()["remote"] is not None
+    finally:
+        svc.stop()
+        srv.stop()
+
+
+# ----------------------------------------------------- CheckTx end-to-end
+
+
+def test_checktx_secp_envelopes_route_and_verify():
+    """Key-typed envelopes through verify_tx_signature: cosmos and eth
+    secp txs verify through MODE_SECP, tampered ones judge False, and
+    the spied mode proves the routing."""
+    svc = VerifyService(failover=False)
+    seen = []
+    real = svc._make_verifier
+
+    def spy(mode):
+        seen.append(mode[0])
+        return real(mode)
+
+    svc._make_verifier = spy
+    try:
+        ck = secp.PrivKey.from_seed(b"ck-cosmos")
+        ek = seth.PrivKey.from_seed(b"ck-eth")
+        good_c = checktx.make_signed_tx(ck, b"cosmos tx")
+        good_e = checktx.make_signed_tx(ek, b"eth tx")
+        assert checktx.verify_tx_signature(good_c, service=svc) is True
+        assert checktx.verify_tx_signature(good_e, service=svc) is True
+        bad = bytearray(good_c)
+        bad[-1] ^= 1  # corrupt payload
+        assert checktx.verify_tx_signature(bytes(bad), service=svc) is False
+        bad_e = bytearray(good_e)
+        bad_e[len(checktx.MAGIC_V2) + 1 + 65 + 10] ^= 1  # corrupt sig
+        assert checktx.verify_tx_signature(bytes(bad_e), service=svc) is False
+        assert seen and set(seen) == {"secp"}
+        # unsigned passes through untouched, ed25519 still MODE_PLAIN
+        assert checktx.verify_tx_signature(b"unsigned", service=svc) is None
+        edk = ed.PrivKey.from_seed(b"n" * 32)
+        assert checktx.verify_tx_signature(
+            checktx.make_signed_tx(edk, b"ed"), service=svc
+        ) is True
+        assert seen[-1] == "plain"
+    finally:
+        svc.stop()
+
+
+def test_checktx_secp_host_fallback_on_backpressure():
+    svc = VerifyService(queue_max=1, failover=False)
+    try:
+        svc.submit(
+            [(b"\x01" * 32, b"clog", b"\x02" * 64)], Klass.MEMPOOL
+        )  # queue at its bound
+        ck = secp.PrivKey.from_seed(b"ck-bp")
+        tx = checktx.make_signed_tx(ck, b"still-works")
+        assert checktx.verify_tx_signature(tx, service=svc) is True
+    finally:
+        svc.stop()
+
+
+def test_checktx_host_verify_is_mode_cpu_verifier():
+    """The inline host verdict goes through cpu_verifier_for_mode —
+    the ONE per-mode fallback seam — for every key type."""
+    ck = secp.PrivKey.from_seed(b"hv")
+    payload = b"hv-payload"
+    tx = checktx.make_signed_tx(ck, payload)
+    kt, pub, sig, _ = checktx.parse_signed_tx(tx)
+    assert kt == "secp256k1"
+    assert checktx._host_verify(
+        MODE_SECP, pub, checktx.SIGN_DOMAIN + payload, sig
+    ) is True
+    # malformed lengths judge False (never raise) through the seam
+    assert checktx._host_verify(MODE_SECP, b"x", b"m", b"y") is False

@@ -57,7 +57,7 @@ def test_tenant_chain_templates_are_deterministic_and_tampered():
     from cometbft_tpu.verifysvc import checktx
 
     for tx, good in a1.txs[:10]:
-        pub, sig, payload = checktx.parse_signed_tx(tx)
+        _, pub, sig, payload = checktx.parse_signed_tx(tx)
         assert (
             host.verify_signature(pub, checktx.SIGN_DOMAIN + payload, sig)
             is good

@@ -671,10 +671,59 @@ def test_checktx_collect_timeout_falls_back_to_host(svc, monkeypatch):
 def test_signed_tx_envelope_roundtrip():
     sk = host.PrivKey.from_seed(b"e" * 32)
     tx = checktx.make_signed_tx(sk, b"payload-bytes")
-    pub, sig, payload = checktx.parse_signed_tx(tx)
+    kt, pub, sig, payload = checktx.parse_signed_tx(tx)
+    assert kt == "ed25519"
     assert pub == sk.pub_key().data and payload == b"payload-bytes"
     assert checktx.parse_signed_tx(b"unsigned") is None
     assert checktx.parse_signed_tx(checktx.MAGIC + b"short") is None
+
+
+def test_legacy_envelope_wire_unchanged_after_key_type_byte(svc):
+    """Envelope versioning pin (ISSUE 15): the PRE-key-type v1 wire —
+    MAGIC | pub(32) | sig(64) | payload, built by hand exactly as every
+    pre-v2 writer emitted it — must still parse to the same fields and
+    verify unchanged, and ed25519 make_signed_tx must still EMIT that
+    exact legacy wire (old planes keep understanding new txs)."""
+    s = svc()
+    sk = host.PrivKey.from_seed(b"v1" * 16)
+    payload = b"old-wire-payload"
+    sig = sk.sign(checktx.SIGN_DOMAIN + payload)
+    legacy = checktx.MAGIC + sk.pub_key().data + sig + payload
+    # the writer still emits byte-identical v1 for ed25519 keys
+    assert checktx.make_signed_tx(sk, payload) == legacy
+    kt, pub, psig, ppayload = checktx.parse_signed_tx(legacy)
+    assert (kt, pub, psig, ppayload) == ("ed25519", sk.pub_key().data, sig, payload)
+    assert checktx.verify_tx_signature(legacy, service=s) is True
+    # tampering still detected through the legacy parse
+    bad = bytearray(legacy)
+    bad[-1] ^= 1
+    assert checktx.verify_tx_signature(bytes(bad), service=s) is False
+
+
+def test_v2_envelope_key_type_byte(svc):
+    """The v2 wire: MAGIC_V2 | key_type(1) | pub | sig | payload, with
+    per-type widths; unknown key-type bytes and truncated envelopes
+    pass through unsigned (None) exactly like short v1 headers."""
+    from cometbft_tpu.crypto import secp256k1 as secp
+
+    s = svc()
+    sk = secp.PrivKey.from_seed(b"v2-secp")
+    tx = checktx.make_signed_tx(sk, b"typed-payload")
+    assert tx.startswith(checktx.MAGIC_V2)
+    assert tx[len(checktx.MAGIC_V2)] == checktx.KEY_TYPE_BYTES["secp256k1"]
+    kt, pub, sig, payload = checktx.parse_signed_tx(tx)
+    assert kt == "secp256k1" and len(pub) == 33 and len(sig) == 64
+    assert payload == b"typed-payload"
+    # a hand-built v2 ed25519 envelope parses too (the byte is enough)
+    ed = host.PrivKey.from_seed(b"m" * 32)
+    esig = ed.sign(checktx.SIGN_DOMAIN + b"p")
+    v2ed = checktx.MAGIC_V2 + b"\x00" + ed.pub_key().data + esig + b"p"
+    assert checktx.parse_signed_tx(v2ed) == ("ed25519", ed.pub_key().data, esig, b"p")
+    assert checktx.verify_tx_signature(v2ed, service=s) is True
+    # unknown key type byte / truncation -> unsigned pass-through
+    assert checktx.parse_signed_tx(checktx.MAGIC_V2 + b"\x7f" + b"x" * 200) is None
+    assert checktx.parse_signed_tx(checktx.MAGIC_V2 + b"\x01" + b"x" * 10) is None
+    assert checktx.parse_signed_tx(checktx.MAGIC_V2) is None
 
 
 def test_checktx_bit_identical_to_host_path(svc):
@@ -702,7 +751,7 @@ def test_checktx_bit_identical_to_host_path(svc):
         parsed = checktx.parse_signed_tx(tx)
         if parsed is None:
             return None
-        pub, sig, payload = parsed
+        _, pub, sig, payload = parsed
         return host.verify_signature(pub, checktx.SIGN_DOMAIN + payload, sig)
 
     for tx in corpus:
