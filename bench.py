@@ -584,8 +584,17 @@ def _run_secp() -> None:
       The host path is pure-Python bigint ECDSA (~tens of ms per
       signature), so it is measured on min(n, BENCH_SECP_HOST_CAP
       [default 64]) rows and reported per-signature plus extrapolated
-      (``host_measured_rows`` marks the cap — an extrapolated number is
-      never passed off as a measured one).
+      (``host_extrapolated`` carries the flag AND the cap AND the
+      measured-subset size — an extrapolated number is never passed
+      off as a measured one, and the JSON line alone says how much was
+      actually measured).
+    * **phase attribution** (BENCH_SECP_PHASES=0 to skip): the
+      top-size dispatch split into hash / decode / assembly / h2d /
+      kernel / fetch (models/secp_verifier.LAST_PHASES), captured for
+      the default shape (GLV + fused on-device hashing) AND the PR-15
+      witness (noglv + host hashing, BENCH_SECP_PHASE_WITNESS=0 to
+      skip its extra compile) — the GLV and hashing-residency deltas
+      ride in the same JSON line as the sweep.
     * **mixed ingest round** (BENCH_SECP_MIXED_SECONDS, default 10):
       concurrent ed25519-commit consensus load plus TWO mempool CheckTx
       sender pools — ed25519 (v1 envelopes, MODE_PLAIN) and secp256k1
@@ -661,12 +670,15 @@ def _run_secp() -> None:
             return dt
 
         host_ms = p50(run_host)
-        row["host_measured_rows"] = hn
         row["host_p50_ms_per_sig"] = round(host_ms / hn, 3)
         row["host_p50_ms"] = round(
             host_ms if hn == n else host_ms / hn * n, 3
         )
-        row["host_extrapolated"] = hn != n
+        row["host_extrapolated"] = {
+            "extrapolated": hn != n,
+            "cap": host_cap,
+            "measured_rows": hn,
+        }
         row["tpu_speedup_vs_host"] = round(
             row["host_p50_ms"] / row["tpu_p50_ms"], 2
         ) if row["tpu_p50_ms"] else None
@@ -674,6 +686,55 @@ def _run_secp() -> None:
     REPORT["sweep"] = sweep
     top = sweep[str(max(sizes))]
     REPORT["value"] = top["tpu_p50_ms"]
+
+    # ---- phase attribution of the top-size dispatch: default shape
+    # (GLV + fused hashing) vs the PR-15 witness (noglv + host
+    # hashing) — the same LAST_PHASES capture scripts/
+    # profile_secp_phases.py prints, embedded in the JSON line
+    if os.environ.get("BENCH_SECP_PHASES", "1") != "0":
+        import statistics
+
+        phase_keys = ("hash_ms", "decode_ms", "assembly_ms",
+                      "h2d_ms", "kernel_ms", "fetch_ms")
+        cfgs: dict[str, dict[str, str]] = {"glv_fused": {}}
+        if os.environ.get("BENCH_SECP_PHASE_WITNESS", "1") != "0":
+            cfgs["noglv_host"] = {
+                "COMETBFT_TPU_SECP_GLV": "0",
+                "COMETBFT_TPU_SECP_HASH_DEVICE_MIN": "0",
+            }
+        pbatch = items[:max(sizes)]
+        attribution: dict[str, dict] = {}
+        for cname, cenv in cfgs.items():
+            saved = {k: os.environ.get(k) for k in cenv}
+            os.environ.update(cenv)
+            try:
+                mv._verify_items(pbatch, use_device=True)  # warm variant
+                samples: dict[str, list[float]] = {k: [] for k in phase_keys}
+                walls = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    mv._verify_items(pbatch, use_device=True)
+                    walls.append((time.perf_counter() - t0) * 1e3)
+                    for k in phase_keys:
+                        samples[k].append(mv.LAST_PHASES.get(k, 0.0))
+                wall = statistics.median(walls)
+                attribution[cname] = {
+                    "wall_p50_ms": round(wall, 3),
+                    "hash_device": bool(mv.LAST_PHASES.get("hash_device")),
+                    **{k: {
+                        "p50_ms": round(statistics.median(samples[k]), 3),
+                        "share_of_wall": round(
+                            statistics.median(samples[k]) / wall, 3
+                        ) if wall else 0.0,
+                    } for k in phase_keys},
+                }
+            finally:
+                for k, old in saved.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+        REPORT["phase_attribution"] = attribution
 
     # ---- mixed ed25519 + secp256k1 ingest round
     seconds = float(os.environ.get("BENCH_SECP_MIXED_SECONDS", "10"))

@@ -206,10 +206,18 @@ KERNELS: tuple[Kernel, ...] = (
     ),
     # ---- ops/secp256k1.py — the batched ECDSA lane (MODE_SECP):
     # range/low-s validation, Montgomery batch inversion (s^-1 mod n and
-    # the affine z^-1 mod p, one Fermat chain each), Shamir double-scalar
-    # u1*G + u2*Q, and the cosmos/eth verdicts — ONE fused program.  The
-    # G window table is host-precomputed and device_put-resident (PR-11
-    # pattern: never a table-build compile), passed as the last argument.
+    # the affine z^-1 mod p, one Fermat chain each), the scalar walk
+    # u1*G + u2*Q, and the cosmos/eth/ecrecover verdicts — ONE fused
+    # program.  The G window table is host-precomputed and
+    # device_put-resident (PR-11 pattern: never a table-build compile),
+    # passed as the last tensor argument.  TWO static axes, each the
+    # COMB_TREE witness pattern: ``glv`` selects the GLV endomorphism
+    # quad-scalar walk over 33 windows (True, the default) vs the plain
+    # 66-window Shamir chain (False, the bit-exactness witness —
+    # COMETBFT_TPU_SECP_GLV=0), and ``recover`` adds the ecrecover
+    # R-lift (sqrt chain) + recovered-address Keccak, traced only when
+    # a batch actually carries ecrecover rows.  All four combinations
+    # are declared so none can drift unfingerprinted.
     Kernel(
         name="secp256k1_verify_batch",
         fn="cometbft_tpu.ops.secp256k1:verify_batch",
@@ -217,10 +225,97 @@ KERNELS: tuple[Kernel, ...] = (
             i32(N, 22), i32(N, 22), boolean(N),  # pubkey x, y, decode-ok
             i32(N, 22), i32(N, 22), i32(N, 22),  # e, r, s (raw 256-bit)
             boolean(N), i32(N),  # eth-row flag, recovery id
+            boolean(N), u8(N, 20),  # ecrecover-row flag, sender address
             i32(16, 66),  # resident G window table (flat Jacobian rows)
         ),
         out=(boolean(N),),
-        max_eqns=18_000,  # measured 13,688
+        static_kwargs=(("glv", True), ("recover", False)),
+        max_eqns=28_000,  # measured 21,248
+    ),
+    Kernel(
+        name="secp256k1_verify_batch_recover",
+        fn="cometbft_tpu.ops.secp256k1:verify_batch",
+        args=(
+            i32(N, 22), i32(N, 22), boolean(N),
+            i32(N, 22), i32(N, 22), i32(N, 22),
+            boolean(N), i32(N), boolean(N), u8(N, 20),
+            i32(16, 66),
+        ),
+        out=(boolean(N),),
+        static_kwargs=(("glv", True), ("recover", True)),
+        max_eqns=29_500,  # measured 22,694
+    ),
+    Kernel(
+        name="secp256k1_verify_batch_noglv",
+        fn="cometbft_tpu.ops.secp256k1:verify_batch",
+        args=(
+            i32(N, 22), i32(N, 22), boolean(N),
+            i32(N, 22), i32(N, 22), i32(N, 22),
+            boolean(N), i32(N), boolean(N), u8(N, 20),
+            i32(16, 66),
+        ),
+        out=(boolean(N),),
+        static_kwargs=(("glv", False), ("recover", False)),
+        max_eqns=18_000,  # measured 13,688 (the pre-GLV program, unchanged)
+    ),
+    Kernel(
+        name="secp256k1_verify_batch_noglv_recover",
+        fn="cometbft_tpu.ops.secp256k1:verify_batch",
+        args=(
+            i32(N, 22), i32(N, 22), boolean(N),
+            i32(N, 22), i32(N, 22), i32(N, 22),
+            boolean(N), i32(N), boolean(N), u8(N, 20),
+            i32(16, 66),
+        ),
+        out=(boolean(N),),
+        static_kwargs=(("glv", False), ("recover", True)),
+        max_eqns=20_000,  # measured 15,134
+    ),
+    # the fused hash->verify program: padded message bytes in, verdicts
+    # out — SHA-256 (cosmos) and Keccak-256 (eth/ecrecover) digests
+    # computed on device and multiplexed per row, then the verify_batch
+    # body.  Trace shape = the CheckTx envelope bucket
+    # (COMETBFT_TPU_SECP_HASH_MAX_LEN=119: 2 SHA blocks, 1 Keccak block).
+    Kernel(
+        name="secp256k1_hash_verify",
+        fn="cometbft_tpu.ops.secp256k1:hash_verify_batch",
+        args=(
+            u8(N, 2, 64), i32(N),  # SHA-256-padded blocks + active
+            u8(N, 1, 136), i32(N),  # Keccak-padded blocks + active
+            i32(N, 22), i32(N, 22), boolean(N),  # pubkey x, y, decode-ok
+            i32(N, 22), i32(N, 22),  # r, s
+            boolean(N), i32(N), boolean(N), u8(N, 20),
+            i32(16, 66),
+        ),
+        out=(boolean(N),),
+        static_kwargs=(("glv", True), ("recover", False)),
+        max_eqns=29_000,  # measured 22,111
+    ),
+    Kernel(
+        name="secp256k1_hash_verify_recover",
+        fn="cometbft_tpu.ops.secp256k1:hash_verify_batch",
+        args=(
+            u8(N, 2, 64), i32(N),
+            u8(N, 1, 136), i32(N),
+            i32(N, 22), i32(N, 22), boolean(N),
+            i32(N, 22), i32(N, 22),
+            boolean(N), i32(N), boolean(N), u8(N, 20),
+            i32(16, 66),
+        ),
+        out=(boolean(N),),
+        static_kwargs=(("glv", True), ("recover", True)),
+        max_eqns=30_500,  # measured 23,557
+    ),
+    # ---- ops/keccak.py — batched Keccak-256 (the Ethereum 0x01-padded
+    # variant): (hi, lo) uint32 lane halves, 24 rounds as ONE fori_loop
+    # body, rho/pi statically unrolled — the hashing half the fused secp
+    # program inlines, also dispatched standalone via keccak256_device.
+    Kernel(
+        name="keccak256_blocks",
+        fn="cometbft_tpu.ops.keccak:keccak256_blocks",
+        args=(u8(N, 1, 136), i32(N)),
+        out=(u8(N, 32),),
+        max_eqns=700,  # measured 577 (fori-rolled: O(1) in round count)
     ),
     # ---- models/comb_verifier.py — cache assembly + the device program
     Kernel(
@@ -289,6 +384,8 @@ JIT_SITES: dict[str, str] = {
         "bls381_validate_aggregate_g1"
     ),
     "cometbft_tpu/ops/secp256k1.py::verify_batch": "secp256k1_verify_batch",
+    "cometbft_tpu/ops/secp256k1.py::hash_verify_batch": "secp256k1_hash_verify",
+    "cometbft_tpu/ops/keccak.py::keccak256_blocks": "keccak256_blocks",
     # models/verifier.py jits ops/ed25519.verify_batch (the uncached path)
     "cometbft_tpu/models/verifier.py::verify_batch": "ed25519_verify_batch",
     "cometbft_tpu/models/comb_verifier.py::_assemble_churn": "comb_assemble_churn",
@@ -341,6 +438,14 @@ COLLECT_BOUNDARIES: dict[str, str] = {
     "cometbft_tpu/ops/secp256k1.py::verify_batch_device": (
         "the secp ECDSA bridge: one blocking fetch of the per-row "
         "verdict bits"
+    ),
+    "cometbft_tpu/ops/secp256k1.py::hash_verify_batch_device": (
+        "the fused hash->verify bridge: one blocking fetch of the "
+        "per-row verdict bits"
+    ),
+    "cometbft_tpu/ops/keccak.py::keccak256_device": (
+        "the batched Keccak-256 bridge: one blocking fetch of the "
+        "digests"
     ),
     "cometbft_tpu/ops/secp256k1.py::from_limbs": (
         "host-side limb decoder (tests); receives already-fetched "

@@ -36,7 +36,8 @@ def supports_batch_verifier(key_type: str) -> bool:
     ConsensusParams.validator.pub_key_types — that is the whole
     backend-selection story (docs/verify_service.md)."""
     return key_type in (
-        ed25519.KEY_TYPE, BLS_KEY_TYPE, "secp256k1", "secp256k1eth"
+        ed25519.KEY_TYPE, BLS_KEY_TYPE,
+        "secp256k1", "secp256k1eth", "ecrecover",
     )
 
 
@@ -98,7 +99,7 @@ def create_batch_verifier(
             from ..models.bls_verifier import CpuBlsBatchVerifier
 
             return CpuBlsBatchVerifier()
-        if key_type in ("secp256k1", "secp256k1eth"):
+        if key_type in ("secp256k1", "secp256k1eth", "ecrecover"):
             from ..models.secp_verifier import CpuSecpBatchVerifier
 
             return CpuSecpBatchVerifier()

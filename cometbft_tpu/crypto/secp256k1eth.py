@@ -23,6 +23,13 @@ PRIVKEY_SIZE = 32
 SIGNATURE_SIZE = 65  # R || S || V
 ENABLED = True
 
+# The ecrecover wire shape: real Ethereum txs carry no pubkey at all —
+# the verifier recovers Q from the signature and compares the derived
+# address against the 20-byte sender.  RECOVER_KEY_TYPE is that third
+# wire shape's key type (verifysvc MODE_SECP routing; checktx byte 3).
+RECOVER_KEY_TYPE = "ecrecover"
+ADDRESS_SIZE = 20
+
 
 def _uncompress_bytes(pt) -> bytes:
     x, y = pt
@@ -69,6 +76,25 @@ def recover_pubkey(msg_hash: bytes, sig: bytes) -> bytes:
     return _uncompress_bytes(pt)
 
 
+def verify_address_signature(addr: bytes, msg: bytes, sig: bytes) -> bool:
+    """The true ecrecover verdict: recover the signer from R||S||V over
+    Keccak256(msg) and compare Keccak256(pubkey[1:])[12:] against the
+    20-byte sender address.  Same gauntlet as PubKey.verify_signature
+    (high-S rejected up front, every recover failure judges False) —
+    this is the host oracle the device ecrecover lane is bit-identical
+    to (ops/secp256k1.verify_batch with recover rows)."""
+    if len(addr) != ADDRESS_SIZE or len(sig) != SIGNATURE_SIZE:
+        return False
+    s = int.from_bytes(sig[32:64], "big")
+    if s > _c.N // 2:
+        return False
+    try:
+        recovered = recover_pubkey(keccak256(msg), sig)
+    except ValueError:
+        return False
+    return keccak256(recovered[1:])[12:] == addr
+
+
 @dataclass(frozen=True)
 class PubKey:
     data: bytes  # 65-byte uncompressed
@@ -101,6 +127,32 @@ class PubKey:
         except ValueError:
             return False
         return recovered == self.data
+
+
+@dataclass(frozen=True)
+class RecoverPubKey:
+    """The ecrecover 'pubkey': just the 20-byte sender address — what an
+    Ethereum tx actually carries.  Quacks like the other key types so
+    the verify plane's host fallbacks treat it uniformly."""
+
+    data: bytes  # 20-byte address
+
+    def __post_init__(self):
+        if len(self.data) != ADDRESS_SIZE:
+            raise ValueError("ecrecover key must be a 20-byte address")
+
+    @property
+    def type(self) -> str:
+        return RECOVER_KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def address(self) -> bytes:
+        return self.data
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return verify_address_signature(self.data, msg, sig)
 
 
 @dataclass(frozen=True)
@@ -161,3 +213,16 @@ class PrivKey:
             return (
                 r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
             )
+
+
+class RecoverPrivKey(PrivKey):
+    """Signs exactly like PrivKey (same R||S||V wire) but identifies as
+    the ecrecover key type: pub_key() is the 20-byte address, so signed
+    envelopes carry no pubkey — the production Ethereum tx shape."""
+
+    @property
+    def type(self) -> str:
+        return RECOVER_KEY_TYPE
+
+    def pub_key(self) -> RecoverPubKey:
+        return RecoverPubKey(super().pub_key().address())

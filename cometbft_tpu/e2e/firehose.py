@@ -1,0 +1,347 @@
+"""CheckTx firehose soak: production-shaped secp ingest at volume.
+
+The acceptance proof of the Ethereum-rate ingest lane (PAPERS.md
+arXiv:2112.02229): >=100k signed transactions — all three
+secp wire shapes (Cosmos 33/64, Ethereum 65/65, ecrecover 20/65)
+interleaved with repeat senders, exactly the shape a public mempool
+sees — pushed through ONE verify service by concurrent sender threads,
+with periodic adversarial STORM windows (tampered signatures, high-s
+rewrites, wrong recover addresses, r >= n, truncated envelopes) mixed
+into the stream.  One machine-readable SLO artifact, soak.py-shaped:
+
+  * **slo_latency** — per-key-type CheckTx latency percentiles, p99
+    bounded per key type (the Ethereum-shaped ingest claim, measured
+    end-to-end through checktx.verify_tx_signature: parse -> schedule
+    -> coalesce -> dispatch -> settle);
+  * **zero_drift** — every verdict, storm rows included, bit-identical
+    to its construction-time host-oracle expectation
+    (models/secp_verifier._host_verify_one — the gauntlet the kernel
+    is pinned against);
+  * **cache_hit_rate** — repeat senders must actually hit the decoded-
+    pubkey cache: the ``verify_svc_secp_pubkey_cache_total`` counter's
+    hit share over the run must clear ``cache_hit_min`` (ecrecover
+    rows never decode, so they are outside the denominator by
+    construction);
+  * **no_leak** — RSS / thread / queue-depth watermarks flat across
+    the run (utils/leaktest.ResourceWatermarks) and the service
+    drained to zero afterwards;
+  * **completed** — every scheduled tx was processed (a silently
+    dropped tx is a lost verdict).
+
+Sender pools are PRE-SIGNED (signing is ~ms-per-tx of pure-Python
+bigint work — signing inline would rate-limit the firehose below the
+plane's capacity) and replayed round-robin, which is also what makes
+the repeat-sender cache claim honest: the pool's sender count, not the
+tx count, bounds the distinct-key working set.
+
+Driven by ``scripts/firehose_soak.py`` (full >=100k run, knobs
+COMETBFT_TPU_SECP_FIREHOSE_TXS / _SENDERS); tests/test_firehose.py
+runs a host-path smoke in tier-1 and a reduced device-path soak in the
+slow tier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from ..crypto import secp256k1 as host_secp
+from ..crypto import secp256k1eth as host_eth
+from ..models import secp_verifier as sv
+from ..utils import envknobs, leaktest
+from ..utils.log import get_logger
+from ..utils.metrics import hub as _mhub
+from ..verifysvc import checktx
+from ..verifysvc.service import Klass, VerifyService
+
+_log = get_logger("e2e.firehose")
+
+KEY_TYPES = ("secp256k1", "secp256k1eth", "ecrecover")
+
+
+@dataclass
+class FirehoseConfig:
+    """Knobs of one firehose run.  Zeros defer to the env knobs
+    (COMETBFT_TPU_SECP_FIREHOSE_TXS / _SENDERS) so the scripts/ driver
+    and the acceptance run share one source of defaults; the test
+    smoke overrides with small explicit values."""
+
+    total_txs: int = 0  # 0 -> COMETBFT_TPU_SECP_FIREHOSE_TXS
+    senders_per_type: int = 0  # 0 -> COMETBFT_TPU_SECP_FIREHOSE_SENDERS
+    txs_per_sender: int = 8  # pre-signed pool depth per sender
+    workers: int = 8
+    storm_every: int = 5000  # a storm window every N scheduled txs
+    storm_len: int = 128  # adversarial txs per window
+    seed: int = 16
+    batch_max: int = 16
+    queue_max: int = 1 << 16
+    slo_p99_ms: float = 500.0  # per key type
+    cache_hit_min: float = 0.9
+    cache_check: bool = True  # off for host-path smokes: the decode
+    # cache (and its counter) only runs in the device assembly loop
+    leak_check: bool = True
+    json_path: str = ""
+
+
+def _storm_pool(cfg: FirehoseConfig, rng) -> list[tuple[bytes, object]]:
+    """Adversarial envelopes with construction-known verdicts: every
+    invalid class the PR-15/16 corpora pin, as WIRE txs."""
+    out: list[tuple[bytes, object]] = []
+    ck = host_secp.PrivKey.from_seed(rng.bytes(32))
+    ek = host_eth.PrivKey.from_seed(rng.bytes(32))
+    rk = host_eth.RecoverPrivKey.from_seed(rng.bytes(32))
+    n_ = host_secp.N
+
+    # tampered signature byte (valid envelope, False verdict)
+    tx = bytearray(checktx.make_signed_tx(ck, b"storm tamper"))
+    tx[len(checktx.MAGIC_V2) + 1 + 33 + 5] ^= 1
+    out.append((bytes(tx), False))
+    # high-s + flipped v rewrite of a valid eth signature
+    sig = ek.sign(b"storm highs")
+    s_ = int.from_bytes(sig[32:64], "big")
+    hs = sig[:32] + (n_ - s_).to_bytes(32, "big") + bytes([sig[64] ^ 1])
+    ktb = bytes([checktx.KEY_TYPE_BYTES["secp256k1eth"]])
+    out.append((
+        checktx.MAGIC_V2 + ktb + ek.pub_key().data + hs + b"storm highs",
+        False,
+    ))
+    # ecrecover with the wrong sender address
+    tx = bytearray(checktx.make_signed_tx(rk, b"storm addr"))
+    off = len(checktx.MAGIC_V2) + 1
+    tx[off:off + 20] = b"\x42" * 20
+    out.append((bytes(tx), False))
+    # r >= n
+    sig = ck.sign(b"storm range")
+    bad = (n_ + 1).to_bytes(32, "big") + sig[32:64]
+    ktb = bytes([checktx.KEY_TYPE_BYTES["secp256k1"]])
+    out.append((
+        checktx.MAGIC_V2 + ktb + ck.pub_key().data + bad + b"storm range",
+        False,
+    ))
+    # truncated envelope: parses as UNSIGNED (None), never an error
+    tx = checktx.make_signed_tx(ek, b"storm trunc")
+    out.append((tx[: len(checktx.MAGIC_V2) + 1 + 10], None))
+    # and one VALID tx per wire shape inside the storm — poison rows
+    # must not bleed into neighbors sharing the coalesced batch
+    for sk in (ck, ek, rk):
+        out.append((checktx.make_signed_tx(sk, b"storm valid"), True))
+    # cross-check every expectation against the host oracle
+    for tx, want in out:
+        parsed = checktx.parse_signed_tx(tx)
+        if want is None:
+            assert parsed is None, "truncated storm tx must parse unsigned"
+        else:
+            kt, pub, sig, payload = parsed
+            got = sv._host_verify_one(
+                pub, checktx.SIGN_DOMAIN + payload, sig
+            )
+            assert got is want, (kt, got, want)
+    return out
+
+
+def _percentile(vals: list[float], q: float):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def run_firehose(cfg: FirehoseConfig) -> dict:
+    """Execute one firehose; returns the SLO report dict (also written
+    to cfg.json_path when set)."""
+    import numpy as np
+
+    total = cfg.total_txs or envknobs.get_int(envknobs.SECP_FIREHOSE_TXS)
+    senders = cfg.senders_per_type or envknobs.get_int(
+        envknobs.SECP_FIREHOSE_SENDERS
+    )
+    rng = np.random.default_rng(cfg.seed)
+
+    # ---- pre-signed replay pools, one per wire shape
+    mk = {
+        "secp256k1": host_secp.PrivKey.from_seed,
+        "secp256k1eth": host_eth.PrivKey.from_seed,
+        "ecrecover": host_eth.RecoverPrivKey.from_seed,
+    }
+    pools: dict[str, list[bytes]] = {}
+    t0 = time.monotonic()
+    for kt in KEY_TYPES:
+        keys = [mk[kt](rng.bytes(32)) for _ in range(senders)]
+        pools[kt] = [
+            checktx.make_signed_tx(sk, b"%s tx %d" % (kt.encode(), j))
+            for j in range(cfg.txs_per_sender)
+            for sk in keys
+        ]
+    storm = _storm_pool(cfg, rng)
+    _log.info(
+        f"firehose pools signed in {time.monotonic() - t0:.1f}s: "
+        f"{senders} senders x {cfg.txs_per_sender} txs x "
+        f"{len(KEY_TYPES)} key types (+{len(storm)} storm shapes); "
+        f"run = {total} txs"
+    )
+
+    svc = VerifyService(batch_max=cfg.batch_max, queue_max=cfg.queue_max)
+    watermarks = leaktest.ResourceWatermarks(
+        gauges={
+            "inflight": lambda: len(svc._inflight),
+            "queued_sigs": lambda: sum(svc._class_sigs[k] for k in Klass),
+        }
+    )
+    cache0 = {
+        r: _mhub().secp_pubkey_cache.value(result=r) for r in ("hit", "miss")
+    }
+
+    lat: dict[str, list[float]] = {kt: [] for kt in KEY_TYPES}
+    drift: list[str] = []
+    storm_seen = [0]
+    processed = [0]
+    next_idx = [0]
+    mtx = threading.Lock()
+    stop_ev = threading.Event()
+
+    def is_storm(i: int) -> bool:
+        return cfg.storm_every > 0 and (
+            i % cfg.storm_every >= cfg.storm_every - cfg.storm_len
+        )
+
+    def worker() -> None:
+        while not stop_ev.is_set():
+            with mtx:
+                i = next_idx[0]
+                if i >= total:
+                    return
+                next_idx[0] += 1
+            if is_storm(i):
+                tx, want = storm[i % len(storm)]
+                got = checktx.verify_tx_signature(tx, service=svc)
+                with mtx:
+                    processed[0] += 1
+                    storm_seen[0] += 1
+                    if got is not want and len(drift) < 32:
+                        drift.append(
+                            f"storm tx {i}: got {got} want {want}"
+                        )
+                continue
+            kt = KEY_TYPES[i % len(KEY_TYPES)]
+            pool = pools[kt]
+            tx = pool[(i // len(KEY_TYPES)) % len(pool)]
+            t = time.perf_counter()
+            got = checktx.verify_tx_signature(tx, service=svc)
+            dt = (time.perf_counter() - t) * 1e3
+            with mtx:
+                processed[0] += 1
+                lat[kt].append(dt)
+                if got is not True and len(drift) < 32:
+                    drift.append(f"{kt} tx {i}: got {got} want True")
+
+    def sampler() -> None:
+        while not stop_ev.is_set():
+            watermarks.sample()
+            stop_ev.wait(0.5)
+
+    started_unix = time.time()
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, name=f"firehose-{i}", daemon=True)
+        for i in range(cfg.workers)
+    ]
+    threads.append(
+        threading.Thread(target=sampler, name="firehose-sampler", daemon=True)
+    )
+    for t in threads:
+        t.start()
+    for t in threads[:-1]:
+        t.join()
+    stop_ev.set()
+    threads[-1].join(timeout=5)
+    wall_s = time.monotonic() - t0
+
+    # drain: the service must return to zero queued/in-flight
+    drained = False
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        with svc._cond:
+            queued = sum(svc._class_sigs[k] for k in Klass)
+        if queued == 0 and not svc._inflight:
+            drained = True
+            break
+        time.sleep(0.05)
+    watermarks.sample()
+    svc_stats = svc.stats(lock_timeout=2.0)
+    svc.stop()
+
+    cache1 = {
+        r: _mhub().secp_pubkey_cache.value(result=r) for r in ("hit", "miss")
+    }
+    hits = cache1["hit"] - cache0["hit"]
+    lookups = hits + cache1["miss"] - cache0["miss"]
+    hit_rate = (hits / lookups) if lookups else None
+
+    per_kt = {
+        kt: {
+            "count": len(v),
+            "p50_ms": _percentile(v, 0.5),
+            "p95_ms": _percentile(v, 0.95),
+            "p99_ms": _percentile(v, 0.99),
+        }
+        for kt, v in lat.items()
+    }
+    slo_ok = all(
+        st["count"] > 0 and st["p99_ms"] is not None
+        and st["p99_ms"] <= cfg.slo_p99_ms
+        for st in per_kt.values()
+    )
+    leak = (
+        watermarks.flat() if cfg.leak_check else {"ok": True, "skipped": True}
+    )
+    leak["drained"] = drained
+    if cfg.cache_check:
+        cache_ok = hit_rate is not None and hit_rate >= cfg.cache_hit_min
+    else:
+        cache_ok = True
+
+    assertions = {
+        "slo_latency": {
+            "ok": slo_ok, "p99_bound_ms": cfg.slo_p99_ms, "per_key_type": per_kt,
+        },
+        "zero_drift": {
+            "ok": not drift, "storm_txs": storm_seen[0], "drift": drift,
+        },
+        "cache_hit_rate": {
+            "ok": cache_ok,
+            "hit_rate": None if hit_rate is None else round(hit_rate, 4),
+            "lookups": lookups,
+            "min": cfg.cache_hit_min,
+            "checked": cfg.cache_check,
+        },
+        "no_leak": {"ok": bool(leak["ok"]) and drained, **leak},
+        "completed": {
+            "ok": processed[0] == total, "processed": processed[0],
+            "scheduled": total,
+        },
+    }
+    report = {
+        "ok": all(a["ok"] for a in assertions.values()),
+        "started_unix": started_unix,
+        "wall_s": round(wall_s, 1),
+        "txs_per_s": round(total / wall_s, 1) if wall_s else None,
+        "config": {**asdict(cfg), "total_txs": total,
+                   "senders_per_type": senders},
+        "assertions": assertions,
+        "service": {
+            "dispatched_batches": svc_stats["dispatched_batches"],
+            "rejected": svc_stats["rejected"],
+        },
+        "watermark_samples": len(watermarks.samples),
+    }
+    if cfg.json_path:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(cfg.json_path)), exist_ok=True
+        )
+        with open(cfg.json_path, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+        _log.info(f"firehose SLO artifact written to {cfg.json_path}")
+    return report

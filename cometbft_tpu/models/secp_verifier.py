@@ -4,23 +4,33 @@ the verify-service seam (verifysvc/service.MODE_SECP).
 This is the lane real user traffic uses (ROADMAP item 4; PAPERS.md
 arXiv:2112.02229): Ethereum-shaped CheckTx ingest is, by transaction
 volume, the biggest workload class, and its signatures are ECDSA over
-secp256k1 — either Cosmos-style (33-byte compressed pubkey, 64-byte
-r||s over SHA-256, ``crypto/secp256k1``) or Ethereum-style (65-byte
-uncompressed pubkey, 65-byte R||S||V over Keccak-256,
-``crypto/secp256k1eth``).  One lane serves both: rows are told apart
-by their pubkey length, exactly as the two host modules are told apart
-by their wire shapes.
+secp256k1 — Cosmos-style (33-byte compressed pubkey, 64-byte r||s over
+SHA-256, ``crypto/secp256k1``), Ethereum-style (65-byte uncompressed
+pubkey, 65-byte R||S||V over Keccak-256, ``crypto/secp256k1eth``), or
+true ecrecover (20-byte sender ADDRESS, 65-byte R||S||V — no pubkey on
+the wire at all; the verifier recovers the signer and compares the
+derived address, ``crypto/secp256k1eth.verify_address_signature``).
+One lane serves all three: rows are told apart by their pubkey length,
+exactly as the host modules are told apart by their wire shapes.
 
 Verdict procedure (identical on every path — the bit-identity contract
 the failover/remote fallbacks inherit, same shape as models/bls_verifier):
 
 1. host half: the pubkey encoding decodes (compressed decompression /
-   uncompressed parse; cached per key — decoding costs a field sqrt),
+   uncompressed parse; cached per key — decoding costs a field sqrt;
+   ecrecover rows skip decode, their "pubkey" is the target address),
    the signature has the right length for the key's wire format, and
-   the message hash (SHA-256 / Keccak-256) is computed.
+   the message hash (SHA-256 / Keccak-256) is computed — ON DEVICE,
+   fused into the verify dispatch (ops/secp256k1.hash_verify_batch),
+   when the batch clears ``COMETBFT_TPU_SECP_HASH_DEVICE_MIN`` and
+   every message fits ``COMETBFT_TPU_SECP_HASH_MAX_LEN``; the host
+   hash loop otherwise (the hashing-residency seam,
+   docs/verify_service.md).
 2. data half: range + low-s checks, s^-1 and the affine normalization
-   (Montgomery batch inversion), u1*G + u2*Q (Shamir), and the
-   x(R') mod n == r / Ecrecover-parity verdict — on device
+   (Montgomery batch inversion), u1*G + u2*Q — the GLV endomorphism
+   quad-scalar walk by default, the plain Shamir witness under
+   ``COMETBFT_TPU_SECP_GLV=0`` — and the x(R') mod n == r /
+   Ecrecover-parity / recovered-address verdict — on device
    (ops/secp256k1.verify_batch) when the batch clears
    ``COMETBFT_TPU_SECP_DEVICE_MIN``, on host (the crypto modules'
    own ``verify_signature``) otherwise.  The kernel is constructed to
@@ -54,8 +64,20 @@ COSMOS_PUB = host_secp.PUBKEY_SIZE  # 33: compressed
 COSMOS_SIG = host_secp.SIGNATURE_SIZE  # 64: r || s
 ETH_PUB = host_eth.PUBKEY_SIZE  # 65: 0x04 || x || y
 ETH_SIG = host_eth.SIGNATURE_SIZE  # 65: R || S || V
+ECR_PUB = host_eth.ADDRESS_SIZE  # 20: sender address (no pubkey on wire)
+ECR_SIG = host_eth.SIGNATURE_SIZE  # 65: R || S || V
 
 _MISS = object()
+
+# Phase attribution of the LAST device dispatch: "*_ms" keys
+# (hash / decode / assembly / h2d / kernel / fetch) plus rows /
+# hash_device / glv markers.  Overwritten on every device dispatch —
+# consumed by bench.py (BENCH_WORKLOAD=secp phase_attribution) and
+# scripts/profile_secp_phases.py, which run one dispatch at a time, so
+# no thread merging.  ``hash_ms`` is the HOST side of hashing: the
+# digest loop on the host-hash path, just the block padding on the
+# fused path (the digests themselves then ride inside kernel_ms).
+LAST_PHASES: dict[str, float] = {}
 
 # pubkey bytes -> affine (x, y) int pair | None (malformed encoding).
 # Decoding a compressed key costs one field sqrt (~pow mod p); CheckTx
@@ -89,7 +111,9 @@ def _decode_pub(pub: bytes):
     cache = _pk_cache()
     hit = cache.get(pub, _MISS)
     if hit is not _MISS:
+        _mhub().secp_pubkey_cache.inc(result="hit")
         return hit
+    _mhub().secp_pubkey_cache.inc(result="miss")
     aff = None
     try:
         if len(pub) == COSMOS_PUB:
@@ -112,6 +136,8 @@ def _host_verify_one(pub: bytes, msg: bytes, sig: bytes) -> bool:
             return host_secp.PubKey(pub).verify_signature(msg, sig)
         if len(pub) == ETH_PUB:
             return host_eth.PubKey(pub).verify_signature(msg, sig)
+        if len(pub) == ECR_PUB:
+            return host_eth.verify_address_signature(pub, msg, sig)
     except ValueError:
         return False
     return False
@@ -154,54 +180,115 @@ def _verify_items(items, use_device: bool) -> tuple[bool, list[bool]]:
     s = np.zeros((b, dev.NLIMBS), dtype=np.int32)
     is_eth = np.zeros((b,), dtype=bool)
     v = np.zeros((b,), dtype=np.int32)
+    is_rec = np.zeros((b,), dtype=bool)
+    addr = np.zeros((b, ECR_PUB), dtype=np.uint8)
+
+    # hashing residency: fuse SHA-256/Keccak-256 into the device
+    # dispatch when the batch is wide enough to amortize it and every
+    # message fits the padded block shape the program compiled for
+    hmin = envknobs.get_int(envknobs.SECP_HASH_DEVICE_MIN)
+    hmax = envknobs.get_int(envknobs.SECP_HASH_MAX_LEN)
+    hash_dev = (
+        hmin > 0
+        and n >= hmin
+        and all(len(msg) <= hmax for (_, msg, _) in items)
+    )
+    msgs: list[bytes] = [b""] * b
+    phases = {"decode_ms": 0.0, "hash_ms": 0.0}
 
     qxs, qys, es, rs, ss, rows = [], [], [], [], [], []
     for i, (pub, msg, sig) in enumerate(items):
         eth = len(pub) == ETH_PUB
-        aff = _decode_pub(pub)
-        # the signature wire shape must match the KEY's wire format —
-        # the host modules' own length gate
-        sig_len = ETH_SIG if eth else COSMOS_SIG
-        if aff is None or len(sig) != sig_len:
-            continue  # row stays valid=False / s=0 -> judged False
-        is_eth[i] = eth
-        if eth:
-            v[i] = sig[64]
-            h = keccak256(msg)
+        rec = len(pub) == ECR_PUB
+        if rec:
+            # no pubkey on the wire: the kernel recovers the signer and
+            # compares the derived address — nothing to decode or cache
+            if len(sig) != ECR_SIG:
+                continue
+            aff = (0, 0)
+            addr[i] = np.frombuffer(pub, dtype=np.uint8)
         else:
-            h = hashlib.sha256(msg).digest()
+            td = _time.perf_counter()
+            aff = _decode_pub(pub)
+            phases["decode_ms"] += (_time.perf_counter() - td) * 1e3
+            # the signature wire shape must match the KEY's wire format
+            # — the host modules' own length gate
+            sig_len = ETH_SIG if eth else COSMOS_SIG
+            if aff is None or len(sig) != sig_len:
+                continue  # row stays valid=False / s=0 -> judged False
+            valid[i] = True
+        is_eth[i] = eth
+        is_rec[i] = rec
+        if eth or rec:
+            v[i] = sig[64]
+        if hash_dev:
+            msgs[i] = msg
+        else:
+            th = _time.perf_counter()
+            h = keccak256(msg) if (eth or rec) else hashlib.sha256(msg).digest()
+            phases["hash_ms"] += (_time.perf_counter() - th) * 1e3
+            es.append(int.from_bytes(h, "big"))
         qxs.append(aff[0])
         qys.append(aff[1])
-        es.append(int.from_bytes(h, "big"))
         rs.append(int.from_bytes(sig[:32], "big"))
         ss.append(int.from_bytes(sig[32:64], "big"))
         rows.append(i)
     if rows:
         qx[rows] = dev.ints_to_limbs_np(qxs)
         qy[rows] = dev.ints_to_limbs_np(qys)
-        valid[rows] = True
-        e[rows] = dev.ints_to_limbs_np(es)
         r[rows] = dev.ints_to_limbs_np(rs)
         s[rows] = dev.ints_to_limbs_np(ss)
+        if not hash_dev:
+            e[rows] = dev.ints_to_limbs_np(es)
+    glv = envknobs.get_bool(envknobs.SECP_GLV)
     m = _mhub()
-    m.verify_phase_seconds.observe(
-        _time.perf_counter() - t0, phase="secp_assembly"
+    assembly_s = _time.perf_counter() - t0
+    m.verify_phase_seconds.observe(assembly_s, phase="secp_assembly")
+    phases["assembly_ms"] = (
+        assembly_s * 1e3 - phases["decode_ms"] - phases["hash_ms"]
     )
     t1 = _time.perf_counter()
     with tracing.span(
         "verify.secp_batch",
-        {"sigs": n, "where": "device"} if tracing.enabled() else None,
+        {"sigs": n, "where": "device", "hash": "device" if hash_dev else "host"}
+        if tracing.enabled() else None,
     ):
-        ok = dev.verify_batch_device(qx, qy, valid, e, r, s, is_eth, v)
+        if hash_dev:
+            from ..ops import keccak as kops
+            from ..ops import sha2 as sops
+
+            tp = _time.perf_counter()
+            sha_blocks, sha_active = sops.pad_messages_sha256(
+                msgs, max_len=hmax
+            )
+            kec_blocks, kec_active = kops.pad_messages_keccak(
+                msgs, max_len=hmax
+            )
+            phases["hash_ms"] += (_time.perf_counter() - tp) * 1e3
+            ok = dev.hash_verify_batch_device(
+                sha_blocks, sha_active, kec_blocks, kec_active,
+                qx, qy, valid, r, s, is_eth, v,
+                is_rec=is_rec, addr=addr, glv=glv, timings=phases,
+            )
+        else:
+            ok = dev.verify_batch_device(
+                qx, qy, valid, e, r, s, is_eth, v,
+                is_rec=is_rec, addr=addr, glv=glv, timings=phases,
+            )
     m.verify_phase_seconds.observe(
         _time.perf_counter() - t1, phase="secp_device"
     )
+    phases["rows"] = float(n)
+    phases["hash_device"] = 1.0 if hash_dev else 0.0
+    phases["glv"] = 1.0 if glv else 0.0
+    LAST_PHASES.clear()
+    LAST_PHASES.update(phases)
     res = [bool(x) for x in ok[:n]]
     return (all(res) and bool(res), res)
 
 
 def _check_item(pub: bytes, msg: bytes, sig: bytes) -> None:
-    if len(pub) not in (COSMOS_PUB, ETH_PUB) or len(sig) not in (
+    if len(pub) not in (ECR_PUB, COSMOS_PUB, ETH_PUB) or len(sig) not in (
         COSMOS_SIG,
         ETH_SIG,
     ):

@@ -29,20 +29,49 @@ The ECDSA batch (one fused program per bucket shape):
   products — the exact latent bug PR 11 found in the ed25519 comb
   table build; a malformed row can never corrupt a valid row's
   inverse (pinned by tests/test_secp_ops.py).
-* **Shamir's-trick double-scalar multiplication** — u1*G + u2*Q with
-  one shared doubling chain over 66 4-bit windows: per window 4
-  doublings + one add from the fixed G window table + one add from the
-  per-signature Q table (built on device, 1 dbl + 13 adds).  The G
-  table (j*G for j = 0..15, Jacobian Montgomery limbs) is precomputed
-  host-side and `jax.device_put` once per process — the PR-11
-  table-residency pattern: no table-build program ever compiles, and
-  the resident buffer is passed as a kernel argument, never re-staged
-  per call.  Lookups are one-hot matmuls (gathers serialize on TPU).
+* **GLV quad-scalar multiplication** (the default; ``glv=False`` keeps
+  the plain Shamir chain as the bit-exactness witness, the PR-1
+  ``COMB_TREE`` pattern) — u1*G + u2*Q with one shared doubling chain.
+  The secp256k1 endomorphism phi(x, y) = (beta*x, y) acts as
+  multiplication by lambda (a cube root of 1 mod n), so each scalar
+  splits as k = k1 + lambda*k2 with |k1|, |k2| < ~2^129 (lattice
+  basis from the extended Euclid run on (n, lambda); the rounding is
+  two 384-bit-shift multiplies by precomputed constants, Algorithm
+  3.74 of Guide to ECC).  The walk then covers 33 4-bit windows over
+  FOUR points (G, phi(G), Q, phi(Q) — the phi tables are one
+  beta-multiply of the X rows) instead of 66 windows over two: the
+  doubling chain that dominates the kernel halves (132 doublings vs
+  264; adds stay 132).  Signs fold into per-row conditional Y
+  negation of the table lookups.
+* **Shamir's-trick double-scalar multiplication** (the witness path) —
+  66 4-bit windows: per window 4 doublings + one add from the fixed G
+  window table + one add from the per-signature Q table (built on
+  device, 1 dbl + 13 adds).  The G table (j*G for j = 0..15, Jacobian
+  Montgomery limbs) is precomputed host-side and `jax.device_put` once
+  per process — the PR-11 table-residency pattern: no table-build
+  program ever compiles, and the resident buffer is passed as a kernel
+  argument, never re-staged per call.  Lookups are one-hot matmuls
+  (gathers serialize on TPU).
 * **verdict** — cosmos rows check x(R') mod n == r (x == r or
   x == r + n when r + n < p, exactly the host's `pt[0] % N == r`);
   eth rows (65-byte R||S||V signatures) check x(R') == r exactly plus
   the recovery-id parity y(R') & 1 == v, which is equivalent to
   Ecrecover(h, sig) == Q (s*R == e*G + r*Q  <=>  R == u1*G + u2*Q).
+* **true ecrecover rows** (``recover=True``, a trace-time flag so
+  verify-only batches never pay for it) — Ethereum txs carry no
+  pubkey, only the 20-byte sender address.  Marked rows lift
+  R = (r, sqrt(r^3 + 7)) with the parity v (one batched Fermat
+  sqrt chain, x^((p+1)/4)), walk Q = (-e/r)*G + (s/r)*R through the
+  SAME quad-scalar chain (u1 = -e*r^-1, u2 = s*r^-1, point = R), and
+  compare Keccak256(x || y)[12:] of the recovered point against the
+  address — bit-identical to crypto/secp256k1eth.recover_pubkey +
+  address() in every edge (non-residue r, infinity, high-s, v > 1).
+
+``hash_verify_batch`` fuses the message hashing in front of all of the
+above: cosmos rows through ops/sha2.sha256_blocks, eth/ecrecover rows
+through ops/keccak.keccak256_blocks, digests multiplexed per row — one
+device program from padded payload bytes to verdict bits, so firehose
+ingest never serializes a per-tx host hash loop.
 
 All paths are branch-free selects, so the verdict is bit-identical to
 the pure-host crypto/secp256k1 / crypto/secp256k1eth lane in every
@@ -108,6 +137,128 @@ class _Mod:
 
 FP = _Mod(P)
 FN = _Mod(N)
+
+
+# ------------------------------------------------------- GLV decomposition
+# The secp256k1 endomorphism: beta is a nontrivial cube root of 1 mod p,
+# lambda the matching cube root of 1 mod n, with
+# lambda * (x, y) = (beta * x, y) for every curve point.  All constants
+# are DERIVED here from the curve parameters (not pasted): beta/lambda
+# from small-base exponentiation, the short lattice basis from the
+# extended Euclid run on (n, lambda), the rounding multipliers g_i from
+# one 384-bit-shift division — and the pairing + decomposition bounds
+# are asserted at import, so a wrong constant cannot survive to trace
+# time.
+
+
+def _find_glv() -> tuple[int, int]:
+    beta = lam = None
+    g = 2
+    while beta is None:
+        c = pow(g, (P - 1) // 3, P)
+        if c != 1:
+            beta = c
+        g += 1
+    g = 2
+    while lam is None:
+        c = pow(g, (N - 1) // 3, N)
+        if c != 1:
+            lam = c
+        g += 1
+    # the two cube roots come with an arbitrary choice each; pick the
+    # pair that actually satisfies lambda*G == (beta*Gx, Gy)
+    for lc in (lam, lam * lam % N):
+        got = host_secp._mul(lc, host_secp.G)
+        for bc in (beta, beta * beta % P):
+            if got == (bc * host_secp.G[0] % P, host_secp.G[1]):
+                return bc, lc
+    raise AssertionError("secp256k1 GLV beta/lambda pairing not found")
+
+
+_BETA, _LAM = _find_glv()
+
+
+def _glv_basis() -> tuple[int, int, int, int]:
+    """Two short lattice vectors (a, b) with a + b*lambda == 0 (mod n)
+    (extended Euclid on (n, lambda), stopping at the sqrt(n) crossing —
+    Guide to ECC, Alg. 3.74); normalized so det == +n."""
+    rs, ts = [N, _LAM], [0, 1]
+    while rs[-1] * rs[-1] >= N:
+        q = rs[-2] // rs[-1]
+        rs.append(rs[-2] - q * rs[-1])
+        ts.append(ts[-2] - q * ts[-1])
+    q = rs[-2] // rs[-1]
+    rs.append(rs[-2] - q * rs[-1])
+    ts.append(ts[-2] - q * ts[-1])
+    a1, b1 = rs[-2], -ts[-2]
+    cand_a = (rs[-3], -ts[-3])
+    cand_b = (rs[-1], -ts[-1])
+    a2, b2 = min(cand_a, cand_b, key=lambda v: v[0] * v[0] + v[1] * v[1])
+    det = a1 * b2 - a2 * b1
+    assert abs(det) == N
+    if det < 0:
+        a2, b2 = -a2, -b2
+    assert (a1 + b1 * _LAM) % N == 0 and (a2 + b2 * _LAM) % N == 0
+    return a1, b1, a2, b2
+
+
+_A1, _B1, _A2, _B2 = _glv_basis()
+
+# rounding multipliers: c_i = round(k * |b_j| / n) computed on device as
+# (k * g_i + 2^383) >> 384 with g_i = round(2^384 * |b_j| / n) — wide
+# enough that the +-1 rounding slack only nudges |k1|, |k2| within their
+# ~2^129 bound, never the k1 + lambda*k2 == k identity (k1 is computed
+# FROM k2, so the identity holds by construction for every k)
+_G1 = ((1 << 384) * abs(_B2) + N // 2) // N
+_G2 = ((1 << 384) * abs(_B1) + N // 2) // N
+_S1 = 1 if _B2 > 0 else -1  # sign(b2):  c1 = _S1 * round(k*|b2|/n)
+_S2 = 1 if _B1 < 0 else -1  # sign(-b1): c2 = _S2 * round(k*|b1|/n)
+# k2 = -c1*b1 - c2*b2 folded into unsigned device constants:
+# k2 = c1' * M1 + c2' * M2 (mod n) with c_i' the unsigned roundings
+_M1 = (-_S1 * _B1) % N
+_M2 = (-_S2 * _B2) % N
+
+_G1_LIMBS = _int_to_limbs(_G1)
+_G2_LIMBS = _int_to_limbs(_G2)
+# Montgomery-form multipliers: mul(plain, const*R) -> plain product
+_M1R = _int_to_limbs(_M1 * R_MONT % N)
+_M2R = _int_to_limbs(_M2 * R_MONT % N)
+_LAMR = _int_to_limbs(_LAM * R_MONT % N)
+_BETA_M = _int_to_limbs(_BETA * R_MONT % P)
+
+# signed-halves boundary: the true halves satisfy |k_i| < ~2^129, so a
+# canonical k_i in [0, 2^132) is the half itself and anything else is
+# k_i - n (2^132 is a clean 11-limb edge -> 33 4-bit windows)
+_GLV_SIGN_BOUND = 1 << 132
+NWINDOWS_GLV = 33
+
+
+def _split_host(k: int) -> tuple[int, int]:
+    """Host-int mirror of the device split (the import self-check and
+    the tests' oracle): k -> signed (k1, k2) with k1 + lambda*k2 == k
+    (mod n)."""
+    c1 = (k * _G1 + (1 << 383)) >> 384
+    c2 = (k * _G2 + (1 << 383)) >> 384
+    k2 = (c1 * _M1 + c2 * _M2) % N
+    k1 = (k - _LAM * k2) % N
+    s1 = k1 if k1 < _GLV_SIGN_BOUND else k1 - N
+    s2 = k2 if k2 < _GLV_SIGN_BOUND else k2 - N
+    return s1, s2
+
+
+def _selfcheck_glv() -> None:
+    samples = [0, 1, 2, N - 1, N - 2, N // 2, _LAM, N - _LAM]
+    x = 1
+    for _ in range(56):
+        x = x * 3 % N
+        samples.append(x)
+    for k in samples:
+        s1, s2 = _split_host(k)
+        assert (s1 + _LAM * s2) % N == k % N, k
+        assert abs(s1) < 1 << 130 and abs(s2) < 1 << 130, k
+
+
+_selfcheck_glv()
 
 # anti-diagonal collector: outer(a, b).reshape @ _DIAG == conv(a, b)
 _DIAG = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS), dtype=np.int32)
@@ -258,19 +409,25 @@ def _add_const(a, climbs):
 # ------------------------------------------------ Montgomery batch inverse
 
 
-def _mont_pow_inv(x, mod: _Mod):
-    """x^(m-2) in the Montgomery domain (ONE element, shape (..., 22)):
-    the single Fermat chain of the batch-inversion trick.  lax.scan over
-    the fixed MSB-first bit vector of m-2 keeps the jaxpr one
-    square+conditional-multiply body."""
+def _mont_pow(x, bits, mod: _Mod):
+    """x^E in the Montgomery domain for a fixed host exponent given as
+    its MSB-first bit vector: lax.scan keeps the jaxpr one
+    square+conditional-multiply body regardless of the bit count.  Used
+    for the batch-inversion Fermat chain (E = m - 2) and the ecrecover
+    square-root chain (E = (p+1)/4)."""
     one = jnp.broadcast_to(jnp.asarray(mod.one_mont), x.shape)
 
     def step(acc, bit):
         acc = sqr(acc, mod)
         return jnp.where(bit, mul(acc, x, mod), acc), None
 
-    acc, _ = lax.scan(step, one, jnp.asarray(mod.inv_bits))
+    acc, _ = lax.scan(step, one, jnp.asarray(bits))
     return acc
+
+
+def _mont_pow_inv(x, mod: _Mod):
+    """x^(m-2) — the single Fermat chain of the batch-inversion trick."""
+    return _mont_pow(x, mod.inv_bits, mod)
 
 
 def _shifted(x, k: int, fill):
@@ -482,66 +639,89 @@ def _windows(a):
     return w[:, ::-1].T
 
 
-# ----------------------------------------------------------- verification
+# ------------------------------------------------------ GLV device half
 
 
-def verify_batch(qx, qy, q_valid, e, r, s, is_eth, v, gtab):
-    """Batched ECDSA verification, one fused device program.
+def _carry_all(a):
+    """Signed conv limbs -> canonical digits at the SAME width (the
+    final carry must be provably zero: callers bound the value below
+    2^(12*width))."""
+    aT = jnp.moveaxis(a, -1, 0)
 
-    qx, qy  : (B, 22) int32 — affine pubkey coordinates, PLAIN canonical
-              limbs (host decode/decompress already rejected malformed
-              encodings via q_valid; garbage limbs on invalid rows are
-              harmless — they feed only multiplications)
-    q_valid : (B,) bool — host-side decode verdict
-    e       : (B, 22) int32 — raw 256-bit message-hash value (SHA-256
-              for cosmos rows, Keccak-256 for eth rows); the Montgomery
-              conversion reduces it mod n exactly like the host's % N
-    r, s    : (B, 22) int32 — raw signature scalars
-    is_eth  : (B,) bool — row wire format: eth R||S||V recovery
-              semantics vs cosmos compressed-key semantics
-    v       : (B,) int32 — eth recovery id (0/1); ignored on cosmos rows
-    gtab    : (16, 66) int32 — the resident G window table
-              (:func:`g_table`), an ARGUMENT so the device_put buffer is
-              reused across dispatches instead of re-staged as a baked
-              constant
+    def step(c, limb):
+        v = limb + c
+        return v >> BITS, v & MASK
 
-    Returns (B,) bool, bit-identical to the host verifiers.
+    _, outT = lax.scan(step, jnp.zeros_like(aT[0]), aT)
+    return jnp.moveaxis(outT, 0, -1)
 
-    Manifest kernel ``secp256k1_verify_batch`` (analysis/kernel_manifest):
-    eqn-budgeted and fingerprint-pinned; the jit site is the bridge's
-    module-cached ``jax.jit(verify_batch)`` registered in JIT_SITES.
-    """
-    # ---- validation (device half): on-curve + scalar ranges + low-s
-    qx_m = to_mont(qx, FP)
-    qy_m = to_mont(qy, FP)
-    q_ok = q_valid & on_curve(qx_m, qy_m)
-    n_l = FN.limbs
-    r_ok = ~is_zero(r) & _lt_const(r, n_l)
-    s_ok = (
-        ~is_zero(s)
-        & _lt_const(s, n_l)
-        & _lt_const(s, _int_to_limbs(N // 2 + 1))  # low-s: s <= n/2
+
+def _mul_shift_384(k, glimbs):
+    """round(k * g / 2^384) for a (B, 22) canonical scalar and a host
+    constant g < 2^264: one outer-product conv (the mul staging, no
+    reduction), +2^383 into the conv limbs (limb 31, weight 2^372,
+    value 2^11), a full carry chain (product + rounder < 2^521 < 2^528
+    so the 44-digit carry is exact), then the digits above bit 384
+    (limb 32 up) — 12 digits, zero-padded back to a (B, 22) scalar."""
+    outer = (k[..., :, None] * jnp.asarray(glimbs)[None, :]).reshape(
+        k.shape[:-1] + (NLIMBS * NLIMBS,)
     )
-    v_ok = jnp.where(is_eth, v <= 1, True)
-    row_pre = q_ok & r_ok & s_ok & v_ok
+    t = outer @ jnp.asarray(_DIAG)  # (B, 44) conv limbs
+    t = t.at[..., 31].add(1 << 11)  # + 2^383 = round-half-up
+    t = _carry_all(t)
+    hi = t[..., 32:]  # digits of weight >= 2^384
+    pad = jnp.zeros(k.shape[:-1] + (NLIMBS - hi.shape[-1],), dtype=k.dtype)
+    return jnp.concatenate([hi, pad], axis=-1)
 
-    # ---- u1 = e/s, u2 = r/s (mod n), s^-1 amortized across the batch.
-    # Sanitize BEFORE the shared product: an s = 0 row would zero the
-    # total and poison every valid row's inverse.
-    one_plain = jnp.asarray(FN.one_plain)
-    s_safe = select(s_ok, s, jnp.broadcast_to(one_plain, s.shape))
-    w_m = batch_inverse(to_mont(s_safe, FN), FN)
-    e_m = to_mont(e, FN)  # to-Montgomery reduces mod n (host: e % N)
-    r_m = to_mont(r, FN)
-    u1 = from_mont(mul(e_m, w_m, FN), FN)
-    u2 = from_mont(mul(r_m, w_m, FN), FN)
 
-    # ---- Shamir interleave: acc := 16*acc + u1_i*G + u2_i*Q per window
-    one_m = jnp.broadcast_to(jnp.asarray(FP.one_mont), qx.shape)
-    Qz = select(q_ok, one_m, jnp.zeros_like(qx))
-    qtab = _build_q_table(qx_m, qy_m, Qz)
+def _signed_abs(k):
+    """Canonical k in [0, n) holding a signed half -> (|half|, neg):
+    halves are < 2^130 in magnitude, so k < 2^132 IS the half and
+    anything else encodes k - n."""
+    neg = ~_lt_const(k, _int_to_limbs(_GLV_SIGN_BOUND))
+    kabs = select(neg, sub(jnp.zeros_like(k), k, FN), k)
+    return kabs, neg
+
+
+def _glv_split(k):
+    """(B, 22) plain canonical scalar mod n -> the quad-walk's signed
+    halves (|k1|, k1_neg, |k2|, k2_neg) with k1 + lambda*k2 == k (mod
+    n).  Mirrors :func:`_split_host` limb for limb."""
+    c1 = _mul_shift_384(k, _G1_LIMBS)
+    c2 = _mul_shift_384(k, _G2_LIMBS)
+    k2 = add(
+        mul(c1, jnp.asarray(_M1R), FN), mul(c2, jnp.asarray(_M2R), FN), FN
+    )
+    k1 = sub(k, mul(k2, jnp.asarray(_LAMR), FN), FN)
+    k1a, k1n = _signed_abs(k1)
+    k2a, k2n = _signed_abs(k2)
+    return k1a, k1n, k2a, k2n
+
+
+def _windows_glv(a):
+    """(B, 22) canonical |half| (< 2^132, limbs 11+ all zero) ->
+    (33, B) 4-bit windows, MSB first."""
+    h = a[:, : NWINDOWS_GLV // 3]  # 11 limbs cover the 132 live bits
+    w = jnp.stack([h & MASK, h >> 4, h >> 8], axis=-1) & 15
+    w = w.reshape(a.shape[0], NWINDOWS_GLV)
+    return w[:, ::-1].T
+
+
+def _neg_y(Y, flag):
+    """Per-row conditional point negation (Jacobian: negate Y).  Folded
+    signs of the GLV halves; canonical 0 stays 0."""
+    return select(flag, sub(jnp.zeros_like(Y), Y, FP), Y)
+
+
+# ------------------------------------------------- the two walk variants
+
+
+def _walk_shamir(u1, u2, qtab, gtab):
+    """The non-GLV bit-exactness witness: 66 shared windows, per window
+    4 doublings (rolled scan) + one G-table add + one Q-table add."""
     u1w = _windows(u1)
     u2w = _windows(u2)
+    one_m = jnp.broadcast_to(jnp.asarray(FP.one_mont), u1.shape)
 
     def step(i, acc):
         # 4 doublings as a rolled scan: one doubling body in the jaxpr
@@ -559,8 +739,228 @@ def verify_batch(qx, qy, q_valid, e, r, s, is_eth, v, gtab):
         X, Y, Z = pt_add(X, Y, Z, qX, qY, qZ)
         return (X, Y, Z)
 
-    inf = (one_m, one_m, jnp.zeros_like(qx))
-    X, Y, Z = lax.fori_loop(0, NWINDOWS, step, inf)
+    inf = (one_m, one_m, jnp.zeros_like(u1))
+    return lax.fori_loop(0, NWINDOWS, step, inf)
+
+
+def _walk_glv(u1, u2, qtab, gtab):
+    """The GLV quad-scalar walk: both scalars split into signed halves,
+    33 shared windows over G, phi(G), Q, phi(Q) — half the doubling
+    chain of :func:`_walk_shamir` for the same four adds per window.
+    The phi tables are one beta-multiply of the X rows (phi is
+    (beta*X, Y, Z) in Jacobian too: x_aff = X/Z^2 scales by beta);
+    negative halves negate the looked-up Y per row."""
+    k1a, k1n, k2a, k2n = _glv_split(u1)
+    l1a, l1n, l2a, l2n = _glv_split(u2)
+    wg, wpg = _windows_glv(k1a), _windows_glv(k2a)
+    wq, wpq = _windows_glv(l1a), _windows_glv(l2a)
+
+    beta16 = jnp.broadcast_to(jnp.asarray(_BETA_M), (16, NLIMBS))
+    pg_tab = jnp.concatenate(
+        [mul(gtab[:, :NLIMBS], beta16, FP), gtab[:, NLIMBS:]], axis=-1
+    )
+    tX, tY, tZ = qtab
+    pq_tab = (
+        mul(tX, jnp.broadcast_to(jnp.asarray(_BETA_M), tX.shape), FP),
+        tY,
+        tZ,
+    )
+    one_m = jnp.broadcast_to(jnp.asarray(FP.one_mont), u1.shape)
+
+    def step(i, acc):
+        (X, Y, Z), _ = lax.scan(
+            lambda p, _: (pt_double(*p), None), acc, None, length=4
+        )
+        for tab, w, neg, look in (
+            (gtab, wg, k1n, _lookup_g),
+            (pg_tab, wpg, k2n, _lookup_g),
+            (qtab, wq, l1n, _lookup_q),
+            (pq_tab, wpq, l2n, _lookup_q),
+        ):
+            aX, aY, aZ = look(
+                tab, lax.dynamic_index_in_dim(w, i, axis=0, keepdims=False)
+            )
+            X, Y, Z = pt_add(X, Y, Z, aX, _neg_y(aY, neg), aZ)
+        return (X, Y, Z)
+
+    inf = (one_m, one_m, jnp.zeros_like(u1))
+    return lax.fori_loop(0, NWINDOWS_GLV, step, inf)
+
+
+# ------------------------------------------- ecrecover / hashing helpers
+
+# (p+1)/4 MSB-first: the Fermat square-root chain of the R-lift
+_SQRT_BITS = np.array([b == "1" for b in bin((P + 1) // 4)[2:]], dtype=bool)
+
+# canonical 12-bit limbs (LE) <-> 32 big-endian bytes, as static gathers:
+# BE byte j is LE byte k = 31-j, which spans limbs q = 2k//3 and q+1 at
+# in-limb shift 8k - 12q in {0, 4, 8}
+_BE_Q = np.array([(2 * (31 - j)) // 3 for j in range(32)], dtype=np.int32)
+_BE_SH = np.array(
+    [8 * (31 - j) - 12 * ((2 * (31 - j)) // 3) for j in range(32)],
+    dtype=np.int32,
+)
+# digest bytes (BE) -> limbs: limb i spans LE bytes k0 = 12i//8 and
+# k0+1 at shift 12i - 8*k0 in {0, 4} (top limb reads past byte 31 ->
+# two zero pad bytes)
+_E_K0 = np.array([(12 * i) // 8 for i in range(NLIMBS)], dtype=np.int32)
+_E_SH = np.array(
+    [12 * i - 8 * ((12 * i) // 8) for i in range(NLIMBS)], dtype=np.int32
+)
+
+
+def _limbs_to_bytes_be(a):
+    """(B, 22) plain canonical limbs (< 2^256) -> (B, 32) uint8, big
+    endian — the recovered point's coordinates as Keccak input."""
+    lo = a[..., _BE_Q]
+    hi = a[..., _BE_Q + 1]
+    val = lo + (hi << 12)  # <= 4095 + 4095*4096 < 2^24: int32-safe
+    return ((val >> jnp.asarray(_BE_SH)) & 255).astype(jnp.uint8)
+
+
+def _digest_to_limbs(dig):
+    """(B, 32) uint8 big-endian digest -> (B, 22) int32 canonical limbs
+    (the raw 256-bit e the verify path expects)."""
+    le = dig[..., ::-1].astype(jnp.int32)
+    pad = jnp.zeros(dig.shape[:-1] + (2,), dtype=jnp.int32)
+    le = jnp.concatenate([le, pad], axis=-1)
+    val = le[..., _E_K0] + (le[..., _E_K0 + 1] << 8)
+    return (val >> jnp.asarray(_E_SH)) & MASK
+
+
+# Keccak block for the 64-byte x || y preimage: pad10*1 tail as a host
+# constant (0x01 at offset 64, 0x80 at 135; 136-byte rate, one block)
+_ADDR_PAD = np.zeros(72, dtype=np.uint8)
+_ADDR_PAD[0] = 0x01
+_ADDR_PAD[-1] = 0x80
+
+
+def _address_from_affine(x_aff, y_aff):
+    """Plain affine limbs -> (B, 20) uint8 Ethereum address:
+    Keccak256(x_be || y_be)[12:], one single-block batched permutation
+    (ops/keccak)."""
+    from . import keccak as _keccak
+
+    xb = _limbs_to_bytes_be(x_aff)
+    yb = _limbs_to_bytes_be(y_aff)
+    tail = jnp.broadcast_to(
+        jnp.asarray(_ADDR_PAD), x_aff.shape[:-1] + (_ADDR_PAD.shape[0],)
+    )
+    block = jnp.concatenate([xb, yb, tail], axis=-1)
+    dig = _keccak.keccak256_blocks(block[..., None, :])
+    return dig[..., 12:32]
+
+
+# ----------------------------------------------------------- verification
+
+
+def verify_batch(
+    qx, qy, q_valid, e, r, s, is_eth, v, is_rec, addr, gtab,
+    *, glv=True, recover=False,
+):
+    """Batched ECDSA verification, one fused device program.
+
+    qx, qy  : (B, 22) int32 — affine pubkey coordinates, PLAIN canonical
+              limbs (host decode/decompress already rejected malformed
+              encodings via q_valid; garbage limbs on invalid rows are
+              harmless — they feed only multiplications)
+    q_valid : (B,) bool — host-side decode verdict
+    e       : (B, 22) int32 — raw 256-bit message-hash value (SHA-256
+              for cosmos rows, Keccak-256 for eth/ecrecover rows); the
+              Montgomery conversion reduces it mod n like the host's % N
+    r, s    : (B, 22) int32 — raw signature scalars
+    is_eth  : (B,) bool — row wire format: eth R||S||V recovery
+              semantics vs cosmos compressed-key semantics
+    v       : (B,) int32 — recovery id (0/1); ignored on cosmos rows
+    is_rec  : (B,) bool — true ecrecover rows (no pubkey: recover the
+              signer from r/v and compare addresses).  Only honored
+              under ``recover=True``; callers without such rows pass
+              all-False and the cheaper program
+    addr    : (B, 20) uint8 — expected sender address on ecrecover rows
+    gtab    : (16, 66) int32 — the resident G window table
+              (:func:`g_table`), an ARGUMENT so the device_put buffer is
+              reused across dispatches instead of re-staged as a baked
+              constant
+    glv     : trace-time: GLV quad-scalar walk (default) vs the plain
+              Shamir witness walk — bit-identical by contract
+              (tests/test_secp_glv.py), knob-selected like COMB_TREE
+    recover : trace-time: compile the R-lift sqrt chain + the on-device
+              address Keccak.  False keeps verify-only batches on a
+              program that never pays for either
+
+    Returns (B,) bool, bit-identical to the host verifiers.
+
+    Manifest kernels ``secp256k1_verify_batch[_recover][ _noglv]``
+    (analysis/kernel_manifest): eqn-budgeted and fingerprint-pinned per
+    (glv, recover) variant; the jit site is the bridge's module-cached
+    ``jax.jit(verify_batch, static_argnames=...)`` in JIT_SITES.
+    """
+    # ---- validation (device half): on-curve + scalar ranges + low-s
+    qx_m = to_mont(qx, FP)
+    qy_m = to_mont(qy, FP)
+    n_l = FN.limbs
+    r_ok = ~is_zero(r) & _lt_const(r, n_l)
+    s_ok = (
+        ~is_zero(s)
+        & _lt_const(s, n_l)
+        & _lt_const(s, _int_to_limbs(N // 2 + 1))  # low-s: s <= n/2
+    )
+    if recover:
+        v_ok = jnp.where(is_eth | is_rec, v <= 1, True)
+        # R-lift: x = r, y = sqrt(x^3 + 7) via x^((p+1)/4), flipped to
+        # the parity v — exactly host recover_pubkey's lift (which
+        # rejects r >= n before lifting, as r_ok does here)
+        rx_m = to_mont(r, FP)
+        y2 = add(mul(sqr(rx_m, FP), rx_m, FP), jnp.asarray(_B7_M), FP)
+        y_m = _mont_pow(y2, _SQRT_BITS, FP)
+        lift_ok = jnp.all(sqr(y_m, FP) == y2, axis=-1)  # y2 was a QR
+        y_plain = from_mont(y_m, FP)
+        flip = (y_plain[:, 0] & 1) != v
+        ry_m = select(flip, sub(jnp.zeros_like(y_m), y_m, FP), y_m)
+        q_ok = jnp.where(is_rec, lift_ok, q_valid & on_curve(qx_m, qy_m))
+        Px_m = select(is_rec, rx_m, qx_m)
+        Py_m = select(is_rec, ry_m, qy_m)
+    else:
+        v_ok = jnp.where(is_eth, v <= 1, True)
+        q_ok = q_valid & on_curve(qx_m, qy_m)
+        Px_m, Py_m = qx_m, qy_m
+    row_pre = q_ok & r_ok & s_ok & v_ok
+
+    # ---- scalars, the shared denominator amortized across the batch:
+    # verify rows    u1 = e/s,  u2 = r/s  (mod n)
+    # ecrecover rows u1 = -e/r, u2 = s/r  (Q = r^-1 (s*R - e*G))
+    # Sanitize BEFORE the shared product: a zero denominator row would
+    # zero the total and poison every valid row's inverse.
+    one_plain = jnp.asarray(FN.one_plain)
+    if recover:
+        w_in = select(is_rec, r, s)
+        w_in_ok = jnp.where(is_rec, r_ok, s_ok)
+    else:
+        w_in = s
+        w_in_ok = s_ok
+    w_safe = select(w_in_ok, w_in, jnp.broadcast_to(one_plain, s.shape))
+    w_m = batch_inverse(to_mont(w_safe, FN), FN)
+    e_m = to_mont(e, FN)  # to-Montgomery reduces mod n (host: e % N)
+    u1_m = mul(e_m, w_m, FN)
+    if recover:
+        u1_m = select(
+            is_rec, sub(jnp.zeros_like(u1_m), u1_m, FN), u1_m
+        )
+        u2_src_m = select(is_rec, to_mont(s, FN), to_mont(r, FN))
+    else:
+        u2_src_m = to_mont(r, FN)
+    u1 = from_mont(u1_m, FN)
+    u2 = from_mont(mul(u2_src_m, w_m, FN), FN)
+
+    # ---- the double-scalar walk: u1*G + u2*P with P the pubkey (or
+    # the lifted R on ecrecover rows); invalid rows enter as infinity
+    one_m = jnp.broadcast_to(jnp.asarray(FP.one_mont), qx.shape)
+    Pz = select(q_ok, one_m, jnp.zeros_like(qx))
+    qtab = _build_q_table(Px_m, Py_m, Pz)
+    if glv:
+        X, Y, Z = _walk_glv(u1, u2, qtab, gtab)
+    else:
+        X, Y, Z = _walk_shamir(u1, u2, qtab, gtab)
 
     # ---- affine normalization, z^-1 amortized across the batch (the
     # second shared inversion; Z = 0 rows sanitized exactly like s = 0)
@@ -573,17 +973,64 @@ def verify_batch(qx, qy, q_valid, e, r, s, is_eth, v, gtab):
 
     # ---- verdict
     rn = _add_const(r, n_l)  # r + n (< 2^257, fits the limb vector)
-    cosmos_ok = jnp.all(x_aff == r, axis=-1) | (
+    x_eq_r = jnp.all(x_aff == r, axis=-1)
+    cosmos_ok = x_eq_r | (
         _lt_const(rn, FP.limbs) & jnp.all(x_aff == rn, axis=-1)
     )
-    eth_ok = jnp.all(x_aff == r, axis=-1) & ((y_aff[:, 0] & 1) == v)
-    return row_pre & z_nonzero & jnp.where(is_eth, eth_ok, cosmos_ok)
+    eth_ok = x_eq_r & ((y_aff[:, 0] & 1) == v)
+    if recover:
+        # the walked point IS the recovered pubkey: address-compare it
+        rec_ok = jnp.all(_address_from_affine(x_aff, y_aff) == addr, axis=-1)
+        verdict = jnp.where(
+            is_rec, rec_ok, jnp.where(is_eth, eth_ok, cosmos_ok)
+        )
+    else:
+        verdict = jnp.where(is_eth, eth_ok, cosmos_ok)
+    return row_pre & z_nonzero & verdict
+
+
+def hash_verify_batch(
+    sha_blocks, sha_active, kec_blocks, kec_active,
+    qx, qy, q_valid, r, s, is_eth, v, is_rec, addr, gtab,
+    *, glv=True, recover=False,
+):
+    """The fused hash->verify program: padded message bytes in, verdict
+    bits out — ONE dispatch, so firehose ingest never serializes a
+    per-tx host hash loop (the hashing-residency seam documented in
+    docs/verify_service.md).
+
+    sha_blocks / sha_active : (B, nb, 64) uint8 + (B,) int32 — every
+        row's message SHA-256-padded (ops/sha2.pad_messages_sha256)
+    kec_blocks / kec_active : (B, nb', 136) uint8 + (B,) int32 — the
+        SAME messages Keccak-padded (ops/keccak.pad_messages_keccak)
+    remaining args/kwargs   : exactly :func:`verify_batch` minus ``e``
+
+    Both digests are computed for every row (branch-free batch; the
+    loser is masked per row), then multiplexed: Keccak-256 for
+    eth/ecrecover rows, SHA-256 for cosmos rows — matching the host
+    hash choice bit for bit.
+
+    Manifest kernels ``secp256k1_hash_verify[_recover]``; jit site is
+    the module-cached bridge below.
+    """
+    from . import keccak as _keccak
+    from . import sha2 as _sha2
+
+    sha_d = _sha2.sha256_blocks(sha_blocks, sha_active)
+    kec_d = _keccak.keccak256_blocks(kec_blocks, kec_active)
+    dig = jnp.where((is_eth | is_rec)[..., None], kec_d, sha_d)
+    e = _digest_to_limbs(dig)
+    return verify_batch(
+        qx, qy, q_valid, e, r, s, is_eth, v, is_rec, addr, gtab,
+        glv=glv, recover=recover,
+    )
 
 
 # ------------------------------------------------------------ host bridge
 
 
 _VERIFY_JIT = None
+_HASH_VERIFY_JIT = None
 _JIT_MTX = threading.Lock()
 
 
@@ -618,18 +1065,41 @@ def from_limbs(a) -> np.ndarray:
     return out.reshape(a.shape[:-1])
 
 
-def verify_batch_device(qx, qy, q_valid, e, r, s, is_eth, v) -> np.ndarray:
+def _rec_defaults(b: int, is_rec, addr):
+    if is_rec is None:
+        is_rec = np.zeros((b,), dtype=bool)
+    if addr is None:
+        addr = np.zeros((b, 20), dtype=np.uint8)
+    return is_rec, addr
+
+
+def verify_batch_device(
+    qx, qy, q_valid, e, r, s, is_eth, v,
+    is_rec=None, addr=None, glv=True, timings=None,
+) -> np.ndarray:
     """One device dispatch of the batched ECDSA kernel over pre-packed
     host arrays; the blocking result fetch is this bridge's declared
-    collect point (analysis/kernel_manifest.COLLECT_BOUNDARIES)."""
+    collect point (analysis/kernel_manifest.COLLECT_BOUNDARIES).
+
+    The ``recover`` trace flag is derived here: batches without
+    ecrecover rows ride the cheaper program (no sqrt chain, no address
+    Keccak).  When ``timings`` is a dict the bridge splits its wall
+    time into h2d / kernel / fetch milliseconds (additive — repeated
+    dispatches accumulate) for the bench/profiler phase attribution."""
+    import time
+
     import jax
 
     global _VERIFY_JIT
     if _VERIFY_JIT is None:
         with _JIT_MTX:
             if _VERIFY_JIT is None:
-                _VERIFY_JIT = jax.jit(verify_batch)
-    ok = _VERIFY_JIT(
+                _VERIFY_JIT = jax.jit(
+                    verify_batch, static_argnames=("glv", "recover")
+                )
+    is_rec, addr = _rec_defaults(qx.shape[0], is_rec, addr)
+    t0 = time.perf_counter()
+    dev_args = (
         jnp.asarray(qx),
         jnp.asarray(qy),
         jnp.asarray(q_valid),
@@ -638,6 +1108,76 @@ def verify_batch_device(qx, qy, q_valid, e, r, s, is_eth, v) -> np.ndarray:
         jnp.asarray(s),
         jnp.asarray(is_eth),
         jnp.asarray(v),
+        jnp.asarray(is_rec),
+        jnp.asarray(addr),
         g_table(),
     )
-    return np.asarray(ok)
+    t1 = time.perf_counter()
+    ok = _VERIFY_JIT(
+        *dev_args, glv=bool(glv), recover=bool(np.any(is_rec))
+    )
+    ok.block_until_ready()
+    t2 = time.perf_counter()
+    out = np.asarray(ok)
+    if timings is not None:
+        t3 = time.perf_counter()
+        timings["h2d_ms"] = timings.get("h2d_ms", 0.0) + (t1 - t0) * 1e3
+        timings["kernel_ms"] = (
+            timings.get("kernel_ms", 0.0) + (t2 - t1) * 1e3
+        )
+        timings["fetch_ms"] = timings.get("fetch_ms", 0.0) + (t3 - t2) * 1e3
+    return out
+
+
+def hash_verify_batch_device(
+    sha_blocks, sha_active, kec_blocks, kec_active,
+    qx, qy, q_valid, r, s, is_eth, v,
+    is_rec=None, addr=None, glv=True, timings=None,
+) -> np.ndarray:
+    """The fused hash->verify dispatch (device-resident hashing); same
+    collect-point and ``timings`` contract as
+    :func:`verify_batch_device`."""
+    import time
+
+    import jax
+
+    global _HASH_VERIFY_JIT
+    if _HASH_VERIFY_JIT is None:
+        with _JIT_MTX:
+            if _HASH_VERIFY_JIT is None:
+                _HASH_VERIFY_JIT = jax.jit(
+                    hash_verify_batch, static_argnames=("glv", "recover")
+                )
+    is_rec, addr = _rec_defaults(qx.shape[0], is_rec, addr)
+    t0 = time.perf_counter()
+    dev_args = (
+        jnp.asarray(sha_blocks),
+        jnp.asarray(sha_active),
+        jnp.asarray(kec_blocks),
+        jnp.asarray(kec_active),
+        jnp.asarray(qx),
+        jnp.asarray(qy),
+        jnp.asarray(q_valid),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(is_eth),
+        jnp.asarray(v),
+        jnp.asarray(is_rec),
+        jnp.asarray(addr),
+        g_table(),
+    )
+    t1 = time.perf_counter()
+    ok = _HASH_VERIFY_JIT(
+        *dev_args, glv=bool(glv), recover=bool(np.any(is_rec))
+    )
+    ok.block_until_ready()
+    t2 = time.perf_counter()
+    out = np.asarray(ok)
+    if timings is not None:
+        t3 = time.perf_counter()
+        timings["h2d_ms"] = timings.get("h2d_ms", 0.0) + (t1 - t0) * 1e3
+        timings["kernel_ms"] = (
+            timings.get("kernel_ms", 0.0) + (t2 - t1) * 1e3
+        )
+        timings["fetch_ms"] = timings.get("fetch_ms", 0.0) + (t3 - t2) * 1e3
+    return out

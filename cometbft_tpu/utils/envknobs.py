@@ -141,6 +141,42 @@ SECP_PUBKEY_CACHE = _declare(
     "square root, and CheckTx ingest repeats senders, so decode is "
     "paid once per key, not once per transaction.  0 disables caching.",
 )
+SECP_GLV = _declare(
+    "COMETBFT_TPU_SECP_GLV", "bool", True,
+    "`0` selects the plain 66-window Shamir double-scalar walk (the "
+    "bit-exactness witness path) instead of the GLV endomorphism "
+    "quad-scalar walk over 33 windows in ops/secp256k1.verify_batch.  "
+    "The verdict is bit-identical either way (tests/test_secp_glv.py "
+    "pins it); GLV roughly halves the shared doubling chain that "
+    "dominates the kernel.",
+)
+SECP_HASH_DEVICE_MIN = _declare(
+    "COMETBFT_TPU_SECP_HASH_DEVICE_MIN", "int", 64,
+    "Minimum secp batch width at/above which message hashing (SHA-256 "
+    "for cosmos rows, Keccak-256 for eth/ecrecover rows) fuses into "
+    "the device dispatch (ops/secp256k1.hash_verify_batch) instead of "
+    "running as a per-row host loop; 0 disables the fused path.  Only "
+    "batches whose every message fits COMETBFT_TPU_SECP_HASH_MAX_LEN "
+    "take it — the verdict is bit-identical either way.",
+)
+SECP_HASH_MAX_LEN = _declare(
+    "COMETBFT_TPU_SECP_HASH_MAX_LEN", "int", 119,
+    "Longest message (bytes) eligible for on-device hashing in the "
+    "fused secp dispatch: 119 keeps every row inside one Keccak rate "
+    "block (136 - pad) and two SHA-256 blocks — the CheckTx envelope "
+    "shape.  A batch with any longer message hashes on host.",
+)
+SECP_FIREHOSE_TXS = _declare(
+    "COMETBFT_TPU_SECP_FIREHOSE_TXS", "int", 100000,
+    "Signed-tx count scripts/firehose_soak.py drives through the "
+    "CheckTx secp firehose (>= 100k is the acceptance shape).",
+)
+SECP_FIREHOSE_SENDERS = _declare(
+    "COMETBFT_TPU_SECP_FIREHOSE_SENDERS", "int", 32,
+    "Distinct repeat senders per key type in the firehose pool — small "
+    "enough that the decoded-pubkey cache must earn its > 0.9 hit-rate "
+    "SLO, large enough to exercise eviction-free steady state.",
+)
 
 # verify service (verifysvc/ — priority-scheduled device batching)
 VERIFYSVC_BATCH_MAX = _declare(

@@ -394,6 +394,13 @@ class Hub:
             "building; building = async build in flight, batch routed "
             "to the uncached kernel)",
         )
+        self.secp_pubkey_cache = r.counter(
+            "verify_svc_secp_pubkey_cache_total",
+            "Decoded-secp256k1-pubkey cache lookups in the MODE_SECP "
+            "lane (label result=hit|miss); CheckTx ingest repeats "
+            "senders, so the firehose soak asserts the hit rate from "
+            "this counter instead of inferring it",
+        )
         # ---- verify service scheduler (verifysvc/service.py)
         self.verify_svc_queue_depth = r.gauge(
             "verify_svc_queue_depth",

@@ -20,6 +20,11 @@ versions:
                      0x00  ed25519        pub 32   sig 64
                      0x01  secp256k1      pub 33   sig 64  (r||s, SHA-256)
                      0x02  secp256k1eth   pub 65   sig 65  (R||S||V, Keccak)
+                     0x03  ecrecover      pub 20   sig 65  (R||S||V, Keccak;
+                           the "pubkey" is the 20-byte sender ADDRESS —
+                           the verifier recovers the signer and compares
+                           the derived address, the real Ethereum tx
+                           shape where no pubkey rides the wire)
 
 In both versions the signature is over ``SIGN_DOMAIN + payload``
 (domain separation: a tx signature can never be replayed as a vote
@@ -62,11 +67,13 @@ KEY_TYPE_BYTES: dict[str, int] = {
     "ed25519": 0,
     "secp256k1": 1,
     "secp256k1eth": 2,
+    "ecrecover": 3,
 }
 _KT_SHAPES: dict[int, tuple[str, int, int]] = {
     0: ("ed25519", 32, 64),
     1: ("secp256k1", 33, 64),
     2: ("secp256k1eth", 65, 65),
+    3: ("ecrecover", 20, 65),
 }
 
 

@@ -50,7 +50,7 @@ def resolve_mode(pubkeys: list[bytes] | None, key_type: str = "ed25519"):
     uncached), everything else the uncached kernel."""
     if key_type == "bls12_381":
         return MODE_BLS
-    if key_type in ("secp256k1", "secp256k1eth"):
+    if key_type in ("secp256k1", "secp256k1eth", "ecrecover"):
         return MODE_SECP
     if pubkeys is None:
         return MODE_PLAIN
@@ -127,9 +127,10 @@ class ServiceBatchVerifier:
             self._items.append((pub_key, msg, sig))
             return
         if self._mode[0] == "secp":
-            # 33-byte compressed (cosmos, 64-byte r||s) or 65-byte
-            # uncompressed (eth, 65-byte R||S||V) wire shapes
-            if len(pub_key) not in (33, 65) or len(sig) not in (64, 65):
+            # 33-byte compressed (cosmos, 64-byte r||s), 65-byte
+            # uncompressed (eth, 65-byte R||S||V), or 20-byte sender
+            # address (ecrecover, 65-byte R||S||V) wire shapes
+            if len(pub_key) not in (20, 33, 65) or len(sig) not in (64, 65):
                 raise ValueError("malformed secp256k1 pubkey or signature")
             self._items.append((pub_key, msg, sig))
             return

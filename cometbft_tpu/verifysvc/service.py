@@ -219,10 +219,12 @@ _KEY_TYPE_MODE = {
     "": MODE_PLAIN,
     "ed25519": MODE_PLAIN,
     "bls12_381": MODE_BLS,
-    # both secp wire formats share the MODE_SECP lane: the verifier
-    # tells rows apart by pubkey length, like the host crypto modules
+    # all three secp wire formats share the MODE_SECP lane: the
+    # verifier tells rows apart by pubkey length, like the host crypto
+    # modules (20-byte "pubkey" = ecrecover sender address)
     "secp256k1": MODE_SECP,
     "secp256k1eth": MODE_SECP,
+    "ecrecover": MODE_SECP,
 }
 
 

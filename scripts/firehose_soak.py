@@ -1,0 +1,72 @@
+"""CheckTx firehose soak driver: >=100k mixed secp ingest through one
+verify plane with adversarial storm windows — the acceptance run of
+the Ethereum-rate ingest lane (e2e/firehose.py has the SLO contract).
+
+    python scripts/firehose_soak.py --json /tmp/firehose.json
+
+Defaults come from the COMETBFT_TPU_SECP_FIREHOSE_TXS / _SENDERS knobs
+(100000 txs, 32 senders per key type); exit code is nonzero when any
+SLO assertion fails, so the run gates CI the same way scripts/soak.py
+does.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from cometbft_tpu.e2e.firehose import FirehoseConfig, run_firehose  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--txs", type=int, default=0,
+                    help="total txs (0 = COMETBFT_TPU_SECP_FIREHOSE_TXS)")
+    ap.add_argument("--senders", type=int, default=0,
+                    help="senders per key type (0 = _SENDERS knob)")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--storm-every", type=int, default=5000)
+    ap.add_argument("--storm-len", type=int, default=128)
+    ap.add_argument("--batch-max", type=int, default=16)
+    ap.add_argument("--slo-p99-ms", type=float, default=500.0)
+    ap.add_argument("--cache-hit-min", type=float, default=0.9)
+    ap.add_argument("--no-cache-check", action="store_true",
+                    help="skip the pubkey-cache SLO (host-path runs "
+                         "never touch the decode cache)")
+    ap.add_argument("--seed", type=int, default=16)
+    ap.add_argument("--json", default="", help="write the SLO artifact here")
+    args = ap.parse_args()
+
+    cfg = FirehoseConfig(
+        total_txs=args.txs,
+        senders_per_type=args.senders,
+        workers=args.workers,
+        storm_every=args.storm_every,
+        storm_len=args.storm_len,
+        batch_max=args.batch_max,
+        slo_p99_ms=args.slo_p99_ms,
+        cache_hit_min=args.cache_hit_min,
+        cache_check=not args.no_cache_check,
+        seed=args.seed,
+        json_path=args.json,
+    )
+    report = run_firehose(cfg)
+    print(json.dumps(
+        {
+            "ok": report["ok"],
+            "wall_s": report["wall_s"],
+            "txs_per_s": report["txs_per_s"],
+            "assertions": {
+                k: v["ok"] for k, v in report["assertions"].items()
+            },
+        },
+        indent=1,
+    ))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -222,3 +222,81 @@ def test_cross_lane_verdicts_disagree_on_wire_shape():
     msg = b"cross-lane"
     assert not es.pub_key().verify_signature(msg, cs.sign(msg))
     assert not cs.pub_key().verify_signature(msg, es.sign(msg))
+
+
+# ----------------------------------------------------- ecrecover lane
+
+
+def test_ecrecover_privkey1_address_kat():
+    """The most widely known derivation KAT: private key 1's address —
+    pins the whole recover-then-compare chain against a published
+    value, not just internal consistency."""
+    sk = eth.RecoverPrivKey((1).to_bytes(32, "big"))
+    assert sk.type == "ecrecover"
+    addr = sk.pub_key().data
+    assert addr.hex() == "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+    msg = b"ecrecover-kat"
+    sig = sk.sign(msg)
+    assert eth.verify_address_signature(addr, msg, sig)
+    recovered = eth.recover_pubkey(keccak256(msg), sig)
+    assert keccak256(recovered[1:])[12:] == addr
+
+
+def test_ecrecover_verdict_is_recover_then_compare():
+    """verify_address_signature must equal "recover_pubkey then compare
+    derived address" on every row — the bit-identity oracle the device
+    ecrecover lane is pinned to."""
+    sk = eth.RecoverPrivKey.from_seed(b"kat-rec")
+    addr = sk.pub_key().data
+    msg = b"rec-oracle"
+    sig = sk.sign(msg)
+    cases = [
+        (addr, msg, sig),
+        (b"\x77" * 20, msg, sig),  # wrong address
+        (addr, msg + b"!", sig),  # tampered message
+        (addr, msg, bytes([sig[0] ^ 1]) + sig[1:]),  # tampered r
+        (addr, msg, sig[:64] + bytes([sig[64] ^ 1])),  # flipped v
+        (addr, msg, sig[:64] + bytes([2])),  # v out of range
+        (addr, msg, _sig(0, 1) + b"\x00"),  # r = 0
+        (addr, msg, _sig(c.N, 1) + b"\x00"),  # r >= n
+        (addr, msg, sig[:64]),  # wrong length
+    ]
+    for a, m, sg in cases:
+        if len(sg) != 65:
+            want = False
+        elif int.from_bytes(sg[32:64], "big") > c.N // 2:
+            want = False
+        else:
+            try:
+                rec = eth.recover_pubkey(keccak256(m), sg)
+                want = keccak256(rec[1:])[12:] == a
+            except ValueError:
+                want = False
+        assert eth.verify_address_signature(a, m, sg) is want, (a[:4], m)
+
+
+def test_ecrecover_high_s_rejected_even_though_recover_accepts():
+    """recover_pubkey itself accepts a high-S signature (with flipped
+    v it recovers the same key) — the VERDICT still rejects it, same
+    as the eth lane's malleability gate."""
+    sk = eth.RecoverPrivKey.from_seed(b"kat-rec-hs")
+    addr = sk.pub_key().data
+    msg = b"rec-high-s"
+    sig = sk.sign(msg)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    hs = _sig(r, c.N - s) + bytes([sig[64] ^ 1])
+    # the recover half really does succeed and round-trip...
+    rec = eth.recover_pubkey(keccak256(msg), hs)
+    assert keccak256(rec[1:])[12:] == addr
+    # ...but the verdict is False: malleable wire forms are rejected
+    assert not eth.verify_address_signature(addr, msg, hs)
+
+
+def test_recover_pubkey_type_quacks_like_the_others():
+    pk = eth.RecoverPrivKey.from_seed(b"kat-rec-shape").pub_key()
+    assert pk.type == "ecrecover"
+    assert len(pk.bytes()) == eth.ADDRESS_SIZE
+    assert pk.address() == pk.bytes()
+    with pytest.raises(ValueError):
+        eth.RecoverPubKey(b"\x01" * 19)

@@ -46,11 +46,12 @@ def _clean_state():
 
 
 def _secp_corpus(seed: bytes = b"corpus"):
-    """Cosmos + eth rows with tampered/invalid entries; returns
-    (items, expected per-row)."""
+    """Cosmos + eth + ecrecover rows with tampered/invalid entries;
+    returns (items, expected per-row)."""
     c1 = secp.PrivKey.from_seed(seed + b"-c1")
     c2 = secp.PrivKey.from_seed(seed + b"-c2")
     e1 = seth.PrivKey.from_seed(seed + b"-e1")
+    r1 = seth.RecoverPrivKey.from_seed(seed + b"-r1")
     msg = b"secp-svc-" + seed
     good_c = (c1.pub_key().data, msg, c1.sign(msg))
     wrong_key = (c2.pub_key().data, msg, c1.sign(msg))
@@ -58,8 +59,10 @@ def _secp_corpus(seed: bytes = b"corpus"):
     sig = bytearray(c1.sign(msg))
     sig[40] ^= 1
     tampered = (c1.pub_key().data, msg, bytes(sig))
-    items = [good_c, wrong_key, good_e, tampered]
-    return items, [True, False, True, False]
+    good_r = (r1.pub_key().data, msg, r1.sign(msg))
+    wrong_addr = (b"\x13" * 20, msg, r1.sign(msg))
+    items = [good_c, wrong_key, good_e, tampered, good_r, wrong_addr]
+    return items, [True, False, True, False, True, False]
 
 
 # ------------------------------------------------------------- routing
@@ -68,18 +71,23 @@ def _secp_corpus(seed: bytes = b"corpus"):
 def test_key_type_routing():
     assert crypto_batch.supports_batch_verifier("secp256k1")
     assert crypto_batch.supports_batch_verifier("secp256k1eth")
+    assert crypto_batch.supports_batch_verifier("ecrecover")
     assert resolve_mode(None, key_type="secp256k1") == MODE_SECP
     assert resolve_mode(None, key_type="secp256k1eth") == MODE_SECP
+    assert resolve_mode(None, key_type="ecrecover") == MODE_SECP
     assert resolve_mode([b"x" * 33] * 4, key_type="secp256k1") == MODE_SECP
     assert mode_key_type(MODE_SECP) == "secp256k1"
     assert mode_for_key_type("secp256k1") == MODE_SECP
     assert mode_for_key_type("secp256k1eth") == MODE_SECP
+    assert mode_for_key_type("ecrecover") == MODE_SECP
     assert mode_for_key_type("ed25519") == MODE_PLAIN
     assert mode_for_key_type("dsa") is None
 
     v = crypto_batch.create_batch_verifier("secp256k1")
     assert isinstance(v, ServiceBatchVerifier) and v._mode == MODE_SECP
     v = crypto_batch.create_batch_verifier("secp256k1eth")
+    assert isinstance(v, ServiceBatchVerifier) and v._mode == MODE_SECP
+    v = crypto_batch.create_batch_verifier("ecrecover")
     assert isinstance(v, ServiceBatchVerifier) and v._mode == MODE_SECP
 
 
@@ -89,12 +97,15 @@ def test_cpu_backend_returns_host_secp_verifier(monkeypatch):
     assert isinstance(v, M.CpuSecpBatchVerifier)
     v = crypto_batch.create_batch_verifier("secp256k1eth")
     assert isinstance(v, M.CpuSecpBatchVerifier)
+    v = crypto_batch.create_batch_verifier("ecrecover")
+    assert isinstance(v, M.CpuSecpBatchVerifier)
 
 
 def test_client_add_validates_secp_sizes():
     v = ServiceBatchVerifier(Klass.MEMPOOL, MODE_SECP, service=VerifyService())
     v.add(b"\x02" + b"\x01" * 32, b"m", b"\x02" * 64)  # cosmos shapes
     v.add(b"\x04" + b"\x01" * 64, b"m", b"\x02" * 65)  # eth shapes
+    v.add(b"\x01" * 20, b"m", b"\x02" * 65)  # ecrecover shapes (address)
     with pytest.raises(ValueError):
         v.add(b"\x01" * 32, b"m", b"\x02" * 64)  # ed25519-sized pub
     with pytest.raises(ValueError):
@@ -285,16 +296,26 @@ def test_checktx_secp_envelopes_route_and_verify():
     try:
         ck = secp.PrivKey.from_seed(b"ck-cosmos")
         ek = seth.PrivKey.from_seed(b"ck-eth")
+        rk = seth.RecoverPrivKey.from_seed(b"ck-rec")
         good_c = checktx.make_signed_tx(ck, b"cosmos tx")
         good_e = checktx.make_signed_tx(ek, b"eth tx")
+        good_r = checktx.make_signed_tx(rk, b"rec tx")
+        # the ecrecover envelope carries only the 20-byte address
+        kt, pub, _, _ = checktx.parse_signed_tx(good_r)
+        assert kt == "ecrecover" and pub == rk.pub_key().data
+        assert len(pub) == 20
         assert checktx.verify_tx_signature(good_c, service=svc) is True
         assert checktx.verify_tx_signature(good_e, service=svc) is True
+        assert checktx.verify_tx_signature(good_r, service=svc) is True
         bad = bytearray(good_c)
         bad[-1] ^= 1  # corrupt payload
         assert checktx.verify_tx_signature(bytes(bad), service=svc) is False
         bad_e = bytearray(good_e)
         bad_e[len(checktx.MAGIC_V2) + 1 + 65 + 10] ^= 1  # corrupt sig
         assert checktx.verify_tx_signature(bytes(bad_e), service=svc) is False
+        bad_r = bytearray(good_r)
+        bad_r[len(checktx.MAGIC_V2) + 1 + 20 + 10] ^= 1  # corrupt sig
+        assert checktx.verify_tx_signature(bytes(bad_r), service=svc) is False
         assert seen and set(seen) == {"secp"}
         # unsigned passes through untouched, ed25519 still MODE_PLAIN
         assert checktx.verify_tx_signature(b"unsigned", service=svc) is None
