@@ -333,6 +333,7 @@ def _run_mixed() -> None:
 
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.crypto import ed25519 as host
+    from cometbft_tpu.utils import heightline
     from cometbft_tpu.verifysvc import checktx
     from cometbft_tpu.verifysvc.service import global_service
 
@@ -368,9 +369,18 @@ def _run_mixed() -> None:
     lat_mtx = threading.Lock()
     errors: list[str] = []
 
+    # each consensus round below is one synthetic "height": the bench
+    # surfaces the same per-height ledger a node serves on
+    # /height_timeline, with the commit verify attributed per height
+    hl = heightline.HeightlineRegistry(capacity=128, enabled=True)
+
     def consensus_loop():
         try:
+            height = 0
             while not stop.is_set():
+                height += 1
+                hl.set_current(height)
+                hl.mark(height, "start", _record=False)
                 v = crypto_batch.create_batch_verifier("ed25519", pubkeys=pubs)
                 t = time.perf_counter()
                 for pub, msg, sig in items:
@@ -378,6 +388,8 @@ def _run_mixed() -> None:
                 ok, per = v.verify()
                 dt = (time.perf_counter() - t) * 1e3
                 assert ok and len(per) == N
+                hl.mark(height, "commit", _record=False)
+                hl.note_verify(N, dt / 1e3, height=height)
                 with lat_mtx:
                     lat["consensus"].append(dt)
         except BaseException as e:  # noqa: BLE001 — report, don't hang the bench
@@ -432,6 +444,18 @@ def _run_mixed() -> None:
         "rejected": stats["rejected"],
         "batch_max": stats["batch_max"],
         "deadline_ms": stats["deadline_ms"],
+    }
+    snap = hl.snapshot(limit=10)
+    REPORT["height_timeline"] = {
+        "heights_total": hl.current,
+        "newest": [
+            {
+                "height": h["height"],
+                "commit_s": h["phase_seconds"].get("commit"),
+                "verify": h["verify"],
+            }
+            for h in snap["heights"]
+        ],
     }
     if errors:
         REPORT["error"] = "; ".join(errors[:4])

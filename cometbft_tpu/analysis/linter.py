@@ -140,6 +140,7 @@ def all_checks() -> dict[str, object]:
         socket_timeout,
         swallowed_exc,
         thread_names,
+        undocumented_metric,
         untracked_jit,
         weak_type_literal,
     )
@@ -150,6 +151,7 @@ def all_checks() -> dict[str, object]:
         raw_env,
         jax_purity,
         metrics_registry,
+        undocumented_metric,
         thread_names,
         untracked_jit,
         host_sync,

@@ -1,0 +1,110 @@
+"""Check: undocumented-metric.
+
+Every metric the Hub registers in ``utils/metrics.py`` must have a row
+in ``docs/observability.md``'s metric inventory (a ``| `cometbft_<name>`
+| ...`` table row), and every documented row must correspond to a
+registered metric.  The inventory is the operator-facing contract — a
+series that ships without a row is invisible to whoever builds the
+dashboard, and a row whose series was renamed away is worse: it
+documents a metric that silently stopped existing.
+
+Scope: registration call sites (``r.counter/gauge/histogram("name",
+...)``) inside ``class Hub`` of ``utils/metrics.py``; the staleness
+direction additionally accepts any name registered elsewhere in the
+module (``NodeMetrics``) so shared rows don't read as stale.  The check
+fires only while linting ``utils/metrics.py`` itself — one module, one
+documentation diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .linter import Finding, Module
+
+CHECK_ID = "undocumented-metric"
+SUMMARY = "Hub metric without a docs/observability.md inventory row (or a stale row)"
+
+_TARGET_SUFFIX = "utils/metrics.py"
+_DOC_RELPATH = "docs/observability.md"
+_FACTORIES = {"counter", "gauge", "histogram"}
+_ROW_RE = re.compile(r"^\|\s*`cometbft_([A-Za-z0-9_]+)`")
+
+
+def _registrations(tree: ast.AST) -> tuple[list[tuple[str, int]], set[str]]:
+    """(Hub registrations as (metric name, line), every registered name
+    module-wide).  A registration is ``<anything>.counter|gauge|
+    histogram("literal", ...)`` — the Registry factory idiom the
+    metrics-via-registry check already enforces."""
+    hub: list[tuple[str, int]] = []
+    everywhere: set[str] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FACTORIES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            everywhere.add(node.args[0].value)
+            if cls.name == "Hub":
+                hub.append((node.args[0].value, node.lineno))
+    return hub, everywhere
+
+
+def _doc_path(metrics_path: str) -> str:
+    # <root>/cometbft_tpu/utils/metrics.py -> <root>/docs/observability.md
+    root = os.path.dirname(os.path.dirname(os.path.dirname(metrics_path)))
+    return os.path.join(root, *_DOC_RELPATH.split("/"))
+
+
+def check(mod: Module) -> list[Finding]:
+    if not mod.path.endswith(_TARGET_SUFFIX):
+        return []
+    hub, everywhere = _registrations(mod.tree)
+    doc_path = _doc_path(mod.path)
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc_lines = f.read().splitlines()
+    except OSError:
+        return [
+            Finding(
+                CHECK_ID, mod.path, 1, 0,
+                f"cannot read {_DOC_RELPATH}: the metric inventory the "
+                "Hub's series are documented in is missing",
+            )
+        ]
+    documented: dict[str, int] = {}
+    for lineno, line in enumerate(doc_lines, 1):
+        m = _ROW_RE.match(line)
+        if m:
+            documented.setdefault(m.group(1), lineno)
+
+    findings: list[Finding] = []
+    for name, lineno in hub:
+        if name not in documented:
+            findings.append(
+                Finding(
+                    CHECK_ID, mod.path, lineno, 0,
+                    f"Hub metric `cometbft_{name}` has no inventory row "
+                    f"in {_DOC_RELPATH} — add `| \\`cometbft_{name}\\` | "
+                    "type | labels | meaning |`",
+                )
+            )
+    for name, lineno in sorted(documented.items(), key=lambda kv: kv[1]):
+        if name not in everywhere:
+            findings.append(
+                Finding(
+                    CHECK_ID, _DOC_RELPATH, lineno, 0,
+                    f"stale inventory row: `cometbft_{name}` is not "
+                    f"registered anywhere in {_TARGET_SUFFIX}",
+                )
+            )
+    return findings

@@ -18,6 +18,7 @@ from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
 from ..types.block import Block, ExtendedCommit
 from ..utils import healthmon, tracing
+from ..utils.heightline import registry as _heightline
 from ..utils.log import get_logger
 from ..wire import blocksync_pb as pb
 from .pool import BlockPool, BlockRequest, PeerError
@@ -424,6 +425,13 @@ class BlocksyncReactor(Reactor):
         from ..verifysvc.service import Klass
 
         chain_id = self.initial_state.chain_id
+        hh = first.header.height
+        hl = _heightline()
+        # fast-synced heights never see proposals/votes; the timeline is
+        # full_block (have the bytes) -> commit (verified+saved) -> apply
+        hl.mark(hh, "full_block")
+        nsigs = len(second.last_commit.signatures) if second.last_commit else 0
+        t_verify = time.monotonic()
         if (
             pend is not None
             and pend.first is first
@@ -456,6 +464,9 @@ class BlocksyncReactor(Reactor):
                     second.last_commit,
                     klass=Klass.BLOCKSYNC,
                 )
+        # blocksync knows its height — attribute the wait explicitly
+        # (the verify-service collector can't; it uses the current height)
+        hl.note_verify(nsigs, time.monotonic() - t_verify, height=hh)
         with tracing.span(
             "blocksync.validate",
             {"height": first.header.height} if tracing.enabled() else None,
@@ -476,14 +487,17 @@ class BlocksyncReactor(Reactor):
         else:
             self.store.save_block(first, first_parts, second.last_commit)
         self.pool.pop_request()
+        hl.mark(hh, "commit")
 
         with tracing.span(
             "blocksync.apply",
             {"height": first.header.height} if tracing.enabled() else None,
         ):
-            return self.block_exec.apply_verified_block(
+            new_state = self.block_exec.apply_verified_block(
                 state, first_id, first, syncing_to_height=self.pool.max_height()
             )
+        hl.mark(hh, "apply")
+        return new_state
 
     # ------------------------------------------------- switch to consensus
 

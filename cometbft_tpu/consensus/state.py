@@ -31,6 +31,7 @@ from ..types.vote import Vote, VoteError
 from ..types.vote_set import ErrVoteConflictingVotes, VoteSet
 from ..utils import healthmon, tracing
 from ..utils.flightrec import recorder as _flightrec
+from ..utils.heightline import registry as _heightline
 from ..utils.log import get_logger
 from ..utils.service import Service
 from ..verifysvc.service import Klass as _VerifyKlass
@@ -596,6 +597,12 @@ class ConsensusState(Service):
         self._round_started_at = now
         m.cs_validators_power.set(validators.total_voting_power())
         self._update_round_step(round, STEP_NEW_ROUND)
+        if not self._replay_mode:
+            # height timeline: first round entry stamps "start"; later
+            # rounds only bump the recorded max round (first-mark-wins)
+            hl = _heightline()
+            hl.set_current(height)
+            hl.mark(height, "start", round_=round)
         rs.validators = validators
         if round != 0:
             # round advanced: drop the stale proposal (state.go:1102)
@@ -784,6 +791,9 @@ class ConsensusState(Service):
                 pol_round=proposal.pol_round,
                 block=proposal.block_id.hash.hex()[:12],
             )
+            _heightline().mark(
+                proposal.height, "proposal", round_=proposal.round
+            )
         rs.proposal = proposal
         rs.proposal_receive_time_ns = receive_time_ns
         if rs.proposal_block_parts is None:
@@ -800,6 +810,8 @@ class ConsensusState(Service):
         if not added or not rs.proposal_block_parts.is_complete():
             return
         rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+        if not self._replay_mode:
+            _heightline().mark(rs.height, "full_block", round_=rs.round)
         self.logger.info(
             f"received complete proposal block h={rs.proposal_block.header.height} "
             f"hash={rs.proposal_block.hash().hex()[:12]}"
@@ -907,6 +919,8 @@ class ConsensusState(Service):
             return
 
         self.event_bus.publish_polka(rs.round_state_event())
+        if not bid.is_nil() and not self._replay_mode:
+            _heightline().mark(height, "prevote_23", round_=round)
 
         if bid.is_nil():
             # polka for nil: precommit nil and unlock (state.go:1661)
@@ -954,6 +968,19 @@ class ConsensusState(Service):
             return
         rs.commit_time_ns = time.time_ns()
         self._update_round_step(rs.round, STEP_COMMIT)
+        if not self._replay_mode:
+            # commit entry doubles as the +2/3-precommit observation
+            # point — _enter_commit is only reached on a precommit
+            # majority, so both marks share commit_time_ns
+            hl = _heightline()
+            hl.mark(
+                height, "precommit_23",
+                wall_ns=rs.commit_time_ns, round_=commit_round,
+            )
+            hl.mark(
+                height, "commit",
+                wall_ns=rs.commit_time_ns, round_=commit_round,
+            )
         rs.commit_round = commit_round
         precommits = rs.votes.precommits(commit_round)
         bid, ok = precommits.two_thirds_majority()
@@ -1040,6 +1067,12 @@ class ConsensusState(Service):
         state_copy = self.state.copy()
         new_state = self.block_exec.apply_verified_block(state_copy, bid, block)
         self.update_to_state(new_state)
+        if not self._replay_mode:
+            hl = _heightline()
+            hl.mark(height, "apply", round_=rs.commit_round)
+            # verify batches between now and the next round-0 entry
+            # belong to the height we just moved to
+            hl.set_current(self.rs.height)
         self._schedule_round0(self.rs)
 
     # --------------------------------------------------------------- votes

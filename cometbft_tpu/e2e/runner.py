@@ -110,6 +110,21 @@ class E2ENode:
                 f"{self.latency_ms}:{self.latency_jitter_ms}"
             )
         env.update(self.extra_env)
+        from ..utils import tracing as _tracing
+
+        _tv = env.get("COMETBFT_TPU_TRACE", "").lower()
+        _tv_explicit_path = (
+            "COMETBFT_TPU_TRACE" in self.extra_env
+            and (os.sep in _tv or _tv.endswith(".json"))
+        )
+        if _tv not in _tracing._OFF_VALUES and not _tv_explicit_path:
+            # tracing armed (parent env or node spec): every node exports
+            # its OWN trace file at exit — a shared inherited path would
+            # be torn by concurrent atexit writers; the chaos/soak
+            # epilogues merge the per-process exports into one timeline
+            # (utils/tracemerge).  Only an explicit per-node path in the
+            # spec's env is left alone.
+            env["COMETBFT_TPU_TRACE"] = os.path.join(self.home, "trace.json")
         if self.abci_port and self.app_proc is None:
             # external app rides the ABCI socket or gRPC transport (the
             # generator's abci axis); it outlives node restarts the way

@@ -35,6 +35,12 @@ The scenarios extend the e2e :class:`~cometbft_tpu.e2e.runner.Runner`
                            host path (heights keep advancing), and
                            restarting the plane must probation-restore
                            the remote path on every node.
+``trace_smoke``            1 node + verifyd with span tracing armed in
+                           both processes: after clean shutdown the
+                           per-process exports must merge into ONE
+                           timeline in which a node-side span and the
+                           plane's server span share a trace_id, and
+                           /height_timeline must cover >= 5 heights.
 ========================== ==============================================
 
 Driven by ``scripts/chaos.py`` (``--json`` emits a machine-readable
@@ -200,7 +206,8 @@ def _collect_artifacts(runner: Runner, out_dir: str) -> dict:
             continue
         dumps = {}
         for route in ("tpu_health", "verify_svc_status",
-                      "dump_consensus_trace", "faults", "status"):
+                      "dump_consensus_trace", "height_timeline",
+                      "faults", "status"):
             try:
                 dumps[route] = node.rpc(route)
             except Exception as e:  # noqa: BLE001 — partial artifacts beat none
@@ -234,6 +241,46 @@ def _finish(
     res.ok = res.liveness and res.safety and not res.problems
     res.elapsed_s = time.monotonic() - t0
     return res
+
+
+def _trace_armed() -> bool:
+    """Is COMETBFT_TPU_TRACE truthy in the harness env?  When it is,
+    every spawned node/verifyd exports its own trace file (see
+    e2e/runner E2ENode.start) and the scenario epilogue merges them."""
+    from ..utils import envknobs, tracing
+
+    return envknobs.get_str(envknobs.TRACE).lower() not in tracing._OFF_VALUES
+
+
+def _merge_scenario_traces(res: ScenarioResult) -> None:
+    """After the nodes have exited (their atexit exports flushed),
+    stitch every per-process trace export under the artifact dir into
+    ONE Perfetto timeline — <artifact_dir>/merged.trace.json."""
+    import glob
+
+    from ..utils import tracemerge
+
+    if "merged_trace" in res.details:
+        return  # the scenario already merged (trace_smoke asserts on it)
+    paths = sorted(
+        glob.glob(os.path.join(res.artifact_dir, "net", "*", "trace.json"))
+        + glob.glob(os.path.join(res.artifact_dir, "*.trace.json"))
+    )
+    out = os.path.join(res.artifact_dir, "merged.trace.json")
+    paths = [p for p in paths if os.path.abspath(p) != os.path.abspath(out)]
+    if not paths:
+        return
+    try:
+        report = tracemerge.merge_files(paths, out)
+    except tracemerge.MergeError as e:
+        res.details["trace_merge_error"] = str(e)
+        return
+    res.details["merged_trace"] = out
+    res.details["trace_processes"] = len(report["processes"])
+    _log.info(
+        f"merged {report['total_events']} trace events from "
+        f"{len(report['processes'])} process(es) -> {out}"
+    )
 
 
 def _failover_events(node) -> list[dict]:
@@ -676,7 +723,14 @@ def scenario_plane_crash(out_dir: str, base_port: int = 27200) -> ScenarioResult
     plane_addr = f"127.0.0.1:{base_port + 900}"
     os.makedirs(res.artifact_dir, exist_ok=True)
     plane_log = os.path.join(res.artifact_dir, "verifyd.log")
-    plane, plane_addr = vserver.spawn_verifyd(plane_addr, log_path=plane_log)
+    plane_env = {}
+    if _trace_armed():
+        plane_env["COMETBFT_TPU_TRACE"] = os.path.join(
+            res.artifact_dir, "verifyd.trace.json"
+        )
+    plane, plane_addr = vserver.spawn_verifyd(
+        plane_addr, extra_env=plane_env, log_path=plane_log
+    )
     res.details["plane_addr"] = plane_addr
     # a tight breaker leash so the scenario's windows stay short: small
     # request budget, a couple of connection failures to trip, fast
@@ -761,7 +815,15 @@ def scenario_plane_crash(out_dir: str, base_port: int = 27200) -> ScenarioResult
             )
 
         # ---- revive the plane at the same address; probation restores
-        plane, _ = vserver.spawn_verifyd(plane_addr, log_path=plane_log)
+        if plane_env:
+            # the revived plane gets its own export — re-using the first
+            # incarnation's path would overwrite its (crashed) trace
+            plane_env["COMETBFT_TPU_TRACE"] = os.path.join(
+                res.artifact_dir, "verifyd2.trace.json"
+            )
+        plane, _ = vserver.spawn_verifyd(
+            plane_addr, extra_env=plane_env, log_path=plane_log
+        )
         restored = _drive_load_until(
             r, lambda: all(b == "closed" for b in _breakers()), 120,
             "breakers closed after restart", extra=signed_load,
@@ -796,6 +858,145 @@ def scenario_plane_crash(out_dir: str, base_port: int = 27200) -> ScenarioResult
             _log.debug(f"plane teardown kill: {e!r}")
 
 
+def _linked_cross_process_trace_ids(events: list[dict]) -> list[str]:
+    """trace_ids that link a server-side plane span (verify.rpc.serve)
+    in one process to any span/instant in a DIFFERENT process — the
+    cross-process stitch the whole propagation machinery exists for."""
+    server_pids: dict[str, set] = {}
+    other_pids: dict[str, set] = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        bucket = (
+            server_pids if e.get("name") == "verify.rpc.serve" else other_pids
+        )
+        bucket.setdefault(tid, set()).add(e.get("pid"))
+    return sorted(
+        tid for tid, spids in server_pids.items()
+        if other_pids.get(tid, set()) - spids
+    )
+
+
+def scenario_trace_smoke(out_dir: str, base_port: int = 27600) -> ScenarioResult:
+    """End-to-end distributed-tracing smoke: one node consumes a REAL
+    out-of-process verify plane (verifyd) with span tracing armed in
+    both processes.  After >=6 committed heights under signed CheckTx
+    load, the node's /height_timeline must report per-phase wall times
+    for >=5 heights, and — after both processes exit cleanly and their
+    atexit trace exports flush — the merged Perfetto timeline must span
+    both processes with at least one client-side span sharing a
+    trace_id with the plane's server-side verify.rpc.serve span."""
+    from ..verifysvc import server as vserver
+
+    res = ScenarioResult(
+        "trace_smoke", artifact_dir=os.path.join(out_dir, "trace_smoke")
+    )
+    t0 = time.monotonic()
+    os.makedirs(res.artifact_dir, exist_ok=True)
+    plane_env = {
+        "COMETBFT_TPU_TRACE": os.path.join(
+            res.artifact_dir, "verifyd.trace.json"
+        )
+    }
+    plane, plane_addr = vserver.spawn_verifyd(
+        f"127.0.0.1:{base_port + 900}",
+        extra_env=plane_env,
+        log_path=os.path.join(res.artifact_dir, "verifyd.log"),
+    )
+    m = Manifest(
+        chain_id="chaos-trace-smoke",
+        nodes=[
+            NodeSpec("solo", env={
+                # truthy-not-a-path: the runner redirects it to the
+                # node's own <home>/trace.json export
+                "COMETBFT_TPU_TRACE": "1",
+                "COMETBFT_TPU_VERIFYRPC_ADDR": plane_addr,
+            })
+        ],
+        target_height=6,
+        load_tx_per_round=1,
+    )
+    r = Runner(
+        m, os.path.join(out_dir, "trace_smoke", "net"), base_port=base_port
+    )
+    r.setup()
+    r.start()
+    node = r.nodes[0]
+    signed_load = _signed_tx_sender(node, "trace")
+    try:
+        if not _drive_load_until(
+            r, lambda: _min_height(r) >= 6, 240, "six committed heights",
+            extra=signed_load,
+        ):
+            res.problems.append(
+                f"node never reached height 6 (at {_min_height(r)})"
+            )
+            return _finish(res, r, t0, upto=6)
+        res.liveness = True
+
+        ht = node.rpc("height_timeline")
+        timed = [
+            h for h in ht.get("heights", [])
+            if h.get("phase_seconds") and "commit" in h.get("phases_wall_ns", {})
+        ]
+        res.details["timeline_heights"] = len(timed)
+        if len(timed) < 5:
+            res.problems.append(
+                f"/height_timeline has {len(timed)} committed heights "
+                "with phase deltas, want >= 5"
+            )
+
+        res.details["remote_section"] = node.verify_svc().get("remote")
+        res = _finish(res, r, t0, upto=6)
+
+        # clean shutdown (SIGTERM) so both atexit exports hit disk,
+        # then stitch and assert the cross-process link
+        r.stop_all()
+        plane.terminate()
+        try:
+            plane.wait(timeout=20)
+        except Exception:  # noqa: BLE001
+            plane.kill()
+        _merge_scenario_traces(res)
+        merged_path = res.details.get("merged_trace")
+        if not merged_path:
+            res.problems.append(
+                "no merged timeline produced "
+                f"({res.details.get('trace_merge_error', 'no exports found')})"
+            )
+        else:
+            with open(merged_path) as f:
+                doc = json.load(f)
+            events = doc.get("traceEvents", [])
+            pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+            linked = _linked_cross_process_trace_ids(events)
+            res.details["trace_pids"] = len(pids)
+            res.details["linked_trace_ids"] = len(linked)
+            if len(pids) < 2:
+                res.problems.append(
+                    f"merged timeline spans {len(pids)} process(es), want >= 2"
+                )
+            if not linked:
+                res.problems.append(
+                    "no client-side span shares a trace_id with a "
+                    "server-side verify.rpc.serve span"
+                )
+        res.ok = res.liveness and res.safety and not res.problems
+        res.elapsed_s = time.monotonic() - t0
+        return res
+    finally:
+        r.stop_all()
+        try:
+            plane.terminate()
+            plane.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            try:
+                plane.kill()
+            except OSError as e:
+                _log.debug(f"plane teardown kill: {e!r}")
+
+
 # ------------------------------------------------------------- registry
 
 SCENARIOS = {
@@ -806,6 +1007,7 @@ SCENARIOS = {
     "double_sign": scenario_double_sign,
     "valset_rotation_blocksync": scenario_valset_rotation_blocksync,
     "plane_crash": scenario_plane_crash,
+    "trace_smoke": scenario_trace_smoke,
 }
 
 # the six "full" scenarios scripts/chaos.py runs by default (the smoke
@@ -860,6 +1062,14 @@ def run_scenario(
             },
             artifact_dir=os.path.join(out_dir, name),
         )
+    if _trace_armed():
+        # every node process has exited (stop_all in the scenario's
+        # finally), so the per-process atexit exports are on disk
+        try:
+            _merge_scenario_traces(res)
+        except Exception as e:  # noqa: BLE001 — merging must never fail a run
+            _log.warning(f"trace merge failed: {e!r}")
+            res.details.setdefault("trace_merge_error", repr(e))
     _log.info(
         f"chaos scenario {name}: {'PASS' if res.ok else 'FAIL'} "
         f"({res.elapsed_s:.1f}s, problems={res.problems})"

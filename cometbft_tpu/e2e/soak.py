@@ -187,6 +187,7 @@ class SoakRun:
         self.rogue = cfg.rogue or self.chains[-1].name
         self._verifyd = None
         self.plane_addr: str | None = None
+        self._plane_gen = 0  # verifyd incarnation counter (trace file names)
         if cfg.remote_plane:
             # the PLANE owns admission control: its env carries the real
             # quota/batch shape, while the client-side service's quota is
@@ -273,16 +274,60 @@ class SoakRun:
     def _spawn_plane(self) -> None:
         from ..verifysvc import server as vserver
 
+        from ..utils import tracing
+
         addr = self.plane_addr or f"127.0.0.1:{self.cfg.verifyd_port}"
         log = os.path.join(
             self.cfg.artifact_dir or os.getcwd(), "soak-verifyd.log"
         ) if self.cfg.artifact_dir else None
+        env = dict(self._verifyd_env)
+        if tracing.enabled() and self.cfg.artifact_dir:
+            # each incarnation exports its own trace (mid-soak kill -9
+            # cycles lose theirs — only clean exits flush); the run
+            # epilogue merges whatever landed
+            self._plane_gen += 1
+            env["COMETBFT_TPU_TRACE"] = os.path.join(
+                self.cfg.artifact_dir,
+                f"soak-verifyd{self._plane_gen}.trace.json",
+            )
         self._verifyd, self.plane_addr = vserver.spawn_verifyd(
-            addr, extra_env=dict(self._verifyd_env), log_path=log,
+            addr, extra_env=env, log_path=log,
         )
         _log.info(
             f"soak verifyd at {self.plane_addr} (pid {self._verifyd.pid})"
         )
+
+    def _merge_traces(self) -> dict | None:
+        """Tracing armed + an artifact dir: export this process's span
+        ring and stitch it with whatever plane incarnations flushed into
+        ONE ``merged.trace.json`` (utils/tracemerge).  None when tracing
+        is off or there's nowhere to put it."""
+        import glob
+
+        from ..utils import tracemerge, tracing
+
+        if not (tracing.enabled() and self.cfg.artifact_dir):
+            return None
+        own = os.path.join(self.cfg.artifact_dir, "soak.trace.json")
+        try:
+            tracing.export_chrome_trace(own)
+        except Exception as e:  # noqa: BLE001 — tracing must never fail the soak
+            _log.warning(f"soak trace export: {e!r}")
+            return {"error": repr(e)}
+        paths = [own] + sorted(glob.glob(
+            os.path.join(self.cfg.artifact_dir, "soak-verifyd*.trace.json")
+        ))
+        out = os.path.join(self.cfg.artifact_dir, "merged.trace.json")
+        try:
+            rep = tracemerge.merge_files(paths, out)
+        except tracemerge.MergeError as e:
+            return {"error": str(e), "exports": paths}
+        return {
+            "merged": out,
+            "processes": len(rep["processes"]),
+            "events": rep["total_events"],
+            "skipped": [s["label"] for s in rep.get("skipped", [])],
+        }
 
     def _plane_stats(self) -> dict | None:
         from ..verifysvc import remote as vremote
@@ -724,10 +769,21 @@ class SoakRun:
         self.svc.stop()
         if self._verifyd is not None:
             try:
-                self._verifyd.kill()
-                self._verifyd.wait(timeout=10)
+                # SIGTERM first: the plane's clean exit flushes its
+                # atexit trace export (mid-soak crash cycles SIGKILL and
+                # forfeit theirs by design)
+                self._verifyd.terminate()
+                self._verifyd.wait(timeout=15)
             except Exception as e:  # noqa: BLE001 — teardown of a maybe-dead child
                 _log.warning(f"soak verifyd teardown: {e!r}")
+                try:
+                    self._verifyd.kill()
+                    self._verifyd.wait(timeout=10)
+                except Exception as e2:  # noqa: BLE001 — already force-killing
+                    _log.warning(f"soak verifyd force-kill: {e2!r}")
+        trace = self._merge_traces()
+        if trace is not None:
+            report["trace"] = trace
         if cfg.json_path:
             os.makedirs(
                 os.path.dirname(os.path.abspath(cfg.json_path)), exist_ok=True

@@ -710,6 +710,24 @@ class Environment:
 
         return recorder().dump()
 
+    def height_timeline(self, limit=None) -> dict:
+        """Per-height consensus timeline (ours, no reference analogue):
+        for each of the last N heights, the wall time the pipeline
+        reached every phase (proposal received, block assembled, 2/3
+        prevote, 2/3 precommit, commit, apply), the per-phase deltas in
+        seconds, and the height's verify-batch attribution — "why was
+        height H slow" in one request (utils/heightline.py).  `limit`
+        keeps only the newest N heights."""
+        from ..utils.heightline import registry
+
+        lim = None
+        if limit is not None and limit != "":
+            try:
+                lim = int(limit)
+            except (TypeError, ValueError):
+                raise RPCError(-32602, f"bad limit {limit!r}")
+        return registry().snapshot(limit=lim)
+
     # --------------------------------------------- fault injection (chaos)
 
     def _require_fault_rpc(self) -> None:
@@ -881,6 +899,7 @@ ROUTES = {
     "consensus_state": ("", Environment.consensus_state),
     "dump_consensus_state": ("", Environment.dump_consensus_state),
     "dump_consensus_trace": ("", Environment.dump_consensus_trace),
+    "height_timeline": ("limit", Environment.height_timeline),
     "verify_svc_status": ("", Environment.verify_svc_status),
     # fault injection (chaos harness; live only with COMETBFT_TPU_FAULT_RPC=1)
     "arm_fault": ("name,value", Environment.arm_fault),

@@ -435,9 +435,27 @@ TRACE_RING = _declare(
     "COMETBFT_TPU_TRACE_RING", "int", 65536,
     "Tracer ring capacity in events (clamped to >= 1).",
 )
+TRACE_CTX = _declare(
+    "COMETBFT_TPU_TRACE_CTX", "bool", True,
+    "`0` disables span-context propagation: no trace_id/span_id args on "
+    "recorded events and no traceparent field on verify-plane RPC "
+    "requests (the per-process tracer itself stays governed by "
+    "COMETBFT_TPU_TRACE).",
+)
 FLIGHTREC = _declare(
     "COMETBFT_TPU_FLIGHTREC", "int", 1024,
     "Consensus flight-recorder ring capacity (clamped to >= 1).",
+)
+HEIGHTLINE_CAP = _declare(
+    "COMETBFT_TPU_HEIGHTLINE_CAP", "int", 512,
+    "Per-height consensus timeline ledger capacity in heights (clamped "
+    "to >= 8); the oldest heights are evicted as new ones commit.",
+)
+HEIGHTLINE = _declare(
+    "COMETBFT_TPU_HEIGHTLINE", "bool", True,
+    "`0` disables the per-height timeline ledger (utils/heightline): "
+    "no phase recording, an empty `/height_timeline` RPC answer, and "
+    "no `consensus_height_phase_seconds` observations.",
 )
 
 # health sentinel (utils/healthmon)

@@ -361,6 +361,15 @@ class Hub:
             "consensus_timeout_fired_total",
             "Consensus timeouts fired by the ticker (label step)",
         )
+        self.cs_height_phase = r.histogram(
+            "consensus_height_phase_seconds",
+            "Wall time between a height's consecutive timeline phases "
+            "(label phase=proposal|full_block|prevote_23|precommit_23|"
+            "commit|apply) — fed by the per-height ledger "
+            "(utils/heightline); 'why was height H slow' reads here "
+            "first, then /height_timeline for the per-height detail",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2, 4, 8, 16),
+        )
         # ---- stores (store/metrics.go BlockStore access durations)
         self.store_access_seconds = r.histogram(
             "store_block_store_access_duration_seconds",

@@ -1,0 +1,174 @@
+"""Stitch per-process Chrome trace exports into ONE Perfetto timeline.
+
+Every process's tracer (utils/tracing) exports timestamps from its own
+``perf_counter_ns`` epoch — two processes' exports cannot be overlaid
+directly.  But each export leads with a ``wall_clock_anchor`` metadata
+record: one (wall_time_ns, perf_counter_ns) pair sampled at export
+time, giving the correlation
+
+    wall_ns(event) = wall_time_ns + (event.ts * 1000 - perf_counter_ns)
+
+This module rebases every export onto the wall clock, shifts the merged
+timeline to start at zero (Perfetto dislikes 53-bit microsecond
+timestamps), namespaces each export under its own pid (collisions —
+pid reuse, or the same process exported twice — are remapped to a
+synthetic pid), labels each process track, and reports the per-export
+**anchor skew**: on one host ``wall_time_ns - perf_counter_ns`` should
+be (nearly) the same constant in every process, so the spread between
+exports measures wall-clock adjustment/jitter between their export
+moments — a large skew means cross-process span alignment is only
+trustworthy to that bound.
+
+Cross-process *causality* doesn't rely on timestamps at all: spans
+recorded under a propagated :class:`~.tracing.SpanContext` carry
+``trace_id`` args, so a consensus-side verify span and the plane's
+server-side span link by id however the clocks sit.
+
+``scripts/trace_merge.py`` is the CLI; the chaos scenarios and the soak
+engine call :func:`merge_files` directly when ``COMETBFT_TPU_TRACE`` is
+armed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ANCHOR_NAME = "wall_clock_anchor"
+
+
+class MergeError(ValueError):
+    """An input export is unusable (no events / no anchor)."""
+
+
+def _load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    else:
+        events = doc  # bare-array form of the trace-event format
+    if not isinstance(events, list):
+        raise MergeError(f"{path}: traceEvents is not a list")
+    return events
+
+
+def _find_anchor(events: list[dict], path: str) -> tuple[int, int]:
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == ANCHOR_NAME:
+            args = e.get("args", {})
+            try:
+                return int(args["wall_time_ns"]), int(args["perf_counter_ns"])
+            except (KeyError, TypeError, ValueError):
+                raise MergeError(f"{path}: malformed {ANCHOR_NAME} record")
+    raise MergeError(f"{path}: no {ANCHOR_NAME} record (not a tracing.py export?)")
+
+
+def merge_exports(
+    exports: list[tuple[str, list[dict]]],
+) -> tuple[dict, dict]:
+    """Merge ``[(label, events), ...]`` into one timeline.
+
+    Returns ``(merged_doc, report)``: ``merged_doc`` is a Perfetto-
+    loadable ``{"traceEvents": [...]}`` dict; ``report`` carries per-
+    label pid assignment, event counts, and anchor skew in ns relative
+    to the earliest-offset export."""
+    if not exports:
+        raise MergeError("nothing to merge")
+    prepared = []
+    for label, events in exports:
+        wall_ns, perf_ns = _find_anchor(events, label)
+        pid = None
+        for e in events:
+            if "pid" in e:
+                pid = e["pid"]
+                break
+        prepared.append({
+            "label": label,
+            "events": events,
+            "offset_ns": wall_ns - perf_ns,  # perf epoch -> wall epoch
+            "pid": pid if pid is not None else 0,
+        })
+    base_offset = min(p["offset_ns"] for p in prepared)
+    # zero point: the earliest rebased event start across all exports
+    t0_ns = None
+    for p in prepared:
+        for e in p["events"]:
+            if e.get("ph") == "M":
+                continue
+            wall = p["offset_ns"] + int(e.get("ts", 0) * 1000)
+            if t0_ns is None or wall < t0_ns:
+                t0_ns = wall
+    if t0_ns is None:
+        raise MergeError("no span/instant events in any export")
+
+    used_pids: set[int] = set()
+    out: list[dict] = []
+    report: dict = {"processes": [], "t0_wall_ns": t0_ns}
+    synth = 1 << 20  # synthetic pid range, above any real Linux pid
+    for p in prepared:
+        pid = p["pid"]
+        remapped = pid in used_pids
+        if remapped:
+            while synth in used_pids:
+                synth += 1
+            pid = synth
+        used_pids.add(pid)
+        name = os.path.basename(p["label"])
+        out.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": name + (" (pid remapped)" if remapped else "")},
+        })
+        n = 0
+        for e in p["events"]:
+            e = dict(e)
+            e["pid"] = pid
+            if e.get("ph") == "M":
+                if e.get("name") == ANCHOR_NAME:
+                    continue  # superseded by the merge's common timeline
+                out.append(e)
+                continue
+            wall = p["offset_ns"] + int(e.get("ts", 0) * 1000)
+            e["ts"] = (wall - t0_ns) / 1e3
+            out.append(e)
+            n += 1
+        report["processes"].append({
+            "label": p["label"],
+            "pid": pid,
+            "pid_remapped": remapped,
+            "events": n,
+            "anchor_skew_ns": p["offset_ns"] - base_offset,
+        })
+    out.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    merged = {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [p["label"] for p in prepared],
+            "anchor_skew_ns": {
+                r["label"]: r["anchor_skew_ns"] for r in report["processes"]
+            },
+        },
+    }
+    return merged, report
+
+
+def merge_files(paths: list[str], out_path: str) -> dict:
+    """Merge export files into ``out_path``; returns the report.  Files
+    that fail to load/anchor are skipped and listed under
+    ``report["skipped"]`` — a crashed process's torn half-written export
+    must not cost the timeline of every healthy one."""
+    exports = []
+    skipped = []
+    for path in paths:
+        try:
+            exports.append((path, _load_events(path)))
+        except (OSError, ValueError) as e:
+            skipped.append({"label": path, "error": str(e)})
+    merged, report = merge_exports(exports)
+    report["skipped"] = skipped
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    report["out"] = out_path
+    report["total_events"] = sum(p["events"] for p in report["processes"])
+    return report

@@ -20,6 +20,16 @@ Enable with COMETBFT_TPU_TRACE=1 (drain via export_chrome_trace / the
 API) or COMETBFT_TPU_TRACE=/path/to/out.trace.json to also auto-export
 at interpreter exit.  COMETBFT_TPU_TRACE_RING sizes the ring (events,
 default 65536).
+
+Cross-process correlation: a :class:`SpanContext` (W3C-traceparent-
+shaped trace_id/span_id pair) can be installed as the thread's current
+context (:func:`context_scope`); every event recorded under a scope
+carries ``trace_id``/``span_id`` args, and the context serializes to /
+parses from a ``traceparent`` string so it can ride a wire field — the
+verify plane's RPC layer propagates it, and ``scripts/trace_merge.py``
+stitches the per-process exports into one timeline where client and
+server spans of a remote verify share a trace_id.
+COMETBFT_TPU_TRACE_CTX=0 turns propagation off (events stay local).
 """
 
 from __future__ import annotations
@@ -41,6 +51,7 @@ _CHUNK = 64
 _DEFAULT_RING = 65536
 
 _ENABLED = False
+_CTX_ENABLED = True  # COMETBFT_TPU_TRACE_CTX — span-context propagation
 _EXPORT_PATH: str | None = None
 
 _ring_mtx = threading.Lock()
@@ -90,6 +101,102 @@ def reset() -> None:
 def dropped_count() -> int:
     """Events evicted from the ring since the last reset()."""
     return _dropped
+
+
+# ----------------------------------------------------------- span context
+
+
+class SpanContext:
+    """Propagable identity of one distributed trace: a 16-byte trace_id
+    shared by every span of the trace (across processes) and an 8-byte
+    span_id naming this hop.  Shaped after the W3C traceparent header
+    (version 00, sampled flag always 01) so the wire form is a plain
+    printable string any tracing stack recognizes."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def child(self) -> "SpanContext":
+        """Same trace, fresh hop id — what a server installs so its
+        spans link to the client's without claiming its span_id."""
+        return SpanContext(self.trace_id, os.urandom(8).hex())
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, header: str) -> "SpanContext | None":
+        """Parse a traceparent string; None on anything malformed — a
+        bad context from a peer must degrade to 'unlinked', never raise
+        into the request path."""
+        parts = header.split("-")
+        if len(parts) != 4:
+            return None
+        _ver, tid, sid, _flags = parts
+        if len(tid) != 32 or len(sid) != 16:
+            return None
+        try:
+            int(tid, 16)
+            int(sid, 16)
+        except ValueError:
+            return None
+        if tid == "0" * 32 or sid == "0" * 16:
+            return None
+        return cls(tid, sid)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SpanContext)
+            and self.trace_id == other.trace_id
+            and self.span_id == other.span_id
+        )
+
+    def __repr__(self):
+        return f"SpanContext({self.to_traceparent()!r})"
+
+
+def new_context() -> SpanContext:
+    """A fresh root context (random trace_id + span_id)."""
+    return SpanContext(os.urandom(16).hex(), os.urandom(8).hex())
+
+
+def current_context() -> SpanContext | None:
+    """The calling thread's installed context, if any."""
+    return getattr(_tls, "ctx", None)
+
+
+class _CtxScope:
+    __slots__ = ("_ctx", "_prev", "_installed")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._installed = ctx is not None
+
+    def __enter__(self):
+        if self._installed:
+            self._prev = getattr(_tls, "ctx", None)
+            _tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc) -> bool:
+        if self._installed:
+            _tls.ctx = self._prev
+        return False
+
+
+def context_scope(ctx: SpanContext | None):
+    """Install ``ctx`` as the thread's current context for the block:
+    every span/instant recorded inside carries its trace_id/span_id
+    args.  ``None`` leaves the current context untouched (so call sites
+    can pass an optional context unconditionally)."""
+    return _CtxScope(ctx if propagation_enabled() else None)
+
+
+def propagation_enabled() -> bool:
+    return _ENABLED and _CTX_ENABLED
 
 
 # ------------------------------------------------------------- recording
@@ -149,6 +256,14 @@ def _flush(b: list) -> None:
 
 
 def _emit(ph: str, name: str, ts_ns: int, dur_ns: int, labels) -> None:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        # events recorded under a context scope carry the trace identity
+        # as args — the cross-process link trace_merge.py stitches on
+        merged = dict(labels) if labels else {}
+        merged.setdefault("trace_id", ctx.trace_id)
+        merged.setdefault("span_id", ctx.span_id)
+        labels = merged
     b = _buf()
     b.append((ph, name, ts_ns, dur_ns, _tls.tid, labels))
     if len(b) >= _CHUNK:
@@ -320,4 +435,5 @@ if _v.lower() not in _OFF_VALUES:
 
         atexit.register(_atexit_export)
 _ring_cap = max(1, envknobs.get_int(envknobs.TRACE_RING))
+_CTX_ENABLED = envknobs.get_bool(envknobs.TRACE_CTX)
 del _v

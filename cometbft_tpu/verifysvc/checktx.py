@@ -46,6 +46,7 @@ way.
 
 from __future__ import annotations
 
+from ..utils import tracing
 from .service import (
     Klass,
     VerifyService,
@@ -165,14 +166,22 @@ def verify_tx_signature(
             # a node with no local accelerator still batches through a
             # configured shared remote plane
             svc = global_service()
+    # one span context per signed tx: the service request inherits it
+    # (riding the wire to a remote plane), and the host fallback below
+    # re-installs it, so a degraded check still traces as one trace_id
+    ctx = (
+        (tracing.current_context() or tracing.new_context())
+        if tracing.propagation_enabled() else None
+    )
     if svc is not None:
         import time as _time
 
         t0 = _time.monotonic()
         try:
-            _, per = svc.submit(
-                [(pub, msg, sig)], Klass.MEMPOOL, mode, tenant=tenant
-            ).collect(collect_timeout_s())
+            with tracing.context_scope(ctx):
+                _, per = svc.submit(
+                    [(pub, msg, sig)], Klass.MEMPOOL, mode, tenant=tenant
+                ).collect(collect_timeout_s())
             return bool(per and per[0])
         except VerifyServiceBackpressure:
             pass  # admission control said no: fall through to the host
@@ -188,4 +197,5 @@ def verify_tx_signature(
             )
         except ValueError:
             return False  # malformed pubkey/sig lengths can't be valid
-    return _host_verify(mode, pub, msg, sig)
+    with tracing.context_scope(ctx):
+        return _host_verify(mode, pub, msg, sig)

@@ -107,6 +107,11 @@ class ServiceBatchVerifier:
         self._tenant = tenant if tenant is not None else default_tenant()
         self._items: list[tuple[bytes, bytes, bytes]] = []
         self.last_timings: dict[str, float] = {}
+        # this batch's span context, minted at submit(): the service
+        # request inherits it (and carries it to a remote plane), and
+        # the host-fallback / collect-stall paths re-install it so a
+        # degraded batch's spans still share one trace_id
+        self._ctx = None
 
     def __len__(self) -> int:
         return len(self._items)
@@ -158,7 +163,7 @@ class ServiceBatchVerifier:
 
         cpu = cpu_verifier_for_mode(self._mode)
         cpu._items = list(self._items)
-        with tracing.span(
+        with tracing.context_scope(self._ctx), tracing.span(
             span_name,
             {"class": self._klass.label, "sigs": len(cpu._items)}
             if tracing.enabled() else None,
@@ -171,11 +176,16 @@ class ServiceBatchVerifier:
         host — the caller-side fallback of the admission-control loop."""
         if not self._items:
             return ("sync", (False, []))
+        if tracing.propagation_enabled() and self._ctx is None:
+            # root of this batch's trace — unless the caller already
+            # installed one (e.g. an RPC-served verify), which we join
+            self._ctx = tracing.current_context() or tracing.new_context()
         try:
-            return ("svc", self._service().submit(
-                list(self._items), self._klass, self._mode,
-                tenant=self._tenant,
-            ))
+            with tracing.context_scope(self._ctx):
+                return ("svc", self._service().submit(
+                    list(self._items), self._klass, self._mode,
+                    tenant=self._tenant,
+                ))
         except VerifyServiceBackpressure:
             return ("sync", self._host_fallback("verify.svc_fallback"))
 

@@ -69,13 +69,13 @@ class RemotePlaneError(RuntimeError):
 class _Pending:
     __slots__ = (
         "rid", "digest", "items", "klass", "tenant", "deadline",
-        "key_type",
+        "key_type", "trace_ctx",
         "event", "response", "error", "attempts", "sent_on_gen", "_done_cb",
     )
 
     def __init__(
         self, rid, digest, items, klass, tenant, deadline,
-        key_type: str = "ed25519",
+        key_type: str = "ed25519", trace_ctx: str = "",
     ):
         self.rid = rid
         self.digest = digest
@@ -84,6 +84,10 @@ class _Pending:
         self.tenant = tenant
         self.deadline = deadline
         self.key_type = key_type
+        # serialized span context (traceparent); rides EVERY send of
+        # this request, idempotent resends included, so the plane's
+        # spans join the submitter's trace whichever attempt lands
+        self.trace_ctx = trace_ctx
         self.event = threading.Event()
         self.response: tuple[bool, list[bool]] | None = None
         self.error: BaseException | None = None
@@ -272,6 +276,13 @@ class RemotePlaneClient:
         scheduler).  Raises :class:`RemotePlaneError` when the breaker
         is open — the service then builds the host path instead."""
         items = list(items)
+        # capture the submitter's span context NOW (the host worker runs
+        # under the batch's context scope): it rides the wire so the
+        # plane's server-side spans share this trace_id
+        ctx = (
+            tracing.current_context()
+            if tracing.propagation_enabled() else None
+        )
         pend = _Pending(
             rid=uuid.uuid4().bytes,
             digest=wire.batch_digest(items),
@@ -280,6 +291,12 @@ class RemotePlaneClient:
             tenant=tenant,
             deadline=time.monotonic() + self.budget_s,
             key_type=key_type,
+            trace_ctx=ctx.to_traceparent() if ctx is not None else "",
+        )
+        tracing.instant(
+            "verify.rpc.submit",
+            {"class": klass.label, "tenant": tenant, "sigs": len(items)}
+            if tracing.enabled() else None,
         )
         with self._mtx:
             # breaker checked UNDER the lock the trip flips it under: a
@@ -376,6 +393,7 @@ class RemotePlaneClient:
                     ],
                     attempt=pend.attempts,
                     key_type=pend.key_type,
+                    trace_ctx=pend.trace_ctx,
                 )
             )
             try:

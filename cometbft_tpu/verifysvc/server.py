@@ -49,7 +49,7 @@ import sys
 import threading
 import time
 
-from ..utils import envknobs, fail
+from ..utils import envknobs, fail, tracing
 from ..utils.log import get_logger
 from ..utils.netutil import close_socket
 from . import wire
@@ -344,7 +344,22 @@ class VerifyServer:
                     f"{req.request_id.hex()[:12]})"
                 )
                 os.kill(os.getpid(), sig)
-        resp = self._verify_response(req, deadline)
+        # adopt the client's span context (a CHILD of it: same trace_id,
+        # fresh hop id) so this worker's spans — and the service spans
+        # under the submit below — join the submitter's trace across the
+        # process boundary; an absent/malformed context serves unlinked
+        ctx = None
+        if req.trace_ctx and tracing.propagation_enabled():
+            parent = tracing.SpanContext.from_traceparent(req.trace_ctx)
+            if parent is not None:
+                ctx = parent.child()
+        with tracing.context_scope(ctx), tracing.span(
+            "verify.rpc.serve",
+            {"sigs": len(req.items), "attempt": req.attempt,
+             "key_type": req.key_type or "ed25519"}
+            if tracing.enabled() else None,
+        ):
+            resp = self._verify_response(req, deadline)
         if resp is None:
             return
         # socket-level response shaping (delay / drop seams)

@@ -355,7 +355,7 @@ class Ticket:
 
 
 class _Request:
-    __slots__ = ("items", "klass", "mode", "ticket", "enq", "tenant")
+    __slots__ = ("items", "klass", "mode", "ticket", "enq", "tenant", "ctx")
 
     def __init__(self, items, klass: Klass, mode, tenant: str = DEFAULT_TENANT):
         self.items = items
@@ -364,6 +364,25 @@ class _Request:
         self.tenant = tenant
         self.ticket = Ticket(len(items))
         self.enq = time.monotonic()
+        # the submitter's span context: the scheduler/worker/collector
+        # threads re-install it around their spans, so every hop of this
+        # request — including the remote plane's, the context rides the
+        # wire — shares the submitter's trace_id
+        self.ctx = (
+            tracing.current_context()
+            if tracing.propagation_enabled() else None
+        )
+
+
+def _batch_ctx(batch: list["_Request"]):
+    """The span context a coalesced batch's spans run under: the first
+    member's (consensus batches are single-request; a coalesced mempool
+    batch's members joined one dispatch, so one trace naming it is the
+    honest attribution)."""
+    for r in batch:
+        if r.ctx is not None:
+            return r.ctx
+    return None
 
 
 def _parse_weights(spec: str) -> dict[Klass, int]:
@@ -1161,7 +1180,8 @@ class VerifyService:
             if tracing.enabled() else None
         )
         bv = None
-        with tracing.span("verify.sched.dispatch", labels):
+        with tracing.context_scope(_batch_ctx(batch)), \
+                tracing.span("verify.sched.dispatch", labels):
             try:
                 if fail.armed("fail_dispatch") is not None:
                     raise fail.InjectedFault("injected fault: fail_dispatch")
@@ -1244,7 +1264,8 @@ class VerifyService:
                 {"class": klass.label, "requests": len(batch)}
                 if tracing.enabled() else None
             )
-            with tracing.span("verify.sched.hostwork", labels):
+            with tracing.context_scope(_batch_ctx(batch)), \
+                    tracing.span("verify.sched.hostwork", labels):
                 try:
                     ticket = bv.submit()  # the inline work happens here
                 except BaseException as e:  # noqa: BLE001 — settle the tickets, keep serving
@@ -1328,7 +1349,9 @@ class VerifyService:
              "requests": len(batch)}
             if tracing.enabled() else None
         )
-        with tracing.span("verify.sched.collect", labels):
+        t_collect = time.monotonic()
+        with tracing.context_scope(_batch_ctx(batch)), \
+                tracing.span("verify.sched.collect", labels):
             try:
                 if not (isinstance(ticket, tuple) and ticket and ticket[0] == "sync"):
                     # injected-fault seams, in the same place a real
@@ -1351,6 +1374,12 @@ class VerifyService:
                 )
                 return
         total = sum(len(r.items) for r in batch)
+        if batch[0].klass == Klass.CONSENSUS:
+            # height-timeline verify attribution: tickets don't carry
+            # heights, so the batch lands on the ledger's current one
+            from ..utils.heightline import registry as _hl_registry
+
+            _hl_registry().note_verify(total, time.monotonic() - t_collect)
         if len(res) != total:
             err = RuntimeError(
                 f"verifier returned {len(res)} results for {total} "
@@ -1431,7 +1460,7 @@ class VerifyService:
             for r in batch:
                 if r.ticket.done():
                     continue
-                with tracing.span(
+                with tracing.context_scope(r.ctx), tracing.span(
                     "verify.failover.reverify",
                     {"class": r.klass.label, "sigs": len(r.items)}
                     if tracing.enabled() else None,

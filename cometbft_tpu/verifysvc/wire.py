@@ -78,6 +78,14 @@ class VerifyRequest(Message):
         # bls12_381 -> MODE_BLS, secp256k1/secp256k1eth -> MODE_SECP);
         # an unknown value is bad_request
         Field(8, "key_type", "string"),
+        # optional W3C traceparent ("00-<trace_id>-<span_id>-01",
+        # utils/tracing.SpanContext): the client's span context, so the
+        # plane's server-side spans join the submitter's trace across
+        # the process boundary.  "" (the proto3 default) encodes to
+        # NOTHING — a request without a context is byte-identical to
+        # the pre-context wire, and an old decoder skips the field;
+        # malformed values parse to "no context", never an error
+        Field(9, "trace_ctx", "string"),
     ]
 
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome trace exports into one Perfetto timeline.
+
+    python scripts/trace_merge.py --out merged.trace.json \
+        node0/trace.json node1/trace.json verifyd.trace.json
+
+Each input is a utils/tracing export (its ``wall_clock_anchor`` record
+rebases the process's monotonic timestamps onto the wall clock); the
+output opens in Perfetto (ui.perfetto.dev) with one process track per
+input and all spans on one common timeline.  Spans recorded under a
+propagated span context carry ``trace_id`` args — search a trace_id in
+Perfetto to follow one verify batch from the consensus-side submit into
+the remote plane's scheduler and back.  Anchor skew between the inputs
+(how far the processes' wall/monotonic offsets disagree) is printed per
+input and embedded under ``otherData.anchor_skew_ns``.
+
+Exit codes: 0 merged; 1 nothing mergeable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.utils import tracemerge  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-process Chrome trace exports into one "
+        "Perfetto timeline"
+    )
+    p.add_argument("inputs", nargs="+", help="per-process trace JSON files")
+    p.add_argument("--out", default="merged.trace.json",
+                   help="merged timeline path (default: merged.trace.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the merge report as JSON")
+    args = p.parse_args(argv)
+    try:
+        report = tracemerge.merge_files(args.inputs, args.out)
+    except tracemerge.MergeError as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"merged {report['total_events']} events from "
+              f"{len(report['processes'])} process(es) -> {report['out']}")
+        for proc in report["processes"]:
+            skew_ms = proc["anchor_skew_ns"] / 1e6
+            print(f"  pid {proc['pid']:>7}  {proc['events']:>6} events  "
+                  f"skew {skew_ms:+.3f} ms  {proc['label']}")
+        for s in report.get("skipped", []):
+            print(f"  skipped {s['label']}: {s['error']}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,137 @@
+"""scripts/bench_compare.py — the round-over-round perf diff.
+
+Proven against the CHECKED-IN driver rounds: r01/r02 are valid
+(783.101 ms @ 0.35x vs 845.655 ms @ 0.33x, a +7.99% headline
+regression), r03 crashed (rc=1, no JSON), r04/r05 are degraded
+backend-unavailable rounds (value null + "error") — the three
+exclusion shapes the comparator must refuse to treat as numbers."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_compare.py")
+
+
+def _round(n: int) -> str:
+    return os.path.join(REPO, f"BENCH_r0{n}.json")
+
+
+def _load_mod():
+    spec = importlib.util.spec_from_file_location("bench_compare", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+
+
+# ----------------------------------------------------- checked-in rounds
+
+
+def test_r01_vs_r02_within_default_threshold():
+    """+7.99% sits under the default 10% gate: reported, not fatal."""
+    r = _run(_round(1), _round(2), "--json")
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["headline"]["delta_pct"] == pytest.approx(7.99, abs=0.01)
+    assert rep["vs_baseline"]["delta"] == pytest.approx(-0.02)
+    assert rep["regressions"] == []
+
+
+def test_r01_vs_r02_trips_tighter_threshold():
+    r = _run("--threshold", "0.05", _round(1), _round(2))
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stderr and "+8.0%" in r.stderr
+    # the improvement direction never trips: new faster than old
+    assert _run("--threshold", "0.05", _round(2), _round(1)).returncode == 0
+
+
+@pytest.mark.parametrize("n,why", [
+    (3, "rc=1"),              # driver bench crashed, no JSON at all
+    (4, "backend-unavailable"),  # degraded: value null + error
+    (5, "backend-unavailable"),
+])
+def test_degraded_and_wedge_rounds_excluded(n, why):
+    r = _run(_round(1), _round(n))
+    assert r.returncode == 2
+    assert "excluded" in r.stderr and why in r.stderr
+    # symmetric: a degraded BASELINE is just as unusable
+    assert _run(_round(n), _round(1)).returncode == 2
+
+
+def test_unreadable_and_mismatched_inputs_exit_2(tmp_path):
+    r = _run(_round(1), str(tmp_path / "missing.json"))
+    assert r.returncode == 2
+    other = tmp_path / "other_metric.json"
+    other.write_text(json.dumps(
+        {"metric": "something_else_ms", "value": 10.0}
+    ))
+    r = _run(_round(1), str(other))
+    assert r.returncode == 2 and "metric mismatch" in r.stderr
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_lane_and_phase_share_diffs():
+    """Per-lane p50/p95 each gate independently; phase wall-share
+    shifts are reported in percentage points but never trip the exit
+    (attribution drift is a smell, not a regression by itself)."""
+    mod = _load_mod()
+    old = {
+        "metric": "verify_mixed_consensus_p50_ms", "value": 100.0,
+        "classes": {
+            "consensus": {"p50_ms": 100.0, "p95_ms": 200.0},
+            "mempool": {"p50_ms": 50.0, "p95_ms": 80.0},
+            "old_only": {"p50_ms": 1.0, "p95_ms": 2.0},
+        },
+        "phase_attribution": {
+            "hash": {"p50_ms": 10.0, "share_of_wall": 0.30},
+            "verify": {"p50_ms": 60.0, "share_of_wall": 0.50},
+        },
+    }
+    new = {
+        "metric": "verify_mixed_consensus_p50_ms", "value": 101.0,
+        "classes": {
+            "consensus": {"p50_ms": 102.0, "p95_ms": 300.0},  # p95 +50%
+            "mempool": {"p50_ms": 49.0, "p95_ms": None},      # unmeasured
+        },
+        "phase_attribution": {
+            "hash": {"p50_ms": 9.0, "share_of_wall": 0.55},   # +25 pp
+            "verify": {"p50_ms": 61.0, "share_of_wall": 0.25},
+        },
+    }
+    rep = mod.compare(old, new, threshold=0.10)
+    assert set(rep["lanes"]) == {"consensus", "mempool"}  # intersection
+    assert rep["lanes"]["consensus"]["p95_ms"]["delta_pct"] == 50.0
+    assert "p95_ms" not in rep["lanes"]["mempool"]  # null side skipped
+    assert rep["phase_shares"]["hash"]["shift_pp"] == pytest.approx(25.0)
+    assert rep["regressions"] == [
+        "lane consensus p95_ms: 200.0 -> 300.0 (+50.0%)"
+    ]
+
+
+def test_classify_shapes():
+    mod = _load_mod()
+    # bare bench JSON (no driver wrapper) is accepted directly
+    ok, reason = mod.classify({"metric": "m", "value": 1.0}, "x")
+    assert reason is None and ok["value"] == 1.0
+    for doc, frag in [
+        ({"rc": 1, "parsed": {"value": 1.0}}, "rc=1"),
+        ({"rc": 0, "parsed": None}, "no parsed"),
+        ({"rc": 0, "parsed": {"value": None}}, "null"),
+        ({"metric": "m", "value": 2.0, "error": "boom"}, "degraded"),
+    ]:
+        obj, reason = mod.classify(doc, "x")
+        assert obj is None and frag in reason, (doc, reason)

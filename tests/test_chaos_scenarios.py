@@ -178,7 +178,10 @@ def test_registry_names_the_six_full_scenarios():
         "wedge", "crash_replay", "partition_heal", "double_sign",
         "valset_rotation_blocksync", "plane_crash",
     }
-    assert set(sc.DEFAULT_SCENARIOS) | {"wedge_smoke"} == set(sc.SCENARIOS)
+    # the two smokes ride in the registry but not the default chaos run
+    assert set(sc.DEFAULT_SCENARIOS) | {"wedge_smoke", "trace_smoke"} == set(
+        sc.SCENARIOS
+    )
 
 
 # ------------------------------------------------------------ slow tier
@@ -227,3 +230,22 @@ def test_scenario_plane_crash(tmp_path):
     assert d["breakers_after_crash"] == ["open"] * 3
     assert d["breakers_after_restart"] == ["closed"] * 3
     assert d["plane_requests_after_restart"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_trace_smoke(tmp_path):
+    """The PR-17 acceptance run: node + real verifyd subprocess with
+    tracing armed in both; after clean SIGTERM exits the merged Perfetto
+    timeline spans both processes with a consensus-side span sharing a
+    trace_id with the plane's server-side verify.rpc.serve span, and
+    /height_timeline reported phase wall times for >= 5 heights."""
+    res = sc.run_scenario("trace_smoke", str(tmp_path))
+    assert res.ok, json.dumps(res.to_dict(), indent=1)
+    d = res.details
+    assert d["timeline_heights"] >= 5
+    assert d["trace_pids"] >= 2
+    assert d["linked_trace_ids"] >= 1
+    # the merged doc itself is Perfetto-loadable trace-event JSON
+    with open(d["merged_trace"]) as f:
+        doc = json.load(f)
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]

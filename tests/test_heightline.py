@@ -1,0 +1,197 @@
+"""Per-height consensus timeline ledger (utils/heightline, PR 17):
+bounded capacity, first-mark-wins phase ordering, verify attribution,
+the /height_timeline RPC route, and restart survival via flight-
+recorder replay."""
+
+import pytest
+
+from cometbft_tpu.utils import heightline
+from cometbft_tpu.utils.flightrec import recorder
+from cometbft_tpu.utils.heightline import HeightlineRegistry, PHASES
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    yield
+    heightline.registry().clear()
+    recorder().clear()
+
+
+def _reg(**kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("enabled", True)
+    return HeightlineRegistry(**kw)
+
+
+# --------------------------------------------------------------- feeding
+
+
+def test_phase_deltas_and_first_mark_wins():
+    r = _reg()
+    base = 1_000_000_000_000
+    s = 1_000_000_000  # ns per second
+    r.mark(5, "start", wall_ns=base, round_=0, _record=False)
+    r.mark(5, "proposal", wall_ns=base + 1 * s, round_=0, _record=False)
+    # a round-1 re-proposal must NOT rewind the timeline, only the round
+    r.mark(5, "proposal", wall_ns=base + 9 * s, round_=1, _record=False)
+    r.mark(5, "full_block", wall_ns=base + 2 * s, round_=1, _record=False)
+    r.mark(5, "commit", wall_ns=base + 4 * s, round_=1, _record=False)
+    r.mark(5, "apply", wall_ns=base + 5 * s, round_=1, _record=False)
+    snap = r.snapshot()
+    assert snap["count"] == 1
+    h = snap["heights"][0]
+    assert h["height"] == 5 and h["round"] == 1
+    assert h["phases_wall_ns"]["proposal"] == base + 1 * s  # first mark won
+    # each delta measures from the latest EARLIER marked phase —
+    # prevote/precommit were never marked, so commit measures from
+    # full_block
+    assert h["phase_seconds"] == pytest.approx(
+        {"proposal": 1.0, "full_block": 1.0, "commit": 2.0, "apply": 1.0}
+    )
+    assert h["total_seconds"] == pytest.approx(5.0)
+
+
+def test_bounded_capacity_evicts_oldest():
+    r = _reg(capacity=8)
+    for h in range(1, 13):
+        r.mark(h, "commit", _record=False)
+    snap = r.snapshot()
+    assert snap["count"] == 8 and snap["evicted"] == 4
+    assert [e["height"] for e in snap["heights"]] == list(range(5, 13))
+    # capacity floor: tiny configs clamp to 8, never 0
+    assert HeightlineRegistry(capacity=1, enabled=True).capacity == 8
+
+
+def test_snapshot_limit_keeps_newest():
+    r = _reg()
+    for h in (1, 2, 3, 4):
+        r.mark(h, "commit", _record=False)
+    snap = r.snapshot(limit=2)
+    assert [e["height"] for e in snap["heights"]] == [3, 4]
+    assert r.snapshot(limit=0)["heights"] == []
+
+
+def test_verify_attribution_current_and_explicit():
+    r = _reg()
+    # unattributable: no current height yet -> dropped, not mis-binned
+    r.note_verify(10, 0.5)
+    assert r.snapshot()["count"] == 0
+    r.set_current(7)
+    r.note_verify(64, 0.25)            # service collector: current height
+    r.note_verify(32, 0.25)
+    r.note_verify(100, 1.0, height=3)  # blocksync: knows its height
+    snap = {e["height"]: e for e in r.snapshot()["heights"]}
+    assert snap[7]["verify"] == {"batches": 2, "sigs": 96, "wait_s": 0.5}
+    assert snap[3]["verify"] == {"batches": 1, "sigs": 100, "wait_s": 1.0}
+    assert r.current == 7
+
+
+def test_disabled_registry_is_inert():
+    r = _reg(enabled=False)
+    r.mark(1, "commit", _record=False)
+    r.set_current(1)
+    r.note_verify(5, 0.1)
+    snap = r.snapshot()
+    assert snap["count"] == 0 and snap["current_height"] == 0
+    assert snap["enabled"] is False
+
+
+def test_invalid_marks_ignored():
+    r = _reg()
+    r.mark(0, "commit", _record=False)       # genesis/unset height
+    r.mark(-3, "commit", _record=False)
+    r.mark(4, "not-a-phase", _record=False)  # unknown phase
+    assert r.snapshot()["count"] == 0
+
+
+def test_mark_observes_phase_histogram():
+    from cometbft_tpu.utils.metrics import hub
+
+    def _count():
+        for line in hub().cs_height_phase.expose():
+            if "_count" in line and 'phase="commit"' in line:
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    r = _reg()
+    before = _count()
+    base = 2_000_000_000_000
+    r.mark(9, "start", wall_ns=base)
+    r.mark(9, "commit", wall_ns=base + 3_000_000_000)
+    assert _count() == before + 1
+
+
+# ---------------------------------------------------------- flightrec
+
+
+def test_restore_from_flightrec_replays_marks():
+    """The restart story: marks cross-record into the flight recorder,
+    so a FRESH registry rebuilt from the ring carries the same wall
+    times (and no double metric observation — _record=False replay)."""
+    src = _reg()
+    base = 3_000_000_000_000
+    for h in (4, 5):
+        src.mark(h, "start", wall_ns=base + h, round_=1)
+        src.mark(h, "commit", wall_ns=base + h + 1_000_000_000, round_=1)
+
+    fresh = _reg()
+    n = heightline.restore_from_flightrec(fresh)
+    assert n == 4
+    snap = fresh.snapshot()
+    assert [e["height"] for e in snap["heights"]] == [4, 5]
+    assert snap["heights"][0]["phases_wall_ns"] == {
+        "start": base + 4, "commit": base + 4 + 1_000_000_000,
+    }
+    assert snap["heights"][0]["round"] == 1
+    # current height resumes at the top replayed height
+    assert fresh.current == 5
+
+
+def test_restore_from_dumped_trace_dict():
+    """Post-mortem shape: replay from a dumped {"entries": [...]} doc
+    (debugdump bundle) rather than the live recorder; foreign kinds and
+    malformed heightline entries are skipped, not fatal."""
+    dump = {"entries": [
+        {"kind": "step", "height": 2, "round": 0},
+        {"kind": "heightline", "height": 2, "round": 0,
+         "detail": {"phase": "commit", "t_wall_ns": 123}},
+        {"kind": "heightline", "height": 2, "round": 0,
+         "detail": {"phase": "bogus", "t_wall_ns": 456}},
+    ]}
+    r = _reg()
+    assert heightline.restore_from_flightrec(r, dump) == 1
+    assert r.snapshot()["heights"][0]["phases_wall_ns"] == {"commit": 123}
+
+
+# ---------------------------------------------------------------- RPC
+
+
+def test_height_timeline_rpc_route():
+    from cometbft_tpu.rpc.core import ROUTES, Environment, RPCError
+
+    params, fn = ROUTES["height_timeline"]
+    assert params == "limit"
+    g = heightline.registry()
+    base = 4_000_000_000_000
+    for h in (1, 2, 3):
+        g.mark(h, "start", wall_ns=base, _record=False)
+        g.mark(h, "commit", wall_ns=base + 2_000_000_000, _record=False)
+    env = Environment(None)
+    out = fn(env)
+    assert out["count"] == 3 and out["enabled"] is True
+    assert {"height", "round", "phases_wall_ns", "phase_seconds",
+            "total_seconds", "verify"} <= set(out["heights"][0])
+    assert out["heights"][0]["phase_seconds"]["commit"] == pytest.approx(2.0)
+    # limit arrives as a string from the query layer
+    limited = fn(env, limit="1")
+    assert [e["height"] for e in limited["heights"]] == [3]
+    with pytest.raises(RPCError):
+        fn(env, limit="not-a-number")
+
+
+def test_phase_order_is_canonical():
+    assert PHASES == (
+        "start", "proposal", "full_block", "prevote_23",
+        "precommit_23", "commit", "apply",
+    )
+    assert heightline.METRIC_PHASES == PHASES[1:]

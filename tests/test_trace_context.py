@@ -1,0 +1,261 @@
+"""Cross-process trace propagation (PR 17): SpanContext and its W3C
+traceparent wire form, thread-local context scoping, the VerifyRequest
+``trace_ctx`` field's back-compat pin, and the trace-merge stitcher.
+
+The byte-for-byte pin mirrors tests/test_verifysvc.py's envelope-
+versioning pin: a VerifyRequest that carries no trace context must
+encode EXACTLY the pre-context wire (field 9 absent, not empty), and
+the pre-context decoder shape (no field 9 declared) must still parse a
+context-carrying request — old planes keep serving new clients.
+"""
+
+import json
+import os
+
+import pytest
+
+from cometbft_tpu.utils import tracemerge, tracing
+from cometbft_tpu.verifysvc import wire
+from cometbft_tpu.verifysvc.service import Klass
+from cometbft_tpu.wire.proto import Message
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    yield
+    tracing.set_enabled(False, ring_capacity=65536)
+    tracing.reset()
+
+
+# ------------------------------------------------------------ SpanContext
+
+
+def test_traceparent_roundtrip_and_child():
+    ctx = tracing.new_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    hdr = ctx.to_traceparent()
+    assert hdr == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = tracing.SpanContext.from_traceparent(hdr)
+    assert back == ctx
+    # child: same trace, fresh hop — the server-side install
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id and kid.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "not-a-traceparent",
+    "00-abc-def-01",                                  # short ids
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",        # non-hex trace_id
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",        # all-zero trace_id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",        # all-zero span_id
+    "00-" + "1" * 32 + "-" + "1" * 16,                # missing flags
+])
+def test_malformed_traceparent_degrades_to_none(bad):
+    """A bad context from a peer must read as 'unlinked', never raise
+    into the request path."""
+    assert tracing.SpanContext.from_traceparent(bad) is None
+
+
+def test_context_scope_labels_spans_and_restores():
+    tracing.set_enabled(True)
+    tracing.reset()
+    ctx = tracing.new_context()
+    assert tracing.current_context() is None
+    with tracing.context_scope(ctx):
+        assert tracing.current_context() is ctx
+        with tracing.span("inside"):
+            pass
+        # None leaves the installed context untouched (optional-ctx call
+        # sites pass it unconditionally)
+        with tracing.context_scope(None):
+            assert tracing.current_context() is ctx
+    assert tracing.current_context() is None
+    with tracing.span("outside"):
+        pass
+    events = {e["name"]: e for e in tracing.chrome_trace_events()}
+    assert events["inside"]["args"]["trace_id"] == ctx.trace_id
+    assert events["inside"]["args"]["span_id"] == ctx.span_id
+    assert "trace_id" not in events["outside"].get("args", {})
+
+
+def test_propagation_requires_tracing_enabled():
+    """With the tracer off, context_scope is inert — no thread-local
+    writes on the hot path when nobody is recording."""
+    assert not tracing.propagation_enabled()
+    with tracing.context_scope(tracing.new_context()):
+        assert tracing.current_context() is None
+    tracing.set_enabled(True)
+    assert tracing.propagation_enabled()  # TRACE_CTX defaults on
+
+
+# ----------------------------------------------- wire back-compat pin
+
+
+def _items():
+    return [(b"p" * 32, b"msg-a", b"s" * 64), (b"q" * 32, b"", b"t" * 64)]
+
+
+def _req_kwargs():
+    items = _items()
+    return dict(
+        request_id=b"r" * 16, digest=wire.batch_digest(items),
+        tenant="chain-a", klass=int(Klass.CONSENSUS), budget_ms=900,
+        items=[wire.SigItem(pub=p, msg=m, sig=s) for p, m, s in items],
+        attempt=1, key_type="ed25519",
+    )
+
+
+class _VerifyRequestV1(Message):
+    """The PRE-trace-context request shape: the exact field list minus
+    field 9 — what every pre-PR-17 peer encodes and decodes."""
+
+    FIELDS = [f for f in wire.VerifyRequest.FIELDS if f.name != "trace_ctx"]
+
+
+def test_verify_request_without_context_is_byte_identical_to_v1():
+    assert any(f.num == 9 and f.name == "trace_ctx"
+               for f in wire.VerifyRequest.FIELDS)
+    old_wire = _VerifyRequestV1(**_req_kwargs()).encode()
+    # default (unset) context and explicit empty both omit field 9
+    assert wire.VerifyRequest(**_req_kwargs()).encode() == old_wire
+    assert wire.VerifyRequest(trace_ctx="", **_req_kwargs()).encode() == old_wire
+    # and the v1 bytes round-trip through the NEW decoder unchanged
+    dec = wire.VerifyRequest.decode(old_wire)
+    assert dec.trace_ctx == ""
+    assert dec.encode() == old_wire
+    assert dec.tenant == "chain-a" and dec.attempt == 1
+    assert wire.batch_digest(
+        [(i.pub, i.msg, i.sig) for i in dec.items]
+    ) == dec.digest
+
+
+def test_old_decoder_skips_context_field():
+    """A context-carrying request still parses on a pre-context peer:
+    the codec skips unknown fields, every other field lands intact."""
+    ctx = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    new_wire = wire.VerifyRequest(trace_ctx=ctx, **_req_kwargs()).encode()
+    assert new_wire != _VerifyRequestV1(**_req_kwargs()).encode()
+    old_view = _VerifyRequestV1.decode(new_wire)
+    assert old_view.request_id == b"r" * 16
+    assert old_view.tenant == "chain-a" and old_view.budget_ms == 900
+    assert [(i.pub, i.msg, i.sig) for i in old_view.items] == _items()
+    # the new decoder sees the context verbatim
+    assert wire.VerifyRequest.decode(new_wire).trace_ctx == ctx
+
+
+# ------------------------------------------------------------ tracemerge
+
+
+def _export(pid, offset_ns, names, tid=1):
+    """A minimal tracing.py-shaped export: anchor + complete spans.
+    ``offset_ns`` is the process's wall-minus-perf clock offset."""
+    events = [{
+        "ph": "M", "name": tracemerge.ANCHOR_NAME, "pid": pid, "tid": 0,
+        "args": {"wall_time_ns": offset_ns + 1_000_000,
+                 "perf_counter_ns": 1_000_000},
+    }]
+    for i, (name, args) in enumerate(names):
+        events.append({
+            "ph": "X", "name": name, "pid": pid, "tid": tid,
+            "ts": 1000.0 + i * 500, "dur": 100.0, "args": args,
+        })
+    return {"traceEvents": events}
+
+
+def test_merge_rebases_onto_wall_clock_and_reports_skew(tmp_path):
+    """Two exports whose perf epochs differ by 5 ms land on one
+    timeline: same-instant spans coincide, skew is reported."""
+    a = tmp_path / "a.trace.json"
+    b = tmp_path / "b.trace.json"
+    a.write_text(json.dumps(_export(100, 1_000_000_000, [("client", {})])))
+    b.write_text(json.dumps(
+        _export(200, 1_005_000_000, [("server", {})])))
+    out = tmp_path / "merged.json"
+    report = tracemerge.merge_files([str(a), str(b)], str(out))
+    assert report["total_events"] == 2 and len(report["processes"]) == 2
+    skews = {p["label"]: p["anchor_skew_ns"] for p in report["processes"]}
+    assert skews[str(a)] == 0 and skews[str(b)] == 5_000_000
+    doc = json.loads(out.read_text())
+    ev = {e["name"]: e for e in doc["traceEvents"] if e["ph"] != "M"}
+    # b's offset is 5 ms later, so its identical local ts lands 5 ms
+    # further right on the merged (wall) timeline
+    assert ev["server"]["ts"] - ev["client"]["ts"] == pytest.approx(5000.0)
+    assert ev["client"]["ts"] == 0.0  # timeline starts at zero
+    assert {ev["client"]["pid"], ev["server"]["pid"]} == {100, 200}
+    assert doc["otherData"]["anchor_skew_ns"][str(b)] == 5_000_000
+
+
+def test_merge_remaps_colliding_pids_and_skips_torn_files(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"  # same pid as a: reused across processes
+    torn = tmp_path / "torn.json"
+    a.write_text(json.dumps(_export(77, 0, [("one", {})])))
+    b.write_text(json.dumps(_export(77, 0, [("two", {})])))
+    torn.write_text('{"traceEvents": [{"ph": "X"')  # half-written
+    out = tmp_path / "m.json"
+    report = tracemerge.merge_files(
+        [str(a), str(b), str(torn)], str(out)
+    )
+    assert [s["label"] for s in report["skipped"]] == [str(torn)]
+    pids = {p["label"]: p for p in report["processes"]}
+    assert not pids[str(a)]["pid_remapped"]
+    assert pids[str(b)]["pid_remapped"] and pids[str(b)]["pid"] != 77
+    doc = json.loads(out.read_text())
+    assert len({e["pid"] for e in doc["traceEvents"] if e["ph"] != "M"}) == 2
+
+
+def test_merge_refuses_anchorless_input(tmp_path):
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(
+        {"traceEvents": [{"ph": "X", "name": "x", "ts": 1, "dur": 1}]}
+    ))
+    with pytest.raises(tracemerge.MergeError, match="wall_clock_anchor"):
+        tracemerge.merge_exports(
+            [(str(bare), json.loads(bare.read_text())["traceEvents"])]
+        )
+    # merge_files with ONLY unusable inputs raises too (nothing to merge)
+    with pytest.raises(tracemerge.MergeError):
+        tracemerge.merge_files([str(bare)], str(tmp_path / "out.json"))
+
+
+def test_trace_ids_survive_merge_for_cross_process_linking(tmp_path):
+    """The stitch the machinery exists for: the client's span and the
+    server's verify.rpc.serve span share a trace_id across pids in the
+    merged doc (the assertion scenario_trace_smoke makes on real
+    processes, proven here on synthetic exports)."""
+    from cometbft_tpu.e2e.scenarios import _linked_cross_process_trace_ids
+
+    tid = "ab" * 16
+    a = tmp_path / "node.json"
+    b = tmp_path / "plane.json"
+    a.write_text(json.dumps(_export(
+        10, 0, [("verify.sched.dispatch", {"trace_id": tid})])))
+    b.write_text(json.dumps(_export(
+        20, 0, [("verify.rpc.serve", {"trace_id": tid})])))
+    out = tmp_path / "m.json"
+    tracemerge.merge_files([str(a), str(b)], str(out))
+    events = json.loads(out.read_text())["traceEvents"]
+    assert _linked_cross_process_trace_ids(events) == [tid]
+    # an unlinked trace (server-side only) does not count
+    assert _linked_cross_process_trace_ids(
+        [e for e in events if e.get("name") == "verify.rpc.serve"]
+    ) == []
+
+
+def test_trace_merge_cli(tmp_path):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge_cli", os.path.join(repo, "scripts", "trace_merge.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(_export(5, 0, [("s", {})])))
+    out = tmp_path / "m.json"
+    assert mod.main([str(a), "--out", str(out)]) == 0
+    assert json.loads(out.read_text())["traceEvents"]
+    assert mod.main([str(tmp_path / "missing.json"),
+                     "--out", str(out)]) == 1
