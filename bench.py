@@ -35,7 +35,11 @@ backend-less round still certifies that the verify plane's shapes,
 dtypes, and jaxpr fingerprints hold — and "shardcheck": the
 sharded-plane contract pass (analysis/shardcheck) traced under a
 forced 8-device CPU mesh in a subprocess, certifying shardings,
-collective census, compile-cost budgets, and donation discipline.
+collective census, compile-cost budgets, and donation discipline —
+and "rangecheck": per-kernel overflow headroom from the checked-in
+range certificates (analysis/rangecheck) with a live interval
+spot-check over the fast hash-plane kernels (BENCH_RANGECHECK=0
+opts out, like the other two).
 
 BENCH_WORKLOAD=multichip sweeps the same verify over device counts
 (default 1/2/4/8) and reports per-count p50 scaling plus
@@ -200,6 +204,10 @@ def probe_backend() -> bool:
         "0", "false", "no", "off"
     ):
         REPORT["shardcheck"] = _shardcheck_report()
+    if os.environ.get("BENCH_RANGECHECK", "1").lower() not in (
+        "0", "false", "no", "off"
+    ):
+        REPORT["rangecheck"] = _rangecheck_report()
     from cometbft_tpu.utils import envknobs as _envknobs
 
     if _envknobs.get_bool(_envknobs.FAILOVER):
@@ -290,6 +298,30 @@ def _shardcheck_report() -> dict:
                 "sharding_constraint" not in c for c in censuses.values()
             ) if censuses else None,
             "device_count": data.get("device_count"),
+            "elapsed_s": round(time.monotonic() - t0, 1),
+        }
+    except BaseException as e:  # noqa: BLE001 — the JSON line must still emit
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def _rangecheck_report() -> dict:
+    """The limb-range contract pass (analysis/rangecheck): per-kernel
+    overflow headroom from the checked-in range certificates, plus a
+    live interval spot-check over the fast hash-plane kernels diffed
+    against those certificates — the same wedged-round pattern as the
+    "kernelcheck"/"shardcheck" fields above.  The FULL interval pass is
+    minutes of CPU (the curve walks dominate), so the certificates carry
+    the field-kernel headroom and the spot subset keeps the round honest
+    about drift.  Runs under the cpu pin the kernelcheck report already
+    forced; BENCH_RANGECHECK=0 skips it (the bench-harness tests do, to
+    stay inside their subprocess timeout)."""
+    try:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        t0 = time.monotonic()
+        from cometbft_tpu.analysis import rangecheck
+
+        return {
+            **rangecheck.bench_summary(),
             "elapsed_s": round(time.monotonic() - t0, 1),
         }
     except BaseException as e:  # noqa: BLE001 — the JSON line must still emit

@@ -90,6 +90,24 @@ class Kernel:
                     is gone.  Budgets are measured counts plus ~30%
                     headroom — an unrolled-loop blowup fails in
                     milliseconds, an innocuous +1 eqn does not.
+    arg_ranges    : declared input value ranges for the range abstract
+                    interpreter (analysis/rangecheck.py), one entry per
+                    arg: ``(lo, hi)`` inclusive, or None for the full
+                    dtype range.  These are the ASSUMPTIONS the range
+                    certificates are proved under — callers owe them
+                    (canonical limb digits [0, 2^12), flags {0, 1},
+                    active block counts).  None for the whole tuple =
+                    every arg at its dtype range.
+    out_ranges    : declared output ranges, same shape as ``out`` —
+                    the checker PROVES these hold (canonical digits out
+                    means limb-equality-is-value-equality downstream).
+                    None entries are unchecked.
+    loop_invariants : assume-guarantee bounds for scan carries where
+                    widening is too coarse: ``(scan_ordinal,
+                    carry_ordinal, lo, hi)`` tuples, ordinals in
+                    interpretation (pre-order) encounter order.  The
+                    checker verifies each declared bound covers the
+                    initial carry and is inductive before using it.
     """
 
     name: str
@@ -100,10 +118,17 @@ class Kernel:
     needs_mesh: bool = False
     mesh_static: tuple = ()
     max_eqns: int = 0  # fixture rows may omit; production rows may not
+    arg_ranges: tuple | None = None
+    out_ranges: tuple | None = None
+    loop_invariants: tuple = ()
 
 
 _TABLES = i32(64, 9, 3, 22, V)  # ops/comb.py layout: validator axis minor
 _B_TABLES = f32(22, 66, 4096)  # shared radix-4096 base-point comb
+
+# Declared value ranges (analysis/rangecheck.py input specs).
+DIGITS = (0, 4095)  # canonical 12-bit limb digit, ops/field.py freeze()
+FLAG = (0, 1)  # bit-packed / boolean-as-int payload field
 
 
 KERNELS: tuple[Kernel, ...] = (
@@ -117,6 +142,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(V, 32),),
         out=(_TABLES, boolean(V)),
         max_eqns=32_000,
+        out_ranges=(DIGITS, None),
     ),
     Kernel(
         name="comb_verify_cached_tree",
@@ -125,6 +151,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(V),),
         static_kwargs=(("tree", True),),
         max_eqns=50_000,  # measured 38,618
+        arg_ranges=(DIGITS, None, None, None, None, DIGITS),
     ),
     Kernel(
         # the sequential cross-check path must stay pinned too: it is the
@@ -135,6 +162,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(V),),
         static_kwargs=(("tree", False),),
         max_eqns=36_000,  # measured 27,633
+        arg_ranges=(DIGITS, None, None, None, None, DIGITS),
     ),
     # ---- ops/ed25519.py — the uncached Straus kernel
     Kernel(
@@ -143,6 +171,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 32), u8(N, 32), u8(N, 32), u8(N, 2, 128), i32(N)),
         out=(boolean(N),),
         max_eqns=100_000,  # measured 76,880
+        arg_ranges=(None, None, None, None, (0, 2)),
     ),
     # ---- ops/sha2.py — challenge hashing + device payload assembly
     Kernel(
@@ -151,6 +180,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 2, 64), i32(N)),
         out=(u8(N, 32),),
         max_eqns=1_000,  # measured 153
+        arg_ranges=(None, (0, 2)),
     ),
     Kernel(
         name="sha512_blocks",
@@ -158,6 +188,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 2, 128), i32(N)),
         out=(u8(N, 64),),
         max_eqns=1_000,  # measured 376
+        arg_ranges=(None, (0, 2)),
     ),
     Kernel(
         name="sha2_parse_verify_payload",
@@ -173,6 +204,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 1, 64), i32(N)),
         out=(u8(32),),
         max_eqns=2_000,  # measured 628
+        arg_ranges=(None, (0, 1)),
     ),
     # ---- ops/bls381.py — the FastAggregateVerify data plane: batched
     # KeyValidate (on-curve + subgroup) and the tree-reduced G1 pubkey
@@ -184,6 +216,8 @@ KERNELS: tuple[Kernel, ...] = (
         args=(i32(N, 32), i32(N, 32), i32(N, 32)),
         out=(i32(32), i32(32), i32(32)),
         max_eqns=18_000,  # measured 12,966
+        arg_ranges=(DIGITS, DIGITS, DIGITS),
+        out_ranges=(DIGITS, DIGITS, DIGITS),
     ),
     Kernel(
         # subgroup check = [r]P via lax.scan over the 255 order bits: the
@@ -194,6 +228,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(i32(N, 32), i32(N, 32), boolean(N)),
         out=(boolean(N),),
         max_eqns=8_500,  # measured 6,474
+        arg_ranges=(DIGITS, DIGITS, None),
     ),
     Kernel(
         # validation + tree-reduced aggregation fused into ONE dispatch —
@@ -203,6 +238,8 @@ KERNELS: tuple[Kernel, ...] = (
         args=(i32(N, 32), i32(N, 32), boolean(N)),
         out=(boolean(N), i32(32), i32(32), i32(32)),
         max_eqns=26_000,  # measured 19,445
+        arg_ranges=(DIGITS, DIGITS, None),
+        out_ranges=(None, DIGITS, DIGITS, DIGITS),
     ),
     # ---- ops/secp256k1.py — the batched ECDSA lane (MODE_SECP):
     # range/low-s validation, Montgomery batch inversion (s^-1 mod n and
@@ -231,6 +268,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", True), ("recover", False)),
         max_eqns=28_000,  # measured 21,248
+        arg_ranges=(DIGITS, DIGITS, None, DIGITS, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     Kernel(
         name="secp256k1_verify_batch_recover",
@@ -244,6 +282,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", True), ("recover", True)),
         max_eqns=29_500,  # measured 22,694
+        arg_ranges=(DIGITS, DIGITS, None, DIGITS, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     Kernel(
         name="secp256k1_verify_batch_noglv",
@@ -257,6 +296,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", False), ("recover", False)),
         max_eqns=18_000,  # measured 13,688 (the pre-GLV program, unchanged)
+        arg_ranges=(DIGITS, DIGITS, None, DIGITS, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     Kernel(
         name="secp256k1_verify_batch_noglv_recover",
@@ -270,6 +310,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", False), ("recover", True)),
         max_eqns=20_000,  # measured 15,134
+        arg_ranges=(DIGITS, DIGITS, None, DIGITS, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     # the fused hash->verify program: padded message bytes in, verdicts
     # out — SHA-256 (cosmos) and Keccak-256 (eth/ecrecover) digests
@@ -290,6 +331,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", True), ("recover", False)),
         max_eqns=29_000,  # measured 22,111
+        arg_ranges=(None, (0, 2), None, FLAG, DIGITS, DIGITS, None, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     Kernel(
         name="secp256k1_hash_verify_recover",
@@ -305,6 +347,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(N),),
         static_kwargs=(("glv", True), ("recover", True)),
         max_eqns=30_500,  # measured 23,557
+        arg_ranges=(None, (0, 2), None, FLAG, DIGITS, DIGITS, None, DIGITS, DIGITS, None, FLAG, None, None, DIGITS),
     ),
     # ---- ops/keccak.py — batched Keccak-256 (the Ethereum 0x01-padded
     # variant): (hi, lo) uint32 lane halves, 24 rounds as ONE fori_loop
@@ -316,6 +359,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(u8(N, 1, 136), i32(N)),
         out=(u8(N, 32),),
         max_eqns=700,  # measured 577 (fori-rolled: O(1) in round count)
+        arg_ranges=(None, (0, 1)),
     ),
     # ---- models/comb_verifier.py — cache assembly + the device program
     Kernel(
@@ -329,6 +373,9 @@ KERNELS: tuple[Kernel, ...] = (
         out=(_TABLES, boolean(V)),
         static_kwargs=(("V", V),),
         max_eqns=500,  # measured 32
+        arg_ranges=(DIGITS, None, DIGITS, None, (0, V - 1), (0, V - 1),
+                    (0, V - 1)),
+        out_ranges=(DIGITS, None),
     ),
     Kernel(
         name="comb_device_verify",
@@ -336,6 +383,7 @@ KERNELS: tuple[Kernel, ...] = (
         args=(_TABLES, boolean(V), u8(V, 32), u8(V, PAYLOAD_W)),
         out=(u8(2),),  # packbits(V=4 lanes) -> 1 byte, + the all-ok byte
         max_eqns=50_000,  # measured 39,068
+        arg_ranges=(DIGITS, None, None, None),
     ),
     # ---- parallel/verify.py — the mesh-sharded programs (1-device CPU
     # mesh for the trace; the collective mix is what the fingerprint pins)
@@ -346,6 +394,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(boolean(), boolean(N)),
         needs_mesh=True,
         max_eqns=100_000,  # measured 76,888
+        arg_ranges=(None, None, None, None, (0, 2)),
     ),
     Kernel(
         name="sharded_verify_cached",
@@ -355,6 +404,7 @@ KERNELS: tuple[Kernel, ...] = (
         needs_mesh=True,
         mesh_static=(True,),  # tree=True, part of the jit cache key
         max_eqns=50_000,  # measured 39,075
+        arg_ranges=(DIGITS, None, None, None),
     ),
     Kernel(
         name="sharded_merkle_root",
@@ -363,6 +413,7 @@ KERNELS: tuple[Kernel, ...] = (
         out=(u8(32),),
         needs_mesh=True,
         max_eqns=2_000,  # measured 633
+        arg_ranges=(None, (0, 1)),
     ),
 )
 

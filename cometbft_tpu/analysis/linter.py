@@ -140,6 +140,7 @@ def all_checks() -> dict[str, object]:
         socket_timeout,
         swallowed_exc,
         thread_names,
+        unchecked_shift_width,
         undocumented_metric,
         untracked_jit,
         weak_type_literal,
@@ -156,6 +157,7 @@ def all_checks() -> dict[str, object]:
         untracked_jit,
         host_sync,
         weak_type_literal,
+        unchecked_shift_width,
         donated_read,
         socket_timeout,
     )
@@ -171,6 +173,11 @@ KERNEL_CHECK_IDS = ("untracked-jit", "host-sync-in-hot-path", "weak-type-literal
 #: (scripts/lint.py --check sharding) alongside the shardcheck
 #: multi-device trace pass.
 SHARDING_CHECK_IDS = ("donated-read-after-dispatch",)
+
+#: The range-plane subset: the AST half of the limb-range contract gate
+#: (scripts/lint.py --check range) alongside the rangecheck interval
+#: interpreter pass.
+RANGE_CHECK_IDS = ("unchecked-shift-width",)
 
 
 def iter_py_files(paths: list[str]) -> list[str]:

@@ -1,0 +1,146 @@
+"""Check: unchecked-shift-width.
+
+A shift whose amount is itself traced data defeats range analysis: the
+interval interpreter (analysis/rangecheck.py) can bound ``x >> 12`` or
+``lax.shift_left(borrow, BITS)`` exactly, but a data-dependent amount
+makes the result's bit-width unknowable — and in these kernels a dynamic
+shift is never intentional (limb widths, carry cut points, and window
+sizes are all host constants).  This check flags shift sites inside
+jitted bodies (same traced-closure scan as weak-type-literal, seeded
+with the manifest's cross-module entry points) whose amount expression
+contains traced computation:
+
+* a call into ``jnp``/``jax``/``lax`` (the amount is a device value);
+* a subscript (indexing into an array of shift counts).
+
+Host-static amounts — int literals, module constants (``BITS``), python
+loop variables from an unrolled ``for k in range(8)`` — are fine: they
+are concrete at trace time and the range interpreter sees them as
+literals in the jaxpr.  Statements under
+``jax.ensure_compile_time_eval()`` are host-side folding and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import kernel_manifest as manifest
+from ._jitscan import traced_closure
+from .linter import Finding, Module, dotted_name, terminal_name
+
+CHECK_ID = "unchecked-shift-width"
+SUMMARY = "data-dependent shift amount inside a jitted body"
+
+SCOPE_DIRS = {"ops", "parallel", "models"}
+
+#: lax shift primitives whose second argument is the shift amount.
+_SHIFT_CALLS = {
+    "shift_left",
+    "shift_right_logical",
+    "shift_right_arithmetic",
+    "left_shift",
+    "right_shift",
+}
+
+#: dtype/array constructors: wrapping host data in one is the repo's
+#: standard "pin the dtype" idiom, so the wrapper itself is static —
+#: only its ARGUMENTS can make the amount dynamic.
+_CONST_WRAPPERS = {
+    "asarray", "array", "arange",
+    "uint8", "uint16", "uint32", "uint64",
+    "int8", "int16", "int32", "int64", "float32",
+}
+
+#: host builtins that fold at trace time.
+_HOST_FNS = {"int", "len", "min", "max", "abs", "range", "sum"}
+
+
+def _dynamic_reason(amount: ast.expr) -> str | None:
+    """Why the shift amount is traced data, or None when host-static.
+
+    A pure-AST check can't do dataflow, so the rule is syntactic: device
+    computation (a non-constructor jnp/jax/lax call, or any subscript)
+    anywhere in the amount expression flags it; literals, names, host
+    arithmetic, and dtype-pinning constructors over static arguments
+    pass.  The interval interpreter is the semantic backstop."""
+    if isinstance(amount, ast.Call):
+        d = dotted_name(amount.func) or terminal_name(amount.func) or "?"
+        root = d.split(".", 1)[0]
+        leaf = d.rsplit(".", 1)[-1]
+        if root in ("np", "numpy") or leaf in _CONST_WRAPPERS or d in _HOST_FNS:
+            for a in list(amount.args) + [kw.value for kw in amount.keywords]:
+                r = _dynamic_reason(a)
+                if r:
+                    return r
+            return None
+        return f"computed by {d}(...)"
+    if isinstance(amount, ast.Subscript):
+        return "indexed from an array"
+    if isinstance(amount, ast.BinOp):
+        return _dynamic_reason(amount.left) or _dynamic_reason(amount.right)
+    if isinstance(amount, ast.UnaryOp):
+        return _dynamic_reason(amount.operand)
+    if isinstance(amount, (ast.List, ast.Tuple)):
+        for e in amount.elts:
+            r = _dynamic_reason(e)
+            if r:
+                return r
+    return None
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, fn_name: str):
+        self.mod = mod
+        self.fn_name = fn_name
+        self.findings: list[Finding] = []
+
+    def _add(self, node: ast.AST, desc: str, reason: str) -> None:
+        self.findings.append(
+            Finding(
+                CHECK_ID, self.mod.path, node.lineno, node.col_offset,
+                f"{desc} with data-dependent amount ({reason}) inside "
+                f"jitted body {self.fn_name!r} — dynamic shift widths "
+                "defeat range analysis; hoist the amount to a host "
+                "constant",
+            )
+        )
+
+    def visit_With(self, node: ast.With):  # noqa: N802
+        for item in node.items:
+            d = dotted_name(
+                item.context_expr.func
+                if isinstance(item.context_expr, ast.Call)
+                else item.context_expr
+            )
+            if d and d.endswith("ensure_compile_time_eval"):
+                return  # host-side constant folding
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp):  # noqa: N802
+        if isinstance(node.op, (ast.LShift, ast.RShift)):
+            reason = _dynamic_reason(node.right)
+            if reason:
+                op = "<<" if isinstance(node.op, ast.LShift) else ">>"
+                self._add(node, f"shift '{op}'", reason)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        name = terminal_name(node.func)
+        if name in _SHIFT_CALLS and len(node.args) >= 2:
+            reason = _dynamic_reason(node.args[1])
+            if reason:
+                self._add(node, f"{name}()", reason)
+        self.generic_visit(node)
+
+
+def check(mod: Module) -> list[Finding]:
+    if not SCOPE_DIRS.intersection(mod.parts[:-1]):
+        return []
+    findings: list[Finding] = []
+    closure = traced_closure(mod.tree, manifest.traced_roots(mod.path))
+    for name, fn in closure.items():
+        v = _BodyVisitor(mod, name)
+        for stmt in fn.body:
+            v.visit(stmt)
+        findings.extend(v.findings)
+    return findings

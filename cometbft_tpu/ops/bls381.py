@@ -19,7 +19,13 @@ anti-diagonal matmul (so XLA sees 2 ops, not ~2000 scalar muls), the
 Montgomery reduction is 32 unrolled multiply-add steps, and every op
 returns canonical limbs in [0, p) so int32 bounds hold everywhere:
 conv sums <= 32*4095^2 ~ 5.4e8, reduction adds <= 32*4095^2 more —
-peak < 1.1e9 < 2^31.
+peak < 1.1e9 < 2^31.  The interval interpreter confirms the hand
+bound: the proved peak over the whole G1 kernel set is 836,038,240
+(1.36 bits of int32 headroom; analysis/range_fingerprints.json
+entries ``bls381_*``) — and the scaling law in
+docs/limb_headroom.md shows 12-bit limbs are already the widest safe
+width for this conv depth, so the headroom funds deeper adds, not
+wider limbs.
 
 All device values are in the Montgomery domain; the host bridge
 converts with to_mont/from_mont.

@@ -28,6 +28,22 @@ the uncached kernel.
 Verification semantics are identical (ZIP-215 / cofactored; see
 ops/ed25519.py module doc); tests/test_comb.py checks agreement against
 both the uncached kernel and the host verifier.
+
+Range contracts (analysis/rangecheck.py; certificate entries
+``comb_*`` in analysis/range_fingerprints.json): the f32 comb planes
+never carry more than a single 12-bit digit per partial sum — the
+one-hot table lookups are proved to select, not accumulate, so the
+peak |f32 value| is 4095, leaving ~12 bits of slack under the 2^24
+exact-integer envelope (docs/limb_headroom.md: that slack is what
+funds wider comb digits).  The int32 plane peaks at 1,252,794,005 in
+the shared field walk.  One proved-adversarial hazard shapes this
+module: comb tables are attacker-influenced device inputs (a hostile
+validator key produces arbitrary canonical table coords), and the
+TREE accumulation path sums two lifted Niels points before the first
+field mul — without the F.carry in ed25519.niels_to_extended those
+sums exceed the MULIN mul-input bound and the conv partial sums
+clear 2^31.  The certificate pins the carried version; the rangecheck
+gate fails any regression.
 """
 
 from __future__ import annotations

@@ -22,6 +22,17 @@ types/validation.go:265 verifyCommitBatch expectations):
   - x = 0 with sign bit 1 accepted,
   - s < L enforced (checked in ops/scalar.py),
   - equation checked with cofactor 8: [8][s]B == [8]R + [8][k]A.
+
+Range contract (proved by analysis/rangecheck.py, pinned in
+analysis/range_fingerprints.json entry ``ed25519_verify_batch``): with
+inputs at their manifest-declared ranges, every int32 intermediate of
+the full verify walk stays within |x| <= 1,252,794,005 — about 0.78
+bits of int32 headroom at the tightest point (the field-mul conv
+partial sums).  The contract leans on two limb invariants from
+ops/field.py: TIGHT (|limb0| <= 3584, others <= 2051) out of carry,
+and MULIN (|limb0| <= 14336, others <= 8204) into mul — any point sum
+wider than MULIN must pass through F.carry before the next mul (see
+niels_to_extended for the one production site where this bit).
 """
 
 from __future__ import annotations
@@ -146,8 +157,17 @@ def niels_to_extended(n: Niels) -> Point:
     ((1,1,0) -> (0:2:2:0)) and for sign-flipped entries
     ((y-x, y+x, -2dxy) -> (-2x : 2y : 2 : -2xy)).
     """
-    x2 = F.sub(n.yplusx, n.yminusx)
-    y2 = F.add(n.yplusx, n.yminusx)
+    # Carry the lifted sums back into the TIGHT profile: for canonical
+    # table entries the raw y+x +/- y-x limbs reach +/-8190, and the
+    # FIRST tree fold adds two lifted points — its F.add(p.y, p.x) would
+    # hit +/-12285 per limb, past the MULIN contract (|limb|<=8204), and
+    # the mul conv partial sums would clear 2^31 on adversarial
+    # (attacker-chosen pubkey) tables.  One carry pass is elementwise
+    # shifts, noise next to the fold's 9 muls; the range certificate
+    # (analysis/range_fingerprints.json, comb_verify_cached_tree) pins
+    # the proof.
+    x2 = F.carry(F.sub(n.yplusx, n.yminusx))
+    y2 = F.carry(F.add(n.yplusx, n.yminusx))
     batch = x2.shape[:-2] + x2.shape[-1:]
     one = F.one(batch)
     return Point(x2, y2, F.add(one, one), F.mul(n.t2d, _c(_INV_D_L)))

@@ -17,7 +17,12 @@ carries (unlike sha2's (hi, lo) adds).  Flat lane index l = x + 5*y
 matches the host absorb order (lane i of a block lands at a[i%5][i//5],
 which IS flat index i).  The 24 rounds run as ONE lax.fori_loop body
 (round constants indexed dynamically), so the jaxpr stays O(1) in
-rounds; the rho/pi lane permutation is statically unrolled inside the
+rounds (range contract: the whole state plane is uint32 XOR/AND/NOT/
+rotate — wrap-defined, no signed overflow surface — and every shift
+amount is a host constant; certificate ``keccak256_blocks`` in
+analysis/range_fingerprints.json pins the proof, and the
+unchecked-shift-width linter check keeps the amounts static); the
+rho/pi lane permutation is statically unrolled inside the
 body (fixed per-lane offsets).
 
 Multi-block messages use the same blocks+active contract as

@@ -12,7 +12,11 @@ leading, limbs minor), R = 2^264, and every op returns canonical limbs
 in [0, m).  The 44-limb product is one outer-product + one constant
 anti-diagonal matmul; the reduction is a fori_loop (O(1) jaxpr in the
 limb count).  int32 bounds: conv sums <= 22*4095^2 ~ 3.7e8, reduction
-adds <= the same again — peak < 7.4e8 < 2^31.
+adds <= the same again — peak < 7.4e8 < 2^31.  The interval
+interpreter (analysis/rangecheck.py) proves the tight version of that
+estimate: peak |intermediate| = 716,255,216 across all five secp
+kernels (1.58 bits of int32 headroom; certificate entries
+``secp256k1_*`` in analysis/range_fingerprints.json).
 
 The ECDSA batch (one fused program per bucket shape):
 

@@ -5,6 +5,7 @@
                            [--check ID ...]
     python scripts/lint.py regen-fingerprints
     python scripts/lint.py regen-shardings
+    python scripts/lint.py regen-ranges
 
 Runs every check in cometbft_tpu/analysis over the given paths (default:
 the cometbft_tpu package), filters through the checked-in allowlist
@@ -33,6 +34,16 @@ cometbft_tpu/analysis/shard_fingerprints.json goldens.
 ``regen-shardings`` re-traces and rewrites the goldens; open contract
 findings refuse regeneration — blessing drift never blesses a broken
 contract.
+
+The special id ``range`` selects the limb-range contract gate
+(docs/limb_headroom.md): the unchecked-shift-width AST check PLUS the
+rangecheck interval pass — every manifest kernel abstract-interpreted
+over declared input ranges, every intermediate held to its dtype's safe
+range (int32 magnitude, the 2^24 f32-exact threshold), declared output
+ranges enforced, and the result diffed against the checked-in
+cometbft_tpu/analysis/range_fingerprints.json certificates.
+``regen-ranges`` re-interprets and rewrites the certificates; open
+overflow findings refuse regeneration.
 
 Check toggles live in pyproject.toml:
 
@@ -120,6 +131,29 @@ def regen_shardings() -> int:
     return 0
 
 
+def regen_ranges() -> int:
+    """Re-interpret every manifest kernel and rewrite the range
+    certificates."""
+    from cometbft_tpu.analysis import rangecheck
+
+    findings, reports = rangecheck.regenerate()
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"\n{len(findings)} range finding(s) — regeneration only "
+            "blesses drift, never an open overflow; certificates NOT "
+            "written",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"interpreted {len(reports)} kernels -> "
+        f"{rangecheck.RANGE_FINGERPRINTS_PATH}"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -127,6 +161,8 @@ def main(argv: list[str] | None = None) -> int:
         return regen_fingerprints()
     if argv and argv[0] == "regen-shardings":
         return regen_shardings()
+    if argv and argv[0] == "regen-ranges":
+        return regen_ranges()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None)
@@ -137,7 +173,9 @@ def main(argv: list[str] | None = None) -> int:
         action="append",
         metavar="ID",
         help="restrict to the given check id(s); 'kernel' = the three "
-        "kernel-plane AST checks + the kernelcheck trace/fingerprint gate",
+        "kernel-plane AST checks + the kernelcheck trace/fingerprint gate; "
+        "'sharding' = the 8-device shardcheck gate; 'range' = the "
+        "unchecked-shift-width AST check + the rangecheck interval gate",
     )
     ap.add_argument(
         "--config",
@@ -160,10 +198,13 @@ def main(argv: list[str] | None = None) -> int:
               "kernelcheck trace/fingerprint pass)")
         print("sharding: the sharded-program contract gate (donated-read "
               "AST check + 8-device shardcheck trace/golden pass)")
+        print("range: the limb-range contract gate (unchecked-shift-width "
+              "AST check + rangecheck interval/certificate pass)")
         return 0
 
     run_trace = False
     run_shard_trace = False
+    run_range_trace = False
     if args.check:
         ids: list[str] = []
         for c in args.check:
@@ -173,6 +214,9 @@ def main(argv: list[str] | None = None) -> int:
             elif c == "sharding":
                 run_shard_trace = True
                 ids.extend(linter.SHARDING_CHECK_IDS)
+            elif c == "range":
+                run_range_trace = True
+                ids.extend(linter.RANGE_CHECK_IDS)
             else:
                 ids.append(c)
         unknown_ids = set(ids) - set(checks)
@@ -215,6 +259,16 @@ def main(argv: list[str] | None = None) -> int:
         kernel_summary = kernelcheck.summary(kfindings, traces)
         stale = allowlist.unused()  # kernel findings may have used entries
 
+    range_summary = None
+    if run_range_trace:
+        from cometbft_tpu.analysis import rangecheck
+
+        rfindings, reports = rangecheck.run_check()
+        rfindings = [f for f in rfindings if not allowlist.suppresses(f)]
+        findings = findings + rfindings
+        range_summary = rangecheck.summary(rfindings, reports)
+        stale = allowlist.unused()
+
     shard_summary = None
     if run_shard_trace:
         from cometbft_tpu.analysis import shardcheck
@@ -246,6 +300,10 @@ def main(argv: list[str] | None = None) -> int:
             from cometbft_tpu.analysis import shardcheck
 
             enabled_ids |= set(shardcheck.FINDING_CHECK_IDS)
+        if run_range_trace:
+            from cometbft_tpu.analysis import rangecheck
+
+            enabled_ids |= set(rangecheck.FINDING_CHECK_IDS)
         stale = [e for e in stale if e.check in enabled_ids]
 
     if args.json:
@@ -266,6 +324,7 @@ def main(argv: list[str] | None = None) -> int:
                 "ok": not findings,
                 **({"kernel": kernel_summary} if kernel_summary else {}),
                 **({"sharding": shard_summary} if shard_summary else {}),
+                **({"range": range_summary} if range_summary else {}),
             },
             indent=2,
         ))

@@ -122,6 +122,25 @@ def test_lane_and_phase_share_diffs():
     ]
 
 
+def test_rangecheck_summary_passes_through_unchanged():
+    """Backend-less rounds embed a "rangecheck" block (bench.py); the
+    comparator must neither diff it nor choke on it — it only reads
+    metric/value/classes/phase_attribution."""
+    mod = _load_mod()
+    rng = {
+        "ok": True, "mode": "certificates+spot", "certificates": 23,
+        "headroom": {"ed25519_verify_batch": {"peak_int32": 1252794005}},
+    }
+    old = {"metric": "m", "value": 100.0, "rangecheck": rng}
+    new = {"metric": "m", "value": 104.0, "rangecheck": rng}
+    ok, reason = mod.classify(old, "x")
+    assert reason is None and ok["rangecheck"] == rng
+    rep = mod.compare(old, new, threshold=0.10)
+    assert rep["headline"]["delta_pct"] == pytest.approx(4.0)
+    assert rep["regressions"] == []
+    assert "rangecheck" not in rep  # not a perf surface: passed over
+
+
 def test_classify_shapes():
     mod = _load_mod()
     # bare bench JSON (no driver wrapper) is accepted directly

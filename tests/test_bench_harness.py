@@ -58,6 +58,10 @@ def test_unavailable_backend_yields_structured_error():
             # ladder; its wiring is covered by
             # tests/test_kernelcheck.py::test_bench_reports_kernelcheck_when_backend_unavailable
             "BENCH_KERNELCHECK": "0",
+            # same timeout arithmetic for the range-certificate embed;
+            # its wiring is covered by
+            # tests/test_rangecheck.py::test_bench_embeds_rangecheck_report
+            "BENCH_RANGECHECK": "0",
         }
     )
     assert out["metric"] == "verify_commit_p50_10k_ms"
@@ -91,6 +95,7 @@ def test_unavailable_backend_degrades_to_cpu():
             "BENCH_PROBE_RETRY_DELAY": "0",
             "BENCH_KERNELCHECK": "0",
             "BENCH_SHARDCHECK": "0",
+            "BENCH_RANGECHECK": "0",
             # small degraded scale: host path is ~4 ms/sig pure-Python
             "BENCH_DEGRADED_N": "64",
             "BENCH_DEGRADED_ITERS": "2",

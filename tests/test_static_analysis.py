@@ -21,6 +21,7 @@ from cometbft_tpu.analysis import (
     socket_timeout,
     swallowed_exc,
     thread_names,
+    unchecked_shift_width,
 )
 from cometbft_tpu.utils import envknobs
 
@@ -650,6 +651,78 @@ def test_knobs_doc_is_generated_and_current():
         "docs/knobs.md is stale — regenerate with "
         "`python -m cometbft_tpu.utils.envknobs > docs/knobs.md`"
     )
+
+
+# ------------------------------------- unchecked-shift-width (range plane)
+
+def test_unchecked_shift_width_flags_dynamic_amounts():
+    src = '''
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+@jax.jit
+def k(x, widths):
+    a = lax.shift_left(x, jnp.sum(x))        # device-computed amount
+    b = x >> widths[0]                       # indexed from an array
+    c = jnp.right_shift(x, lax.rem(x, x))    # traced call as amount
+    return a + b + c
+'''
+    found = unchecked_shift_width.check(_mod(src, "cometbft_tpu/ops/fake.py"))
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3, msgs
+    assert "computed by jnp.sum(...)" in msgs
+    assert "indexed from an array" in msgs
+    assert "computed by lax.rem(...)" in msgs
+    assert all(f.check == "unchecked-shift-width" for f in found)
+
+
+def test_unchecked_shift_width_exempts_static_amounts():
+    # literals, module constants, unrolled-loop variables, dtype-pinning
+    # constructors over static args, compile-time eval, and host code
+    src = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BITS = 12
+
+@jax.jit
+def k(x, idx):
+    a = x >> 12
+    b = lax.shift_left(x, BITS)
+    for r in (7, 9, 13):
+        x = x ^ (x >> np.uint32(r))
+    c = jnp.left_shift(x, jnp.asarray(BITS - 4, jnp.uint32))
+    with jax.ensure_compile_time_eval():
+        d = x >> idx[0]
+    return a + b + c
+
+def host_only(x, n):
+    return x >> n[0]
+'''
+    assert unchecked_shift_width.check(
+        _mod(src, "cometbft_tpu/ops/fake.py")
+    ) == []
+
+
+def test_unchecked_shift_width_scope_and_registration():
+    src = '''
+import jax
+
+@jax.jit
+def k(x, w):
+    return x >> w[0]
+'''
+    # outside ops//parallel//models: silent
+    assert unchecked_shift_width.check(
+        _mod(src, "cometbft_tpu/types/fake.py")
+    ) == []
+    # the range-plane AST subset is registered (scripts/lint.py
+    # --check range resolves through it)
+    assert "unchecked-shift-width" in linter.RANGE_CHECK_IDS
+    assert set(linter.RANGE_CHECK_IDS) <= set(linter.all_checks())
 
 
 # ------------------------------------------------- the gate
