@@ -144,6 +144,7 @@ def all_checks() -> dict[str, object]:
         undocumented_metric,
         untracked_jit,
         weak_type_literal,
+        wire_length,
     )
 
     mods = (
@@ -160,6 +161,7 @@ def all_checks() -> dict[str, object]:
         unchecked_shift_width,
         donated_read,
         socket_timeout,
+        wire_length,
     )
     return {m.CHECK_ID: m for m in mods}
 
@@ -178,6 +180,11 @@ SHARDING_CHECK_IDS = ("donated-read-after-dispatch",)
 #: (scripts/lint.py --check range) alongside the rangecheck interval
 #: interpreter pass.
 RANGE_CHECK_IDS = ("unchecked-shift-width",)
+
+#: The Byzantine-input subset: the AST half of the taint contract gate
+#: (scripts/lint.py --check taint) alongside the taintcheck dataflow
+#: pass over the taint_manifest source/sanitizer/sink registry.
+TAINT_CHECK_IDS = ("unbounded-wire-length",)
 
 
 def iter_py_files(paths: list[str]) -> list[str]:

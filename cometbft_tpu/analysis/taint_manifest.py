@@ -1,0 +1,451 @@
+"""Byzantine-input taint manifest: every untrusted-bytes SOURCE, every
+SANITIZER, every SINK.
+
+A BFT engine's threat model makes every byte arriving from a peer, an
+RPC caller, or a CheckTx envelope attacker-chosen; the reference
+codebase encodes that as a pervasive decode-then-``ValidateBasic``
+discipline (types/validation.go, consensus/reactor.go Receive).  This
+manifest is the machine-checkable registry of that discipline for the
+host half of this repo — the analogue of ``kernel_manifest`` for the
+device half:
+
+* :data:`SOURCES` — where untrusted bytes enter (reactor ``receive``
+  payloads, wire frame readers, CheckTx envelopes, RPC params, on-disk
+  documents).  Each row names the entry function, which of its
+  parameters (or which calls inside it) carry attacker bytes, and the
+  typed-error contract its decoder must honor under the adversarial
+  decode gauntlet (tests/test_decode_gauntlet.py).
+* :data:`SANITIZER_FUNCS` / :data:`SANITIZER_METHODS` — the calls that
+  make a tainted value safe: the wire-level ``validate_*_message``
+  validators (types/msg_validation.py), envelope parsers that enforce
+  their own length/shape contracts, and ``validate_basic`` methods.
+* :data:`SINKS` — calls no tainted value may reach: consensus state
+  transitions, pool/store/evidence writes, and the verify-service
+  device-dispatch seams.  A sink marked ``validating`` performs its own
+  validation internally and is a permitted destination.
+* :data:`DECODE_SITES` — the exhaustive map of every proto/envelope
+  decode call site in the package to its source (or an explicit trusted
+  justification).  ``analysis/taintcheck.py`` re-discovers the sites
+  from the AST and diffs both directions, so an unregistered decode
+  surface and a stale manifest row are both findings (the
+  kernel_manifest JIT_SITES pattern).
+
+Plain data only — importable with no heavy dependencies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Source:
+    """One untrusted-bytes entry point."""
+
+    name: str
+    path: str  # repo-relative module path (suffix-matched)
+    func: str  # the entry function holding the decode
+    #: parameters of ``func`` that arrive attacker-controlled
+    tainted_params: tuple[str, ...] = ()
+    #: terminal call names inside ``func`` whose results are attacker
+    #: bytes (stream readers: conn.read/read_exact/recv)
+    tainted_calls: tuple[str, ...] = ()
+    #: run the interprocedural dataflow pass from this entry; False for
+    #: stream framing and file loads whose contract is bounds + typed
+    #: errors rather than sanitize-before-sink (gauntlet still covers
+    #: them)
+    dataflow: bool = True
+    #: exception class names the decode path may raise on malformed
+    #: input — the gauntlet's typed-error contract (anything else, or a
+    #: hang/unbounded allocation, is a failure)
+    errors: tuple[str, ...] = ("ValueError",)
+    notes: str = ""
+
+
+SOURCES: tuple[Source, ...] = (
+    # ---------------------------------------------------- p2p reactors
+    Source(
+        name="consensus-receive",
+        path="cometbft_tpu/consensus/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_consensus_message + typed "
+        "validate_basic at Proposal/Vote/Part conversion",
+    ),
+    Source(
+        name="blocksync-receive",
+        path="cometbft_tpu/blocksync/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_blocksync_message; blocks "
+        "additionally pass Block.validate_basic before pool.add_block",
+    ),
+    Source(
+        name="statesync-receive",
+        path="cometbft_tpu/statesync/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_statesync_message",
+    ),
+    Source(
+        name="mempool-receive",
+        path="cometbft_tpu/mempool/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_mempool_message; check_tx is a "
+        "validating sink (size caps + app CheckTx)",
+    ),
+    Source(
+        name="evidence-receive",
+        path="cometbft_tpu/evidence/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_evidence_list; add_evidence is a "
+        "validating sink (ev.validate_basic + verify)",
+    ),
+    Source(
+        name="pex-receive",
+        path="cometbft_tpu/p2p/pex/reactor.py",
+        func="receive",
+        tainted_params=("msg_bytes",),
+        notes="sanitized by validate_pex_message (addr count cap + "
+        "id@host:port shape)",
+    ),
+    # ------------------------------------------------- p2p wire framing
+    Source(
+        name="p2p-packet",
+        path="cometbft_tpu/p2p/conn/connection.py",
+        func="_read_packet",
+        tainted_calls=("read",),
+        dataflow=False,
+        errors=("ValueError", "ConnectionError"),
+        notes="length prefix bounded by MAX_PACKET_WIRE_SIZE before "
+        "read_exact; stream reassembly bounded by recv_message_capacity; "
+        "a truncated stream is ConnectionError by contract",
+    ),
+    Source(
+        name="secretconn-frame",
+        path="cometbft_tpu/p2p/conn/secret_connection.py",
+        func="read",
+        tainted_calls=("_read_exact",),
+        dataflow=False,
+        errors=("SecretConnectionError",),
+        notes="fixed 1044-byte sealed frames; AEAD-authenticated before "
+        "the length field is trusted; length bounded by DATA_MAX_SIZE",
+    ),
+    Source(
+        name="nodeinfo-handshake",
+        path="cometbft_tpu/p2p/transport.py",
+        func="_exchange_node_info",
+        tainted_calls=("read_exact",),
+        errors=(
+            "ValueError",
+            "TransportError",
+            "NodeInfoError",
+            "SecretConnectionError",  # truncation surfaces from the conn
+        ),
+        notes="length prefix bounded by MAX_NODE_INFO_SIZE before "
+        "read_exact; NodeInfo.validate_basic sanitizes the result",
+    ),
+    # --------------------------------------------- verify-plane framing
+    Source(
+        name="verifysvc-frame",
+        path="cometbft_tpu/verifysvc/wire.py",
+        func="_try_decode",
+        tainted_params=("self",),
+        dataflow=False,
+        notes="FrameReader bounds the varint length against max_frame "
+        "before buffering the payload",
+    ),
+    Source(
+        name="checktx-envelope",
+        path="cometbft_tpu/verifysvc/checktx.py",
+        func="verify_tx_signature",
+        tainted_params=("tx",),
+        notes="parse_signed_tx is the sanitizer: fixed-width envelope "
+        "slices per key type; malformed envelopes return None "
+        "(pass-through-unsigned) and never reach submit()",
+    ),
+    # -------------------------------------------------- ABCI tx payloads
+    Source(
+        name="kvstore-validator-tx",
+        path="cometbft_tpu/abci/kvstore.py",
+        func="parse_validator_tx",
+        tainted_params=("tx",),
+        dataflow=False,
+        notes="the PR-8 lesson: parse_validator_tx IS the sanitizer — "
+        "base64(validate=True), power >= 0, ed25519 pubkey length "
+        "pinned to 32 before any validator update is emitted",
+    ),
+    # ------------------------------------------------------ ABCI framing
+    Source(
+        name="abci-server-frame",
+        path="cometbft_tpu/abci/server.py",
+        func="_handle_conn",
+        tainted_calls=("recv",),
+        dataflow=False,
+        notes="length-delimited Request frames; malformed prefix or "
+        "frame answers an exception response and drops the connection",
+    ),
+    Source(
+        name="abci-client-frame",
+        path="cometbft_tpu/abci/client.py",
+        func="_recv_routine",
+        tainted_calls=("recv",),
+        dataflow=False,
+        errors=("ValueError", "ClientError"),
+        notes="app responses; slices bounded by buffered bytes",
+    ),
+    # -------------------------------------------------------- RPC surface
+    Source(
+        name="rpc-broadcast-evidence",
+        path="cometbft_tpu/rpc/core.py",
+        func="broadcast_evidence",
+        tainted_params=("evidence",),
+        errors=("ValueError", "RPCError"),
+        notes="base64 proto evidence from a JSON-RPC caller; "
+        "pool.add_evidence is the validating sink",
+    ),
+    Source(
+        name="rpc-services-frame",
+        path="cometbft_tpu/rpc/services.py",
+        func="_serve_conn",
+        tainted_calls=("read",),
+        dataflow=False,
+        notes="_read_frame bounds the varint length against _MAX_MSG; "
+        "handler payload decodes answer errors in-band",
+    ),
+    # ---------------------------------------------------- privval framing
+    Source(
+        name="privval-frame",
+        path="cometbft_tpu/privval/signer.py",
+        func="_recv_msg",
+        tainted_calls=("read",),
+        errors=("ValueError", "RemoteSignerError"),
+        dataflow=False,
+        notes="length prefix bounded by MAX_PRIVVAL_MSG_SIZE before the "
+        "read loop allocates",
+    ),
+    # ------------------------------------------------ block reassembly
+    Source(
+        name="block-assembly",
+        path="cometbft_tpu/consensus/state.py",
+        func="_add_proposal_block_part",
+        dataflow=False,
+        notes="Block.decode over assemble()d parts: every part's merkle "
+        "proof was verified against the proposal's PartSetHeader hash "
+        "in PartSet.add_part, so the bytes are proposer-committed; "
+        "decode errors surface as ValueError to the receive wrapper",
+    ),
+    # ------------------------------------------------------- file loads
+    Source(
+        name="wal-replay",
+        path="cometbft_tpu/consensus/wal.py",
+        func="decode_records",
+        tainted_params=("buf",),
+        dataflow=False,
+        errors=("CorruptWALError",),
+        notes="CRC + length-bounded records; every malformation is "
+        "CorruptWALError so replay can repair the tail",
+    ),
+    Source(
+        name="genesis-file",
+        path="cometbft_tpu/types/genesis.py",
+        func="from_json",
+        tainted_params=("data",),
+        dataflow=False,
+        notes="operator-supplied JSON; every malformation (missing key, "
+        "type confusion, bad hex) is re-raised as ValueError and "
+        "validate_and_complete gates the result",
+    ),
+    Source(
+        name="addrbook-file",
+        path="cometbft_tpu/p2p/pex/addrbook.py",
+        func="_load",
+        dataflow=False,
+        notes="on-disk JSON built from gossip; corrupt documents raise "
+        "ValueError, records re-enter through add_address",
+    ),
+    # ----------------------------------------------------- light client
+    Source(
+        name="light-proof",
+        path="cometbft_tpu/light/rpc.py",
+        func="abci_query",
+        dataflow=False,
+        errors=("VerificationFailed", "ValueError"),
+        notes="untrusted provider's proof ops; the whole parse is "
+        "wrapped fail-closed into VerificationFailed (the inner proto "
+        "decode raises ValueError)",
+    ),
+)
+
+
+#: Free functions whose return value is SAFE given tainted arguments —
+#: they validate internally and raise (or return None) on garbage.
+SANITIZER_FUNCS = frozenset(
+    {
+        "validate_consensus_message",
+        "validate_blocksync_message",
+        "validate_statesync_message",
+        "validate_mempool_message",
+        "validate_pex_message",
+        "validate_evidence_list",
+        "validate_peer_address",
+        "parse_signed_tx",
+        "parse_validator_tx",
+    }
+)
+
+#: Method names that sanitize their receiver: ``x.validate_basic()``
+#: makes ``x`` safe (raising on garbage), per the reference's
+#: ValidateBasic contract.
+SANITIZER_METHODS = frozenset({"validate_basic", "validate_and_complete"})
+
+
+@dataclass(frozen=True)
+class Sink:
+    """A call no tainted value may reach (terminal attribute name)."""
+
+    name: str
+    #: the sink validates its arguments internally — tainted values are
+    #: permitted to reach it, with the justification recorded here
+    validating: bool = False
+    reason: str = ""
+
+
+SINKS: tuple[Sink, ...] = (
+    # consensus state transitions (consensus/state.py)
+    Sink("set_proposal"),
+    Sink("add_vote"),
+    Sink("add_proposal_block_part"),
+    # blocksync pool feeds (blocksync/pool.py)
+    Sink("add_block"),
+    Sink("set_peer_range"),
+    # statesync pool feeds (statesync/syncer.py)
+    Sink("add_snapshot"),
+    Sink("add_chunk"),
+    # address book writes (p2p/pex/addrbook.py)
+    Sink("add_address"),
+    # state/execution apply + store writes
+    Sink("apply_block"),
+    Sink("save_block"),
+    # verify-service device-dispatch seams (verifysvc/service.py,
+    # models/*verifier add()/submit())
+    Sink("submit"),
+    Sink("add_evidence", validating=True,
+         reason="EvidencePool.add_evidence runs ev.validate_basic() + "
+                "full verification before persisting"),
+    Sink("check_tx", validating=True,
+         reason="CListMempool.check_tx enforces max_tx_bytes, cache "
+                "dedup, signature admission, and the app's CheckTx"),
+)
+
+SINK_NAMES = frozenset(s.name for s in SINKS)
+VALIDATING_SINKS = frozenset(s.name for s in SINKS if s.validating)
+
+#: Call results that stay untainted even with tainted arguments:
+#: fixed-range scalars (sizes, predicates), not attacker-shaped data.
+UNTAINTING_BUILTINS = frozenset(
+    {"len", "bool", "isinstance", "hash", "id", "monotonic", "time"}
+)
+
+
+# ------------------------------------------------------------ decode map
+
+#: Every proto/envelope decode call site in the package, keyed
+#: ``"path::enclosing-function"``, mapped to its Source name or an
+#: explicit ``"trusted: <why>"`` justification.  taintcheck re-discovers
+#: the sites syntactically and diffs both directions.
+DECODE_SITES: dict[str, str] = {
+    # ------------------------------------------------- wire surfaces
+    "cometbft_tpu/consensus/reactor.py::receive": "consensus-receive",
+    "cometbft_tpu/blocksync/reactor.py::receive": "blocksync-receive",
+    "cometbft_tpu/statesync/reactor.py::receive": "statesync-receive",
+    "cometbft_tpu/mempool/reactor.py::receive": "mempool-receive",
+    "cometbft_tpu/evidence/reactor.py::receive": "evidence-receive",
+    "cometbft_tpu/p2p/pex/reactor.py::receive": "pex-receive",
+    "cometbft_tpu/p2p/conn/connection.py::_read_packet": "p2p-packet",
+    "cometbft_tpu/p2p/transport.py::_exchange_node_info": "nodeinfo-handshake",
+    "cometbft_tpu/verifysvc/wire.py::_try_decode": "verifysvc-frame",
+    "cometbft_tpu/verifysvc/checktx.py::verify_tx_signature": "checktx-envelope",
+    "cometbft_tpu/abci/server.py::_handle_conn": "abci-server-frame",
+    "cometbft_tpu/abci/client.py::_recv_routine": "abci-client-frame",
+    "cometbft_tpu/privval/signer.py::_recv_msg": "privval-frame",
+    "cometbft_tpu/consensus/state.py::_add_proposal_block_part": "block-assembly",
+    "cometbft_tpu/light/rpc.py::abci_query": "light-proof",
+    # ------------------------------------------------- ABCI tx payloads
+    "cometbft_tpu/abci/kvstore.py::check_tx": "kvstore-validator-tx",
+    "cometbft_tpu/abci/kvstore.py::process_proposal": "kvstore-validator-tx",
+    "cometbft_tpu/abci/kvstore.py::finalize_block": "kvstore-validator-tx",
+    # ------------------------------------------------------ RPC surface
+    "cometbft_tpu/rpc/core.py::broadcast_evidence": "rpc-broadcast-evidence",
+    "cometbft_tpu/rpc/services.py::_serve_conn": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_get_by_height": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_get_block_results": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_set_block_retain": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_set_block_results_retain": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_set_tx_indexer_retain": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::_set_block_indexer_retain": "rpc-services-frame",
+    # client side of the block/pruning service: responses from the node
+    # we dialed; still length-bounded and decoded under the same codec
+    "cometbft_tpu/rpc/services.py::_call": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_by_height": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::latest_height_stream": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_block_results": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_version": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_block_retain_height": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_block_results_retain_height": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_tx_indexer_retain_height": "rpc-services-frame",
+    "cometbft_tpu/rpc/services.py::get_block_indexer_retain_height": "rpc-services-frame",
+    # ------------------------------------------------------- file loads
+    "cometbft_tpu/consensus/wal.py::decode_records": "wal-replay",
+    "cometbft_tpu/consensus/wal.py::iter_records": "wal-replay",
+    # -------------------------------------------------- trusted locals
+    # Our own DB bytes: written by this process via the store layer;
+    # corruption is a crash-worthy operator problem, not peer input.
+    "cometbft_tpu/store/block_store.py::load_block_meta": "trusted: local block DB",
+    "cometbft_tpu/store/block_store.py::load_block": "trusted: local block DB",
+    "cometbft_tpu/store/block_store.py::load_block_part": "trusted: local block DB",
+    "cometbft_tpu/store/block_store.py::load_block_commit": "trusted: local block DB",
+    "cometbft_tpu/store/block_store.py::load_seen_commit": "trusted: local block DB",
+    "cometbft_tpu/store/block_store.py::load_block_extended_commit": "trusted: local block DB",
+    "cometbft_tpu/state/store.py::load": "trusted: local state DB",
+    "cometbft_tpu/state/store.py::load_validators": "trusted: local state DB",
+    "cometbft_tpu/state/store.py::load_consensus_params": "trusted: local state DB",
+    "cometbft_tpu/state/store.py::load_finalize_block_response": "trusted: local state DB",
+    "cometbft_tpu/light/store.py::light_block": "trusted: local light-client DB; blocks were verified before store",
+    "cometbft_tpu/light/store.py::latest_light_block": "trusted: local light-client DB; blocks were verified before store",
+    "cometbft_tpu/light/store.py::first_light_block": "trusted: local light-client DB; blocks were verified before store",
+    "cometbft_tpu/light/store.py::light_block_before": "trusted: local light-client DB; blocks were verified before store",
+    "cometbft_tpu/evidence/pool.py::evidence_from_proto_bytes": "trusted: local evidence DB reload; wire entry is add_evidence",
+    "cometbft_tpu/privval/file_pv.py::_only_differ_by_timestamp": "trusted: local last-sign state file written by this process",
+    "cometbft_tpu/types/block.py::decode": "trusted: codec helper; untrusted callers are registered at their own sites",
+    "cometbft_tpu/e2e/firehose.py::_storm_pool": "trusted: in-process load generator parsing its own generated txs",
+}
+
+
+def site_registered(path: str, func: str) -> str | None:
+    """The DECODE_SITES entry for a discovered site, suffix-matching the
+    path the same way the allowlist does (absolute or repo-relative
+    invocations must resolve identically)."""
+    key_tail = f"{path}::{func}"
+    for key, val in DECODE_SITES.items():
+        if key_tail == key or key_tail.endswith("/" + key):
+            return val
+    return None
+
+
+def source_by_name(name: str) -> Source | None:
+    for s in SOURCES:
+        if s.name == name:
+            return s
+    return None
+
+
+def dataflow_sources() -> tuple[Source, ...]:
+    return tuple(s for s in SOURCES if s.dataflow)
+
+
+def gauntlet_sources() -> tuple[Source, ...]:
+    """Every source the adversarial decode gauntlet must cover."""
+    return SOURCES

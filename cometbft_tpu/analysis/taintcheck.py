@@ -1,0 +1,565 @@
+"""Byzantine-input taint checker: prove every untrusted-bytes value is
+validated before it reaches a consensus/state/store/dispatch sink.
+
+The dataflow half of the ``taint`` gate (``scripts/lint.py --check
+taint``), driven entirely by :mod:`taint_manifest`:
+
+1. **Decode-surface exhaustiveness** — rediscover every proto/envelope
+   decode call site in the package syntactically and diff it against
+   ``DECODE_SITES`` in both directions: an unregistered decode surface
+   is a ``taint-unregistered-decode`` finding (new wire entry points
+   must declare their source + typed-error contract), and a manifest
+   row matching nothing is ``taint-manifest-stale`` (the registry never
+   outlives the code, the kernel_manifest JIT_SITES discipline).
+
+2. **Validate-before-use dataflow** — for every manifest source with
+   ``dataflow=True``, an abstract interpretation of the entry function
+   over a taint lattice: the declared ``tainted_params`` (and results of
+   ``tainted_calls``) seed the tainted set; taint propagates through
+   assignment, attribute/subscript access, arithmetic, collection
+   construction, f-strings, and calls; a declared SANITIZER call
+   (``validate_*_message(msg)``, ``x.validate_basic()``, ``parsed =
+   parse_signed_tx(tx)``) launders its argument/receiver/result; a
+   tainted value reaching a declared non-validating SINK call is a
+   ``tainted-sink`` finding.  The pass is module-local interprocedural:
+   calls into same-module functions with tainted arguments are analyzed
+   under those tainted parameters (memoized, cycle-tolerant), the
+   collect_functions/terminal_name machinery shared with ``_jitscan``.
+
+Branches join by union (taint survives if EITHER arm leaves it
+tainted), loops run their body twice (enough for the single-level
+loop-carried dependences reactor code exhibits), and ``len()``-style
+scalar builtins are laundering (a size derived from attacker bytes is
+a number, not attacker-shaped data).  The analysis is deliberately
+unsound-toward-noise rather than complete: its job is to hold the
+decode surfaces to the reference's decode-then-ValidateBasic shape
+(types/validation.go, conS.Receive), not to model Python.
+
+Runtime counterpart: tests/test_decode_gauntlet.py feeds every declared
+source truncated/oversized/bit-flipped/type-confused frames and holds
+each to its declared typed-error contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from . import taint_manifest as tm
+from ._jitscan import collect_functions
+from .linter import Finding, terminal_name
+
+#: Finding check ids this pass emits (scripts/lint.py uses these for
+#: stale-allowlist accounting, mirroring rangecheck.FINDING_CHECK_IDS).
+FINDING_CHECK_IDS = frozenset(
+    {"tainted-sink", "taint-unregistered-decode", "taint-manifest-stale"}
+)
+
+MANIFEST_PATH = "cometbft_tpu/analysis/taint_manifest.py"
+
+#: Call names whose RESULT is untrusted bytes/structures wherever they
+#: appear — the envelope/stream decoders of this codebase.  ``.decode``
+#: attribute calls are recognized separately (proto Message classes).
+DECODER_CALL_NAMES = frozenset(
+    {
+        "decode_records",
+        "parse_signed_tx",
+        "parse_validator_tx",
+        "decode_delimited",
+        "decode_varint_stream",
+    }
+)
+
+#: Directories whose decode calls are the codec itself, not a surface.
+_SCAN_EXCLUDE_PARTS = ("wire", "analysis")
+
+
+# ------------------------------------------------------- site discovery
+
+
+@dataclass(frozen=True)
+class DecodeSite:
+    path: str  # repo-relative posix path
+    func: str  # enclosing function name, "<module>" at top level
+    lineno: int
+    col: int
+    callee: str  # the decode call's terminal name
+
+
+def _is_proto_decode(call: ast.Call) -> bool:
+    """``Owner.decode(...)`` / ``pb.Owner.decode(...)`` where the owner
+    chain terminates in a CapWords name — a proto Message classmethod,
+    never ``somebytes.decode("utf-8")`` (lowercase owner)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "decode"):
+        return False
+    owner = terminal_name(f.value)
+    return bool(owner) and owner[:1].isupper()
+
+
+class _SiteScanner(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.sites: list[DecodeSite] = []
+        self._stack: list[str] = []
+
+    def _visit_fn(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn  # noqa: N815
+    visit_AsyncFunctionDef = _visit_fn  # noqa: N815
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        tn = terminal_name(node.func)
+        if _is_proto_decode(node) or tn in DECODER_CALL_NAMES:
+            self.sites.append(
+                DecodeSite(
+                    self.path,
+                    self._stack[-1] if self._stack else "<module>",
+                    node.lineno,
+                    node.col_offset,
+                    tn or "decode",
+                )
+            )
+        self.generic_visit(node)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def discover_decode_sites(pkg_root: str | None = None) -> list[DecodeSite]:
+    """Every decode call site under the package, excluding the codec
+    (wire/) and this analysis layer."""
+    pkg_root = pkg_root or _package_root()
+    base = os.path.dirname(os.path.abspath(pkg_root))
+    sites: list[DecodeSite] = []
+    for root, dirs, files in os.walk(pkg_root):
+        dirs[:] = sorted(
+            d
+            for d in dirs
+            if not d.startswith(".")
+            and d != "__pycache__"
+            and d not in _SCAN_EXCLUDE_PARTS
+        )
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            fpath = os.path.join(root, fname)
+            rel = os.path.relpath(fpath, base).replace(os.sep, "/")
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=fpath)
+            except (SyntaxError, OSError):
+                continue  # the plain linter reports parse errors
+            sc = _SiteScanner(rel)
+            sc.visit(tree)
+            sites.extend(sc.sites)
+    return sites
+
+
+# --------------------------------------------------------- taint engine
+
+
+class _Interp:
+    """Module-local interprocedural taint interpreter for one source."""
+
+    def __init__(self, path: str, funcs: dict, source: tm.Source):
+        self.path = path
+        self.funcs = funcs
+        self.source = source
+        self.findings: list[Finding] = []
+        self._memo: dict[tuple[str, frozenset], bool] = {}
+        self._active: set[tuple[str, frozenset]] = set()
+        self._reported: set[tuple[int, str]] = set()
+
+    # -- entry ---------------------------------------------------------
+
+    def analyze(self, fname: str, tainted_params: frozenset[str]) -> bool:
+        """Interpret ``fname`` with the given parameters tainted; returns
+        whether its return value is tainted."""
+        key = (fname, tainted_params)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._active:
+            return False  # optimistic cycle break; reactor code is acyclic
+        self._active.add(key)
+        env = set(tainted_params)
+        ret = self._exec_block(self.funcs[fname].body, env)
+        self._active.discard(key)
+        self._memo[key] = ret
+        return ret
+
+    # -- statements ----------------------------------------------------
+
+    def _exec_block(self, body: list, env: set[str]) -> bool:
+        ret = False
+        for stmt in body:
+            ret = self._exec_stmt(stmt, env) or ret
+        return ret
+
+    def _bind(self, target: ast.expr, tainted: bool, env: set[str]) -> None:
+        if isinstance(target, ast.Name):
+            (env.add if tainted else env.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, tainted, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted, env)
+        # attribute/subscript targets: no per-field tracking; the owner's
+        # taint already covers reads back out of it
+
+    def _exec_stmt(self, stmt, env: set[str]) -> bool:
+        if isinstance(stmt, ast.Assign):
+            t = self._eval(stmt.value, env)
+            for tgt in stmt.targets:
+                self._bind(tgt, t, env)
+            return False
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self._eval(stmt.value, env), env)
+            return False
+        if isinstance(stmt, ast.AugAssign):
+            t = self._eval(stmt.value, env) or self._eval(stmt.target, env)
+            self._bind(stmt.target, t, env)
+            return False
+        if isinstance(stmt, ast.Expr):
+            self._exec_expr_stmt(stmt.value, env)
+            return False
+        if isinstance(stmt, ast.Return):
+            return self._eval(stmt.value, env) if stmt.value else False
+        if isinstance(stmt, ast.If):
+            self._eval(stmt.test, env)
+            e1, e2 = set(env), set(env)
+            r1 = self._exec_block(stmt.body, e1)
+            r2 = self._exec_block(stmt.orelse, e2)
+            env.clear()
+            env.update(e1 | e2)
+            return r1 or r2
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._eval(stmt.iter, env)
+            self._bind(stmt.target, it, env)
+            # two passes: enough to stabilize single-level loop-carried taint
+            r = self._exec_block(stmt.body, env)
+            self._bind(stmt.target, it or self._eval(stmt.iter, set(env)), env)
+            r = self._exec_block(stmt.body, env) or r
+            return self._exec_block(stmt.orelse, env) or r
+        if isinstance(stmt, ast.While):
+            self._eval(stmt.test, env)
+            r = self._exec_block(stmt.body, env)
+            self._eval(stmt.test, env)
+            r = self._exec_block(stmt.body, env) or r
+            return self._exec_block(stmt.orelse, env) or r
+        if isinstance(stmt, ast.Try):
+            r = self._exec_block(stmt.body, env)
+            for h in stmt.handlers:
+                he = set(env)
+                r = self._exec_block(h.body, he) or r
+                env.update(he)
+            r = self._exec_block(stmt.orelse, env) or r
+            return self._exec_block(stmt.finalbody, env) or r
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, t, env)
+            return self._exec_block(stmt.body, env)
+        if isinstance(stmt, ast.Raise):
+            self._eval(stmt.exc, env)
+            return False
+        if isinstance(stmt, ast.Assert):
+            self._eval(stmt.test, env)
+            return False
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    env.discard(tgt.id)
+            return False
+        # nested defs/classes, imports, pass/break/continue/global: no flow
+        return False
+
+    def _exec_expr_stmt(self, v: ast.expr, env: set[str]) -> None:
+        """Statement-position expression: the place sanitizer calls
+        launder their arguments (``validate_pex_message(msg)``,
+        ``part.validate_basic()``)."""
+        if isinstance(v, ast.Call):
+            tn = terminal_name(v.func)
+            if tn in tm.SANITIZER_FUNCS:
+                self._eval(v, env)  # still scan nested calls for sinks
+                for a in v.args:
+                    if isinstance(a, ast.Name):
+                        env.discard(a.id)
+                return
+            if (
+                isinstance(v.func, ast.Attribute)
+                and v.func.attr in tm.SANITIZER_METHODS
+                and isinstance(v.func.value, ast.Name)
+            ):
+                self._eval(v, env)
+                env.discard(v.func.value.id)
+                return
+        self._eval(v, env)
+
+    # -- expressions ---------------------------------------------------
+
+    def _eval(self, node, env: set[str]) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.Subscript):
+            t = self._eval(node.value, env)
+            self._eval(node.slice, env)
+            return t
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                self._eval(part, env)
+            return False
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.BinOp):
+            return self._eval(node.left, env) | self._eval(node.right, env)
+        if isinstance(node, ast.BoolOp):
+            return any([self._eval(v, env) for v in node.values])
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, env)
+        if isinstance(node, ast.Compare):
+            self._eval(node.left, env)
+            for c in node.comparators:
+                self._eval(c, env)
+            return False  # a bool verdict is a scalar, not attacker data
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env)
+            return self._eval(node.body, env) | self._eval(node.orelse, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._eval(el, env) for el in node.elts])
+        if isinstance(node, ast.Dict):
+            tk = any([self._eval(k, env) for k in node.keys if k is not None])
+            tv = any([self._eval(v, env) for v in node.values])
+            return tk or tv
+        if isinstance(node, ast.JoinedStr):
+            return any([self._eval(v, env) for v in node.values])
+        if isinstance(node, ast.FormattedValue):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.NamedExpr):
+            t = self._eval(node.value, env)
+            self._bind(node.target, t, env)
+            return t
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            envc = set(env)
+            t = False
+            for gen in node.generators:
+                ti = self._eval(gen.iter, envc)
+                self._bind(gen.target, ti, envc)
+                for cond in gen.ifs:
+                    self._eval(cond, envc)
+                t = t or ti
+            if isinstance(node, ast.DictComp):
+                t = self._eval(node.key, envc) | self._eval(node.value, envc) or t
+            else:
+                t = self._eval(node.elt, envc) or t
+            return t
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.Yield):
+            return self._eval(node.value, env) if node.value else False
+        if isinstance(node, ast.Lambda):
+            return False  # not called here; no flow to model
+        return False
+
+    def _eval_call(self, node: ast.Call, env: set[str]) -> bool:
+        func = node.func
+        tn = terminal_name(func)
+        arg_taints = [self._eval(a, env) for a in node.args]
+        kw_taints = {
+            k.arg: self._eval(k.value, env) for k in node.keywords
+        }
+        recv_tainted = (
+            self._eval(func.value, env) if isinstance(func, ast.Attribute) else False
+        )
+        any_tainted = any(arg_taints) or any(kw_taints.values())
+
+        # sink gate: a tainted argument reaching a declared sink with no
+        # sanitizer on the path is THE finding this pass exists for
+        if tn in tm.SINK_NAMES and any_tainted and tn not in tm.VALIDATING_SINKS:
+            dedup = (node.lineno, tn)
+            if dedup not in self._reported:
+                self._reported.add(dedup)
+                self.findings.append(
+                    Finding(
+                        "tainted-sink",
+                        self.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"[{self.source.name}] tainted value reaches sink "
+                        f"{tn}() with no sanitizer on the path — validate "
+                        "before use (docs/byzantine_inputs.md)",
+                    )
+                )
+
+        if tn in tm.SANITIZER_FUNCS:
+            return False  # validated-or-raised result
+        if isinstance(func, ast.Attribute) and func.attr in tm.SANITIZER_METHODS:
+            return False
+        if tn in tm.UNTAINTING_BUILTINS:
+            return False
+        if tn in self.source.tainted_calls:
+            return True
+
+        # module-local interprocedural step: follow the call under the
+        # tainted parameter set (self.method resolves by terminal name,
+        # the _jitscan convention)
+        fn = self.funcs.get(tn)
+        if fn is not None:
+            params = [a.arg for a in fn.args.args]
+            if params and params[0] == "self" and isinstance(func, ast.Attribute):
+                params = params[1:]
+            tainted_params = {
+                params[i]
+                for i, t in enumerate(arg_taints)
+                if t and i < len(params)
+            }
+            tainted_params |= {
+                k for k, t in kw_taints.items() if t and k in set(params)
+            }
+            if tainted_params:
+                return self.analyze(tn, frozenset(tainted_params))
+            return False
+
+        return any_tainted or recv_tainted
+
+
+def _analyze_source(src: tm.Source, base: str) -> list[Finding]:
+    fpath = os.path.join(base, src.path)
+    try:
+        with open(fpath, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=fpath)
+    except (OSError, SyntaxError):
+        return [
+            Finding(
+                "taint-manifest-stale",
+                MANIFEST_PATH,
+                1,
+                0,
+                f"source {src.name!r}: cannot parse {src.path}",
+            )
+        ]
+    funcs = collect_functions(tree)
+    if src.func not in funcs:
+        return [
+            Finding(
+                "taint-manifest-stale",
+                MANIFEST_PATH,
+                1,
+                0,
+                f"source {src.name!r}: no function {src.func!r} in {src.path}",
+            )
+        ]
+    interp = _Interp(src.path, funcs, src)
+    seeds = frozenset(p for p in src.tainted_params if p != "self")
+    interp.analyze(src.func, seeds)
+    return interp.findings
+
+
+# ------------------------------------------------------------ run_check
+
+
+def run_check(pkg_root: str | None = None, allowlist=None) -> tuple[list[Finding], dict]:
+    """The full taint pass: decode-surface exhaustiveness both
+    directions + validate-before-use dataflow from every source.
+    Returns (findings, report); empty findings is the green gate.
+
+    ``allowlist`` filters findings when given (the kernelcheck policy:
+    raw by default so scripts/lint.py can track stale entries)."""
+    pkg_root = pkg_root or _package_root()
+    base = os.path.dirname(os.path.abspath(pkg_root))
+    findings: list[Finding] = []
+
+    sites = discover_decode_sites(pkg_root)
+    matched_keys: set[str] = set()
+    unregistered = 0
+    for site in sites:
+        entry = tm.site_registered(site.path, site.func)
+        if entry is None:
+            unregistered += 1
+            findings.append(
+                Finding(
+                    "taint-unregistered-decode",
+                    site.path,
+                    site.lineno,
+                    site.col,
+                    f"decode surface {site.callee}() in {site.func}() is not "
+                    "registered in taint_manifest.DECODE_SITES — declare its "
+                    "source (and gauntlet coverage) or mark it trusted with "
+                    "a justification",
+                )
+            )
+        else:
+            key_tail = f"{site.path}::{site.func}"
+            for key in tm.DECODE_SITES:
+                if key_tail == key or key_tail.endswith("/" + key):
+                    matched_keys.add(key)
+
+    source_names = {s.name for s in tm.SOURCES}
+    for key, val in tm.DECODE_SITES.items():
+        if key not in matched_keys:
+            findings.append(
+                Finding(
+                    "taint-manifest-stale",
+                    MANIFEST_PATH,
+                    1,
+                    0,
+                    f"DECODE_SITES entry {key!r} matches no decode call — "
+                    "remove it or fix the path::function key",
+                )
+            )
+        if not val.startswith("trusted:") and val not in source_names:
+            findings.append(
+                Finding(
+                    "taint-manifest-stale",
+                    MANIFEST_PATH,
+                    1,
+                    0,
+                    f"DECODE_SITES entry {key!r} names unknown source {val!r}",
+                )
+            )
+
+    analyzed = 0
+    for src in tm.dataflow_sources():
+        findings.extend(_analyze_source(src, base))
+        analyzed += 1
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    report = {
+        "decode_sites": len(sites),
+        "unregistered": unregistered,
+        "sources": len(tm.SOURCES),
+        "dataflow_sources": analyzed,
+        "sinks": len(tm.SINKS),
+    }
+    if allowlist is not None:
+        findings = [f for f in findings if not allowlist.suppresses(f)]
+    return findings, report
+
+
+def summary(findings: list[Finding], report: dict) -> dict:
+    """Machine-readable result for the scripts/lint.py --json block."""
+    return {
+        "ok": not findings,
+        **report,
+        "findings": [
+            {"check": f.check, "path": f.path, "line": f.line, "message": f.message}
+            for f in findings
+        ],
+    }

@@ -1,0 +1,116 @@
+"""unbounded-wire-length: a peer-supplied length prefix must be bounds-
+checked before it drives a read or an allocation.
+
+The privval lesson: ``n = decode_varint_stream(conn)`` followed by
+``conn.read(n - len(buf))`` hands the remote side an arbitrary
+allocation — and the read loop's ``while len(buf) < n`` COMPARE is not a
+guard, it's the amplifier.  A guard is an ``if`` whose test compares the
+length variable and whose body raises, returns, or breaks (the
+``if length > MAX_...: raise`` shape every framing site in this repo
+uses: transport MAX_NODE_INFO_SIZE, connection MAX_PACKET_WIRE_SIZE,
+secret_connection DATA_MAX_SIZE, rpc/services _MAX_MSG, wal
+MAX_WAL_MSG_SIZE_BYTES, privval MAX_PRIVVAL_MSG_SIZE).
+
+Flagged: a variable bound from a wire-length decoder
+(``decode_varint``/``decode_varint_stream``/``struct.unpack*``) that
+reaches a read/recv call argument or a ``bytearray``/``bytes``
+allocation in a function with no such guard on it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .linter import Finding, terminal_name
+
+CHECK_ID = "unbounded-wire-length"
+SUMMARY = (
+    "wire-decoded length prefix drives a read/allocation with no "
+    "bounds check (if-compare + raise/return/break) in the function"
+)
+
+#: Calls whose results are wire-supplied integers (length prefixes).
+_LENGTH_DECODERS = frozenset(
+    {"decode_varint", "decode_varint_stream", "unpack", "unpack_from"}
+)
+
+#: Calls where an unbounded length becomes an attacker-sized read or
+#: allocation.
+_RISKY_CALLS = frozenset({"read", "read_exact", "_read_exact", "recv", "bytearray"})
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _bound_names(target: ast.expr) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _guarded_vars(fn: ast.AST) -> set[str]:
+    """Variables some ``if`` in the function compares and then
+    raises/returns/breaks on — the bounds-check shape."""
+    guarded: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        compared: set[str] = set()
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Compare):
+                compared |= _names_in(sub)
+        if not compared:
+            continue
+        if any(
+            isinstance(s, (ast.Raise, ast.Return, ast.Break))
+            for b in node.body
+            for s in ast.walk(b)
+        ):
+            guarded |= compared
+    return guarded
+
+
+def check(mod) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        length_vars: dict[str, int] = {}  # name -> lineno bound
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if terminal_name(node.value.func) in _LENGTH_DECODERS:
+                    for tgt in node.targets:
+                        for name in _bound_names(tgt):
+                            length_vars.setdefault(name, node.lineno)
+        if not length_vars:
+            continue
+        guarded = _guarded_vars(fn)
+        unguarded = set(length_vars) - guarded
+        if not unguarded:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in _RISKY_CALLS:
+                continue
+            used = set()
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                used |= _names_in(a)
+            for name in sorted(used & unguarded):
+                findings.append(
+                    Finding(
+                        CHECK_ID,
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"wire-decoded length {name!r} (bound at line "
+                        f"{length_vars[name]}) drives "
+                        f"{terminal_name(node.func)}() with no bounds "
+                        "check in the function — cap it before reading/"
+                        "allocating (docs/byzantine_inputs.md)",
+                    )
+                )
+    return findings

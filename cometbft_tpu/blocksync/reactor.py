@@ -17,6 +17,7 @@ import time
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
 from ..types.block import Block, ExtendedCommit
+from ..types.msg_validation import validate_blocksync_message
 from ..utils import healthmon, tracing
 from ..utils.heightline import registry as _heightline
 from ..utils.log import get_logger
@@ -159,6 +160,9 @@ class BlocksyncReactor(Reactor):
             self.switch.stop_peer(peer, "oversized blocksync message")
             return
         msg = pb.BlocksyncMessage.decode(msg_bytes)
+        # validate-before-use: heights/base bounds before the pool sees
+        # them; a raise here makes the switch disconnect the peer
+        validate_blocksync_message(msg)
         which = msg.which()
         if which == "block_request":
             self._respond_to_peer(msg.block_request, peer)
@@ -217,6 +221,7 @@ class BlocksyncReactor(Reactor):
     def _handle_block_response(self, msg: pb.BlockResponse, peer, size: int) -> None:
         try:
             block = Block.from_proto(msg.block)
+            block.validate_basic()
         except Exception as e:  # noqa: BLE001
             self.switch.stop_peer(peer, f"invalid block: {e}")
             return

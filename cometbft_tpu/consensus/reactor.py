@@ -18,6 +18,7 @@ import time
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
 from ..types.block import BlockID
+from ..types.msg_validation import validate_consensus_message
 from ..types.part_set import Part, PartSet
 from ..types.proposal import Proposal
 from ..types.vote import Vote
@@ -242,6 +243,11 @@ class ConsensusReactor(Reactor):
         if self.wait_sync and stream_id != STATE_STREAM:
             return
         msg = pb.ConsensusMessage.decode(msg_bytes)
+        # validate-before-use: bounds-check every peer-supplied field
+        # (heights, rounds, bit-array and part-set sizes) before any arm
+        # touches PeerState or the state machine; a raise here reaches
+        # the switch's receive wrapper, which disconnects the peer
+        validate_consensus_message(msg)
         which = msg.which()
         ps: PeerState = peer.get("consensus_peer_state")
         if ps is None:
@@ -258,15 +264,18 @@ class ConsensusReactor(Reactor):
             ps.set_has_block_part(hp.height, hp.round, hp.index)
         elif which == "proposal":
             proposal = Proposal.from_proto(msg.proposal.proposal)
+            proposal.validate_basic()
             ps.set_has_proposal(proposal)
             self.cs.set_proposal(proposal, peer.id)
         elif which == "block_part":
             bp = msg.block_part
             part = Part.from_proto(bp.part)
+            part.validate_basic()
             ps.set_has_block_part(bp.height, bp.round, part.index)
             self.cs.add_proposal_block_part(bp.height, bp.round, part, peer.id)
         elif which == "vote":
             vote = Vote.from_proto(msg.vote.vote)
+            vote.validate_basic()
             ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
             self.cs.add_vote(vote, peer.id)
         elif which == "vote_set_maj23":

@@ -17,6 +17,7 @@ from ..p2p.reactor import Reactor
 from ..utils.log import get_logger
 from ..wire import types_pb as pb
 from ..types.evidence import evidence_from_proto, evidence_to_proto
+from ..types.msg_validation import validate_evidence_list
 from .pool import ErrInvalidEvidence, EvidencePool
 
 EVIDENCE_STREAM = 0x38
@@ -47,6 +48,10 @@ class EvidenceReactor(Reactor):
 
     def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
         msg = pb.EvidenceListProto.decode(msg_bytes)
+        # validate-before-use: the receive side holds inbound batches to
+        # the same byte budget the send side batches under; a raise here
+        # disconnects the peer
+        validate_evidence_list(msg, len(msg_bytes))
         for evp in msg.evidence or []:
             try:
                 ev = evidence_from_proto(evp)

@@ -19,6 +19,7 @@ import time
 
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
+from ..types.msg_validation import validate_mempool_message
 from ..utils.log import get_logger
 from ..wire import mempool_pb as pb
 from .clist_mempool import CListMempool, TxEntry
@@ -119,8 +120,9 @@ class MempoolReactor(Reactor):
         if self._wait_sync:
             return  # syncing: inbound txs would only be rechecked away
         msg = pb.MempoolMessage.decode(msg_bytes)
-        if msg.which() != "txs" or not msg.txs.txs:
-            return
+        # validate-before-use: empty batches and oversized batches are
+        # protocol violations; a raise here disconnects the peer
+        validate_mempool_message(msg)
         for tx in msg.txs.txs:
             try:
                 self.mempool.check_tx(tx, sender=peer.id)

@@ -211,10 +211,18 @@ class AddrBook:
                 data = json.load(f)
         except (OSError, json.JSONDecodeError):
             return
-        self.key = bytes.fromhex(data.get("key", self.key.hex()))
-        for rec in data.get("addrs", []):
-            self.add_address(rec["addr"], rec.get("src", ""))
-            ka = self._lookup(rec["addr"])
-            if ka and rec.get("bucket_type") == "old":
-                self.mark_good(rec["addr"])
-                ka.last_success = rec.get("last_success", time.time())
+        # the book file is on-disk input: a corrupt or type-confused
+        # document must raise a typed error, not a KeyError/TypeError
+        # from half-read records
+        try:
+            self.key = bytes.fromhex(data.get("key", self.key.hex()))
+            for rec in data.get("addrs", []):
+                self.add_address(rec["addr"], rec.get("src", ""))
+                ka = self._lookup(rec["addr"])
+                if ka and rec.get("bucket_type") == "old":
+                    self.mark_good(rec["addr"])
+                    ka.last_success = rec.get("last_success", time.time())
+        except ValueError:
+            raise
+        except Exception as e:  # noqa: BLE001 — malformed document shape
+            raise ValueError(f"corrupt addrbook file {self.file_path}: {e!r}") from e

@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ...types.msg_validation import validate_pex_message
 from ...utils.log import get_logger
 from ...wire import p2p_pb as pb
 from ..conn.connection import StreamDescriptor
@@ -84,6 +85,10 @@ class PexReactor(Reactor):
 
     def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
         msg = pb.PexMessage.decode(msg_bytes)
+        # validate-before-use: bound the address count and require every
+        # URL to parse as id@host:port before anything reaches the book —
+        # a raise here makes the switch disconnect the peer
+        validate_pex_message(msg)
         if msg.pex_request is not None:
             now = time.monotonic()
             with self._mtx:

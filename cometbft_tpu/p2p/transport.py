@@ -20,6 +20,12 @@ from .node_info import NodeInfo, NodeInfoError
 
 HANDSHAKE_TIMEOUT = 20.0
 
+#: Cap on the peer-supplied NodeInfo length prefix: it sizes the
+#: read_exact() below, so an unbounded value is an attacker-driven
+#: allocation (reference p2p/handshake.go reads via a bounded protoio
+#: reader).
+MAX_NODE_INFO_SIZE = 10240
+
 
 class TransportError(Exception):
     pass
@@ -39,7 +45,7 @@ def _exchange_node_info(conn: SecretConnection, our: NodeInfo) -> NodeInfo:
         except ValueError as e:
             if "truncated" not in str(e) or len(prefix) > 10:
                 raise TransportError("bad nodeinfo length prefix")
-    if length > 10240:
+    if length > MAX_NODE_INFO_SIZE:
         raise TransportError("oversized nodeinfo")
     theirs = NodeInfo.from_proto(p2p_pb.NodeInfoProto.decode(conn.read_exact(length)))
     theirs.validate_basic()

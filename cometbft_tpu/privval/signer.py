@@ -53,6 +53,13 @@ def decode_varint_stream(conn) -> int | None:
             raise RemoteSignerError("varint overflow")
 
 
+#: Privval frames are single sign requests/responses; the reference
+#: bounds them via protoio's maxMsgSize.  The prefix sizes the read
+#: loop's recv() calls, so it must be checked before any allocation —
+#: even on this authenticated link, the peer's bytes are not ours.
+MAX_PRIVVAL_MSG_SIZE = 1024 * 1024
+
+
 def _send_msg(conn, msg: pb.PrivvalMessage) -> None:
     raw = msg.encode()
     conn.write(encode_varint(len(raw)) + raw)
@@ -62,6 +69,8 @@ def _recv_msg(conn) -> pb.PrivvalMessage | None:
     n = decode_varint_stream(conn)
     if n is None:
         return None
+    if n > MAX_PRIVVAL_MSG_SIZE:
+        raise RemoteSignerError(f"privval frame {n} exceeds max")
     buf = b""
     while len(buf) < n:
         chunk = conn.read(n - len(buf))

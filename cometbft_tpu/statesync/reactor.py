@@ -17,6 +17,7 @@ import threading
 
 from ..p2p.conn.connection import StreamDescriptor
 from ..p2p.reactor import Reactor
+from ..types.msg_validation import validate_statesync_message
 from ..utils.log import get_logger
 from ..wire import abci_pb as abci
 from ..wire import statesync_pb as pb
@@ -82,6 +83,9 @@ class StatesyncReactor(Reactor):
 
     def receive(self, stream_id: int, peer, msg_bytes: bytes) -> None:
         msg = pb.StatesyncMessage.decode(msg_bytes)
+        # validate-before-use: snapshot/chunk fields size pool entries
+        # and the fetch schedule; a raise here disconnects the peer
+        validate_statesync_message(msg)
         which = msg.which()
         if which == "snapshots_request":
             self._serve_snapshots(peer)

@@ -96,6 +96,8 @@ class DuplicateVoteEvidence(Evidence):
 
     @classmethod
     def from_proto(cls, m: pb.DuplicateVoteEvidenceProto) -> "DuplicateVoteEvidence":
+        if m.vote_a is None or m.vote_b is None:
+            raise ValueError("DuplicateVoteEvidence proto missing vote")
         return cls(
             vote_a=Vote.from_proto(m.vote_a),
             vote_b=Vote.from_proto(m.vote_b),

@@ -94,24 +94,32 @@ class GenesisDoc:
 
     @classmethod
     def from_json(cls, data: str) -> "GenesisDoc":
-        d = json.loads(data)
-        doc = cls(
-            chain_id=d["chain_id"],
-            genesis_time=Timestamp.from_unix_ns(int(d.get("genesis_time", 0))),
-            initial_height=int(d.get("initial_height", 1)),
-            consensus_params=_params_from_json(d.get("consensus_params")),
-            validators=[
-                GenesisValidator(
-                    pub_key_type=v["pub_key"]["type"],
-                    pub_key_bytes=bytes.fromhex(v["pub_key"]["value"]),
-                    power=int(v["power"]),
-                    name=v.get("name", ""),
-                )
-                for v in d.get("validators", [])
-            ],
-            app_hash=bytes.fromhex(d.get("app_hash", "")),
-            app_state=d.get("app_state", "{}").encode("utf-8"),
-        )
+        # a genesis file is operator-supplied input: every malformation
+        # (missing key, wrong type, bad hex) must surface as ValueError,
+        # never a raw KeyError/TypeError from half-parsed fields
+        try:
+            d = json.loads(data)
+            doc = cls(
+                chain_id=d["chain_id"],
+                genesis_time=Timestamp.from_unix_ns(int(d.get("genesis_time", 0))),
+                initial_height=int(d.get("initial_height", 1)),
+                consensus_params=_params_from_json(d.get("consensus_params")),
+                validators=[
+                    GenesisValidator(
+                        pub_key_type=v["pub_key"]["type"],
+                        pub_key_bytes=bytes.fromhex(v["pub_key"]["value"]),
+                        power=int(v["power"]),
+                        name=v.get("name", ""),
+                    )
+                    for v in d.get("validators", [])
+                ],
+                app_hash=bytes.fromhex(d.get("app_hash", "")),
+                app_state=d.get("app_state", "{}").encode("utf-8"),
+            )
+        except ValueError:
+            raise
+        except Exception as e:  # noqa: BLE001 — malformed document shape
+            raise ValueError(f"malformed genesis doc: {e!r}") from e
         doc.validate_and_complete()
         return doc
 

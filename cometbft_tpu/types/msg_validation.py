@@ -1,0 +1,338 @@
+"""Wire-level validation of peer-supplied reactor messages.
+
+The reference codebase calls ``msg.ValidateBasic()`` on every decoded
+gossip message before acting on it (consensus/reactor.go Receive,
+blocksync/msgs.go, statesync ValidateMsg, pex maxAddresses) — the
+decode-then-validate discipline that keeps a Byzantine peer's bytes out
+of consensus state and out of unbounded allocations.  This module is
+that layer for our reactors: one ``validate_*_message`` function per
+reactor wire envelope, called immediately after ``X.decode(msg_bytes)``
+and BEFORE any field is used.  All failures raise
+:class:`MessageValidationError` (a ``ValueError``), which the switch's
+receive wrapper turns into a peer disconnect.
+
+These validators are registered as SANITIZERS in
+``analysis/taint_manifest.py``: the taintcheck dataflow gate proves every
+reactor routes its decoded message through one of them before the
+message reaches a consensus/state/pool sink.
+"""
+
+from __future__ import annotations
+
+#: Hard ceiling on a block's part count (reference types/params.go
+#: MaxBlockPartsCount: MaxBlockSizeBytes / BlockPartSizeBytes + 1).  A
+#: peer-supplied PartSetHeader.total above this is garbage and must not
+#: size an allocation ([False] * total in PeerState.set_has_proposal).
+MAX_BLOCK_PARTS_COUNT = 1601
+
+#: Reference types/validator_set.go MaxVotesCount — bounds bit-array
+#: sizes and validator indexes arriving in vote gossip.
+MAX_VOTES_COUNT = 10_000
+
+#: Consensus step numbers (consensus/types RoundStepType 1..8).
+MAX_ROUND_STEP = 8
+
+#: Heights/rounds live in int64/int32 in the reference; anything beyond
+#: is wire garbage (and would break downstream arithmetic).
+MAX_HEIGHT = 1 << 62
+MAX_ROUND = (1 << 31) - 1
+
+#: PEX: reference p2p/pex caps one address message at 100 addresses
+#: (maxMsgSize is derived from it); we also bound each URL.
+MAX_PEX_ADDRS = 250
+MAX_PEX_URL_LEN = 256
+
+#: Statesync snapshot advertisement bounds.  The reference only requires
+#: height > 0 and chunks > 0 (statesync/reactor.go validateMsg); we also
+#: cap what feeds allocations or sticks in the snapshot pool.
+MAX_SNAPSHOT_CHUNKS = 1 << 20
+MAX_SNAPSHOT_HASH_LEN = 64
+MAX_SNAPSHOT_METADATA_LEN = 16 * 1024
+
+#: Mempool: one gossip message carries at most this many txs (each tx is
+#: further bounded by the mempool's own max_tx_bytes admission check).
+MAX_TXS_PER_MESSAGE = 100
+
+#: Evidence list gossip cap, matching the reactor's send-side batch
+#: budget (evidence/reactor.go MaxMsgBytes).
+MAX_EVIDENCE_BYTES = 1 << 20
+
+_HEX = set("0123456789abcdef")
+
+
+class MessageValidationError(ValueError):
+    """A peer-supplied wire message failed validate-before-use checks."""
+
+
+def _check_height(h: int, what: str, allow_zero: bool = True) -> None:
+    lo = 0 if allow_zero else 1
+    if not lo <= h <= MAX_HEIGHT:
+        raise MessageValidationError(f"{what}: height {h} out of range")
+
+
+def _check_round(r: int, what: str, allow_negative: bool = False) -> None:
+    lo = -1 if allow_negative else 0
+    if not lo <= r <= MAX_ROUND:
+        raise MessageValidationError(f"{what}: round {r} out of range")
+
+
+def _check_vote_type(t: int, what: str) -> None:
+    from ..wire.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+    if t not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+        raise MessageValidationError(f"{what}: invalid vote type {t}")
+
+
+def _check_bit_array(ba, cap: int, what: str) -> None:
+    """A BitArrayProto is only usable when ``bits`` agrees with the words
+    actually sent: ``to_bools()`` allocates ``bits`` booleans, so an
+    attacker-chosen ``bits`` with no backing ``elems`` is a memory bomb."""
+    if ba is None:
+        return
+    if ba.bits < 0:
+        raise MessageValidationError(f"{what}: negative bit-array size")
+    if ba.bits > cap:
+        raise MessageValidationError(
+            f"{what}: bit-array size {ba.bits} exceeds cap {cap}"
+        )
+    if ba.bits > 64 * len(ba.elems):
+        raise MessageValidationError(
+            f"{what}: bit-array claims {ba.bits} bits but carries "
+            f"{len(ba.elems)} words"
+        )
+
+
+def _check_part_set_header(psh, what: str) -> None:
+    if psh is None:
+        raise MessageValidationError(f"{what}: missing part-set header")
+    if not 0 <= psh.total <= MAX_BLOCK_PARTS_COUNT:
+        raise MessageValidationError(
+            f"{what}: part-set total {psh.total} out of range"
+        )
+    if len(psh.hash) not in (0, 32):
+        raise MessageValidationError(f"{what}: bad part-set hash length")
+
+
+def _check_block_id(bid, what: str) -> None:
+    if bid is None:
+        raise MessageValidationError(f"{what}: missing block ID")
+    if len(bid.hash) not in (0, 32):
+        raise MessageValidationError(f"{what}: bad block hash length")
+    _check_part_set_header(bid.part_set_header, what)
+
+
+# ----------------------------------------------------------- consensus
+
+def validate_consensus_message(msg) -> None:
+    """Bounds-check a decoded ``consensus_pb.ConsensusMessage`` before
+    any arm is dispatched (reference consensus/reactor.go Receive calls
+    msg.ValidateBasic per message type).  Typed deep validation
+    (Proposal/Vote/Part ``validate_basic``) still runs at conversion in
+    the reactor — this layer kills structural garbage and
+    allocation-sizing fields first."""
+    which = msg.which()
+    if which is None:
+        raise MessageValidationError("consensus: empty message")
+    m = getattr(msg, which)
+    if which == "new_round_step":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        if not 0 <= m.step <= MAX_ROUND_STEP:
+            raise MessageValidationError(f"{which}: invalid step {m.step}")
+        _check_round(m.last_commit_round, which, allow_negative=True)
+    elif which == "new_valid_block":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        _check_part_set_header(m.block_part_set_header, which)
+        _check_bit_array(m.block_parts, MAX_BLOCK_PARTS_COUNT, which)
+        if m.block_parts is not None and (
+            m.block_parts.bits != m.block_part_set_header.total
+        ):
+            raise MessageValidationError(
+                f"{which}: bit-array size {m.block_parts.bits} != "
+                f"part-set total {m.block_part_set_header.total}"
+            )
+    elif which == "proposal":
+        if m.proposal is None:
+            raise MessageValidationError(f"{which}: missing proposal")
+        _check_height(m.proposal.height, which)
+        _check_round(m.proposal.round, which)
+        _check_round(m.proposal.pol_round, which, allow_negative=True)
+        _check_block_id(m.proposal.block_id, which)
+    elif which == "proposal_pol":
+        _check_height(m.height, which)
+        _check_round(m.proposal_pol_round, which)
+        _check_bit_array(m.proposal_pol, MAX_VOTES_COUNT, which)
+    elif which == "block_part":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        if m.part is None:
+            raise MessageValidationError(f"{which}: missing part")
+        if not 0 <= m.part.index < MAX_BLOCK_PARTS_COUNT:
+            raise MessageValidationError(
+                f"{which}: part index {m.part.index} out of range"
+            )
+    elif which == "vote":
+        if m.vote is None:
+            raise MessageValidationError(f"{which}: missing vote")
+        _check_height(m.vote.height, which)
+        _check_round(m.vote.round, which)
+        _check_vote_type(m.vote.type, which)
+        if not 0 <= m.vote.validator_index < MAX_VOTES_COUNT:
+            raise MessageValidationError(
+                f"{which}: validator index {m.vote.validator_index} out of range"
+            )
+    elif which == "has_vote":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        _check_vote_type(m.type, which)
+        if not 0 <= m.index < MAX_VOTES_COUNT:
+            raise MessageValidationError(
+                f"{which}: validator index {m.index} out of range"
+            )
+    elif which == "vote_set_maj23":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        _check_vote_type(m.type, which)
+        _check_block_id(m.block_id, which)
+    elif which == "vote_set_bits":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        _check_vote_type(m.type, which)
+        _check_block_id(m.block_id, which)
+        _check_bit_array(m.votes, MAX_VOTES_COUNT, which)
+    elif which == "has_proposal_block_part":
+        _check_height(m.height, which)
+        _check_round(m.round, which)
+        if not 0 <= m.index < MAX_BLOCK_PARTS_COUNT:
+            raise MessageValidationError(
+                f"{which}: part index {m.index} out of range"
+            )
+
+
+# ----------------------------------------------------------- blocksync
+
+def validate_blocksync_message(msg) -> None:
+    """reference blocksync/msgs.go ValidateMsg."""
+    which = msg.which()
+    if which is None:
+        raise MessageValidationError("blocksync: empty message")
+    m = getattr(msg, which)
+    if which in ("block_request", "no_block_response"):
+        _check_height(m.height, which)
+    elif which == "status_response":
+        _check_height(m.height, which)
+        _check_height(m.base, which)
+        if m.base > m.height:
+            raise MessageValidationError(
+                f"{which}: base {m.base} > height {m.height}"
+            )
+    elif which == "block_response":
+        if m.block is None:
+            raise MessageValidationError(f"{which}: missing block")
+
+
+# ----------------------------------------------------------- statesync
+
+def validate_statesync_message(msg) -> None:
+    """reference statesync/reactor.go validateMsg + pool sanity: the
+    snapshot fields size pool entries and the chunk fetch schedule."""
+    which = msg.which()
+    if which is None:
+        raise MessageValidationError("statesync: empty message")
+    m = getattr(msg, which)
+    if which == "snapshots_response":
+        _check_height(m.height, which, allow_zero=False)
+        if m.format < 0:
+            raise MessageValidationError(f"{which}: negative format")
+        if not 1 <= m.chunks <= MAX_SNAPSHOT_CHUNKS:
+            raise MessageValidationError(
+                f"{which}: chunk count {m.chunks} out of range"
+            )
+        if not 1 <= len(m.hash) <= MAX_SNAPSHOT_HASH_LEN:
+            raise MessageValidationError(f"{which}: bad snapshot hash length")
+        if len(m.metadata) > MAX_SNAPSHOT_METADATA_LEN:
+            raise MessageValidationError(f"{which}: oversized metadata")
+    elif which == "chunk_request":
+        _check_height(m.height, which, allow_zero=False)
+        if m.format < 0:
+            raise MessageValidationError(f"{which}: negative format")
+        if not 0 <= m.index < MAX_SNAPSHOT_CHUNKS:
+            raise MessageValidationError(f"{which}: chunk index out of range")
+    elif which == "chunk_response":
+        _check_height(m.height, which, allow_zero=False)
+        if m.format < 0:
+            raise MessageValidationError(f"{which}: negative format")
+        if not 0 <= m.index < MAX_SNAPSHOT_CHUNKS:
+            raise MessageValidationError(f"{which}: chunk index out of range")
+        if m.missing and m.chunk:
+            raise MessageValidationError(
+                f"{which}: chunk marked missing but carries data"
+            )
+
+
+# ----------------------------------------------------------------- pex
+
+def validate_pex_message(msg) -> None:
+    """reference p2p/pex: an address message is bounded (maxAddresses)
+    and every address must parse as ``id@host:port`` with a hex node ID —
+    a book poisoned with garbage URLs wastes dial budget forever."""
+    if msg.pex_request is None and msg.pex_addrs is None:
+        raise MessageValidationError("pex: empty message")
+    if msg.pex_addrs is None:
+        return
+    addrs = msg.pex_addrs.addrs or []
+    if len(addrs) > MAX_PEX_ADDRS:
+        raise MessageValidationError(
+            f"pex: {len(addrs)} addresses exceeds cap {MAX_PEX_ADDRS}"
+        )
+    for a in addrs:
+        validate_peer_address(a.url)
+
+
+def validate_peer_address(url: str) -> None:
+    """``<40-hex-id>@host:port`` — the shape AddrBook stores and the
+    switch dials (reference p2p/netaddr.go NewFromString)."""
+    if not url or len(url) > MAX_PEX_URL_LEN:
+        raise MessageValidationError("pex: empty or oversized address")
+    pid, sep, hostport = url.partition("@")
+    if not sep:
+        raise MessageValidationError(f"pex: address {url!r} missing node ID")
+    if len(pid) != 40 or not set(pid) <= _HEX:
+        raise MessageValidationError(f"pex: bad node ID in {url!r}")
+    host, sep, port = hostport.rpartition(":")
+    if not sep or not host:
+        raise MessageValidationError(f"pex: address {url!r} missing host/port")
+    if not port.isdigit() or not 1 <= int(port) <= 65535:
+        raise MessageValidationError(f"pex: bad port in {url!r}")
+
+
+# ------------------------------------------------------------- mempool
+
+def validate_mempool_message(msg) -> None:
+    """reference mempool/reactor.go Receive: an empty tx list is a
+    protocol violation, and one message must not smuggle an unbounded
+    batch past the per-tx admission checks."""
+    if msg.txs is None or not msg.txs.txs:
+        raise MessageValidationError("mempool: empty tx batch")
+    if len(msg.txs.txs) > MAX_TXS_PER_MESSAGE:
+        raise MessageValidationError(
+            f"mempool: {len(msg.txs.txs)} txs exceeds cap {MAX_TXS_PER_MESSAGE}"
+        )
+    for tx in msg.txs.txs:
+        if not tx:
+            raise MessageValidationError("mempool: empty tx")
+
+
+# ------------------------------------------------------------ evidence
+
+def validate_evidence_list(msg, wire_size: int) -> None:
+    """Bound an inbound evidence batch by the same budget the send side
+    batches under (evidence/reactor.go MaxMsgBytes); per-item validity
+    is the pool's add_evidence -> ev.validate_basic."""
+    if wire_size > MAX_EVIDENCE_BYTES:
+        raise MessageValidationError(
+            f"evidence: message size {wire_size} exceeds cap {MAX_EVIDENCE_BYTES}"
+        )
+    if not (msg.evidence or []):
+        raise MessageValidationError("evidence: empty evidence list")

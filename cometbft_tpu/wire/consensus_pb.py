@@ -25,9 +25,19 @@ class BitArrayProto(Message):
         return cls(bits=len(bools), elems=words)
 
     def to_bools(self) -> list[bool]:
+        # allocation is sized by the wire-supplied ``bits``: refuse any
+        # claim beyond the words actually carried, so a decoded
+        # BitArrayProto(bits=10**9, elems=[]) cannot become a memory
+        # bomb (validate_consensus_message checks this too; this guard
+        # covers every other caller)
+        if self.bits < 0 or self.bits > 64 * len(self.elems):
+            raise ValueError(
+                f"bit array claims {self.bits} bits but carries "
+                f"{len(self.elems)} words"
+            )
         out = []
         for i in range(self.bits):
-            w = self.elems[i // 64] if i // 64 < len(self.elems) else 0
+            w = self.elems[i // 64]
             out.append(bool(w >> (i % 64) & 1))
         return out
 

@@ -45,6 +45,14 @@ cometbft_tpu/analysis/range_fingerprints.json certificates.
 ``regen-ranges`` re-interprets and rewrites the certificates; open
 overflow findings refuse regeneration.
 
+The special id ``taint`` selects the Byzantine-input contract gate
+(docs/byzantine_inputs.md): the unbounded-wire-length AST check PLUS
+the taintcheck dataflow pass — every decode surface diffed against
+taint_manifest.DECODE_SITES in both directions, and every declared
+source abstract-interpreted over a taint lattice to prove no untrusted
+value reaches a consensus/state/store/dispatch sink without a declared
+sanitizer on the path.
+
 Check toggles live in pyproject.toml:
 
     [tool.cometbft-tpu-lint]
@@ -175,7 +183,9 @@ def main(argv: list[str] | None = None) -> int:
         help="restrict to the given check id(s); 'kernel' = the three "
         "kernel-plane AST checks + the kernelcheck trace/fingerprint gate; "
         "'sharding' = the 8-device shardcheck gate; 'range' = the "
-        "unchecked-shift-width AST check + the rangecheck interval gate",
+        "unchecked-shift-width AST check + the rangecheck interval gate; "
+        "'taint' = the unbounded-wire-length AST check + the taintcheck "
+        "Byzantine-input dataflow gate",
     )
     ap.add_argument(
         "--config",
@@ -200,11 +210,14 @@ def main(argv: list[str] | None = None) -> int:
               "AST check + 8-device shardcheck trace/golden pass)")
         print("range: the limb-range contract gate (unchecked-shift-width "
               "AST check + rangecheck interval/certificate pass)")
+        print("taint: the Byzantine-input contract gate (unbounded-wire-"
+              "length AST check + taintcheck decode-surface/dataflow pass)")
         return 0
 
     run_trace = False
     run_shard_trace = False
     run_range_trace = False
+    run_taint_trace = False
     if args.check:
         ids: list[str] = []
         for c in args.check:
@@ -217,6 +230,9 @@ def main(argv: list[str] | None = None) -> int:
             elif c == "range":
                 run_range_trace = True
                 ids.extend(linter.RANGE_CHECK_IDS)
+            elif c == "taint":
+                run_taint_trace = True
+                ids.extend(linter.TAINT_CHECK_IDS)
             else:
                 ids.append(c)
         unknown_ids = set(ids) - set(checks)
@@ -269,6 +285,16 @@ def main(argv: list[str] | None = None) -> int:
         range_summary = rangecheck.summary(rfindings, reports)
         stale = allowlist.unused()
 
+    taint_summary = None
+    if run_taint_trace:
+        from cometbft_tpu.analysis import taintcheck
+
+        tfindings, treport = taintcheck.run_check()
+        tfindings = [f for f in tfindings if not allowlist.suppresses(f)]
+        findings = findings + tfindings
+        taint_summary = taintcheck.summary(tfindings, treport)
+        stale = allowlist.unused()
+
     shard_summary = None
     if run_shard_trace:
         from cometbft_tpu.analysis import shardcheck
@@ -304,6 +330,10 @@ def main(argv: list[str] | None = None) -> int:
             from cometbft_tpu.analysis import rangecheck
 
             enabled_ids |= set(rangecheck.FINDING_CHECK_IDS)
+        if run_taint_trace:
+            from cometbft_tpu.analysis import taintcheck
+
+            enabled_ids |= set(taintcheck.FINDING_CHECK_IDS)
         stale = [e for e in stale if e.check in enabled_ids]
 
     if args.json:
@@ -325,6 +355,7 @@ def main(argv: list[str] | None = None) -> int:
                 **({"kernel": kernel_summary} if kernel_summary else {}),
                 **({"sharding": shard_summary} if shard_summary else {}),
                 **({"range": range_summary} if range_summary else {}),
+                **({"taint": taint_summary} if taint_summary else {}),
             },
             indent=2,
         ))

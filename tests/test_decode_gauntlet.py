@@ -1,0 +1,669 @@
+"""Adversarial decode gauntlet: every untrusted-bytes source declared in
+``analysis/taint_manifest.py`` fed truncated, oversized, bit-flipped,
+type-confused, and seeded-random mutations of golden frames — each must
+return normally or raise one of its DECLARED typed errors, never crash
+with a raw ``KeyError``/``TypeError``/``AttributeError``, hang, or
+allocate unboundedly.
+
+The runtime witness to the static ``taint`` gate: taintcheck proves no
+tainted value reaches a sink without a sanitizer on the path; this file
+proves the sanitizers (and the decoders under them) actually hold their
+typed-error contracts under hostile bytes.  ``HARNESSES`` must cover
+every manifest source — the exhaustiveness test diffs both directions,
+so adding a Source without a harness (or vice versa) fails the tier-1
+suite.
+
+Fast tier: a bounded mutation set per source.  The wide seeded-random
+sweep is ``slow``-marked (tier-2 budget)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import types
+
+import pytest
+
+from cometbft_tpu.abci import kvstore
+from cometbft_tpu.abci.client import ClientError
+from cometbft_tpu.analysis import taint_manifest as tm
+from cometbft_tpu.consensus import wal as cwal
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.light.rpc import VerificationFailed
+from cometbft_tpu.p2p import transport as p2p_transport
+from cometbft_tpu.p2p.conn import connection as p2p_conn
+from cometbft_tpu.p2p.conn import secret_connection as sconn
+from cometbft_tpu.p2p.node_info import NodeInfo, NodeInfoError
+from cometbft_tpu.p2p.pex.addrbook import AddrBook
+from cometbft_tpu.p2p.transport import TransportError
+from cometbft_tpu.privval import signer as privval_signer
+from cometbft_tpu.rpc import services as rpc_services
+from cometbft_tpu.rpc.core import Environment, RPCError
+from cometbft_tpu.types.block import Block
+from cometbft_tpu.types.evidence import evidence_from_proto
+from cometbft_tpu.types.genesis import GenesisDoc
+from cometbft_tpu.types.msg_validation import (
+    validate_blocksync_message,
+    validate_consensus_message,
+    validate_evidence_list,
+    validate_mempool_message,
+    validate_pex_message,
+    validate_statesync_message,
+)
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.verifysvc import checktx
+from cometbft_tpu.verifysvc import wire as vwire
+from cometbft_tpu.wire import abci_pb
+from cometbft_tpu.wire import blocksync_pb as bspb
+from cometbft_tpu.wire import consensus_pb as cpb
+from cometbft_tpu.wire import mempool_pb as mppb
+from cometbft_tpu.wire import p2p_pb
+from cometbft_tpu.wire import privval_pb as pvpb
+from cometbft_tpu.wire import statesync_pb as sspb
+from cometbft_tpu.wire import types_pb as tpb
+from cometbft_tpu.wire import wal_pb
+from cometbft_tpu.wire.proto import encode_varint
+from cometbft_tpu.types.part_set import Part
+
+#: The names the manifest may declare in Source.errors, resolved.
+ERROR_CLASSES = {
+    "ValueError": ValueError,
+    "ConnectionError": ConnectionError,
+    "TransportError": TransportError,
+    "NodeInfoError": NodeInfoError,
+    "SecretConnectionError": sconn.SecretConnectionError,
+    "CorruptWALError": cwal.CorruptWALError,
+    "RemoteSignerError": privval_signer.RemoteSignerError,
+    "VerificationFailed": VerificationFailed,
+    "RPCError": RPCError,
+    "ClientError": ClientError,
+}
+
+
+def _allowed(src: tm.Source) -> tuple[type, ...]:
+    classes = []
+    for name in src.errors:
+        assert name in ERROR_CLASSES, (
+            f"source {src.name}: undeclared error class {name!r} — "
+            "add it to ERROR_CLASSES with its import"
+        )
+        classes.append(ERROR_CLASSES[name])
+    return tuple(classes)
+
+
+# ------------------------------------------------------- fake transports
+
+
+class ScriptedConn:
+    """read()/read_exact() off a fixed byte script — the shape of every
+    stream source's input.  Exhaustion mimics the real carrier: read()
+    returns b'' (socket EOF), read_exact() raises like SecretConnection
+    does on a closed peer."""
+
+    def __init__(self, data: bytes):
+        self._buf = bytes(data)
+
+    def read(self, n: int) -> bytes:
+        n = min(n, len(self._buf))
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def read_exact(self, n: int) -> bytes:
+        if len(self._buf) < n:
+            raise sconn.SecretConnectionError("connection closed during read")
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    recv = read
+
+    def write(self, data: bytes) -> int:
+        return len(data)
+
+    sendall = write
+
+
+class _FakeMConn:
+    """Just enough of MConnection to drive the real ``_read_packet``
+    (borrowed unbound, so the production code path is what runs)."""
+
+    _read_packet = p2p_conn.MConnection._read_packet
+    _read_exact = p2p_conn.MConnection._read_exact
+
+    def __init__(self, data: bytes):
+        self.conn = ScriptedConn(data)
+        self.recv_monitor = types.SimpleNamespace(throttle=lambda *_: None)
+
+
+class _DuplexSock:
+    """In-memory one-direction socket: sendall feeds a buffer recv drains."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def sendall(self, data: bytes) -> None:
+        self._buf += data
+
+    def recv(self, n: int) -> bytes:
+        n = min(n, len(self._buf))
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def _secret_conn_pair():
+    """A writer/reader SecretConnection pair sharing symmetric keys over
+    an in-memory pipe — lets the gauntlet inject mutated ciphertext."""
+    k1, k2 = b"\x11" * 32, b"\x22" * 32
+    pub = ed25519.PrivKey.generate().pub_key()
+    pipe = _DuplexSock()
+    writer = sconn.SecretConnection.__new__(sconn.SecretConnection)
+    reader = sconn.SecretConnection.__new__(sconn.SecretConnection)
+    for c, send_key, recv_key in ((writer, k1, k2), (reader, k2, k1)):
+        sconn.SecretConnection.__init__(c, pipe, send_key, recv_key, pub)
+    return writer, reader, pipe
+
+
+# ------------------------------------------------------- golden frames
+
+
+def _nodeinfo() -> NodeInfo:
+    return NodeInfo(node_id="ab" * 20, listen_addr="1.2.3.4:26656",
+                    network="gauntlet-net", channels=b"\x40\x20")
+
+
+def _dup_vote_evidence_pb() -> bytes:
+    v = tpb.Vote(
+        type=1, height=3, round=0, timestamp=None,
+        validator_address=b"\x01" * 20, validator_index=0,
+        signature=b"\x02" * 64,
+    )
+    return tpb.EvidenceListProto(
+        evidence=[
+            tpb.EvidenceProto(
+                duplicate_vote_evidence=tpb.DuplicateVoteEvidenceProto(
+                    vote_a=v, vote_b=v, total_voting_power=10,
+                    validator_power=5, timestamp=None,
+                )
+            )
+        ]
+    ).encode()
+
+
+def _golden_frames() -> dict[str, list[bytes]]:
+    pex_url = ("cd" * 20) + "@5.6.7.8:26656"
+    return {
+        "consensus-receive": [
+            cpb.ConsensusMessage(
+                new_round_step=cpb.NewRoundStep(
+                    height=5, round=0, step=1,
+                    seconds_since_start_time=2, last_commit_round=-1,
+                )
+            ).encode(),
+            cpb.ConsensusMessage(
+                new_valid_block=cpb.NewValidBlock(
+                    height=3, round=0,
+                    block_part_set_header=tpb.PartSetHeader(
+                        total=2, hash=b"\x07" * 32
+                    ),
+                    block_parts=cpb.BitArrayProto.from_bools([True, False]),
+                    is_commit=False,
+                )
+            ).encode(),
+        ],
+        "blocksync-receive": [
+            bspb.BlocksyncMessage(
+                status_response=bspb.StatusResponse(height=10, base=1)
+            ).encode(),
+            bspb.BlocksyncMessage(
+                block_request=bspb.BlockRequest(height=3)
+            ).encode(),
+        ],
+        "statesync-receive": [
+            sspb.StatesyncMessage(
+                snapshots_response=sspb.SnapshotsResponse(
+                    height=7, format=1, chunks=4,
+                    hash=b"\x03" * 32, metadata=b"{}",
+                )
+            ).encode(),
+        ],
+        "mempool-receive": [
+            mppb.MempoolMessage(txs=mppb.Txs(txs=[b"k=v"])).encode(),
+        ],
+        "evidence-receive": [_dup_vote_evidence_pb()],
+        "pex-receive": [
+            p2p_pb.PexMessage(
+                pex_addrs=p2p_pb.PexAddrs(
+                    addrs=[p2p_pb.PexAddress(url=pex_url)]
+                )
+            ).encode(),
+        ],
+        "p2p-packet": [
+            (lambda payload: encode_varint(len(payload)) + payload)(
+                p2p_pb.Packet(
+                    msg=p2p_pb.PacketMsg(channel_id=0x40, eof=True, data=b"hi")
+                ).encode()
+            ),
+        ],
+        "secretconn-frame": [b""],  # frames are built live per mutation
+        "nodeinfo-handshake": [
+            (lambda payload: encode_varint(len(payload)) + payload)(
+                _nodeinfo().to_proto().encode()
+            ),
+        ],
+        "verifysvc-frame": [
+            vwire.frame(vwire.PlaneMessage(ping_request=vwire.PingRequest())),
+        ],
+        "checktx-envelope": [
+            checktx.MAGIC + b"\x01" * 32 + b"\x02" * 64 + b"payload",
+        ],
+        "kvstore-validator-tx": [
+            kvstore.make_val_set_change_tx(b"\x01" * 32, 5),
+        ],
+        "abci-server-frame": [
+            abci_pb.Request(echo=abci_pb.EchoRequest(message="hi")).encode(),
+        ],
+        "abci-client-frame": [
+            abci_pb.Response(echo=abci_pb.EchoResponse(message="hi")).encode(),
+        ],
+        "rpc-broadcast-evidence": [
+            tpb.EvidenceListProto.decode(_dup_vote_evidence_pb())
+            .evidence[0]
+            .encode(),
+        ],
+        "rpc-services-frame": [
+            (lambda payload: encode_varint(len(payload)) + payload)(
+                b"\x08\x01"
+            ),
+        ],
+        "privval-frame": [
+            (lambda payload: encode_varint(len(payload)) + payload)(
+                pvpb.PrivvalMessage(
+                    ping_request=pvpb.PingRequest()
+                ).encode()
+            ),
+        ],
+        "block-assembly": [
+            tpb.BlockProto().encode() or b"\x0a\x00",
+        ],
+        "wal-replay": [
+            cwal.encode_record(
+                wal_pb.TimedWALMessageProto(
+                    time=None,
+                    msg=wal_pb.WALMessageProto(
+                        end_height=wal_pb.EndHeightProto(height=1)
+                    ),
+                )
+            ),
+        ],
+        "genesis-file": [GenesisDoc(chain_id="gauntlet").to_json().encode()],
+        "addrbook-file": [b""],  # built live (needs a real book save)
+        "light-proof": [
+            __import__(
+                "cometbft_tpu.wire.canonical", fromlist=["x"]
+            ) and b"\x0a\x03key",
+        ],
+    }
+
+
+# ------------------------------------------------------------- harnesses
+
+
+def _h_consensus(data: bytes) -> None:
+    msg = cpb.ConsensusMessage.decode(data)
+    validate_consensus_message(msg)
+    # the arms that convert to typed objects validate them too
+    # (consensus/reactor.py receive)
+    w = msg.which()
+    if w == "proposal":
+        Proposal.from_proto(msg.proposal.proposal).validate_basic()
+    elif w == "vote":
+        Vote.from_proto(msg.vote.vote).validate_basic()
+    elif w == "block_part":
+        Part.from_proto(msg.block_part.part).validate_basic()
+    elif w in ("new_valid_block", "proposal_pol", "vote_set_bits"):
+        arm = getattr(msg, w)
+        ba = getattr(arm, "block_parts", None) or getattr(
+            arm, "proposal_pol", None
+        ) or getattr(arm, "votes", None)
+        if ba is not None:
+            ba.to_bools()  # the bounded-allocation guard
+
+
+def _h_blocksync(data: bytes) -> None:
+    msg = bspb.BlocksyncMessage.decode(data)
+    validate_blocksync_message(msg)
+    if msg.which() == "block_response" and msg.block_response.block is not None:
+        Block.from_proto(msg.block_response.block).validate_basic()
+
+
+def _h_statesync(data: bytes) -> None:
+    validate_statesync_message(sspb.StatesyncMessage.decode(data))
+
+
+def _h_mempool(data: bytes) -> None:
+    validate_mempool_message(mppb.MempoolMessage.decode(data))
+
+
+def _h_evidence(data: bytes) -> None:
+    msg = tpb.EvidenceListProto.decode(data)
+    validate_evidence_list(msg, len(data))
+    for ev_pb in msg.evidence:
+        evidence_from_proto(ev_pb)
+
+
+def _h_pex(data: bytes) -> None:
+    validate_pex_message(p2p_pb.PexMessage.decode(data))
+
+
+def _h_p2p_packet(data: bytes) -> None:
+    _FakeMConn(data)._read_packet()
+
+
+def _h_secretconn(data: bytes) -> None:
+    writer, reader, pipe = _secret_conn_pair()
+    writer.write(b"hello gauntlet")
+    wire_bytes = bytes(pipe._buf)
+    del pipe._buf[:]
+    # splice the mutation into the ciphertext stream
+    pipe.sendall(data if data else wire_bytes)
+    reader.read(14)
+
+
+def _h_nodeinfo(data: bytes) -> None:
+    p2p_transport._exchange_node_info(ScriptedConn(data), _nodeinfo())
+
+
+def _h_verifysvc(data: bytes) -> None:
+    r = vwire.FrameReader(_DuplexSock())
+    r._sock.sendall(data)
+    while r.read() is not None:
+        pass
+
+
+def _h_checktx(data: bytes) -> None:
+    parsed = checktx.parse_signed_tx(data)
+    assert parsed is None or (len(parsed) == 4)
+
+
+def _h_kvstore(data: bytes) -> None:
+    if kvstore.is_validator_tx(data):
+        kt, pub, power = kvstore.parse_validator_tx(data)
+        assert power >= 0 and (kt != "ed25519" or len(pub) == 32)
+
+
+def _h_abci_server(data: bytes) -> None:
+    abci_pb.Request.decode(data)
+
+
+def _h_abci_client(data: bytes) -> None:
+    abci_pb.Response.decode(data)
+
+
+def _h_rpc_evidence(data: bytes) -> None:
+    env = Environment(types.SimpleNamespace(evidence_pool=None))
+    try:
+        env.broadcast_evidence(base64.b64encode(data).decode())
+    except RPCError:
+        pass  # typed by contract; re-checked by _allowed anyway
+    # a caller can also hand non-base64 garbage straight through
+    env.broadcast_evidence(data.decode("latin1"))
+
+
+def _h_rpc_services(data: bytes) -> None:
+    import io
+
+    frame = rpc_services._read_frame(io.BytesIO(data))
+    if frame is not None:
+        from cometbft_tpu.wire import services_pb
+
+        services_pb.GetByHeightRequest.decode(frame)
+
+
+def _h_privval(data: bytes) -> None:
+    privval_signer._recv_msg(ScriptedConn(data))
+
+
+def _h_block_assembly(data: bytes) -> None:
+    Block.decode(data)
+
+
+def _h_wal(data: bytes) -> None:
+    for _ in cwal.decode_records(data):
+        pass
+
+
+def _h_genesis(data: bytes) -> None:
+    GenesisDoc.from_json(data.decode("latin1"))
+
+
+def _h_addrbook(data: bytes) -> None:
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "addrbook.json")
+        if not data:
+            book = AddrBook(file_path=path)
+            book.add_address(("ef" * 20) + "@9.9.9.9:26656", "")
+            book.save()
+        else:
+            with open(path, "wb") as f:
+                f.write(data)
+        AddrBook(file_path=path)
+
+
+def _h_light(data: bytes) -> None:
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.wire import types_pb as tpb
+
+    # Mirrors the fail-closed try in LightRPC.abci_query: anything the
+    # byzantine server controls that blows up during proof decode must
+    # surface as VerificationFailed, never an untyped crash.
+    try:
+        vop = tpb.ValueOpProto.decode(data)
+        proof = vop.proof or tpb.Proof()
+        merkle.Proof(
+            total=proof.total,
+            index=proof.index,
+            leaf_hash=proof.leaf_hash,
+            aunts=list(proof.aunts),
+        )
+    except VerificationFailed:
+        raise
+    except Exception as e:  # noqa: BLE001 — the abci_query wrap
+        raise VerificationFailed(f"abci_query: malformed response: {e}") from e
+
+
+HARNESSES = {
+    "consensus-receive": _h_consensus,
+    "blocksync-receive": _h_blocksync,
+    "statesync-receive": _h_statesync,
+    "mempool-receive": _h_mempool,
+    "evidence-receive": _h_evidence,
+    "pex-receive": _h_pex,
+    "p2p-packet": _h_p2p_packet,
+    "secretconn-frame": _h_secretconn,
+    "nodeinfo-handshake": _h_nodeinfo,
+    "verifysvc-frame": _h_verifysvc,
+    "checktx-envelope": _h_checktx,
+    "kvstore-validator-tx": _h_kvstore,
+    "abci-server-frame": _h_abci_server,
+    "abci-client-frame": _h_abci_client,
+    "rpc-broadcast-evidence": _h_rpc_evidence,
+    "rpc-services-frame": _h_rpc_services,
+    "privval-frame": _h_privval,
+    "block-assembly": _h_block_assembly,
+    "wal-replay": _h_wal,
+    "genesis-file": _h_genesis,
+    "addrbook-file": _h_addrbook,
+    "light-proof": _h_light,
+}
+
+#: Sources whose golden frame itself need not round-trip cleanly (the
+#: surface rejects minimal/empty structures by design).
+GOLDEN_MAY_REJECT = {"block-assembly", "secretconn-frame", "rpc-broadcast-evidence"}
+
+
+# ------------------------------------------------------------ mutations
+
+
+def mutations(golden: bytes, seed: int, n_random: int):
+    """Deterministic adversarial variants of one golden frame."""
+    yield b""
+    for cut in {1, len(golden) // 2, max(len(golden) - 1, 0)}:
+        yield golden[:cut]  # truncations
+    yield golden + golden  # trailing garbage / duplicated frame
+    yield golden + b"\xff" * 64  # oversize tail
+    yield b"\xff" * 10  # max varint spam
+    yield b"\x80" * 64  # unterminated varint
+    yield encode_varint(1 << 60) + golden  # huge length claim
+    rnd = random.Random(seed)
+    if golden:
+        for _ in range(n_random):
+            b = bytearray(golden)
+            for _ in range(rnd.randrange(1, 4)):
+                b[rnd.randrange(len(b))] ^= 1 << rnd.randrange(8)
+            yield bytes(b)  # bit flips
+    for _ in range(n_random):
+        yield bytes(rnd.randrange(256) for _ in range(rnd.randrange(1, 96)))
+
+
+def _drive(name: str, n_random: int) -> None:
+    src = tm.source_by_name(name)
+    harness = HARNESSES[name]
+    allowed = _allowed(src)
+    goldens = _golden_frames()[name]
+    # golden sanity: a well-formed frame passes the whole surface
+    if name not in GOLDEN_MAY_REJECT:
+        for g in goldens:
+            harness(g)
+    seen_others = [f for k, v in _golden_frames().items() if k != name for f in v]
+    for gi, golden in enumerate(goldens):
+        for mi, mut in enumerate(mutations(golden, seed=1000 * gi + 7, n_random=n_random)):
+            try:
+                harness(mut)
+            except allowed:
+                pass
+            except Exception as e:  # noqa: BLE001 — the assertion itself
+                raise AssertionError(
+                    f"{name}: mutation #{mi} of golden #{gi} escaped the "
+                    f"typed-error contract {src.errors} with "
+                    f"{type(e).__name__}: {e!r} (frame {mut[:48].hex()}...)"
+                ) from e
+    # type confusion: every other source's golden fed to this surface
+    for fi, frame in enumerate(seen_others):
+        try:
+            harness(frame)
+        except allowed:
+            pass
+        except Exception as e:  # noqa: BLE001
+            raise AssertionError(
+                f"{name}: foreign golden #{fi} escaped the typed-error "
+                f"contract {src.errors} with {type(e).__name__}: {e!r}"
+            ) from e
+
+
+# --------------------------------------------------------------- tests
+
+
+def test_harness_registry_matches_manifest_both_directions():
+    declared = {s.name for s in tm.gauntlet_sources()}
+    assert declared == set(HARNESSES), (
+        "manifest sources and gauntlet harnesses diverged: "
+        f"missing harnesses {sorted(declared - set(HARNESSES))}, "
+        f"orphan harnesses {sorted(set(HARNESSES) - declared)}"
+    )
+    assert declared == set(_golden_frames()), "golden frames out of sync"
+
+
+@pytest.mark.parametrize("name", sorted(HARNESSES))
+def test_gauntlet(name):
+    _drive(name, n_random=12)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(HARNESSES))
+def test_gauntlet_wide(name):
+    _drive(name, n_random=120)
+
+
+# --------------------------------------- regression pins for the fixes
+
+
+def test_privval_oversize_frame_is_refused_before_allocation():
+    # the unbounded-wire-length bug: a 2^60 length prefix must be
+    # refused at the prefix, not drive the read loop's allocation
+    data = encode_varint(1 << 60)
+    with pytest.raises(privval_signer.RemoteSignerError):
+        privval_signer._recv_msg(ScriptedConn(data))
+
+
+def test_bit_array_claim_beyond_words_is_refused():
+    ba = cpb.BitArrayProto.decode(
+        cpb.BitArrayProto(bits=10**9, elems=[]).encode()
+    )
+    with pytest.raises(ValueError):
+        ba.to_bools()
+
+
+def test_consensus_message_bits_total_mismatch_is_refused():
+    msg = cpb.ConsensusMessage(
+        new_valid_block=cpb.NewValidBlock(
+            height=3, round=0,
+            block_part_set_header=tpb.PartSetHeader(total=5, hash=b"\x07" * 32),
+            block_parts=cpb.BitArrayProto.from_bools([True]),
+            is_commit=False,
+        )
+    )
+    with pytest.raises(ValueError):
+        validate_consensus_message(
+            cpb.ConsensusMessage.decode(msg.encode())
+        )
+
+
+def test_pex_garbage_addresses_are_refused():
+    bad = p2p_pb.PexMessage(
+        pex_addrs=p2p_pb.PexAddrs(addrs=[p2p_pb.PexAddress(url="not-an-addr")])
+    )
+    with pytest.raises(ValueError):
+        validate_pex_message(p2p_pb.PexMessage.decode(bad.encode()))
+
+
+def test_statesync_unbounded_chunk_claim_is_refused():
+    bad = sspb.StatesyncMessage(
+        snapshots_response=sspb.SnapshotsResponse(
+            height=1, format=1, chunks=1 << 40, hash=b"\x01", metadata=b"",
+        )
+    )
+    with pytest.raises(ValueError):
+        validate_statesync_message(sspb.StatesyncMessage.decode(bad.encode()))
+
+
+def test_evidence_oversize_wire_is_refused():
+    msg = tpb.EvidenceListProto.decode(_dup_vote_evidence_pb())
+    with pytest.raises(ValueError):
+        validate_evidence_list(msg, (1 << 20) + 1)
+
+
+def test_genesis_type_confusion_is_valueerror():
+    doc = json.loads(GenesisDoc(chain_id="x").to_json())
+    doc["validators"] = [{"pub_key": "not-a-dict", "power": "1"}]
+    with pytest.raises(ValueError):
+        GenesisDoc.from_json(json.dumps(doc))
+
+
+def test_addrbook_type_confusion_is_valueerror(tmp_path):
+    path = tmp_path / "book.json"
+    path.write_text(json.dumps({"key": "00" * 24, "addrs": [{"no_addr": 1}]}))
+    with pytest.raises(ValueError):
+        AddrBook(file_path=str(path))
+
+
+def test_kvstore_wrong_size_pubkey_is_refused():
+    # valid base64 of the wrong length (the hex-key confusion)
+    tx = kvstore.VALIDATOR_PREFIX.encode() + b"!" + base64.b64encode(
+        b"\x01" * 16
+    ) + b"!5"
+    with pytest.raises(ValueError):
+        kvstore.parse_validator_tx(tx)
