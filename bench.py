@@ -51,7 +51,12 @@ vs BLS-aggregate-commit p50 and reports the crossover set size
 (see _run_bls); BENCH_WORKLOAD=secp sweeps batch sizes comparing the
 TPU-batched secp256k1/ECDSA lane vs the pure-host lane and drives a
 mixed ed25519+secp CheckTx ingest round with per-key-type per-class
-latency (see _run_secp).
+latency (see _run_secp); BENCH_WORKLOAD=proofs sweeps coalesced
+Merkle-proof query counts comparing the one-dispatch TPU proof kernel
+(ops/merkle.proofs_from_leaves) against the host
+proofs_from_byte_slices oracle — bit-identity is asserted on every
+swept size, and the multiproof shared-node dedup factor rides in the
+same line (see _run_proofs).
 
 Baseline: curve25519-voi batch verify ~27.5 us/sig/core on the QA CPUs
 (BASELINE.md: 50-60 us single, ~2x batch gain) -> 275 ms for 10k sigs.
@@ -897,6 +902,98 @@ def _run_secp() -> None:
     emit_and_exit()
 
 
+def _run_proofs() -> None:
+    """BENCH_WORKLOAD=proofs: the TPU proof-serving-plane capture.
+    Sweeps coalesced query counts (BENCH_PROOF_SIZES, default
+    64,256,1024,4096 — the top size is the >=1k-coalesced-queries
+    acceptance point) and measures, per count K over a K-leaf tree:
+
+      * tpu: crypto/merkle.device_proofs_from_byte_slices — host plans
+        sibling coordinates, ONE device dispatch retains every interior
+        level and one-hot-gathers all K audit paths;
+      * host: crypto/merkle.proofs_from_byte_slices — the pure-host
+        oracle that DEFINES the proof bytes (every degraded service
+        route funnels to it).
+
+    Bit-identity between the two is asserted on every swept size — a
+    fast proof plane that serves different bytes is a bug, not a win —
+    and each row carries the multiproof shared-node dedup factor
+    (crypto/merkle.multiproof_plan: naive path-node slots over deduped
+    unique nodes) for the same K.  p50 AND p95 ride per lane: proof
+    fan-out is a latency-sensitive read path, so the tail is part of
+    the claim."""
+    from cometbft_tpu.crypto import merkle as cmerkle
+
+    sizes = [
+        int(x) for x in
+        os.environ.get("BENCH_PROOF_SIZES", "64,256,1024,4096").split(",")
+        if x.strip()
+    ]
+    iters = int(os.environ.get("BENCH_PROOF_ITERS", "5"))
+    REPORT["metric"] = "proof_gen_tpu_batch_p50_ms"
+    REPORT["workload"] = "proofs"
+    REPORT["verifier"] = "merkle-proof-batched"
+    REPORT["sizes"] = sizes
+    REPORT["iters"] = iters
+
+    def pct(vals, q):
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 3)
+
+    rng = np.random.default_rng(29)
+    sweep: dict[str, dict] = {}
+    for n in sizes:
+        row: dict = {}
+        leaves = [rng.bytes(64) for _ in range(n)]
+        idxs = list(range(n))  # every leaf queried: worst-case coalesce
+
+        def run_tpu(leaves=leaves, idxs=idxs):
+            t0 = time.perf_counter()
+            root, proofs = cmerkle.device_proofs_from_byte_slices(leaves, idxs)
+            dt = (time.perf_counter() - t0) * 1e3
+            assert len(proofs) == len(idxs)
+            return dt, root, proofs
+
+        def run_host(leaves=leaves, idxs=idxs):
+            t0 = time.perf_counter()
+            root, all_proofs = cmerkle.proofs_from_byte_slices(leaves)
+            proofs = [all_proofs[i] for i in idxs]
+            dt = (time.perf_counter() - t0) * 1e3
+            return dt, root, proofs
+
+        _, d_root, d_proofs = run_tpu()  # warmup: shape compile / cache hit
+        _, h_root, h_proofs = run_host()
+        # the contract, asserted in the bench itself: same root, same
+        # proof bytes, row for row
+        assert d_root == h_root
+        assert all(
+            dp.total == hp.total and dp.index == hp.index
+            and dp.leaf_hash == hp.leaf_hash and dp.aunts == hp.aunts
+            for dp, hp in zip(d_proofs, h_proofs)
+        ), f"device/host proof divergence at n={n}"
+
+        tpu_runs = [run_tpu()[0] for _ in range(iters)]
+        host_runs = [run_host()[0] for _ in range(iters)]
+        row["tpu_p50_ms"] = pct(tpu_runs, 0.5)
+        row["tpu_p95_ms"] = pct(tpu_runs, 0.95)
+        row["host_p50_ms"] = pct(host_runs, 0.5)
+        row["host_p95_ms"] = pct(host_runs, 0.95)
+        row["tpu_speedup_vs_host"] = round(
+            row["host_p50_ms"] / row["tpu_p50_ms"], 2
+        ) if row["tpu_p50_ms"] else None
+        _, _, coords, naive = cmerkle.multiproof_plan(n, idxs)
+        row["multiproof_dedup_factor"] = round(
+            naive / len(coords), 2
+        ) if coords else None
+        row["bit_identical"] = True  # the asserts above did not fire
+        sweep[str(n)] = row
+    REPORT["sweep"] = sweep
+    top = sweep[str(max(sizes))]
+    REPORT["value"] = top["tpu_p50_ms"]
+    REPORT["unit"] = "ms"
+    emit_and_exit()
+
+
 def _run_multichip() -> None:
     """BENCH_WORKLOAD=multichip: the 8-device scaling capture of ROADMAP
     item 1.  Sweeps the comb-cached commit verify over device counts
@@ -1122,6 +1219,8 @@ def main() -> None:
         _run_bls()
     if os.environ.get("BENCH_WORKLOAD", "") == "secp":
         _run_secp()
+    if os.environ.get("BENCH_WORKLOAD", "") == "proofs":
+        _run_proofs()
 
     N = int(os.environ.get("BENCH_N", "10000"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))

@@ -206,6 +206,33 @@ KERNELS: tuple[Kernel, ...] = (
         max_eqns=2_000,  # measured 628
         arg_ranges=(None, (0, 1)),
     ),
+    # the proof-serving plane: ONE dispatch retains every interior level
+    # and one-hot-gathers K audit paths.  Sibling positions are computed
+    # on HOST (crypto/merkle.proof_plan) so the traced program carries no
+    # data-dependent control flow and no xor/shift index arithmetic —
+    # the gathers are MXU matmuls over {0,1} masks (exact in f32).
+    # Trace shape: n=8 leaves (depth 3), K=4 queries.
+    Kernel(
+        name="merkle_proofs_from_leaves",
+        fn="cometbft_tpu.ops.merkle:proofs_from_leaves",
+        args=(u8(N, 1, 64), i32(N), i32(4), i32(4, 3)),
+        out=(u8(32), u8(4, 32), u8(4, 3, 32)),
+        max_eqns=1_500,  # measured 990
+        # indices are valid leaf positions; sib_pos carries -1 as the
+        # "no aunt at this level" sentinel (promoted odd trailing node)
+        arg_ranges=(None, (0, 1), (0, N - 1), (-1, N - 1)),
+    ),
+    # the multiproof shape: M deduplicated nodes gathered from the flat
+    # level concatenation (n + ceil(n/2) + ... + 1 = 15 nodes at n=8);
+    # shared aunts appear once however many queries need them.
+    Kernel(
+        name="merkle_multiproof_from_leaves",
+        fn="cometbft_tpu.ops.merkle:multiproof_from_leaves",
+        args=(u8(N, 1, 64), i32(N), i32(6)),
+        out=(u8(32), u8(6, 32)),
+        max_eqns=1_500,  # measured 951
+        arg_ranges=(None, (0, 1), (0, 14)),
+    ),
     # ---- ops/bls381.py — the FastAggregateVerify data plane: batched
     # KeyValidate (on-curve + subgroup) and the tree-reduced G1 pubkey
     # sum; Miller loop + final exponentiation stay on host
@@ -415,6 +442,18 @@ KERNELS: tuple[Kernel, ...] = (
         max_eqns=2_000,  # measured 633
         arg_ranges=(None, (0, 1)),
     ),
+    Kernel(
+        # query axis sharded, tree replicated: every device holds the
+        # whole (small) tree and answers its own K/devices queries with
+        # ZERO collectives — the proof fan-out scaling shape
+        name="sharded_merkle_proofs",
+        fn="cometbft_tpu.parallel.verify:_merkle_proofs_fn",
+        args=(u8(N, 1, 64), i32(N), i32(4), i32(4, 3)),
+        out=(u8(32), u8(4, 32), u8(4, 3, 32)),
+        needs_mesh=True,
+        max_eqns=1_500,  # measured 995
+        arg_ranges=(None, (0, 1), (0, N - 1), (-1, N - 1)),
+    ),
 )
 
 
@@ -446,8 +485,16 @@ JIT_SITES: dict[str, str] = {
     "cometbft_tpu/parallel/verify.py::_verify_fn": "sharded_verify_batch",
     "cometbft_tpu/parallel/verify.py::_comb_verify_fn": "sharded_verify_cached",
     "cometbft_tpu/parallel/verify.py::_merkle_fn": "sharded_merkle_root",
+    "cometbft_tpu/parallel/verify.py::_merkle_proofs_fn": "sharded_merkle_proofs",
     # crypto/merkle.py jits ops/merkle.root_from_leaves for host callers
     "cometbft_tpu/crypto/merkle.py::root_from_leaves": "merkle_root_from_leaves",
+    # crypto/merkle.py jits the proof kernels for the proof-serving plane
+    "cometbft_tpu/crypto/merkle.py::proofs_from_leaves": (
+        "merkle_proofs_from_leaves"
+    ),
+    "cometbft_tpu/crypto/merkle.py::multiproof_from_leaves": (
+        "merkle_multiproof_from_leaves"
+    ),
 }
 
 
@@ -691,6 +738,32 @@ SHARDED_KERNELS: tuple[ShardedKernel, ...] = (
         # per-call leaf staging transfers, dead after dispatch
         donate_argnums=(0, 1),
         entry_donated_params=(("leaf_blocks", 1), ("leaf_active", 2)),
+    ),
+    ShardedKernel(
+        name="sharded_merkle_proofs",
+        entrypoint="sharded_merkle_proofs",
+        # 8-way trace: n=8 leaves replicated, K=8 queries (1 per device)
+        args=(u8(N, 1, 64), i32(N), i32(V8), i32(V8, 3)),
+        out=(u8(32), u8(V8, 32), u8(V8, 3, 32)),
+        in_specs=(
+            (),  # leaf blocks: replicated (every device holds the tree)
+            (),  # active counts: replicated
+            (SHARD_AXIS,),  # query indices: sharded
+            (SHARD_AXIS, None),  # per-level sibling positions: sharded
+        ),
+        out_specs=((), (SHARD_AXIS, None), (SHARD_AXIS, None, None)),
+        # ZERO collectives: the tree is replicated, each device answers
+        # its own query slice locally — any collective here is a reshard
+        collectives=(),
+        # measured 995 eqns / loop depth 0 / ~4 KB per device
+        max_eqns=1_500,
+        max_loop_depth=4,
+        max_device_bytes=1 << 20,
+        # the per-call query plan is dead after dispatch; the leaf
+        # blocks are NOT donated — callers reuse a registered tree
+        # across dispatches
+        donate_argnums=(2, 3),
+        entry_donated_params=(("indices", 3), ("sib_pos", 4)),
     ),
 )
 

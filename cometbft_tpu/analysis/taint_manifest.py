@@ -196,7 +196,30 @@ SOURCES: tuple[Source, ...] = (
         errors=("ValueError", "ClientError"),
         notes="app responses; slices bounded by buffered bytes",
     ),
+    # ------------------------------------------------ proof-serving plane
+    Source(
+        name="verifysvc-proof-request",
+        path="cometbft_tpu/verifysvc/wire.py",
+        func="validate_proof_request",
+        tainted_params=("req",),
+        dataflow=False,
+        notes="the ONE gate between a decoded ProofRequest and the proof "
+        "data plane: tree/index bounds checked BEFORE any struct.pack, "
+        "digest recomputed; only ValueError escapes (the server answers "
+        "it as bad_request)",
+    ),
     # -------------------------------------------------------- RPC surface
+    Source(
+        name="rpc-merkle-proof",
+        path="cometbft_tpu/rpc/core.py",
+        func="merkle_proof",
+        tainted_params=("height", "indices"),
+        errors=("ValueError", "RPCError"),
+        notes="JSON-RPC proof fan-out: height/indices parse to bounded "
+        "ints (count capped by COMETBFT_TPU_PROOF_QUERY_MAX, every index "
+        "bounds-checked against the block's tx count) before any leaf "
+        "hashing or service submit",
+    ),
     Source(
         name="rpc-broadcast-evidence",
         path="cometbft_tpu/rpc/core.py",

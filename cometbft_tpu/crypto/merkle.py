@@ -195,6 +195,172 @@ def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
     return root_hash, proofs
 
 
+# ------------------------------------------------- batched device proofs
+#
+# The split-point recursion above is equivalent to a level-by-level
+# reduction with the odd trailing node promoted unchanged (same argument
+# as ops/merkle.hash_level).  Under that view the aunt of a query at
+# level l is its pair sibling (position ^ 1) — unless the sibling index
+# falls off the level (the query's ancestor IS the promoted node), in
+# which case the level contributes no aunt, exactly matching
+# _Node.flatten_aunts.  proof_plan computes those positions on host so
+# the device kernel is pure one-hot gathers.
+
+_JIT_PROOFS = None
+_JIT_MULTI = None
+
+
+def _level_sizes(total: int) -> list[int]:
+    """Sizes of the reduction levels below the root: [n, ceil(n/2), ..., 2]."""
+    sizes = []
+    n = total
+    while n > 1:
+        sizes.append(n)
+        n = (n + 1) // 2
+    return sizes
+
+
+def proof_plan(total: int, indices: list[int]) -> tuple[int, list[list[int]]]:
+    """Per-level sibling positions for each queried index.
+
+    Returns (depth, sib) where sib[k][l] is the position, within level l,
+    of query k's aunt node — or -1 when that level's odd trailing node was
+    promoted through (no aunt emitted, matching _Node.flatten_aunts).
+    Aunt order is leaf-to-root, the order Proof.aunts stores."""
+    if total < 1:
+        raise ValueError("proof plan needs a non-empty tree")
+    sizes = _level_sizes(total)
+    sib = []
+    for idx in indices:
+        idx = int(idx)
+        if idx < 0 or idx >= total:
+            raise ValueError(f"proof index {idx} out of range for total {total}")
+        row = []
+        pos = idx
+        for sz in sizes:
+            s = pos ^ 1
+            row.append(s if s < sz else -1)
+            pos >>= 1
+        sib.append(row)
+    return len(sizes), sib
+
+
+def device_proofs_from_byte_slices(
+    items: list[bytes], indices: list[int]
+) -> tuple[bytes, list[Proof]]:
+    """Batched device proofs for the queried indices: one dispatch gathers
+    every audit path via one-hot sibling selection (ops/merkle
+    ``merkle_proofs_from_leaves``).  Bit-identical to
+    proofs_from_byte_slices by construction — tests assert it over
+    randomized corpora."""
+    global _JIT_PROOFS
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops import merkle as M
+
+    total = len(items)
+    depth, sib = proof_plan(total, indices)
+    blocks, active = M.pad_leaves(items)
+    if _JIT_PROOFS is None:
+        # jit site registered in kernel_manifest.JIT_SITES (manifest
+        # kernel ``merkle_proofs_from_leaves``)
+        _JIT_PROOFS = jax.jit(M.proofs_from_leaves)
+    idx_arr = jnp.asarray(np.asarray(indices, dtype=np.int32))
+    sib_arr = jnp.asarray(
+        np.asarray(sib, dtype=np.int32).reshape(len(indices), depth)
+    )
+    root, leaf_sel, aunts = _JIT_PROOFS(
+        jnp.asarray(blocks), jnp.asarray(active), idx_arr, sib_arr
+    )
+    leaf_np = np.asarray(leaf_sel)
+    aunt_np = np.asarray(aunts)
+    proofs = [
+        Proof(
+            total=total,
+            index=int(idx),
+            leaf_hash=bytes(leaf_np[k]),
+            aunts=[bytes(aunt_np[k, l]) for l in range(depth) if sib[k][l] >= 0],
+        )
+        for k, idx in enumerate(indices)
+    ]
+    return bytes(np.asarray(root)), proofs
+
+
+def multiproof_plan(
+    total: int, indices: list[int]
+) -> tuple[int, list[list[int]], list[int], int]:
+    """Dedup plan for a multiproof: many indices against one tree.
+
+    Returns (depth, sib, coords, naive_slots): coords is the sorted,
+    deduplicated list of flat node coordinates (level-size prefix-sum
+    offsets, level 0 first) covering every queried leaf hash and every
+    aunt; naive_slots is what K independent proofs would have gathered
+    (the dedup factor's numerator)."""
+    depth, sib = proof_plan(total, indices)
+    sizes = _level_sizes(total)
+    offsets = [0]
+    for sz in sizes:
+        offsets.append(offsets[-1] + sz)
+    need = set()
+    naive = 0
+    for k, idx in enumerate(indices):
+        need.add(int(idx))  # level-0 leaf hash
+        naive += 1
+        for l in range(depth):
+            if sib[k][l] >= 0:
+                need.add(offsets[l] + sib[k][l])
+                naive += 1
+    return depth, sib, sorted(need), naive
+
+
+def device_multiproof(
+    items: list[bytes], indices: list[int]
+) -> tuple[bytes, list[Proof], float]:
+    """Multiproof: answer many indices against one tree with shared nodes
+    gathered once (ops/merkle ``merkle_multiproof_from_leaves``).  The
+    per-query Proofs are reassembled on host from the deduplicated node
+    set, so they are byte-for-byte the same objects device_proofs_from_
+    byte_slices (and the host oracle) would produce.  Returns
+    (root, proofs, dedup_factor = naive gather slots / unique nodes)."""
+    global _JIT_MULTI
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops import merkle as M
+
+    total = len(items)
+    depth, sib, coords, naive = multiproof_plan(total, indices)
+    sizes = _level_sizes(total)
+    offsets = [0]
+    for sz in sizes:
+        offsets.append(offsets[-1] + sz)
+    blocks, active = M.pad_leaves(items)
+    if _JIT_MULTI is None:
+        # jit site registered in kernel_manifest.JIT_SITES (manifest
+        # kernel ``merkle_multiproof_from_leaves``)
+        _JIT_MULTI = jax.jit(M.multiproof_from_leaves)
+    coord_arr = jnp.asarray(np.asarray(coords, dtype=np.int32))
+    root, nodes = _JIT_MULTI(jnp.asarray(blocks), jnp.asarray(active), coord_arr)
+    node_np = np.asarray(nodes)
+    by_coord = {c: bytes(node_np[i]) for i, c in enumerate(coords)}
+    proofs = [
+        Proof(
+            total=total,
+            index=int(idx),
+            leaf_hash=by_coord[int(idx)],
+            aunts=[
+                by_coord[offsets[l] + sib[k][l]]
+                for l in range(depth)
+                if sib[k][l] >= 0
+            ],
+        )
+        for k, idx in enumerate(indices)
+    ]
+    dedup = float(naive) / float(len(coords)) if coords else 1.0
+    return bytes(np.asarray(root)), proofs, dedup
+
+
 # ------------------------------------------------------- proof operators
 
 

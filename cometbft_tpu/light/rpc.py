@@ -321,6 +321,56 @@ class VerifyingClient:
         proof.verify(root, tx_hash(tx))  # leaves are TxIDs (types/tx.go:51)
         return resp
 
+    def data_proof(self, height: int = 0, index: int = 0) -> dict:
+        """Fetch an inclusion proof for tx ``index`` of block ``height``
+        (the merkle_proof route, served by the node's PROOF plane) and
+        verify it against the light-client-verified header's data_hash
+        before returning it.  Unlike tx(), this never downloads the
+        block's tx set — the proof alone anchors the returned leaf_hash
+        (the TxID) to verified chain state; a caller holding the tx
+        bytes completes the chain with tx_hash(tx) == leaf_hash."""
+        from ..crypto import merkle
+
+        height = self._resolve_height(height)
+        want = self._verified_header(height)
+        resp = self.rpc.call("merkle_proof", height=height, indices=str(index))
+        # Everything the serving node controls parses inside this try:
+        # malformed hex/base64/ints must surface as the same fail-closed
+        # VerificationFailed as a wrong proof.
+        try:
+            root = bytes.fromhex(resp["root_hash"])
+            total = int(resp["total"])
+            rows = resp["proofs"]
+            if len(rows) != 1:
+                raise VerificationFailed(
+                    f"data_proof: expected 1 proof, got {len(rows)}"
+                )
+            pj = rows[0]
+            proof = merkle.Proof(
+                total=int(pj["total"]),
+                index=int(pj["index"]),
+                leaf_hash=base64.b64decode(pj["leaf_hash"]),
+                aunts=[base64.b64decode(a) for a in pj.get("aunts") or []],
+            )
+        except VerificationFailed:
+            raise
+        except Exception as e:  # noqa: BLE001 — fail closed on any garbage
+            raise VerificationFailed(
+                f"data_proof: malformed response: {e}"
+            ) from e
+        if proof.total != total or proof.index != index:
+            raise VerificationFailed("data_proof: proof row does not match query")
+        if root != want.data_hash:
+            raise VerificationFailed(
+                f"data_proof {height}: root {root.hex()} != verified "
+                f"data_hash {want.data_hash.hex()}"
+            )
+        if proof.compute_root_hash() != want.data_hash:
+            raise VerificationFailed(
+                "data_proof: proof does not verify against data_hash"
+            )
+        return resp
+
     def abci_query(self, path: str, data: bytes, height: int = 0) -> dict:
         """Fail-closed verified query (reference: light/rpc/client.go:110-160
         ABCIQueryWithOptions forces opts.Prove and errors when the proof is
@@ -440,6 +490,9 @@ class LightProxy:
             "commit": lambda p: vc.commit(int(p.get("height") or 0)),
             "validators": lambda p: vc.validators(int(p.get("height") or 0)),
             "tx": lambda p: vc.tx(p["hash"]),
+            "data_proof": lambda p: vc.data_proof(
+                int(p.get("height") or 0), int(p.get("index") or 0)
+            ),
             "abci_query": lambda p: vc.abci_query(
                 p.get("path", ""),
                 base64.b64decode(p.get("data", "")),

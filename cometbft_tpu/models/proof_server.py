@@ -1,0 +1,291 @@
+"""Batched Merkle proof serving: the data plane of the PROOF class.
+
+Light-client fan-out is millions of tiny read-only queries — "prove leaf
+i of tree T" — and the device answer is one dispatch per tree, however
+many queries coalesced against it (ops/merkle.proofs_from_leaves one-hot
+sibling gathers; crypto/merkle.device_proofs_from_byte_slices).  This
+module adapts that kernel to the verify service's BatchVerifier seam so
+proof requests ride the existing (tenant, class) scheduler, wire, and
+breaker machinery unchanged:
+
+  - a query is an item triple ``(tree_digest, index_be8, b"")`` — the
+    same 3-tuple shape every other mode submits, so _Request, blame
+    slicing, and the re-verify paths need no new cases;
+  - trees are registered once in a bounded digest -> leaves cache and
+    referenced by digest; a query against an unknown/evicted digest gets
+    a None result row (a typed miss), never a wrong proof;
+  - results are crypto/merkle.Proof rows (or None), and EVERY route —
+    device, host fallback, remote plane — resolves to byte-identical
+    Proofs because the host oracle proofs_from_byte_slices defines the
+    bytes and the device kernels are pinned bit-identical to it by test.
+
+CpuProofProver is the pure-host plane (cpu_verifier_for_mode("proof")):
+every degraded path — trip, breaker-open, backpressure, collect timeout
+— funnels through it.  TpuProofProver is the device plane; its submit()
+runs the dispatch inline and is therefore routed through the service's
+class-priority host worker (``_entry = None`` -> _submit_is_offloaded),
+so a wide proof batch can never occupy the scheduler thread.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+from collections import OrderedDict
+
+from ..crypto import merkle as cmerkle
+from ..utils import envknobs, tracing
+from ..utils.metrics import hub as _metrics_hub
+
+# ------------------------------------------------------------ tree cache
+
+_INDEX_WIDTH = 8  # query index wire width (big-endian, unsigned)
+
+
+def tree_digest(leaves) -> bytes:
+    """Canonical digest naming a tree by its raw leaves: SHA-256 over
+    length-prefixed leaves (the verifysvc/wire.batch_digest idiom), NOT
+    the Merkle root — naming the preimage means two leaf lists that
+    happen to share a root still cache separately."""
+    h = hashlib.sha256()
+    h.update(struct.pack("<I", len(leaves)))
+    for leaf in leaves:
+        h.update(struct.pack("<I", len(leaf)))
+        h.update(leaf)
+    return h.digest()
+
+
+class _TreeCache:
+    """Bounded LRU of digest -> leaves (COMETBFT_TPU_PROOF_TREE_CACHE)."""
+
+    def __init__(self) -> None:
+        self._mtx = threading.Lock()
+        self._trees: OrderedDict[bytes, tuple[bytes, ...]] = OrderedDict()
+
+    def _cap(self) -> int:
+        return max(1, envknobs.get_int(envknobs.PROOF_TREE_CACHE))
+
+    def put(self, leaves) -> bytes:
+        d = tree_digest(leaves)
+        with self._mtx:
+            self._trees[d] = tuple(leaves)
+            self._trees.move_to_end(d)
+            cap = self._cap()
+            while len(self._trees) > cap:
+                self._trees.popitem(last=False)
+        return d
+
+    def get(self, digest: bytes):
+        with self._mtx:
+            t = self._trees.get(digest)
+            if t is not None:
+                self._trees.move_to_end(digest)
+        _metrics_hub().verify_proof_tree_cache.inc(
+            result="hit" if t is not None else "miss"
+        )
+        return t
+
+
+_CACHE = _TreeCache()
+
+
+def register_tree(leaves) -> bytes:
+    """Pin a tree (list of raw leaf byte strings) into the proof server's
+    cache and return the digest proof queries reference it by."""
+    return _CACHE.put(leaves)
+
+
+def tree_leaves(digest: bytes):
+    """The cached leaves for a digest, or None after eviction/unknown."""
+    return _CACHE.get(digest)
+
+
+# --------------------------------------------------------- query items
+
+
+def encode_query(digest: bytes, index: int):
+    """(tree digest, leaf index) -> the service item triple."""
+    if len(digest) != 32:
+        raise ValueError("tree digest must be 32 bytes")
+    if index < 0 or index >= 1 << 63:
+        raise ValueError("proof index out of range")
+    return (digest, int(index).to_bytes(_INDEX_WIDTH, "big"), b"")
+
+
+def decode_query(item) -> tuple[bytes, int]:
+    """Item triple -> (digest, index); malformed shapes raise ValueError
+    (submit-side validation; the provers themselves judge bad rows None
+    like the cpu verifiers judge malformed rows False)."""
+    digest, idx_b, tail = item
+    if len(digest) != 32 or len(idx_b) != _INDEX_WIDTH or tail != b"":
+        raise ValueError("malformed proof query item")
+    return digest, int.from_bytes(idx_b, "big")
+
+
+def _prove_items(items, device: bool):
+    """Shared prover body: group query items by tree digest, answer each
+    group in one pass, scatter rows back into the caller's add() order.
+
+    Every row is a crypto/merkle.Proof or None (unknown digest, index
+    out of range, malformed item).  The host and device passes are
+    bit-identical by construction (pinned by tests/test_merkle_proofs)."""
+    rows: list = [None] * len(items)
+    by_digest: dict[bytes, list[tuple[int, int]]] = {}
+    for pos, item in enumerate(items):
+        try:
+            digest, idx = decode_query(item)
+        except (ValueError, TypeError):
+            continue  # malformed row -> None, like cpu verifiers' False
+        by_digest.setdefault(digest, []).append((pos, idx))
+    m = _metrics_hub()
+    for digest, queries in by_digest.items():
+        leaves = tree_leaves(digest)
+        if leaves is None:
+            continue  # typed miss: None rows for every query of this tree
+        total = len(leaves)
+        good = [(pos, idx) for pos, idx in queries if 0 <= idx < total]
+        if not good:
+            continue
+        idxs = [idx for _, idx in good]
+        use_device = (
+            device
+            and len(idxs) >= max(1, envknobs.get_int(envknobs.PROOF_DEVICE_MIN))
+        )
+        if use_device:
+            try:
+                with tracing.span(
+                    "verify.proof.device_dispatch",
+                    {"queries": len(idxs), "total": total}
+                    if tracing.enabled() else None,
+                ):
+                    _, proofs = cmerkle.device_proofs_from_byte_slices(
+                        list(leaves), idxs
+                    )
+                m.verify_proof_queries.inc(len(idxs), route="device")
+            except ImportError:
+                use_device = False
+        if not use_device:
+            with tracing.span(
+                "verify.proof.host_route",
+                {"queries": len(idxs)} if tracing.enabled() else None,
+            ):
+                _, all_proofs = cmerkle.proofs_from_byte_slices(list(leaves))
+                proofs = [all_proofs[i] for i in idxs]
+            m.verify_proof_queries.inc(len(idxs), route="host")
+        for (pos, _), proof in zip(good, proofs):
+            rows[pos] = proof
+    ok = bool(rows) and all(r is not None for r in rows)
+    return ok, rows
+
+
+class CpuProofProver:
+    """Pure-host proof plane: proofs_from_byte_slices per referenced tree
+    — the bit-identity oracle every fallback path resolves to.  Exposes
+    the cpu-verifier seam (`_items`, add, verify) so _HostBatchVerifier
+    and _host_verify_items wrap it unchanged."""
+
+    def __init__(self) -> None:
+        self._items: list = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        decode_query((pub_key, msg, sig))  # shape-validate like add() peers
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self):
+        return _prove_items(self._items, device=False)
+
+
+class TpuProofProver:
+    """Device proof plane behind the BatchVerifier seam.  ``_entry =
+    None`` routes submit() through the service's class-priority host
+    worker (the dispatch pads, compiles on cold shapes, and fetches
+    inline), so PROOF-class batches run strictly below every signature
+    class there too."""
+
+    _entry = None
+    _fallback = None
+
+    def __init__(self) -> None:
+        self._items: list = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, pub_key: bytes, msg: bytes, sig: bytes) -> None:
+        decode_query((pub_key, msg, sig))
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self):
+        return self.collect(self.submit())
+
+    def submit(self):
+        if not self._items:
+            return ("sync", (False, []))
+        return ("sync", _prove_items(self._items, device=True))
+
+    def collect(self, ticket):
+        return ticket[1]
+
+
+# ------------------------------------------------------------ front door
+
+
+def prove(
+    leaves,
+    indices,
+    *,
+    tenant: str | None = None,
+    svc=None,
+):
+    """Serve inclusion proofs for ``indices`` of the tree over ``leaves``
+    through the PROOF class of the verify service: queries coalesce with
+    every other caller's into one device batch behind the scheduler, and
+    results come back in THIS caller's index order.
+
+    Returns (root, [Proof, ...]).  Backpressure, a collect deadline, or
+    a scheduler stop all degrade to the host oracle inline — same bytes,
+    by construction.  Raises ValueError for an index out of range (the
+    caller's bug, not a degraded mode)."""
+    from ..verifysvc import service as S
+
+    leaves = list(leaves)
+    total = len(leaves)
+    if total < 1:
+        raise ValueError("cannot prove against an empty tree")
+    indices = [int(i) for i in indices]
+    for i in indices:
+        if i < 0 or i >= total:
+            raise ValueError(f"proof index {i} out of range for total {total}")
+    digest = register_tree(leaves)
+    items = [encode_query(digest, i) for i in indices]
+    if svc is None:
+        svc = S.global_service()
+    rows = None
+    with tracing.span(
+        "verify.proof.prove",
+        {"queries": len(indices), "total": total}
+        if tracing.enabled() else None,
+    ):
+        try:
+            ticket = svc.submit(items, S.Klass.PROOF, S.MODE_PROOF, tenant=tenant)
+            _, rows = ticket.collect(S.collect_timeout_s())
+        except (S.VerifyServiceBackpressure, TimeoutError):
+            with tracing.span("verify.proof.host_fallback"):
+                _, rows = _prove_items(items, device=False)
+    root, proofs = _assemble(leaves, indices, rows)
+    return root, proofs
+
+
+def _assemble(leaves, indices, rows):
+    """Post-collect check: a None row at this level means the tree was
+    evicted between register and dispatch — re-prove on host from the
+    leaves we still hold (identical bytes, the oracle defines them)."""
+    if rows is None or len(rows) != len(indices) or any(r is None for r in rows):
+        root, all_proofs = cmerkle.proofs_from_byte_slices(leaves)
+        return root, [all_proofs[i] for i in indices]
+    root = rows[0].compute_root_hash() if rows else cmerkle.empty_hash()
+    return root, list(rows)

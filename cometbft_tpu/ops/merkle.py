@@ -90,3 +90,80 @@ def root_from_leaves(blocks, active):
     ``sharded_merkle_root`` (census: one all_gather of the D subtree
     roots — analysis/shardcheck)."""
     return root_from_leaf_hashes(leaf_hashes_from_padded(blocks, active))
+
+
+# ------------------------------------------------------- batched proofs
+#
+# Proof generation retains every interior level of the reduction and
+# gathers each query's audit path with one-hot sibling selection per
+# level.  Sibling positions are computed on HOST (crypto/merkle.proof_plan)
+# because query indices are known at dispatch time: the device never sees
+# an xor or shift, only an (== iota) one-hot and an MXU matmul — static
+# depth, no data-dependent control flow, and rangecheck-friendly jaxprs.
+
+
+def _all_levels(blocks, active):
+    """Leaf hashes plus every interior level up to the root.
+
+    levels[0] is (n, 32) leaf hashes; levels[l+1] = hash_level(levels[l])
+    with the odd trailing node promoted (so sizes are n, ceil(n/2), ..., 1
+    — exactly the shape crypto/merkle.proof_plan assumes)."""
+    levels = [leaf_hashes_from_padded(blocks, active)]
+    while levels[-1].shape[0] > 1:
+        levels.append(hash_level(levels[-1]))
+    return levels
+
+
+def _onehot_gather(nodes, pos):
+    """(n, 32) u8 nodes, (k,) i32 positions -> (k, 32) u8 gathered rows.
+
+    A position of -1 (no aunt at this level: the query's ancestor was the
+    promoted odd trailing node) matches nothing and yields a zero row,
+    which the host side drops by its own plan mask.  The gather is an MXU
+    matmul; uint8 is not directly convertible to float32 under the
+    conversion allowlist, so the chain is u8 -> i32 -> f32 and back."""
+    n = nodes.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    mask = (pos[:, None] == iota[None, :]).astype(jnp.float32)
+    vals = nodes.astype(jnp.int32).astype(jnp.float32)
+    out = jnp.matmul(mask, vals, precision="highest")
+    return out.astype(jnp.int32).astype(jnp.uint8)
+
+
+def proofs_from_leaves(blocks, active, indices, sib_pos):
+    """Batched audit paths for K query indices against one tree.
+
+    blocks/active: host-padded leaves (pad_leaves); indices: (K,) i32
+    queried leaf positions; sib_pos: (K, D) i32 per-level sibling
+    positions from crypto/merkle.proof_plan (-1 = no aunt at that level).
+
+    Returns (root (32,), leaf_sel (K, 32) queried leaf hashes,
+    aunts (K, D, 32) leaf-to-root audit nodes, zero rows where
+    sib_pos is -1).  Manifest kernel ``merkle_proofs_from_leaves``."""
+    levels = _all_levels(blocks, active)
+    root = levels[-1][0]
+    leaf_sel = _onehot_gather(levels[0], indices)
+    depth = len(levels) - 1
+    if depth == 0:
+        aunts = jnp.zeros((indices.shape[0], 0, 32), dtype=jnp.uint8)
+    else:
+        aunts = jnp.stack(
+            [_onehot_gather(levels[l], sib_pos[:, l]) for l in range(depth)],
+            axis=1,
+        )
+    return root, leaf_sel, aunts
+
+
+def multiproof_from_leaves(blocks, active, coords):
+    """Multiproof: M deduplicated tree nodes answering many indices at once.
+
+    coords: (M,) i32 flat coordinates into the level-concatenated node
+    array (level 0 first; static offsets are level-size prefix sums —
+    crypto/merkle.multiproof_plan).  Shared aunts across queries appear
+    once in coords, so one gather serves the whole query swarm.
+
+    Returns (root (32,), nodes (M, 32)).  Manifest kernel
+    ``merkle_multiproof_from_leaves``."""
+    levels = _all_levels(blocks, active)
+    flat = jnp.concatenate(levels, axis=0)
+    return levels[-1][0], _onehot_gather(flat, coords)

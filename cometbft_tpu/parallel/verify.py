@@ -293,6 +293,68 @@ def sharded_merkle_root(mesh: Mesh, leaf_blocks, leaf_active):
     return _merkle_fn(mesh)(leaf_blocks, leaf_active)
 
 
+def _merkle_proofs_fn(mesh: Mesh):
+    """Sharded batched proof generation — the QUERY axis shards, the tree
+    replicates.  Each device recomputes every reduction level from the
+    replicated leaf blocks (cheap: the tree is one batched SHA-256 pass)
+    and one-hot-gathers audit paths for its own query shard, so the
+    kernel needs ZERO collectives — the per-query outputs come back
+    sharded exactly as the queries went in, and the root is replicated
+    by construction.
+
+    Only the query arrays are donated: they are per-call staging
+    transfers, while callers may legitimately reuse the (replicated)
+    leaf blocks across several proof dispatches against the same tree.
+
+    Manifest kernel ``sharded_merkle_proofs``.
+    """
+    key = ("merkle_proofs", mesh_cache_key(mesh))
+    cached = _cached_program(key)
+    if cached is not None:
+        return cached
+    axis = mesh.axis_names[0]
+
+    def local(blocks, active, indices, sib_pos):
+        return M.proofs_from_leaves(blocks, active, indices, sib_pos)
+
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis), P(axis, None)),
+            out_specs=(P(), P(axis), P(axis, None, None)),
+        ),
+        in_shardings=(repl, repl, NamedSharding(mesh, P(axis)),
+                      NamedSharding(mesh, P(axis, None))),
+        out_shardings=(repl, NamedSharding(mesh, P(axis)),
+                       NamedSharding(mesh, P(axis, None, None))),
+        donate_argnums=(2, 3),
+    )
+    return _publish_program(key, fn)
+
+
+def sharded_merkle_proofs(mesh: Mesh, blocks, active, indices, sib_pos):
+    """Batched audit paths with the query axis sharded over the mesh.
+
+    blocks/active: host-padded leaves (ops/merkle.pad_leaves), replicated;
+    indices (K,) i32 and sib_pos (K, D) i32 (crypto/merkle.proof_plan)
+    shard over the mesh's first axis — K must be divisible by the mesh
+    size (callers pad the query list; index-0 padding rows are harmless
+    extra gathers the host slices away).  Returns (root (32,) replicated,
+    leaf_sel (K, 32), aunts (K, D, 32)) with per-query outputs sharded
+    like the queries.
+
+    ``indices`` and ``sib_pos`` are DONATED (per-call staging transfers):
+    pass fresh arrays and never read them after this returns.
+    """
+    with tracing.span(
+        "verify.shard_dispatch",
+        {"devices": int(mesh.devices.size)} if tracing.enabled() else None,
+    ):
+        return _merkle_proofs_fn(mesh)(blocks, active, indices, sib_pos)
+
+
 def commit_verification_step(
     mesh: Mesh, a_enc, r_enc, s_bytes, msg_blocks, msg_active, leaf_blocks, leaf_active
 ):

@@ -450,6 +450,73 @@ class Environment:
             },
         }
 
+    def merkle_proof(self, height=None, indices="") -> dict:
+        """Batched tx-inclusion proofs (ours, no reference analogue):
+        one call returns device-generated Merkle proofs for MANY leaf
+        indices of a block's data_hash tree.  Queries ride the verify
+        service's PROOF class (models/proof_server.prove), so concurrent
+        light-client requests coalesce into one device dispatch behind
+        the scheduler; every degraded route answers the same bytes as
+        crypto/merkle.proofs_from_byte_slices.
+
+        ``indices``: a JSON list of ints or a comma-separated string;
+        count capped by COMETBFT_TPU_PROOF_QUERY_MAX (-32602 beyond it).
+        Proof JSON matches tx(prove=true)'s shape, one entry per index
+        in the caller's order."""
+        from ..utils import envknobs
+
+        h = self._height_or_latest(height)
+        blk = self.block_store.load_block(h)
+        if blk is None:
+            raise RPCError(-32603, f"block {h} not found")
+        txs = blk.data.txs
+        if not txs:
+            raise RPCError(-32602, f"block {h} has no txs to prove")
+        if isinstance(indices, str):
+            parts = [p for p in indices.split(",") if p.strip()]
+        elif isinstance(indices, (list, tuple)):
+            parts = list(indices)
+        else:
+            parts = [indices]
+        if not parts:
+            raise RPCError(-32602, "indices must name at least one leaf")
+        cap = max(1, envknobs.get_int(envknobs.PROOF_QUERY_MAX))
+        if len(parts) > cap:
+            raise RPCError(
+                -32602,
+                f"too many indices ({len(parts)} > {cap}, "
+                f"COMETBFT_TPU_PROOF_QUERY_MAX)",
+            )
+        try:
+            idxs = [int(p) for p in parts]
+        except (TypeError, ValueError) as e:
+            raise RPCError(-32602, f"invalid indices: {e}") from e
+        for i in idxs:
+            if i < 0 or i >= len(txs):
+                raise RPCError(
+                    -32602,
+                    f"index {i} out of range for {len(txs)} txs",
+                )
+        from ..models import proof_server
+        from ..types.tx import tx_hash as _tx_hash
+
+        leaves = [_tx_hash(tx) for tx in txs]
+        root, proofs = proof_server.prove(leaves, idxs)
+        return {
+            "height": str(h),
+            "total": str(len(txs)),
+            "root_hash": hex_up(root),
+            "proofs": [
+                {
+                    "total": str(p.total),
+                    "index": str(p.index),
+                    "leaf_hash": b64(p.leaf_hash),
+                    "aunts": [b64(a) for a in p.aunts],
+                }
+                for p in proofs
+            ],
+        }
+
     @staticmethod
     def _order(recs: list, order_by: str, keyfn) -> list:
         """order_by semantics (rpc/core/tx.go): "asc" | "desc" | "" (asc)."""
@@ -882,6 +949,7 @@ ROUTES = {
     "header_by_hash": ("hash", Environment.header_by_hash),
     "commit": ("height", Environment.commit),
     "tx": ("hash,prove", Environment.tx),
+    "merkle_proof": ("height,indices", Environment.merkle_proof),
     "tx_search": ("query,prove,page,per_page,order_by", Environment.tx_search),
     "block_search": ("query,page,per_page,order_by", Environment.block_search),
     "unconfirmed_tx": ("hash", Environment.unconfirmed_tx),

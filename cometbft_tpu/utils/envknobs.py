@@ -318,6 +318,45 @@ VERIFYRPC_DEDUP_WINDOW_S = _declare(
     "verification instead of duplicating it.",
 )
 
+# proof serving plane (models/proof_server.py + verifysvc PROOF class)
+PROOF_DEADLINE_MS = _declare(
+    "COMETBFT_TPU_PROOF_DEADLINE_MS", "int", 5,
+    "PROOF-class coalescing window (ms): how long the verify-service "
+    "scheduler holds a proof request open for more light-client queries "
+    "before dispatching the batch.  Proof traffic is read-only fan-out, "
+    "so it tolerates a longer window than consensus work in exchange "
+    "for wider device batches.  0 = dispatch immediately.",
+)
+PROOF_QUEUE_MAX = _declare(
+    "COMETBFT_TPU_PROOF_QUEUE_MAX", "int", 8192,
+    "PROOF-class queue bound (queries) in the verify service, separate "
+    "from COMETBFT_TPU_VERIFYSVC_QUEUE_MAX: light-client fan-out is the "
+    "one workload expected to arrive thousands-wide, and its backlog "
+    "must backpressure without consuming the signature classes' "
+    "headroom.  0 = use the class-wide queue bound.",
+)
+PROOF_DEVICE_MIN = _declare(
+    "COMETBFT_TPU_PROOF_DEVICE_MIN", "int", 64,
+    "Below this many coalesced queries against one tree the proof "
+    "prover answers on host (crypto/merkle.proofs_from_byte_slices — "
+    "bit-identical by construction); at or above it the batched one-hot "
+    "gather kernel takes the dispatch.",
+)
+PROOF_TREE_CACHE = _declare(
+    "COMETBFT_TPU_PROOF_TREE_CACHE", "int", 256,
+    "Entries in the proof server's digest -> leaves tree cache "
+    "(models/proof_server).  Proof queries reference trees by digest; "
+    "a query against an evicted/unknown digest gets a None row (typed "
+    "miss), never a wrong proof.  LRU, bounded.",
+)
+PROOF_QUERY_MAX = _declare(
+    "COMETBFT_TPU_PROOF_QUERY_MAX", "int", 1024,
+    "Per-request index cap on the merkle_proof RPC route: one JSON-RPC "
+    "call may ask for at most this many leaf indices (invalid-params "
+    "error beyond it), bounding what a single client can pin into one "
+    "PROOF-class submit.",
+)
+
 # verify-service degraded-mode failover (verifysvc/service.py)
 FAILOVER = _declare(
     "COMETBFT_TPU_FAILOVER", "bool", True,

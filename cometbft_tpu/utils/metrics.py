@@ -413,8 +413,9 @@ class Hub:
         # ---- verify service scheduler (verifysvc/service.py)
         self.verify_svc_queue_depth = r.gauge(
             "verify_svc_queue_depth",
-            "Signatures queued per verify-service priority class "
-            "(label class=consensus|blocksync|mempool|background)",
+            "Signatures (or proof queries) queued per verify-service "
+            "priority class (label class=consensus|blocksync|mempool|"
+            "background|proof)",
         )
         self.verify_svc_flush = r.counter(
             "verify_svc_flush_total",
@@ -515,6 +516,20 @@ class Hub:
         self.verify_rpc_breaker_transitions = r.counter(
             "verify_rpc_breaker_transitions_total",
             "Remote-plane breaker transitions (label state=open|closed)",
+        )
+        # ---- proof serving plane (models/proof_server.py)
+        self.verify_proof_queries = r.counter(
+            "verify_proof_queries_total",
+            "Merkle proof queries answered by the PROOF serving class "
+            "(label route=device|host|remote: which data plane produced "
+            "the proofs — all routes bit-identical to "
+            "crypto/merkle.proofs_from_byte_slices by construction)",
+        )
+        self.verify_proof_tree_cache = r.counter(
+            "verify_proof_tree_cache_total",
+            "Proof-server tree-cache lookups by digest (label "
+            "result=hit|miss); a miss yields a typed None row for the "
+            "query, never a wrong proof",
         )
         # ---- health sentinel (utils/healthmon)
         self.health_state = r.gauge(

@@ -69,13 +69,14 @@ class RemotePlaneError(RuntimeError):
 class _Pending:
     __slots__ = (
         "rid", "digest", "items", "klass", "tenant", "deadline",
-        "key_type", "trace_ctx",
+        "key_type", "trace_ctx", "kind", "trees",
         "event", "response", "error", "attempts", "sent_on_gen", "_done_cb",
     )
 
     def __init__(
         self, rid, digest, items, klass, tenant, deadline,
         key_type: str = "ed25519", trace_ctx: str = "",
+        kind: str = "verify", trees=None,
     ):
         self.rid = rid
         self.digest = digest
@@ -84,6 +85,12 @@ class _Pending:
         self.tenant = tenant
         self.deadline = deadline
         self.key_type = key_type
+        # "verify" -> VerifyRequest frames; "proof" -> ProofRequest
+        # frames (items then holds the (tree, index) query pairs and
+        # trees the leaf lists, kept on the pending so every idempotent
+        # resend rebuilds the SAME frame under the same idempotency key)
+        self.kind = kind
+        self.trees = trees
         # serialized span context (traceparent); rides EVERY send of
         # this request, idempotent resends included, so the plane's
         # spans join the submitter's trace whichever attempt lands
@@ -179,6 +186,96 @@ class RemoteBatchVerifier:
     def collect(self, ticket) -> tuple[bool, list[bool]]:
         _kind, pend = ticket
         return self._client.collect(pend)
+
+
+class RemoteProofVerifier:
+    """The PROOF-mode seam over the wire.  Items are the proof query
+    triples (models/proof_server.encode_query); submit() resolves each
+    referenced digest against the LOCAL tree cache and ships the leaves
+    + (tree, index) pairs as one ProofRequest — the plane proves against
+    the exact bytes this node holds, so its answer is bit-identical to
+    the local oracle by construction.  Queries that cannot ship (evicted
+    digest, malformed item, index out of range) keep a local None row —
+    the same typed miss every other route gives them.  Same host-worker
+    routing / watchdog exemption rationale as RemoteBatchVerifier."""
+
+    _entry = None
+    _fallback = None
+    inflight_where = "remote"
+
+    def __init__(self, client: "RemotePlaneClient"):
+        self._client = client
+        self._klass = Klass.PROOF
+        self._tenant = DEFAULT_TENANT
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+        self._slots: list[int] = []
+        self._rows: list = []
+
+    def bind_request(self, klass: Klass, tenant: str) -> None:
+        self._klass = klass
+        self._tenant = tenant
+
+    def add(self, pub: bytes, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub, msg, sig))
+
+    def submit(self):
+        from ..models import proof_server as PS
+
+        trees: list[list[bytes]] = []
+        tree_pos: dict[bytes, int] = {}
+        queries: list[tuple[int, int]] = []
+        slots: list[int] = []
+        rows: list = [None] * len(self._items)
+        for pos, item in enumerate(self._items):
+            try:
+                digest, idx = PS.decode_query(item)
+            except (ValueError, TypeError):
+                continue
+            ti = tree_pos.get(digest)
+            if ti is None:
+                leaves = PS.tree_leaves(digest)
+                if leaves is None:
+                    tree_pos[digest] = -1
+                    continue
+                ti = tree_pos[digest] = len(trees)
+                trees.append(list(leaves))
+            elif ti < 0:
+                continue
+            if idx >= len(trees[ti]):
+                continue
+            queries.append((ti, idx))
+            slots.append(pos)
+        if not queries:
+            # nothing provable: settle locally with the typed misses
+            return ("sync", (False, rows))
+        self._slots = slots
+        self._rows = rows
+        return ("rpc", self._client.submit_proof(
+            trees, queries, self._klass, self._tenant
+        ))
+
+    def defer_collect(self, ticket, cb) -> None:
+        kind, payload = ticket
+        if kind == "sync":
+            cb()
+            return
+        payload.add_done_callback(cb)
+
+    def collect(self, ticket):
+        kind, payload = ticket
+        if kind == "sync":
+            return payload
+        _ok, server_rows = self._client.collect(payload)
+        if len(server_rows) != len(self._slots):
+            raise RemotePlaneError(
+                f"plane answered {len(server_rows)} proof rows for "
+                f"{len(self._slots)} queries"
+            )
+        rows = self._rows
+        for slot, row in zip(self._slots, server_rows):
+            rows[slot] = row
+        _mhub().verify_proof_queries.inc(len(server_rows), route="remote")
+        return bool(rows) and all(r is not None for r in rows), rows
 
 
 class RemotePlaneClient:
@@ -298,6 +395,41 @@ class RemotePlaneClient:
             {"class": klass.label, "tenant": tenant, "sigs": len(items)}
             if tracing.enabled() else None,
         )
+        return self._register_and_send(pend)
+
+    def submit_proof(
+        self, trees, queries, klass: Klass, tenant: str
+    ) -> _Pending:
+        """Register + send one proof batch (leaf lists + (tree, index)
+        query pairs) — the PROOF-mode twin of :meth:`submit`, under the
+        same idempotency, budget, breaker, and resend contracts."""
+        trees = [list(lv) for lv in trees]
+        queries = list(queries)
+        ctx = (
+            tracing.current_context()
+            if tracing.propagation_enabled() else None
+        )
+        pend = _Pending(
+            rid=uuid.uuid4().bytes,
+            digest=wire.proof_digest(trees, queries),
+            items=queries,
+            klass=klass,
+            tenant=tenant,
+            deadline=time.monotonic() + self.budget_s,
+            key_type="proof",
+            trace_ctx=ctx.to_traceparent() if ctx is not None else "",
+            kind="proof",
+            trees=trees,
+        )
+        tracing.instant(
+            "verify.proof.rpc_submit",
+            {"class": klass.label, "tenant": tenant,
+             "queries": len(queries), "trees": len(trees)}
+            if tracing.enabled() else None,
+        )
+        return self._register_and_send(pend)
+
+    def _register_and_send(self, pend: _Pending) -> _Pending:
         with self._mtx:
             # breaker checked UNDER the lock the trip flips it under: a
             # submit racing a trip either registers before the trip's
@@ -380,22 +512,43 @@ class RemotePlaneClient:
             pend.attempts += 1
             pend.sent_on_gen = gen
             budget_ms = max(1, int(pend.remaining() * 1e3))
-            msg = wire.PlaneMessage(
-                verify_request=wire.VerifyRequest(
-                    request_id=pend.rid,
-                    digest=pend.digest,
-                    tenant=pend.tenant,
-                    klass=int(pend.klass),
-                    budget_ms=budget_ms,
-                    items=[
-                        wire.SigItem(pub=p, msg=m, sig=s)
-                        for (p, m, s) in pend.items
-                    ],
-                    attempt=pend.attempts,
-                    key_type=pend.key_type,
-                    trace_ctx=pend.trace_ctx,
+            if pend.kind == "proof":
+                msg = wire.PlaneMessage(
+                    proof_request=wire.ProofRequest(
+                        request_id=pend.rid,
+                        digest=pend.digest,
+                        tenant=pend.tenant,
+                        klass=int(pend.klass),
+                        budget_ms=budget_ms,
+                        trees=[
+                            wire.ProofTree(leaves=list(lv))
+                            for lv in pend.trees
+                        ],
+                        queries=[
+                            wire.ProofQuery(tree=t, index=i)
+                            for (t, i) in pend.items
+                        ],
+                        attempt=pend.attempts,
+                        trace_ctx=pend.trace_ctx,
+                    )
                 )
-            )
+            else:
+                msg = wire.PlaneMessage(
+                    verify_request=wire.VerifyRequest(
+                        request_id=pend.rid,
+                        digest=pend.digest,
+                        tenant=pend.tenant,
+                        klass=int(pend.klass),
+                        budget_ms=budget_ms,
+                        items=[
+                            wire.SigItem(pub=p, msg=m, sig=s)
+                            for (p, m, s) in pend.items
+                        ],
+                        attempt=pend.attempts,
+                        key_type=pend.key_type,
+                        trace_ctx=pend.trace_ctx,
+                    )
+                )
             try:
                 sock.sendall(wire.frame(msg))
                 return True
@@ -522,6 +675,8 @@ class RemotePlaneClient:
         which = msg.which()
         if which == "verify_response":
             self._on_response(msg.verify_response)
+        elif which == "proof_response":
+            self._on_proof_response(msg.proof_response)
         elif which == "ping_response":
             self.logger.debug("verifyrpc: ping response")
         else:
@@ -581,6 +736,51 @@ class RemotePlaneClient:
             # server-side admission control: surface the SAME exception
             # a local reject raises, tenant/scope included, so the
             # caller's fallback path is identical either way
+            m.verify_rpc_requests.inc(result="backpressure")
+            pend.settle(error=VerifyServiceBackpressure(
+                pend.klass, 0, 0, tenant=pend.tenant,
+                scope=resp.scope or "class",
+            ))
+        else:
+            m.verify_rpc_requests.inc(result="error")
+            pend.settle(error=RemotePlaneError(
+                f"plane answered {wire.STATUS_NAMES.get(status, status)}: "
+                f"{resp.error}"
+            ))
+
+    def _on_proof_response(self, resp: wire.ProofResponse) -> None:
+        """The proof_response twin of _on_response: OK settles the
+        pending with (ok, [Proof | None]) rows in wire-query order
+        (ProofMsg total=0 = the typed miss sentinel); backpressure
+        surfaces the SAME exception a local reject raises; everything
+        else is a RemotePlaneError the service answers with a host
+        re-proof — bit-identical bytes either way."""
+        with self._mtx:
+            pend = self._pending.pop(resp.request_id, None)
+            if pend is not None:
+                self._consec_fails = 0
+        if pend is None:
+            return
+        m = _mhub()
+        status = resp.status
+        if status == wire.STATUS_OK:
+            from ..crypto.merkle import Proof
+
+            m.verify_rpc_requests.inc(
+                result="deduped" if resp.deduped else "ok"
+            )
+            rows = [
+                None if not pm.total else Proof(
+                    total=int(pm.total),
+                    index=int(pm.index or 0),
+                    leaf_hash=pm.leaf_hash or b"",
+                    aunts=list(pm.aunts or []),
+                )
+                for pm in (resp.proofs or [])
+            ]
+            ok = bool(rows) and all(r is not None for r in rows)
+            pend.settle(response=(ok, rows))
+        elif status == wire.STATUS_BACKPRESSURE:
             m.verify_rpc_requests.inc(result="backpressure")
             pend.settle(error=VerifyServiceBackpressure(
                 pend.klass, 0, 0, tenant=pend.tenant,

@@ -54,6 +54,7 @@ from ..utils.log import get_logger
 from ..utils.netutil import close_socket
 from . import wire
 from .service import (
+    MODE_PROOF,
     Klass,
     VerifyService,
     VerifyServiceBackpressure,
@@ -283,6 +284,27 @@ class VerifyServer:
                 target=self._handle_verify_guarded, args=(req, conn, wmtx),
                 name="verifyd-req", daemon=True,
             ).start()
+        elif which == "proof_request":
+            # same worker-per-request + inflight-cap shape as verify:
+            # proof batches are scheduled by the service (PROOF class),
+            # never by socket order
+            req = msg.proof_request
+            if not self._req_sem.acquire(blocking=False):
+                with self._stats_mtx:
+                    self._rejected += 1
+                self._send(conn, wmtx, wire.PlaneMessage(
+                    proof_response=wire.ProofResponse(
+                        request_id=req.request_id,
+                        status=wire.STATUS_BACKPRESSURE,
+                        error="plane at max in-flight requests",
+                        scope="server",
+                    )
+                ))
+                return
+            threading.Thread(
+                target=self._handle_proof_guarded, args=(req, conn, wmtx),
+                name="verifyd-proof", daemon=True,
+            ).start()
         elif which == "ping_request":
             self._send(
                 conn, wmtx,
@@ -477,6 +499,142 @@ class VerifyServer:
         resp = wire.VerifyResponse(
             request_id=rid, status=wire.STATUS_OK, all_ok=bool(all_ok),
             verdicts=[1 if v else 0 for v in per],
+        )
+        self.dedup.finish(rid, resp)
+        return resp
+
+    def _handle_proof_guarded(self, req: wire.ProofRequest, conn, wmtx) -> None:
+        try:
+            self._handle_proof(req, conn, wmtx)
+        finally:
+            self._req_sem.release()
+
+    def _handle_proof(self, req: wire.ProofRequest, conn, wmtx) -> None:
+        """The proof_request twin of _handle_verify: same budget,
+        trace-adoption, and response-shaping seams around
+        _proof_response."""
+        deadline = time.monotonic() + max(0, req.budget_ms) / 1e3
+        with self._stats_mtx:
+            self._requests += 1
+        ctx = None
+        if req.trace_ctx and tracing.propagation_enabled():
+            parent = tracing.SpanContext.from_traceparent(req.trace_ctx)
+            if parent is not None:
+                ctx = parent.child()
+        with tracing.context_scope(ctx), tracing.span(
+            "verify.proof.serve",
+            {"queries": len(req.queries or []),
+             "trees": len(req.trees or []), "attempt": req.attempt}
+            if tracing.enabled() else None,
+        ):
+            resp = self._proof_response(req, deadline)
+        if resp is None:
+            return
+        d = fail.armed("rpc_delay_ms")
+        if d:
+            fail.jittered_sleep(d)
+        pct = fail.armed("rpc_drop_pct")
+        if pct is not None and fail.should_drop(pct):
+            self.logger.warning(
+                f"verifyd: injected proof response drop (rid="
+                f"{(req.request_id or b'').hex()[:12]})"
+            )
+            return
+        self._send(conn, wmtx, wire.PlaneMessage(proof_response=resp))
+
+    def _proof_response(
+        self, req: wire.ProofRequest, deadline: float
+    ) -> wire.ProofResponse:
+        from ..models import proof_server as PS
+
+        rid = req.request_id
+        try:
+            trees, queries = wire.validate_proof_request(req)
+        except ValueError as e:
+            return wire.ProofResponse(
+                request_id=rid or b"", status=wire.STATUS_BAD_REQUEST,
+                error=str(e),
+            )
+        state, entry = self.dedup.begin(rid, req.digest)
+        if state == "mismatch":
+            return wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_BAD_REQUEST,
+                error="request_id reused with a different proof digest",
+            )
+        if state == "dup":
+            with self._stats_mtx:
+                self._deduped += 1
+            if not entry["event"].wait(max(0.0, deadline - time.monotonic())):
+                return wire.ProofResponse(
+                    request_id=rid, status=wire.STATUS_DEADLINE,
+                    error="original proof batch still in flight",
+                )
+            cached = entry["response"]
+            if cached is None:
+                return wire.ProofResponse(
+                    request_id=rid, status=wire.STATUS_ERROR,
+                    error="original proof batch aborted", deduped=True,
+                )
+            return wire.ProofResponse(
+                request_id=rid, status=cached.status,
+                proofs=list(cached.proofs or []), error=cached.error,
+                scope=cached.scope, deduped=True,
+            )
+        try:
+            klass = Klass(req.klass)
+        except ValueError:
+            self.dedup.abort(rid)
+            return wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_BAD_REQUEST,
+                error=f"unknown class {req.klass}",
+            )
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            self.dedup.abort(rid)
+            return wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_DEADLINE,
+                error="budget exhausted on arrival",
+            )
+        digests = [PS.register_tree(lv) for lv in trees]
+        items = [PS.encode_query(digests[t], i) for (t, i) in queries]
+        try:
+            ticket = self.svc.submit(
+                items, klass, MODE_PROOF, tenant=req.tenant or None
+            )
+        except VerifyServiceBackpressure as e:
+            with self._stats_mtx:
+                self._rejected += 1
+            resp = wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_BACKPRESSURE,
+                error=str(e), scope=e.scope,
+            )
+            self.dedup.finish(rid, resp)
+            return resp
+        try:
+            _all_ok, rows = ticket.collect(remaining)
+        except TimeoutError:
+            self.dedup.abort(rid)
+            return wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_DEADLINE,
+                error="proof generation outlived the request budget",
+            )
+        except BaseException as e:  # noqa: BLE001 — answer the wire, keep serving
+            with self._stats_mtx:
+                self._errors += 1
+            self.logger.error(f"verifyd: proof batch failed: {e!r}")
+            self.dedup.abort(rid)
+            return wire.ProofResponse(
+                request_id=rid, status=wire.STATUS_ERROR, error=repr(e),
+            )
+        resp = wire.ProofResponse(
+            request_id=rid, status=wire.STATUS_OK,
+            proofs=[
+                wire.ProofMsg(total=0) if p is None else wire.ProofMsg(
+                    total=p.total, index=p.index,
+                    leaf_hash=p.leaf_hash, aunts=list(p.aunts),
+                )
+                for p in rows
+            ],
         )
         self.dedup.finish(rid, resp)
         return resp

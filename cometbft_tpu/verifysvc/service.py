@@ -162,6 +162,11 @@ class Klass(IntEnum):
     BLOCKSYNC = 1
     MEMPOOL = 2
     BACKGROUND = 3
+    # read-only proof serving (light-client fan-out): LOWEST priority by
+    # construction — the scheduler is strict-priority across classes, so
+    # however wide the proof backlog grows it can never delay a queued
+    # CONSENSUS (or any signature-class) dispatch
+    PROOF = 4
 
     @property
     def label(self) -> str:
@@ -173,6 +178,7 @@ _DEADLINE_KNOBS = {
     Klass.BLOCKSYNC: envknobs.VERIFYSVC_DEADLINE_BLOCKSYNC_MS,
     Klass.MEMPOOL: envknobs.VERIFYSVC_DEADLINE_MEMPOOL_MS,
     Klass.BACKGROUND: envknobs.VERIFYSVC_DEADLINE_BACKGROUND_MS,
+    Klass.PROOF: envknobs.PROOF_DEADLINE_MS,
 }
 
 # request modes: how the dispatcher binds a batch to a device program.
@@ -199,13 +205,21 @@ _DEADLINE_KNOBS = {
 #                      like plain ones — but never with a different
 #                      mode, which would hand one verifier two key
 #                      types.
+# ("proof",)        -> batched Merkle proof GENERATION
+#                      (models/proof_server): items are
+#                      (tree_digest, index, b"") query triples, results
+#                      are crypto/merkle.Proof rows.  Coalescible — each
+#                      query's proof is independent, and coalescing is
+#                      the whole point: a light-client swarm's queries
+#                      merge into one one-hot-gather dispatch.
 MODE_PLAIN = ("plain",)
 MODE_BLS = ("bls",)
 MODE_SECP = ("secp",)
+MODE_PROOF = ("proof",)
 
 # modes whose requests may merge into one batch (same mode only):
 # per-row-independent verdicts with one shared data plane
-_COALESCIBLE_MODES = frozenset({"plain", "secp"})
+_COALESCIBLE_MODES = frozenset({"plain", "secp", "proof"})
 
 # the wire spelling of each mode's key type (verifysvc/wire.VerifyRequest
 # .key_type); "" rides as ed25519 for back-compat with pre-BLS planes
@@ -214,6 +228,11 @@ _MODE_KEY_TYPE = {
     "comb": "ed25519",
     "bls": "bls12_381",
     "secp": "secp256k1",
+    # proofs never ride a VerifyRequest — they have their own wire shape
+    # (wire.ProofRequest).  The label exists for metrics/spans only, and
+    # is deliberately ABSENT from _KEY_TYPE_MODE: a VerifyRequest
+    # claiming key_type "proof" is a bad_request, not a proof query.
+    "proof": "proof",
 }
 _KEY_TYPE_MODE = {
     "": MODE_PLAIN,
@@ -439,6 +458,10 @@ def cpu_verifier_for_mode(mode):
         from ..models.secp_verifier import CpuSecpBatchVerifier
 
         return CpuSecpBatchVerifier()
+    if mode[0] == "proof":
+        from ..models.proof_server import CpuProofProver
+
+        return CpuProofProver()
     from ..models.verifier import CpuEd25519BatchVerifier
 
     return CpuEd25519BatchVerifier()
@@ -524,6 +547,13 @@ class VerifyService:
             1, queue_max if queue_max is not None
             else envknobs.get_int(envknobs.VERIFYSVC_QUEUE_MAX)
         )
+        # PROOF gets its own (usually wider) queue bound: light-client
+        # fan-out arrives thousands of queries at a time and must be
+        # able to backlog without that backlog counting against — or
+        # being counted against — the signature classes' bound.  0 =
+        # inherit the class-wide bound.
+        pq = envknobs.get_int(envknobs.PROOF_QUEUE_MAX)
+        self._proof_queue_max = pq if pq and pq > 0 else self.queue_max
         if deadlines_ms is None:
             deadlines_ms = {
                 k: max(0, envknobs.get_int(knob))
@@ -811,6 +841,10 @@ class VerifyService:
                 )
             class_q = self._class_sigs[klass]
             ten_q = self._queued_sigs[klass].get(tenant, 0)
+            qmax = (
+                self._proof_queue_max if klass is Klass.PROOF
+                else self.queue_max
+            )
             with self._out_mtx:
                 ten_out = self._outstanding_sigs[klass].get(tenant, 0)
                 if ten_out + n > self.tenant_quota < self.queue_max:
@@ -827,8 +861,8 @@ class VerifyService:
                     queued, limit, scope = (
                         ten_out, self.tenant_quota, "tenant"
                     )
-                elif class_q + n > self.queue_max:
-                    queued, limit, scope = class_q, self.queue_max, "class"
+                elif class_q + n > qmax:
+                    queued, limit, scope = class_q, qmax, "class"
                 else:
                     queued = limit = 0
                     scope = None
@@ -1115,6 +1149,22 @@ class VerifyService:
         touching it while the tunnel is wedged is exactly the hang the
         trip escaped."""
         rem = self._remote  # one read: stop() nulls it concurrently
+        if mode[0] == "proof":
+            # proofs have their own wire shape and their own device
+            # prover; every degraded arm lands on _HostBatchVerifier
+            # over CpuProofProver -> proofs_from_byte_slices, the
+            # bit-identity oracle
+            if rem is not None:
+                if rem.available():
+                    from .remote import RemoteProofVerifier
+
+                    return RemoteProofVerifier(rem)
+                return _HostBatchVerifier(mode)
+            if self._backend_mode == MODE_CPU_FALLBACK:
+                return _HostBatchVerifier(mode)
+            from ..models.proof_server import TpuProofProver
+
+            return TpuProofProver()
         if rem is not None:
             if rem.available():
                 from .remote import RemoteBatchVerifier

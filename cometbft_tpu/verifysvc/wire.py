@@ -134,6 +134,63 @@ class ArmFaultResponse(Message):
     ]
 
 
+class ProofTree(Message):
+    """One tree shipped by leaves; queries reference it by list position."""
+
+    FIELDS = [Field(1, "leaves", "bytes", repeated=True)]
+
+
+class ProofQuery(Message):
+    FIELDS = [
+        Field(1, "tree", "varint"),   # index into ProofRequest.trees
+        Field(2, "index", "varint"),  # leaf index within that tree
+    ]
+
+
+class ProofMsg(Message):
+    """One crypto/merkle.Proof on the wire.  ``total = 0`` marks a MISSING
+    row (unknown tree / index out of range): a real proof always has
+    total >= 1, so the sentinel cannot collide with a valid proof."""
+
+    FIELDS = [
+        Field(1, "total", "varint"),
+        Field(2, "index", "varint"),
+        Field(3, "leaf_hash", "bytes"),
+        Field(4, "aunts", "bytes", repeated=True),
+    ]
+
+
+class ProofRequest(Message):
+    """One batch of Merkle proof queries — the PROOF class's own wire
+    shape (a VerifyRequest claiming key_type "proof" is a bad_request).
+    Same idempotency key, budget, tenant/class, and trace-context
+    contracts as VerifyRequest; ``digest`` is proof_digest() over the
+    canonical tree+query encoding."""
+
+    FIELDS = [
+        Field(1, "request_id", "bytes"),
+        Field(2, "digest", "bytes"),
+        Field(3, "tenant", "string"),
+        Field(4, "klass", "varint"),
+        Field(5, "budget_ms", "varint"),
+        Field(6, "trees", "message", ProofTree, repeated=True),
+        Field(7, "queries", "message", ProofQuery, repeated=True),
+        Field(8, "attempt", "varint"),
+        Field(9, "trace_ctx", "string"),
+    ]
+
+
+class ProofResponse(Message):
+    FIELDS = [
+        Field(1, "request_id", "bytes"),
+        Field(2, "status", "varint"),
+        Field(3, "proofs", "message", ProofMsg, repeated=True),
+        Field(4, "error", "string"),
+        Field(5, "deduped", "bool"),
+        Field(6, "scope", "string"),
+    ]
+
+
 class PlaneMessage(Message):
     """The oneof envelope on the verifyd socket."""
 
@@ -146,6 +203,8 @@ class PlaneMessage(Message):
         Field(6, "status_response", "message", StatusResponse),
         Field(7, "arm_fault_request", "message", ArmFaultRequest),
         Field(8, "arm_fault_response", "message", ArmFaultResponse),
+        Field(9, "proof_request", "message", ProofRequest),
+        Field(10, "proof_response", "message", ProofResponse),
     ]
 
     def which(self) -> str | None:
@@ -174,6 +233,59 @@ def batch_digest(items) -> bytes:
         h.update(struct.pack("<I", len(sig)))
         h.update(sig)
     return h.digest()
+
+
+def proof_digest(trees, queries) -> bytes:
+    """Canonical digest over a proof request's trees + queries — the
+    content half of its idempotency key.  Same length-prefixing rule as
+    batch_digest; the tree/query section boundary is a length prefix
+    too, so no boundary shifting between sections either."""
+    h = hashlib.sha256()
+    h.update(struct.pack("<I", len(trees)))
+    for leaves in trees:
+        h.update(struct.pack("<I", len(leaves)))
+        for leaf in leaves:
+            h.update(struct.pack("<I", len(leaf)))
+            h.update(leaf)
+    h.update(struct.pack("<I", len(queries)))
+    for tree, index in queries:
+        h.update(struct.pack("<II", tree, index))
+    return h.digest()
+
+
+def validate_proof_request(req: ProofRequest) -> tuple[list, list]:
+    """Structural validation of a decoded ProofRequest — the ONE gate
+    between wire bytes and the proof data plane (taint source
+    ``verifysvc-proof-request``).  Returns (trees, queries) as plain
+    Python lists; every malformed shape raises ValueError, which the
+    server answers as bad_request (the decode gauntlet pins that no
+    other exception type can escape this surface)."""
+    if not req.request_id:
+        raise ValueError("proof request missing request_id")
+    if len(req.digest or b"") != 32:
+        raise ValueError("proof request digest must be 32 bytes")
+    trees = []
+    for t in req.trees or []:
+        leaves = list(t.leaves or [])
+        if not leaves:
+            raise ValueError("proof request tree has no leaves")
+        trees.append(leaves)
+    queries = []
+    for q in req.queries or []:
+        tree = int(q.tree or 0)
+        index = int(q.index or 0)
+        if tree < 0 or tree >= len(trees):
+            raise ValueError(f"proof query references unknown tree {tree}")
+        if index < 0 or index >= len(trees[tree]):
+            raise ValueError(
+                f"proof query index {index} out of range for tree {tree}"
+            )
+        queries.append((tree, index))
+    if not queries:
+        raise ValueError("proof request has no queries")
+    if proof_digest(trees, queries) != req.digest:
+        raise ValueError("proof request digest mismatch")
+    return trees, queries
 
 
 class FrameReader:

@@ -21,6 +21,10 @@ What gets diffed:
 - phase wall-share shifts (``phase_attribution[phase].share_of_wall``),
   reported in percentage points — attribution drift is a smell, not a
   gate, so shares never trip the exit code;
+- the proofs sweep (``sweep`` from ``BENCH_WORKLOAD=proofs``): per
+  query-count tpu/host p50/p95, each held to the threshold like the
+  headline; the multiproof dedup factor is reported-only (it is a
+  property of the query shape, not a latency);
 - ``vs_baseline`` (speedup vs the Go CPU baseline), reported only.
 
 Exit codes: 0 compared, within threshold; 1 regression above
@@ -123,6 +127,36 @@ def compare(old: dict, new: dict, threshold: float) -> dict:
     if lanes:
         report["lanes"] = lanes
 
+    if old.get("workload") == "proofs" and new.get("workload") == "proofs":
+        sweep: dict = {}
+        os_, ns_ = old.get("sweep") or {}, new.get("sweep") or {}
+        for size in sorted(set(os_) & set(ns_), key=lambda s: int(s)):
+            row = {}
+            for q in ("tpu_p50_ms", "tpu_p95_ms", "host_p50_ms", "host_p95_ms"):
+                ov, nv = os_[size].get(q), ns_[size].get(q)
+                if ov is None or nv is None:
+                    continue
+                dq = _pct(ov, nv)
+                row[q] = {
+                    "old": ov,
+                    "new": nv,
+                    "delta_pct": None if dq is None else round(dq * 100, 2),
+                }
+                if dq is not None and dq > threshold:
+                    report["regressions"].append(
+                        f"proofs K={size} {q}: {ov} -> {nv} ({dq * 100:+.1f}%)"
+                    )
+            ov = os_[size].get("multiproof_dedup_factor")
+            nv = ns_[size].get("multiproof_dedup_factor")
+            if ov is not None and nv is not None:
+                row["multiproof_dedup_factor"] = {
+                    "old": ov, "new": nv, "delta": round(nv - ov, 2),
+                }
+            if row:
+                sweep[size] = row
+        if sweep:
+            report["proofs_sweep"] = sweep
+
     shares: dict = {}
     oa, na = old.get("phase_attribution") or {}, new.get("phase_attribution") or {}
     for phase in sorted(set(oa) & set(na)):
@@ -191,6 +225,18 @@ def main(argv: list[str] | None = None) -> int:
                     f"lane {lane:>10} {q}: {cell['old']} -> {cell['new']} "
                     f"({cell['delta_pct']:+.2f}%)"
                 )
+        for size, row in report.get("proofs_sweep", {}).items():
+            for q, cell in row.items():
+                if q == "multiproof_dedup_factor":
+                    print(
+                        f"proofs K={size:>5} dedup: {cell['old']} -> "
+                        f"{cell['new']} ({cell['delta']:+})"
+                    )
+                elif cell["delta_pct"] is not None:
+                    print(
+                        f"proofs K={size:>5} {q}: {cell['old']} -> "
+                        f"{cell['new']} ({cell['delta_pct']:+.2f}%)"
+                    )
         for phase, cell in report.get("phase_shares", {}).items():
             print(
                 f"phase {phase:>14} share: {cell['old']:.3f} -> "

@@ -122,6 +122,75 @@ def test_lane_and_phase_share_diffs():
     ]
 
 
+def test_proofs_sweep_checked_in_rounds():
+    """The checked-in BENCH_WORKLOAD=proofs sample rounds (tests/data/
+    bench_proofs_r0{1,2}.json): r02 is slightly faster at every size, so
+    the comparison passes under the default gate and the text output
+    carries the per-K proofs rows, including the dedup line."""
+    data = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    old = os.path.join(data, "bench_proofs_r01.json")
+    new = os.path.join(data, "bench_proofs_r02.json")
+    r = _run(old, new, "--json")
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["metric"] == "proof_gen_tpu_batch_p50_ms"
+    assert rep["regressions"] == []
+    sweep = rep["proofs_sweep"]
+    assert set(sweep) == {"64", "256", "1024", "4096"}
+    assert sweep["4096"]["tpu_p50_ms"]["delta_pct"] == pytest.approx(-3.34)
+    # dedup factor is reported (delta), never a latency gate
+    assert sweep["4096"]["multiproof_dedup_factor"]["delta"] == 0.0
+    # text mode prints the per-K rows
+    r2 = _run(old, new)
+    assert r2.returncode == 0
+    assert "proofs K=   64 tpu_p50_ms" in r2.stdout
+    assert "proofs K= 4096 dedup: 6.4 -> 6.4 (+0.0)" in r2.stdout
+
+
+def test_proofs_sweep_gates_each_lane_and_skips_dedup():
+    """Unit level: every tpu/host p50/p95 series gates independently at
+    the threshold; the dedup factor and a size present on only one side
+    never gate; non-proofs rounds never grow a proofs_sweep."""
+    mod = _load_mod()
+    base = {
+        "64": {"tpu_p50_ms": 1.0, "tpu_p95_ms": 1.2,
+               "host_p50_ms": 4.0, "host_p95_ms": 4.4,
+               "multiproof_dedup_factor": 3.4},
+        "1024": {"tpu_p50_ms": 2.0, "tpu_p95_ms": 2.4,
+                 "host_p50_ms": 9.0, "host_p95_ms": 10.0,
+                 "multiproof_dedup_factor": 5.4},
+        "8192": {"tpu_p50_ms": 5.0},  # old-only size: skipped
+    }
+    cand = {
+        "64": {"tpu_p50_ms": 1.0, "tpu_p95_ms": 1.8,   # p95 +50%
+               "host_p50_ms": 4.1, "host_p95_ms": None,  # unmeasured
+               "multiproof_dedup_factor": 2.0},           # reported only
+        "1024": {"tpu_p50_ms": 2.5, "tpu_p95_ms": 2.5,  # p50 +25%
+                 "host_p50_ms": 9.1, "host_p95_ms": 10.2,
+                 "multiproof_dedup_factor": 5.4},
+    }
+    old = {"metric": "proof_gen_tpu_batch_p50_ms", "workload": "proofs",
+           "value": 2.0, "sweep": base}
+    new = {"metric": "proof_gen_tpu_batch_p50_ms", "workload": "proofs",
+           "value": 2.1, "sweep": cand}
+    rep = mod.compare(old, new, threshold=0.10)
+    assert set(rep["proofs_sweep"]) == {"64", "1024"}
+    assert rep["proofs_sweep"]["64"]["multiproof_dedup_factor"]["delta"] == -1.4
+    assert "host_p95_ms" not in rep["proofs_sweep"]["64"]  # null side skipped
+    assert rep["regressions"] == [
+        "proofs K=64 tpu_p95_ms: 1.2 -> 1.8 (+50.0%)",
+        "proofs K=1024 tpu_p50_ms: 2.0 -> 2.5 (+25.0%)",
+    ]
+    # a non-proofs round with a stray "sweep" key (e.g. the bls
+    # crossover sweep) must not be diffed as a proofs sweep
+    rep2 = mod.compare(
+        {"metric": "m", "value": 1.0, "workload": "bls", "sweep": base},
+        {"metric": "m", "value": 1.0, "workload": "bls", "sweep": cand},
+        threshold=0.10,
+    )
+    assert "proofs_sweep" not in rep2 and rep2["regressions"] == []
+
+
 def test_rangecheck_summary_passes_through_unchanged():
     """Backend-less rounds embed a "rangecheck" block (bench.py); the
     comparator must neither diff it nor choke on it — it only reads

@@ -191,6 +191,22 @@ def _dup_vote_evidence_pb() -> bytes:
     ).encode()
 
 
+def _proof_request_pb() -> bytes:
+    """A well-formed ProofRequest whose digest matches its content."""
+    trees = [[b"leaf-a", b"leaf-b", b"leaf-c"]]
+    queries = [(0, 1), (0, 2)]
+    return vwire.ProofRequest(
+        request_id=b"\x0b" * 16,
+        digest=vwire.proof_digest(trees, queries),
+        tenant="gauntlet",
+        klass=4,
+        budget_ms=50,
+        trees=[vwire.ProofTree(leaves=trees[0])],
+        queries=[vwire.ProofQuery(tree=t, index=i) for t, i in queries],
+        attempt=1,
+    ).encode()
+
+
 def _golden_frames() -> dict[str, list[bytes]]:
     pex_url = ("cd" * 20) + "@5.6.7.8:26656"
     return {
@@ -255,6 +271,8 @@ def _golden_frames() -> dict[str, list[bytes]]:
         "verifysvc-frame": [
             vwire.frame(vwire.PlaneMessage(ping_request=vwire.PingRequest())),
         ],
+        "verifysvc-proof-request": [_proof_request_pb()],
+        "rpc-merkle-proof": [b"1"],  # -> height "1", indices "1"
         "checktx-envelope": [
             checktx.MAGIC + b"\x01" * 32 + b"\x02" * 64 + b"payload",
         ],
@@ -382,6 +400,42 @@ def _h_verifysvc(data: bytes) -> None:
         pass
 
 
+def _h_proof_request(data: bytes) -> None:
+    # the verifyd server's proof arm: decode the ProofRequest body, then
+    # the ONE validation gate (verifysvc/wire.validate_proof_request) —
+    # everything a byzantine submitter controls must surface ValueError
+    vwire.validate_proof_request(vwire.ProofRequest.decode(data))
+
+
+def _h_rpc_merkle_proof(data: bytes) -> None:
+    from cometbft_tpu.verifysvc import service as vsvc
+
+    txs = [b"tx-a", b"tx-b", b"tx-c"]
+    blk = types.SimpleNamespace(data=types.SimpleNamespace(txs=txs))
+    store = types.SimpleNamespace(height=3, load_block=lambda h: blk)
+    env = Environment(types.SimpleNamespace(block_store=store))
+
+    # route prove() down its host-fallback arm (a stub service that
+    # always backpressures) so the harness exercises the full
+    # param-validation surface plus real host proof generation without
+    # spinning up the global scheduler per mutation
+    class _ShedSvc:
+        def submit(self, items, klass, mode, tenant=None):
+            raise vsvc.VerifyServiceBackpressure(klass, 0, 0)
+
+    from cometbft_tpu.models import proof_server
+
+    real_prove = proof_server.prove
+    s = data.decode("latin1")
+    try:
+        proof_server.prove = lambda lv, ix, **kw: real_prove(
+            lv, ix, svc=_ShedSvc()
+        )
+        env.merkle_proof(height=s or None, indices=s)
+    finally:
+        proof_server.prove = real_prove
+
+
 def _h_checktx(data: bytes) -> None:
     parsed = checktx.parse_signed_tx(data)
     assert parsed is None or (len(parsed) == 4)
@@ -486,6 +540,8 @@ HARNESSES = {
     "secretconn-frame": _h_secretconn,
     "nodeinfo-handshake": _h_nodeinfo,
     "verifysvc-frame": _h_verifysvc,
+    "verifysvc-proof-request": _h_proof_request,
+    "rpc-merkle-proof": _h_rpc_merkle_proof,
     "checktx-envelope": _h_checktx,
     "kvstore-validator-tx": _h_kvstore,
     "abci-server-frame": _h_abci_server,

@@ -1,0 +1,622 @@
+"""Batched Merkle proof serving: the device kernels' host-oracle
+bit-identity contract, the proof plan/multiproof dedup math, the PROOF
+service class (coalescing, blame order, starvation isolation, degraded
+routes), the proof wire (dedup window, remote plane), and the
+merkle_proof RPC route.
+
+Fast tier: everything here host-routes (query counts sit below
+COMETBFT_TPU_PROOF_DEVICE_MIN, or the knob is raised), so no XLA program
+compiles — the scheduler/wire logic under test is identical either way,
+and the host oracle crypto/merkle.proofs_from_byte_slices defines the
+bytes every route must produce.
+
+Slow tier (compile-heavy): the randomized device bit-identity corpora
+(single leaf, odd sizes, duplicate leaves, power-of-two +/-1), the
+device multiproof, and the >=1k-query single-dispatch acceptance.  The
+sharded (8-device mesh) proofs test lives in tests/test_parallel.py with
+the other mesh programs.
+"""
+
+import base64
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as edhost
+from cometbft_tpu.crypto import merkle as cmerkle
+from cometbft_tpu.models import proof_server as PS
+from cometbft_tpu.utils.metrics import hub as mhub
+from cometbft_tpu.verifysvc import remote as vremote
+from cometbft_tpu.verifysvc import server as vserver
+from cometbft_tpu.verifysvc import wire
+from cometbft_tpu.verifysvc.service import (
+    MODE_PROOF,
+    Klass,
+    VerifyService,
+    VerifyServiceBackpressure,
+)
+
+WAIT = 10.0  # generous collect timeout; everything here resolves in ms
+
+
+def _leaves(n, seed=0, width=48):
+    """n random leaves with varied lengths (the randomized corpora)."""
+    rng = np.random.default_rng(1000 + seed)
+    return [rng.bytes(width + (i % 17)) for i in range(n)]
+
+
+def _host_rows(leaves, idxs):
+    root, proofs = cmerkle.proofs_from_byte_slices(list(leaves))
+    return root, [proofs[i] for i in idxs]
+
+
+def _same(a, b):
+    return (a.total, a.index, a.leaf_hash, tuple(a.aunts)) == (
+        b.total, b.index, b.leaf_hash, tuple(b.aunts)
+    )
+
+
+def _sigs(n, tag=b"t"):
+    out = []
+    for i in range(n):
+        sk = edhost.PrivKey.from_seed(bytes([31 + i]) * 32)
+        msg = b"%s-%d" % (tag, i)
+        out.append((sk.pub_key().data, msg, sk.sign(msg)))
+    return out
+
+
+@pytest.fixture
+def svc():
+    services = []
+
+    def make(**kw):
+        s = VerifyService(**kw)
+        services.append(s)
+        return s
+
+    yield make
+    for s in services:
+        s.stop()
+
+
+@pytest.fixture()
+def proof_server():
+    """An in-thread verifyd whose service keeps the REAL _make_verifier
+    (proof mode needs the TpuProofProver seam; sub-threshold batches
+    host-route inside it, so this stays deterministic and jax-free)."""
+    service = VerifyService(failover=False)
+    srv = vserver.VerifyServer(
+        "127.0.0.1:0", service=service, idle_timeout_s=0.2
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+    service.stop()
+
+
+# ------------------------------------------------------- plan + dedup math
+
+
+def test_proof_plan_edges():
+    with pytest.raises(ValueError):
+        cmerkle.proof_plan(0, [])
+    # single leaf: zero levels, an empty aunt row (Proof.aunts == [])
+    assert cmerkle.proof_plan(1, [0]) == (0, [[]])
+    with pytest.raises(ValueError):
+        cmerkle.proof_plan(4, [4])
+    with pytest.raises(ValueError):
+        cmerkle.proof_plan(4, [-1])
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 6, 7, 8, 9, 13, 16, 33])
+def test_proof_plan_reconstructs_host_aunts(n):
+    """The plan's sibling positions, applied to the host level hashes,
+    must reproduce every host proof's aunt list exactly — including the
+    promoted-node levels (-1) that contribute no aunt."""
+    leaves = _leaves(n, seed=n)
+    _, proofs = cmerkle.proofs_from_byte_slices(leaves)
+    depth, sib = cmerkle.proof_plan(n, list(range(n)))
+    # level-by-level reduction with the odd trailing node promoted
+    levels = [[cmerkle.leaf_hash(x) for x in leaves]]
+    while len(levels[-1]) > 1:
+        cur = levels[-1]
+        nxt = [
+            cmerkle.inner_hash(cur[i], cur[i + 1])
+            if i + 1 < len(cur) else cur[i]
+            for i in range(0, len(cur), 2)
+        ]
+        levels.append(nxt)
+    assert depth == len(levels) - 1
+    for i, p in enumerate(proofs):
+        planned = [
+            levels[l][sib[i][l]] for l in range(depth) if sib[i][l] >= 0
+        ]
+        assert planned == list(p.aunts)
+
+
+def test_multiproof_plan_dedup_math():
+    # all 8 leaves of a full tree: every interior node is shared
+    depth, _sib, coords, naive = cmerkle.multiproof_plan(8, list(range(8)))
+    assert depth == 3
+    assert naive == 8 * 4  # each query would gather leaf + 3 aunts
+    assert coords == list(range(14))  # 8 + 4 + 2 flat nodes, deduped
+    # a single query shares nothing: factor exactly 1
+    d1, _s1, c1, n1 = cmerkle.multiproof_plan(8, [3])
+    assert n1 == len(c1) == 1 + d1
+    # duplicate queries dedup to the single-query node set
+    _d2, _s2, c2, n2 = cmerkle.multiproof_plan(8, [3, 3, 3])
+    assert c2 == c1 and n2 == 3 * n1
+
+
+# -------------------------------------------------- query items + cache
+
+
+def test_query_item_codec_validation():
+    d = b"\xaa" * 32
+    item = PS.encode_query(d, 5)
+    assert PS.decode_query(item) == (d, 5)
+    with pytest.raises(ValueError):
+        PS.encode_query(b"short", 0)
+    with pytest.raises(ValueError):
+        PS.encode_query(d, -1)
+    with pytest.raises(ValueError):
+        PS.decode_query((d, b"\x00" * 7, b""))  # short index field
+    with pytest.raises(ValueError):
+        PS.decode_query((d, b"\x00" * 8, b"x"))  # nonempty tail
+    cpu = PS.CpuProofProver()
+    with pytest.raises(ValueError):
+        cpu.add(b"bad", b"\x00" * 8, b"")  # add() shape-validates
+
+
+def test_tree_cache_eviction_and_typed_misses(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_TREE_CACHE", "2")
+    leaves = _leaves(4, seed=1)
+    digest = PS.register_tree(leaves)
+    hit0 = mhub().verify_proof_tree_cache.value(result="hit")
+    miss0 = mhub().verify_proof_tree_cache.value(result="miss")
+    assert PS.tree_leaves(digest) == tuple(leaves)
+    assert mhub().verify_proof_tree_cache.value(result="hit") == hit0 + 1
+    # two more registrations evict the first (cap 2, LRU)
+    PS.register_tree(_leaves(3, seed=2))
+    PS.register_tree(_leaves(5, seed=3))
+    assert PS.tree_leaves(digest) is None
+    assert mhub().verify_proof_tree_cache.value(result="miss") == miss0 + 1
+
+    # prover rows: never-registered digest and out-of-range index are
+    # typed None rows; the good query still resolves to oracle bytes
+    good_digest = PS.register_tree(leaves)
+    cpu = PS.CpuProofProver()
+    cpu.add(*PS.encode_query(b"\x11" * 32, 0))   # unknown tree
+    cpu.add(*PS.encode_query(good_digest, 99))   # index out of range
+    cpu.add(*PS.encode_query(good_digest, 1))
+    ok, rows = cpu.verify()
+    assert not ok and rows[0] is None and rows[1] is None
+    _, want = _host_rows(leaves, [1])
+    assert _same(rows[2], want[0])
+
+
+# --------------------------------------------------- the PROOF class
+
+
+def test_prove_coalesces_callers_and_answers_each_order(svc):
+    """Acceptance core (host-route half): concurrent prove() callers
+    coalesce into ONE PROOF-class dispatch, and each caller's proofs come
+    back in ITS OWN add() order, byte-identical to the host oracle."""
+    s = svc(
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2, Klass.MEMPOOL: 25,
+            Klass.BACKGROUND: 25, Klass.PROOF: 200,
+        },
+    )
+    flushes = []
+    real_dispatch = s._dispatch
+
+    def record(klass, batch, reason):
+        if klass is Klass.PROOF:
+            flushes.append(sum(len(r.items) for r in batch))
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = record
+    leaves = _leaves(9, seed=7)
+    want_root, all_proofs = cmerkle.proofs_from_byte_slices(leaves)
+    orders = {0: [4, 0, 8], 1: [8, 3], 2: [2, 2, 5, 0]}  # dup index too
+    h0 = mhub().verify_proof_queries.value(route="host")
+    results = {}
+
+    def worker(i):
+        results[i] = PS.prove(leaves, orders[i], svc=s)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"t-prover-{i}")
+        for i in orders
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(WAIT)
+    for i, idxs in orders.items():
+        root, proofs = results[i]
+        assert root == want_root
+        assert [p.index for p in proofs] == idxs
+        for p, idx in zip(proofs, idxs):
+            assert _same(p, all_proofs[idx])
+            p.verify(want_root, leaves[idx])  # must not raise
+    # one coalesced dispatch served all three callers' 9 queries
+    assert flushes == [9]
+    assert mhub().verify_proof_queries.value(route="host") == h0 + 9
+
+
+def test_prove_1k_queries_blame_order(svc, monkeypatch):
+    """>=1k coalesced queries answered in the caller's own order.  The
+    device-dispatch twin (same property, route=device, ONE dispatch) is
+    the slow-tier test_device_1k_queries_single_dispatch."""
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_DEVICE_MIN", "1000000")
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    rng = np.random.default_rng(11)
+    leaves = _leaves(32, seed=9)
+    idxs = [int(x) for x in rng.integers(0, 32, size=1200)]
+    root, proofs = PS.prove(leaves, idxs, svc=s)
+    want_root, all_proofs = cmerkle.proofs_from_byte_slices(leaves)
+    assert root == want_root and len(proofs) == 1200
+    for p, i in zip(proofs, idxs):
+        assert _same(p, all_proofs[i])
+
+
+def test_prove_rejects_bad_indices(svc):
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    with pytest.raises(ValueError):
+        PS.prove([], [0], svc=s)
+    with pytest.raises(ValueError):
+        PS.prove([b"a", b"b"], [2], svc=s)
+    with pytest.raises(ValueError):
+        PS.prove([b"a", b"b"], [-1], svc=s)
+
+
+def test_prove_tripped_service_bit_identical(svc):
+    """Degraded route 1: failover tripped to the CPU plane — the
+    CpuProofProver answers, bytes unchanged."""
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    assert s.trip_to_cpu("test-proof-degrade")
+    leaves = _leaves(6, seed=5)
+    idxs = [5, 0, 3]
+    root, proofs = PS.prove(leaves, idxs, svc=s)
+    want_root, want = _host_rows(leaves, idxs)
+    assert root == want_root
+    assert all(_same(p, w) for p, w in zip(proofs, want))
+
+
+def test_prove_backpressure_falls_back_inline(svc, monkeypatch):
+    """Degraded route 2: PROOF queue at its own bound
+    (COMETBFT_TPU_PROOF_QUEUE_MAX, not the signature classes' queue_max)
+    — prove() is rejected and re-proves inline, bytes unchanged."""
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_QUEUE_MAX", "2")
+    s = svc(
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2, Klass.MEMPOOL: 25,
+            Klass.BACKGROUND: 25, Klass.PROOF: 60_000,
+        },
+    )
+    leaves = _leaves(4, seed=13)
+    digest = PS.register_tree(leaves)
+    # park the queue at its bound inside the 60s coalescing window
+    s.submit(
+        [PS.encode_query(digest, 0), PS.encode_query(digest, 1)],
+        Klass.PROOF, MODE_PROOF,
+    )
+    rej0 = mhub().verify_svc_rejected.value(**{"class": "proof"})
+    root, proofs = PS.prove(leaves, [3, 1], svc=s)
+    assert mhub().verify_svc_rejected.value(**{"class": "proof"}) == rej0 + 1
+    want_root, want = _host_rows(leaves, [3, 1])
+    assert root == want_root
+    assert all(_same(p, w) for p, w in zip(proofs, want))
+    # the signature classes' admission was never consumed by proof load
+    ok, per = s.submit(_sigs(2, b"after-bp"), Klass.CONSENSUS).collect(WAIT)
+    assert ok and per == [True, True]
+
+
+def test_prove_evicted_tree_reproves_from_callers_leaves(svc, monkeypatch):
+    """Degraded route 3: the tree is evicted between register and
+    dispatch — the service answers typed None rows and prove() re-proves
+    from the leaves the caller still holds.  Same bytes."""
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_TREE_CACHE", "1")
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    leaves = _leaves(5, seed=17)
+
+    # evict the caller's tree the moment it lands in the cache
+    real_register = PS.register_tree
+
+    def register_then_evict(lv):
+        d = real_register(lv)
+        if list(lv) == leaves:
+            real_register(_leaves(2, seed=99))  # cap 1: evicts d
+        return d
+
+    monkeypatch.setattr(PS, "register_tree", register_then_evict)
+    root, proofs = PS.prove(leaves, [4, 0], svc=s)
+    want_root, want = _host_rows(leaves, [4, 0])
+    assert root == want_root
+    assert all(_same(p, w) for p, w in zip(proofs, want))
+
+
+def test_proof_backlog_cannot_starve_consensus(svc):
+    """THE isolation smoke: a parked PROOF backlog (lowest priority,
+    60s deadline) never delays a consensus submission — consensus
+    dispatches first and resolves while every proof ticket still waits."""
+    s = svc(
+        batch_max=256,
+        deadlines_ms={
+            Klass.CONSENSUS: 0, Klass.BLOCKSYNC: 2, Klass.MEMPOOL: 60_000,
+            Klass.BACKGROUND: 60_000, Klass.PROOF: 60_000,
+        },
+    )
+    order = []
+    real_dispatch = s._dispatch
+
+    def record(klass, batch, reason):
+        order.append(klass)
+        return real_dispatch(klass, batch, reason)
+
+    s._dispatch = record
+    leaves = _leaves(16, seed=3)
+    digest = PS.register_tree(leaves)
+    tickets = [
+        s.submit(
+            [PS.encode_query(digest, i % 16) for i in range(8)],
+            Klass.PROOF, MODE_PROOF,
+        )
+        for _ in range(4)
+    ]
+    t0 = time.monotonic()
+    ok, per = s.submit(_sigs(5, b"cs"), Klass.CONSENSUS).collect(WAIT)
+    waited = time.monotonic() - t0
+    assert ok and per == [True] * 5 and waited < 5.0
+    assert order and order[0] is Klass.CONSENSUS
+    # the proof backlog is still queued, untouched
+    assert s.stats()["queued"]["proof"]["sigs"] == 32
+    assert not any(t.done() for t in tickets)
+
+
+# ------------------------------------------------------------ the wire
+
+
+def test_proof_wire_roundtrip_and_digest():
+    trees = [[b"a", b"bb"], [b"ccc"]]
+    queries = [(0, 1), (1, 0), (0, 0)]
+    req = wire.ProofRequest(
+        request_id=b"p" * 16, digest=wire.proof_digest(trees, queries),
+        tenant="chain-a", klass=int(Klass.PROOF), budget_ms=500,
+        trees=[wire.ProofTree(leaves=list(t)) for t in trees],
+        queries=[wire.ProofQuery(tree=t, index=i) for t, i in queries],
+        attempt=1,
+    )
+    dec = wire.PlaneMessage.decode(
+        wire.PlaneMessage(proof_request=req).encode()
+    )
+    assert dec.which() == "proof_request"
+    r = dec.proof_request
+    assert r.tenant == "chain-a" and r.budget_ms == 500
+    got_trees, got_queries = wire.validate_proof_request(r)
+    assert got_trees == trees and got_queries == queries
+    # digest is boundary-safe across leaves AND across sections
+    assert wire.proof_digest([[b"ab"]], [(0, 0)]) != wire.proof_digest(
+        [[b"a", b"b"]], [(0, 0)]
+    )
+    assert wire.proof_digest([[b"a"]], [(0, 0)]) != wire.proof_digest(
+        [[b"a"], []], [(0, 0)]
+    )
+    # the total=0 MISSING sentinel survives the wire next to a real row
+    resp = wire.ProofResponse(
+        request_id=b"p" * 16, status=wire.STATUS_OK,
+        proofs=[
+            wire.ProofMsg(total=3, index=1, leaf_hash=b"x" * 32,
+                          aunts=[b"y" * 32, b"z" * 32]),
+            wire.ProofMsg(total=0),
+        ],
+    )
+    d = wire.PlaneMessage.decode(
+        wire.PlaneMessage(proof_response=resp).encode()
+    ).proof_response
+    assert d.proofs[0].aunts == [b"y" * 32, b"z" * 32]
+    assert d.proofs[1].total == 0
+
+
+def test_server_proof_dedup_never_reproves(proof_server):
+    """A retried ProofRequest (same id+digest) is answered from the dedup
+    window — proved exactly once, rows byte-identical, deduped flag set."""
+    addr = proof_server.addr
+    leaves = _leaves(5, seed=21)
+    trees = [list(leaves)]
+    queries = [(0, 3), (0, 0)]
+    rid = b"P" * 16
+    req = wire.ProofRequest(
+        request_id=rid, digest=wire.proof_digest(trees, queries),
+        tenant="t", klass=int(Klass.PROOF), budget_ms=5000,
+        trees=[wire.ProofTree(leaves=t) for t in trees],
+        queries=[wire.ProofQuery(tree=t, index=i) for t, i in queries],
+        attempt=1,
+    )
+    first = vremote._one_shot(
+        addr, wire.PlaneMessage(proof_request=req), "proof_response", 10.0
+    )
+    assert first.status == wire.STATUS_OK and not first.deduped
+    _, want = _host_rows(leaves, [3, 0])
+    got = [
+        (p.total, p.index, p.leaf_hash, tuple(p.aunts)) for p in first.proofs
+    ]
+    assert got == [
+        (w.total, w.index, w.leaf_hash, tuple(w.aunts)) for w in want
+    ]
+    req.attempt = 2
+    second = vremote._one_shot(
+        addr, wire.PlaneMessage(proof_request=req), "proof_response", 10.0
+    )
+    assert second.status == wire.STATUS_OK and second.deduped
+    assert [
+        (p.total, p.index, p.leaf_hash, tuple(p.aunts)) for p in second.proofs
+    ] == got
+    st = proof_server.stats()["server"]
+    assert st["deduped"] == 1
+
+
+def test_remote_plane_proofs_bit_identical(proof_server):
+    """Degraded route 4 (actually the REMOTE route): prove() over a live
+    verifyd plane answers the same bytes as the local oracle, and the
+    route=remote counter attributes the queries."""
+    s = VerifyService(
+        remote_addr=proof_server.addr,
+        remote_opts=dict(budget_s=5.0, breaker_fails=2, backoff_s=0.05,
+                         probe_period_s=0.1, probation_ok=2),
+    )
+    try:
+        r0 = mhub().verify_proof_queries.value(route="remote")
+        leaves = _leaves(7, seed=30)
+        idxs = [6, 0, 3, 3]
+        root, proofs = PS.prove(leaves, idxs, svc=s)
+        want_root, want = _host_rows(leaves, idxs)
+        assert root == want_root
+        assert all(_same(p, w) for p, w in zip(proofs, want))
+        for p, i in zip(proofs, idxs):
+            p.verify(root, leaves[i])
+        assert mhub().verify_proof_queries.value(route="remote") == r0 + 4
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------- RPC route
+
+
+def test_rpc_merkle_proof_route(svc, monkeypatch):
+    from cometbft_tpu.rpc.core import Environment, RPCError
+    from cometbft_tpu.types.tx import tx_hash
+    from cometbft_tpu.verifysvc import service as service_mod
+
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    monkeypatch.setattr(service_mod, "global_service", lambda: s)
+    txs = [b"tx-%d" % i for i in range(5)]
+    blk = types.SimpleNamespace(data=types.SimpleNamespace(txs=txs))
+    store = types.SimpleNamespace(
+        height=7, load_block=lambda h: blk if h == 7 else None
+    )
+    env = Environment(types.SimpleNamespace(block_store=store))
+
+    resp = env.merkle_proof(height=None, indices="2,0")  # latest height
+    leaves = [tx_hash(t) for t in txs]
+    want_root, want = _host_rows(leaves, [2, 0])
+    assert resp["height"] == "7" and resp["total"] == "5"
+    assert bytes.fromhex(resp["root_hash"]) == want_root
+    assert len(resp["proofs"]) == 2
+    for pj, w in zip(resp["proofs"], want):
+        assert int(pj["total"]) == w.total and int(pj["index"]) == w.index
+        assert base64.b64decode(pj["leaf_hash"]) == w.leaf_hash
+        assert [base64.b64decode(a) for a in pj["aunts"]] == list(w.aunts)
+        # the JSON round-trips to a verifying Proof
+        p = cmerkle.Proof(
+            total=int(pj["total"]), index=int(pj["index"]),
+            leaf_hash=base64.b64decode(pj["leaf_hash"]),
+            aunts=[base64.b64decode(a) for a in pj["aunts"]],
+        )
+        p.verify(want_root, leaves[p.index])
+
+    # JSON-list indices are accepted too
+    resp2 = env.merkle_proof(height="7", indices=[1, 4])
+    assert [int(p["index"]) for p in resp2["proofs"]] == [1, 4]
+
+    with pytest.raises(RPCError):
+        env.merkle_proof(height=7, indices="")  # no indices
+    with pytest.raises(RPCError):
+        env.merkle_proof(height=7, indices="9")  # out of range
+    with pytest.raises(RPCError):
+        env.merkle_proof(height=3, indices="0")  # no such block
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_QUERY_MAX", "2")
+    with pytest.raises(RPCError):
+        env.merkle_proof(height=7, indices="0,1,2")  # over the cap
+
+
+# ------------------------------------------- slow tier: device identity
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8, 13, 31, 32, 33])
+def test_device_bit_identity_corpora(n):
+    """Device proofs == host oracle, byte for byte, across single-leaf,
+    odd, and power-of-two +/-1 tree sizes over randomized leaves."""
+    leaves = _leaves(n, seed=50 + n)
+    idxs = (
+        list(range(n)) if n <= 8
+        else [0, n // 2, n - 1, 1, n - 2, n // 3]
+    )
+    d_root, d_proofs = cmerkle.device_proofs_from_byte_slices(leaves, idxs)
+    want_root, want = _host_rows(leaves, idxs)
+    assert d_root == want_root
+    for dp, wp in zip(d_proofs, want):
+        assert _same(dp, wp)
+        dp.verify(d_root, leaves[wp.index])  # round-trips Proof.verify
+
+
+@pytest.mark.slow
+def test_device_bit_identity_duplicate_leaves():
+    leaves = [b"same-leaf"] * 9
+    idxs = [0, 4, 8, 4]
+    d_root, d_proofs = cmerkle.device_proofs_from_byte_slices(leaves, idxs)
+    want_root, want = _host_rows(leaves, idxs)
+    assert d_root == want_root
+    for dp, wp in zip(d_proofs, want):
+        assert _same(dp, wp)
+        dp.verify(d_root, b"same-leaf")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [511, 512, 513])
+def test_device_bit_identity_pow2_boundary_large(n):
+    rng = np.random.default_rng(600 + n)
+    leaves = _leaves(n, seed=60 + n, width=20)
+    idxs = sorted({int(x) for x in rng.integers(0, n, size=16)})
+    d_root, d_proofs = cmerkle.device_proofs_from_byte_slices(leaves, idxs)
+    want_root, want = _host_rows(leaves, idxs)
+    assert d_root == want_root
+    for dp, wp in zip(d_proofs, want):
+        assert _same(dp, wp)
+        dp.verify(d_root, leaves[wp.index])
+
+
+@pytest.mark.slow
+def test_device_multiproof_identity_and_dedup():
+    leaves = _leaves(8, seed=70)
+    root, proofs, dedup = cmerkle.device_multiproof(leaves, list(range(8)))
+    want_root, want = _host_rows(leaves, list(range(8)))
+    assert root == want_root
+    assert all(_same(p, w) for p, w in zip(proofs, want))
+    assert dedup == pytest.approx(32 / 14)  # shared interior nodes
+    # K=1 shares nothing
+    r1, p1, f1 = cmerkle.device_multiproof(leaves, [5])
+    assert r1 == want_root and f1 == 1.0 and _same(p1[0], want[5])
+
+
+@pytest.mark.slow
+def test_device_1k_queries_single_dispatch(svc, monkeypatch):
+    """Acceptance: ONE device dispatch serves >=1k coalesced queries,
+    blame in the caller's order, bit-identical to the oracle."""
+    monkeypatch.setenv("COMETBFT_TPU_PROOF_DEVICE_MIN", "64")
+    s = svc(deadlines_ms={k: 0 for k in Klass})
+    calls = []
+    real = cmerkle.device_proofs_from_byte_slices
+
+    def counting(items, indices):
+        calls.append(len(indices))
+        return real(items, indices)
+
+    monkeypatch.setattr(cmerkle, "device_proofs_from_byte_slices", counting)
+    d0 = mhub().verify_proof_queries.value(route="device")
+    rng = np.random.default_rng(81)
+    leaves = _leaves(64, seed=80, width=24)
+    idxs = [int(x) for x in rng.integers(0, 64, size=1024)]
+    root, proofs = PS.prove(leaves, idxs, svc=s)
+    assert calls == [1024]  # the whole batch rode one dispatch
+    assert mhub().verify_proof_queries.value(route="device") == d0 + 1024
+    want_root, all_proofs = cmerkle.proofs_from_byte_slices(leaves)
+    assert root == want_root
+    for p, i in zip(proofs, idxs):
+        assert _same(p, all_proofs[i])

@@ -59,6 +59,7 @@ def test_sharding_manifest_is_internally_consistent():
         assert len(sk.out_specs) == len(sk.out)
     assert set(manifest.sharded_by_name()) == {
         "sharded_verify_batch", "sharded_verify_cached", "sharded_merkle_root",
+        "sharded_merkle_proofs",
     }
     # the donated-entrypoint worklist the AST check consumes: since
     # PR 11 every per-call staging slab of every sharded program is
@@ -70,6 +71,7 @@ def test_sharding_manifest_is_internally_consistent():
         ),
         "sharded_verify_cached": (("payload", 4),),
         "sharded_merkle_root": (("leaf_blocks", 1), ("leaf_active", 2)),
+        "sharded_merkle_proofs": (("indices", 3), ("sib_pos", 4)),
     }
 
 

@@ -850,7 +850,9 @@ def test_service_stats_shape(svc):
     assert ok and per == [True, True]
     st = s.stats()
     assert st["dispatched_batches"]["consensus"] == 1
-    assert set(st["queued"]) == {"consensus", "blocksync", "mempool", "background"}
+    assert set(st["queued"]) == {
+        "consensus", "blocksync", "mempool", "background", "proof",
+    }
     assert st["deadline_ms"]["consensus"] == 0.0
 
 
